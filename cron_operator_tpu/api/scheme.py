@@ -1,0 +1,123 @@
+"""Group/version/kind registry — the analog of the reference's scheme setup
+(``/root/reference/api/v1alpha1/groupversion_info.go`` and the scheme
+composition at ``cmd/operator/start.go:53-59``).
+
+Because the runtime stores everything as unstructured dicts, the scheme's job
+here is (a) GVK parsing/formatting, (b) mapping registered kinds to plural
+resource names (for store bookkeeping and CRD-style addressing), and
+(c) tracking which kinds are known workload kinds for watch wiring.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class GVK:
+    group: str
+    version: str
+    kind: str
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+    def __str__(self) -> str:  # e.g. "kubeflow.org/v1, Kind=JAXJob"
+        return f"{self.api_version}, Kind={self.kind}"
+
+
+def parse_api_version(api_version: str) -> tuple[str, str]:
+    """Split "group/version" (or bare "v1") into (group, version)."""
+    if "/" in api_version:
+        group, version = api_version.split("/", 1)
+        return group, version
+    return "", api_version
+
+
+def gvk_of(obj: Dict[str, Any]) -> Optional[GVK]:
+    """GVK of an unstructured object, or None if apiVersion/kind absent.
+
+    The reference validates this on the workload template at
+    ``internal/controller/cron_util.go:40-56`` (empty GVK → error).
+    """
+    api_version = obj.get("apiVersion") or ""
+    kind = obj.get("kind") or ""
+    if not api_version or not kind:
+        return None
+    group, version = parse_api_version(api_version)
+    return GVK(group=group, version=version, kind=kind)
+
+
+def _default_plural(kind: str) -> str:
+    lower = kind.lower()
+    if lower.endswith("s") or lower.endswith("x") or lower.endswith("ch"):
+        return lower + "es"
+    if lower.endswith("y"):
+        return lower[:-1] + "ies"
+    return lower + "s"
+
+
+class Scheme:
+    """Registry of known kinds → plural resource names + workload flags."""
+
+    def __init__(self) -> None:
+        self._plurals: Dict[GVK, str] = {}
+        self._workload_kinds: set[GVK] = set()
+
+    def register(self, gvk: GVK, plural: Optional[str] = None, workload: bool = False) -> None:
+        self._plurals[gvk] = plural or _default_plural(gvk.kind)
+        if workload:
+            self._workload_kinds.add(gvk)
+
+    def plural(self, gvk: GVK) -> str:
+        return self._plurals.get(gvk) or _default_plural(gvk.kind)
+
+    def is_registered(self, gvk: GVK) -> bool:
+        return gvk in self._plurals
+
+    def workload_kinds(self) -> list[GVK]:
+        return sorted(self._workload_kinds, key=lambda g: (g.group, g.kind))
+
+
+KUBEFLOW_GROUP = "kubeflow.org"
+KUBEFLOW_V1 = "v1"
+
+GVK_CRON = GVK("apps.kubedl.io", "v1alpha1", "Cron")
+GVK_PYTORCHJOB = GVK(KUBEFLOW_GROUP, KUBEFLOW_V1, "PyTorchJob")
+GVK_TFJOB = GVK(KUBEFLOW_GROUP, KUBEFLOW_V1, "TFJob")
+GVK_MPIJOB = GVK(KUBEFLOW_GROUP, KUBEFLOW_V1, "MPIJob")
+GVK_XGBOOSTJOB = GVK(KUBEFLOW_GROUP, KUBEFLOW_V1, "XGBoostJob")
+# The new first-class TPU workload kind (Kubeflow JAXJob follows the same
+# JobStatus convention; see SURVEY.md §3.3 / §7 step 4).
+GVK_JAXJOB = GVK(KUBEFLOW_GROUP, KUBEFLOW_V1, "JAXJob")
+
+
+def default_scheme() -> Scheme:
+    """Scheme with the Cron kind plus the workload-kind surface the reference
+    grants RBAC for (``charts/cron-operator/templates/cluster_role.yaml:25-124``
+    covers pytorchjobs/tfjobs/mpijobs/xgboostjobs) extended with JAXJob."""
+    s = Scheme()
+    s.register(GVK_CRON, "crons")
+    s.register(GVK_PYTORCHJOB, "pytorchjobs", workload=True)
+    s.register(GVK_TFJOB, "tfjobs", workload=True)
+    s.register(GVK_MPIJOB, "mpijobs", workload=True)
+    s.register(GVK_XGBOOSTJOB, "xgboostjobs", workload=True)
+    s.register(GVK_JAXJOB, "jaxjobs", workload=True)
+    return s
+
+
+__all__ = [
+    "GVK",
+    "parse_api_version",
+    "gvk_of",
+    "Scheme",
+    "default_scheme",
+    "GVK_CRON",
+    "GVK_PYTORCHJOB",
+    "GVK_TFJOB",
+    "GVK_MPIJOB",
+    "GVK_XGBOOSTJOB",
+    "GVK_JAXJOB",
+]

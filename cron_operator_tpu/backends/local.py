@@ -1,0 +1,372 @@
+"""Local training runtime — the in-process training-operator replacement.
+
+The reference creates a PyTorchJob/TFJob and walks away; an *external*
+training-operator turns it into pods and writes status conditions back
+(SURVEY.md §3.2 hand-off boundary). This executor closes that loop locally:
+
+- watches the embedded control plane for workload-kind objects,
+- applies TPU admission (topology injection — ``backends.tpu``),
+- models the gang: one Pod object per slice host, owned by the job (so
+  Replace-policy deletion and Cron-deletion cascade kill the whole group),
+- drives the Kubeflow JobStatus condition lifecycle the reconciler's status
+  contract consumes: Created → Running (+startTime) → Succeeded/Failed
+  (+completionTime),
+- actually executes the workload's entrypoint (``backends.registry``) on the
+  available TPU/CPU devices in a worker thread,
+- simulates TPU slice preemption on demand (``preempt()``): all hosts of a
+  slice vanish at once; the job goes Restarting (and re-runs) or Failed
+  according to its restart annotation — mapping preemption onto the
+  JobStatus convention so ``is_workload_finished`` stays correct
+  (SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+from cron_operator_tpu.api.scheme import default_scheme, gvk_of
+from cron_operator_tpu.api.v1alpha1 import rfc3339
+from cron_operator_tpu.backends.registry import (
+    ANNOTATION_ENTRYPOINT,
+    JobContext,
+    resolve_entrypoint,
+)
+from cron_operator_tpu.backends.tpu import inject_tpu_topology
+from cron_operator_tpu.controller.schedule import parse_go_duration
+from cron_operator_tpu.runtime.kube import APIServer, NotFoundError, WatchEvent
+
+logger = logging.getLogger("backends.local")
+
+ANNOTATION_SIMULATE = "tpu.kubedl.io/simulate-duration"
+ANNOTATION_RESTART_ON_PREEMPTION = "tpu.kubedl.io/restart-on-preemption"
+ANNOTATION_PARAM_PREFIX = "tpu.kubedl.io/param."
+
+JobKey = Tuple[str, str, str, str]  # apiVersion, kind, namespace, name
+
+
+class LocalExecutor:
+    """Executes workload objects in-process. See module docstring."""
+
+    def __init__(self, api: APIServer, scheme=None):
+        self.api = api
+        self.scheme = scheme or default_scheme()
+        self._handled_kinds = {
+            (g.api_version, g.kind) for g in self.scheme.workload_kinds()
+        }
+        self._events: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        self._jobs: Dict[JobKey, JobContext] = {}
+        self._threads: Dict[JobKey, threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._running = False
+        self._dispatcher: Optional[threading.Thread] = None
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self.api.add_watcher(self._on_event)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="local-executor", daemon=True
+        )
+        self._dispatcher.start()
+        # Adopt pre-existing jobs (informer initial list).
+        for av, kind in self._handled_kinds:
+            for obj in self.api.list(av, kind):
+                self._events.put(WatchEvent(type="ADDED", object=obj))
+
+    def stop(self) -> None:
+        self._running = False
+        with self._lock:
+            for ctx in self._jobs.values():
+                ctx.cancel.set()
+            threads = list(self._threads.values())
+        self._events.put(None)
+        for t in threads:
+            t.join(timeout=2.0)
+        if self._dispatcher:
+            self._dispatcher.join(timeout=2.0)
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until no jobs are executing (test/bench helper)."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not any(t.is_alive() for t in self._threads.values()):
+                    return True
+            time.sleep(0.02)
+        return False
+
+    # ---- watch dispatch ---------------------------------------------------
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        # Called under the store lock — enqueue only, mutate nothing here.
+        gvk = (ev.object.get("apiVersion", ""), ev.object.get("kind", ""))
+        if gvk in self._handled_kinds:
+            self._events.put(ev)
+
+    def _dispatch_loop(self) -> None:
+        while self._running:
+            ev = self._events.get()
+            if ev is None:
+                return
+            try:
+                self._handle(ev)
+            except Exception:
+                logger.error("executor dispatch failed:\n%s", traceback.format_exc())
+
+    def _handle(self, ev: WatchEvent) -> None:
+        obj = ev.object
+        meta = obj.get("metadata") or {}
+        key: JobKey = (
+            obj.get("apiVersion", ""), obj.get("kind", ""),
+            meta.get("namespace", ""), meta.get("name", ""),
+        )
+        if ev.type == "DELETED":
+            with self._lock:
+                ctx = self._jobs.pop(key, None)
+                self._threads.pop(key, None)
+            if ctx:
+                ctx.cancel.set()
+            return
+        if ev.type != "ADDED":
+            return
+        # Don't re-run jobs already terminal (adoption after executor restart).
+        from cron_operator_tpu.controller.workload import is_workload_finished
+
+        try:
+            _, finished = is_workload_finished(obj)
+        except ValueError:
+            return
+        if finished:
+            return
+        with self._lock:
+            if key in self._jobs:
+                return
+            ctx = self._make_context(obj)
+            self._jobs[key] = ctx
+            t = threading.Thread(
+                target=self._run_job, args=(key, ctx),
+                name=f"job-{key[3]}", daemon=True,
+            )
+            self._threads[key] = t
+        t.start()
+
+    # ---- job execution ----------------------------------------------------
+
+    def _make_context(self, obj: Dict[str, Any]) -> JobContext:
+        meta = obj.get("metadata") or {}
+        ann = meta.get("annotations") or {}
+        params = {
+            k[len(ANNOTATION_PARAM_PREFIX):]: v
+            for k, v in ann.items()
+            if k.startswith(ANNOTATION_PARAM_PREFIX)
+        }
+        return JobContext(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", ""),
+            job=obj,
+            params=params,
+        )
+
+    def _run_job(self, key: JobKey, ctx: JobContext) -> None:
+        av, kind, ns, name = key
+        try:
+            # Admission: TPU topology injection (webhook analog).
+            obj = self.api.try_get(av, kind, ns, name)
+            if obj is None:
+                return
+            spec = inject_tpu_topology(obj)
+            if spec is not None:
+                ctx.slice_spec = spec
+                try:
+                    self.api.update(obj)
+                except Exception:
+                    obj = self.api.try_get(av, kind, ns, name) or obj
+            ctx.job = obj
+
+            self._append_condition(key, "Created", "JobCreated",
+                                   f"{kind} {name} is created.")
+            self._create_pods(key, obj, ctx)
+            self._append_condition(
+                key, "Running", "JobRunning",
+                f"{kind} {name} is running.",
+                extra={"startTime": rfc3339(self.api.clock.now())},
+            )
+
+            self._execute_entrypoint(ctx)
+
+            if ctx.should_stop():
+                return  # deleted/preempted mid-run; status handled elsewhere
+            self._finish_pods(key, obj)
+            self._append_condition(
+                key, "Succeeded", "JobSucceeded",
+                f"{kind} {name} successfully completed.",
+                extra={"completionTime": rfc3339(self.api.clock.now())},
+            )
+        except NotFoundError:
+            pass  # job deleted under us
+        except Exception as err:
+            logger.error("job %s/%s failed:\n%s", ns, name, traceback.format_exc())
+            try:
+                self._append_condition(
+                    key, "Failed", "JobFailed", f"{kind} {name} failed: {err}",
+                    extra={"completionTime": rfc3339(self.api.clock.now())},
+                )
+            except NotFoundError:
+                pass
+
+    def _execute_entrypoint(self, ctx: JobContext) -> None:
+        ann = (ctx.job.get("metadata") or {}).get("annotations") or {}
+        entry_ref = ann.get(ANNOTATION_ENTRYPOINT)
+        if entry_ref:
+            fn = resolve_entrypoint(entry_ref)
+            fn(ctx)
+            return
+        sim = ann.get(ANNOTATION_SIMULATE)
+        if sim:
+            total = parse_go_duration(sim).total_seconds()
+            # sleep in small increments so cancellation is prompt
+            ctx.cancel.wait(timeout=total)
+            return
+        # No entrypoint: trivially succeeds (pure scheduling-object mode).
+
+    # ---- pod-group modeling ----------------------------------------------
+
+    def _replicas(self, obj: Dict[str, Any], ctx: JobContext) -> int:
+        if ctx.slice_spec is not None:
+            return ctx.slice_spec.hosts
+        specs = (obj.get("spec") or {}).get("replicaSpecs") or {}
+        total = 0
+        for rs in specs.values():
+            total += int(rs.get("replicas", 1) or 1)
+        return max(total, 1)
+
+    def _create_pods(self, key: JobKey, obj: Dict[str, Any], ctx: JobContext) -> None:
+        av, kind, ns, name = key
+        meta = obj.get("metadata") or {}
+        n = self._replicas(obj, ctx)
+        for i in range(n):
+            pod = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"{name}-worker-{i}",
+                    "namespace": ns,
+                    "labels": {
+                        "tpu.kubedl.io/job-name": name,
+                        "tpu.kubedl.io/worker-index": str(i),
+                    },
+                    "ownerReferences": [
+                        {
+                            "apiVersion": av,
+                            "kind": kind,
+                            "name": name,
+                            "uid": meta.get("uid", ""),
+                            "controller": True,
+                        }
+                    ],
+                },
+                "status": {"phase": "Running"},
+            }
+            try:
+                self.api.create(pod)
+            except Exception:
+                pass  # re-run after restart may find existing pods
+
+    def _finish_pods(self, key: JobKey, obj: Dict[str, Any]) -> None:
+        _, _, ns, name = key
+        for pod in self.api.list(
+            "v1", "Pod", namespace=ns,
+            label_selector={"tpu.kubedl.io/job-name": name},
+        ):
+            pod["status"] = {"phase": "Succeeded"}
+            try:
+                self.api.update(pod)
+            except Exception:
+                pass
+
+    def _delete_pods(self, ns: str, name: str) -> None:
+        for pod in self.api.list(
+            "v1", "Pod", namespace=ns,
+            label_selector={"tpu.kubedl.io/job-name": name},
+        ):
+            try:
+                self.api.delete("v1", "Pod", ns, pod["metadata"]["name"])
+            except NotFoundError:
+                pass
+
+    # ---- status helpers ---------------------------------------------------
+
+    def _append_condition(
+        self,
+        key: JobKey,
+        cond_type: str,
+        reason: str,
+        message: str,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        av, kind, ns, name = key
+        obj = self.api.get(av, kind, ns, name)
+        status = obj.get("status") or {}
+        conds = list(status.get("conditions") or [])
+        now = rfc3339(self.api.clock.now())
+        conds.append(
+            {
+                "type": cond_type,
+                "status": "True",
+                "reason": reason,
+                "message": message,
+                "lastUpdateTime": now,
+                "lastTransitionTime": now,
+            }
+        )
+        status["conditions"] = conds
+        if extra:
+            status.update(extra)
+        self.api.patch_status(av, kind, ns, name, status)
+
+    # ---- failure injection ------------------------------------------------
+
+    def preempt(self, namespace: str, name: str, kind: str = "JAXJob",
+                api_version: str = "kubeflow.org/v1") -> None:
+        """Simulate TPU slice preemption: every host pod of the slice
+        disappears at once (slice-atomic), and the job's status reflects it
+        through the JobStatus convention."""
+        key: JobKey = (api_version, kind, namespace, name)
+        with self._lock:
+            ctx = self._jobs.get(key)
+        if ctx:
+            ctx.cancel.set()
+        self._delete_pods(namespace, name)
+        obj = self.api.try_get(api_version, kind, namespace, name)
+        if obj is None:
+            return
+        ann = (obj.get("metadata") or {}).get("annotations") or {}
+        restart = (ann.get(ANNOTATION_RESTART_ON_PREEMPTION, "").lower()
+                   in ("1", "true", "yes"))
+        if restart:
+            self._append_condition(
+                key, "Restarting", "TPUSlicePreempted",
+                "TPU slice was preempted; restarting job.",
+            )
+            with self._lock:
+                self._jobs.pop(key, None)
+                self._threads.pop(key, None)
+            # Re-admit as a fresh run (checkpoint restore is the workload's
+            # job — Orbax in the entrypoint; SURVEY.md §5).
+            self._events.put(WatchEvent(type="ADDED", object=obj))
+        else:
+            self._append_condition(
+                key, "Failed", "TPUSlicePreempted",
+                "TPU slice was preempted.",
+                extra={"completionTime": rfc3339(self.api.clock.now())},
+            )
+
+
+__all__ = ["LocalExecutor", "ANNOTATION_SIMULATE", "ANNOTATION_RESTART_ON_PREEMPTION"]

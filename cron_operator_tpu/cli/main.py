@@ -1,0 +1,207 @@
+"""Process entry point — the ``cron-operator start`` analog.
+
+Parity targets: root command ``/root/reference/cmd/main.go:32-49`` and the
+start command's flag surface ``/root/reference/cmd/operator/start.go:215-247``
+(max-concurrent-reconciles, qps/burst, metrics/health bind addresses,
+leader-elect, zap log level/encoder). TPU-native additions: ``--load`` to
+apply manifests at startup (standalone single-process mode — there is no
+external kube-apiserver or training-operator; the embedded control plane and
+the local TPU training runtime fill those roles) and ``--backend`` to pick
+how JAXJob workloads execute.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from cron_operator_tpu import __version__
+
+
+def _parse_bind(addr: str) -> Optional[int]:
+    """':8081' / '8081' → port; '0' → disabled (reference metrics default)."""
+    if addr in ("0", "", "none"):
+        return None
+    return int(addr.rsplit(":", 1)[-1])
+
+
+def _serve(port: int, routes, name: str) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            fn = routes.get(self.path)
+            if fn is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body, ctype = fn()
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *args):
+            pass
+
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=server.serve_forever, name=name, daemon=True).start()
+    return server
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="cron-operator-tpu",
+        description="TPU-native cron-scheduling framework for ML training workloads",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="command")
+
+    start = sub.add_parser("start", help="start the operator manager")
+    # Reference flag surface (start.go:215-247):
+    start.add_argument("--max-concurrent-reconciles", type=int, default=10)
+    start.add_argument("--qps", type=int, default=30,
+                       help="client QPS (accepted for compatibility; the "
+                            "embedded control plane is not rate-limited)")
+    start.add_argument("--burst", type=int, default=50,
+                       help="client burst (compatibility)")
+    start.add_argument("--metrics-bind-address", default="0",
+                       help="':8080' to enable, '0' to disable (default)")
+    start.add_argument("--health-probe-bind-address", default=":8081")
+    start.add_argument("--leader-elect", action="store_true", default=False)
+    start.add_argument("--zap-log-level", default="info",
+                       choices=["debug", "info", "warn", "error"])
+    start.add_argument("--zap-encoder", default="console",
+                       choices=["console", "json"])
+    # TPU-native flags:
+    start.add_argument("--load", action="append", default=[],
+                       metavar="MANIFEST.yaml",
+                       help="apply YAML manifest(s) into the embedded control "
+                            "plane at startup (repeatable)")
+    start.add_argument("--backend", default="local",
+                       choices=["local", "none"],
+                       help="JAXJob execution backend: 'local' runs training "
+                            "in-process on the available TPU/CPU devices; "
+                            "'none' schedules objects only")
+    start.add_argument("--run-for", type=float, default=None,
+                       metavar="SECONDS",
+                       help="exit after N seconds (default: run until signal)")
+    return parser
+
+
+def _configure_logging(level: str, encoder: str) -> None:
+    lvl = {"debug": logging.DEBUG, "info": logging.INFO,
+           "warn": logging.WARNING, "error": logging.ERROR}[level]
+    if encoder == "json":
+        fmt = '{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}'
+    else:
+        fmt = "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"
+    logging.basicConfig(level=lvl, format=fmt, stream=sys.stderr)
+
+
+def cmd_start(args: argparse.Namespace) -> int:
+    _configure_logging(args.zap_log_level, args.zap_encoder)
+    log = logging.getLogger("setup")
+
+    from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+    from cron_operator_tpu.controller import CronReconciler
+    from cron_operator_tpu.runtime import APIServer, Manager
+
+    api = APIServer()
+    scheme = default_scheme()
+    manager = Manager(
+        api,
+        max_concurrent_reconciles=args.max_concurrent_reconciles,
+        leader_elect=args.leader_elect,
+    )
+    reconciler = CronReconciler(api)
+    manager.add_controller(
+        "cron",
+        reconciler.reconcile,
+        for_gvk=GVK_CRON,
+        owns=scheme.workload_kinds(),
+    )
+
+    executor = None
+    if args.backend == "local":
+        from cron_operator_tpu.backends.local import LocalExecutor
+
+        executor = LocalExecutor(api)
+        executor.start()
+
+    servers: List[ThreadingHTTPServer] = []
+    health_port = _parse_bind(args.health_probe_bind_address)
+    if health_port is not None:
+        servers.append(
+            _serve(
+                health_port,
+                {
+                    "/healthz": lambda: (
+                        "ok" if manager.healthz() else "unhealthy", "text/plain"),
+                    "/readyz": lambda: (
+                        "ok" if manager.readyz() else "not ready", "text/plain"),
+                },
+                "health-probes",
+            )
+        )
+        log.info("health probes serving on :%d", health_port)
+    metrics_port = _parse_bind(args.metrics_bind_address)
+    if metrics_port is not None:
+        servers.append(
+            _serve(
+                metrics_port,
+                {"/metrics": lambda: (manager.metrics.render_prometheus(),
+                                      "text/plain")},
+                "metrics",
+            )
+        )
+        log.info("metrics serving on :%d", metrics_port)
+
+    for manifest in args.load:
+        import yaml
+
+        with open(manifest) as f:
+            for doc in yaml.safe_load_all(f):
+                if not doc:
+                    continue
+                doc.setdefault("metadata", {}).setdefault("namespace", "default")
+                api.create(doc)
+                log.info(
+                    "applied %s %s/%s", doc.get("kind"),
+                    doc["metadata"]["namespace"], doc["metadata"].get("name"),
+                )
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+
+    log.info("starting manager (version %s)", __version__)
+    manager.start()
+    stop.wait(timeout=args.run_for)
+
+    log.info("shutting down")
+    manager.stop()
+    if executor is not None:
+        executor.stop()
+    for s in servers:
+        s.shutdown()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.command == "start":
+        return cmd_start(args)
+    parser.print_help()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

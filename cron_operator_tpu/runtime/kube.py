@@ -1,0 +1,335 @@
+"""In-memory Kubernetes-style API server.
+
+The persistence/consistency substrate of the framework: namespaced storage of
+unstructured objects keyed by (apiVersion, kind, namespace, name), with
+
+- optimistic concurrency via ``metadata.resourceVersion`` (etcd analog),
+- ``metadata.generateName`` suffixing, uid assignment, creationTimestamp,
+- label-selector listing (the reconciler lists workloads by the
+  ``kubedl.io/cron-name`` label — reference
+  ``internal/controller/cron_controller.go:242-266``),
+- status subresource patching with semantic-equality short-circuit
+  (reference patches status only on change, ``cron_controller.go:107-120``),
+- watches (ADDED/MODIFIED/DELETED) feeding controller workqueues,
+- owner-reference cascading delete — the kube garbage collector's
+  ``Background`` propagation that the reference relies on when it deletes
+  workloads (``cron_controller.go:210-220,307-323``),
+- an event recorder (reference events: Deadline/OverridePolicy/FailedCreate/
+  TooManyMissedTimes, SURVEY.md §5).
+
+Thread-safe; all returned objects are deep copies.
+"""
+
+from __future__ import annotations
+
+import copy
+import secrets
+import threading
+import uuid
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from cron_operator_tpu.api.v1alpha1 import rfc3339
+from cron_operator_tpu.utils.clock import Clock, RealClock
+
+Unstructured = Dict[str, Any]
+Key = Tuple[str, str, str, str]  # (apiVersion, kind, namespace, name)
+
+
+class ApiError(Exception):
+    """Base class for API-server errors."""
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class AlreadyExistsError(ApiError):
+    pass
+
+
+class ConflictError(ApiError):
+    pass
+
+
+class InvalidError(ApiError):
+    pass
+
+
+@dataclass
+class Event:
+    """A recorded event (corev1.Event analog)."""
+
+    type: str  # "Normal" | "Warning"
+    reason: str
+    message: str
+    involved_kind: str = ""
+    involved_namespace: str = ""
+    involved_name: str = ""
+    timestamp: Optional[datetime] = None
+    count: int = 1
+
+
+@dataclass
+class WatchEvent:
+    type: str  # "ADDED" | "MODIFIED" | "DELETED"
+    object: Unstructured
+
+
+def object_key(obj: Unstructured) -> Key:
+    meta = obj.get("metadata") or {}
+    return (
+        obj.get("apiVersion", ""),
+        obj.get("kind", ""),
+        meta.get("namespace", "") or "",
+        meta.get("name", "") or "",
+    )
+
+
+def match_labels(obj: Unstructured, selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def controller_owner(obj: Unstructured) -> Optional[Dict[str, Any]]:
+    """The controller=true owner reference, if any."""
+    for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
+        if ref.get("controller"):
+            return ref
+    return None
+
+
+class APIServer:
+    """The embedded control plane store. See module docstring."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock: Clock = clock or RealClock()
+        self._lock = threading.RLock()
+        self._objects: Dict[Key, Unstructured] = {}
+        self._events: List[Event] = []
+        self._rv = 0
+        self._watchers: List[Callable[[WatchEvent], None]] = []
+
+    # ---- internal helpers -------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _notify(self, ev_type: str, obj: Unstructured) -> None:
+        # Called with lock held; deliver copies outside the lock would be
+        # nicer but subscribers (workqueues) only enqueue keys, so a direct
+        # call is fine and keeps ordering deterministic.
+        event = WatchEvent(type=ev_type, object=copy.deepcopy(obj))
+        for w in list(self._watchers):
+            w(event)
+
+    # ---- watch / events ---------------------------------------------------
+
+    def add_watcher(self, fn: Callable[[WatchEvent], None]) -> None:
+        """Subscribe to all object changes (controller cache analog)."""
+        with self._lock:
+            self._watchers.append(fn)
+
+    def record_event(
+        self,
+        involved: Unstructured,
+        etype: str,
+        reason: str,
+        message: str,
+    ) -> None:
+        meta = involved.get("metadata") or {}
+        with self._lock:
+            self._events.append(
+                Event(
+                    type=etype,
+                    reason=reason,
+                    message=message,
+                    involved_kind=involved.get("kind", ""),
+                    involved_namespace=meta.get("namespace", ""),
+                    involved_name=meta.get("name", ""),
+                    timestamp=self.clock.now(),
+                )
+            )
+
+    def events(
+        self, reason: Optional[str] = None, involved_name: Optional[str] = None
+    ) -> List[Event]:
+        with self._lock:
+            out = list(self._events)
+        if reason is not None:
+            out = [e for e in out if e.reason == reason]
+        if involved_name is not None:
+            out = [e for e in out if e.involved_name == involved_name]
+        return out
+
+    # ---- CRUD -------------------------------------------------------------
+
+    def create(self, obj: Unstructured) -> Unstructured:
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        if not obj.get("apiVersion") or not obj.get("kind"):
+            raise InvalidError("object must set apiVersion and kind")
+        if not meta.get("name"):
+            gen = meta.get("generateName")
+            if not gen:
+                raise InvalidError("object must set metadata.name or generateName")
+            meta["name"] = gen + secrets.token_hex(3)
+        with self._lock:
+            key = object_key(obj)
+            if key in self._objects:
+                raise AlreadyExistsError(
+                    f"{obj['kind']} {key[2]}/{key[3]} already exists"
+                )
+            meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+            meta["creationTimestamp"] = rfc3339(self.clock.now())
+            meta["resourceVersion"] = self._next_rv()
+            self._objects[key] = obj
+            self._notify("ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def get(
+        self, api_version: str, kind: str, namespace: str, name: str
+    ) -> Unstructured:
+        with self._lock:
+            obj = self._objects.get((api_version, kind, namespace, name))
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def try_get(
+        self, api_version: str, kind: str, namespace: str, name: str
+    ) -> Optional[Unstructured]:
+        try:
+            return self.get(api_version, kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Unstructured]:
+        with self._lock:
+            out = []
+            for (av, k, ns, _), obj in self._objects.items():
+                if av != api_version or k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if not match_labels(obj, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, obj: Unstructured) -> Unstructured:
+        """Full-object replace with optimistic-concurrency check."""
+        obj = copy.deepcopy(obj)
+        key = object_key(obj)
+        with self._lock:
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{key[1]} {key[2]}/{key[3]} not found")
+            meta = obj.setdefault("metadata", {})
+            cur_meta = current["metadata"]
+            rv = meta.get("resourceVersion")
+            if rv and rv != cur_meta.get("resourceVersion"):
+                raise ConflictError(
+                    f"{key[1]} {key[2]}/{key[3]}: resourceVersion conflict"
+                )
+            # immutable fields carry over
+            meta["uid"] = cur_meta.get("uid")
+            meta["creationTimestamp"] = cur_meta.get("creationTimestamp")
+            meta["resourceVersion"] = self._next_rv()
+            self._objects[key] = obj
+            self._notify("MODIFIED", obj)
+            return copy.deepcopy(obj)
+
+    def patch_status(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        status: Dict[str, Any],
+    ) -> Unstructured:
+        """Merge-patch the status subresource.
+
+        Semantic no-op patches (status deep-equal) do not bump the
+        resourceVersion or fire a watch event — mirroring the reference's
+        equality guard before ``Status().Patch`` (``cron_controller.go:113``).
+        """
+        with self._lock:
+            key = (api_version, kind, namespace, name)
+            current = self._objects.get(key)
+            if current is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            if current.get("status") == status:
+                return copy.deepcopy(current)
+            current["status"] = copy.deepcopy(status)
+            current["metadata"]["resourceVersion"] = self._next_rv()
+            self._notify("MODIFIED", current)
+            return copy.deepcopy(current)
+
+    def delete(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        propagation: str = "Background",
+    ) -> None:
+        """Delete an object; Background/Foreground propagation cascades to
+        dependents via ownerReferences (kube GC analog), Orphan does not."""
+        with self._lock:
+            key = (api_version, kind, namespace, name)
+            obj = self._objects.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{kind} {namespace}/{name} not found")
+            self._notify("DELETED", obj)
+            if propagation in ("Background", "Foreground"):
+                self._cascade_delete(obj["metadata"].get("uid"), namespace)
+
+    def _cascade_delete(self, owner_uid: Optional[str], namespace: str) -> None:
+        if not owner_uid:
+            return
+        dependents = [
+            k
+            for k, o in self._objects.items()
+            if k[2] == namespace
+            and any(
+                ref.get("uid") == owner_uid
+                for ref in (o.get("metadata") or {}).get("ownerReferences") or []
+            )
+        ]
+        for k in dependents:
+            dep = self._objects.pop(k, None)
+            if dep is not None:
+                self._notify("DELETED", dep)
+                self._cascade_delete(dep["metadata"].get("uid"), namespace)
+
+    # ---- convenience ------------------------------------------------------
+
+    def all_objects(self) -> List[Unstructured]:
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._objects.values()]
+
+
+__all__ = [
+    "APIServer",
+    "ApiError",
+    "NotFoundError",
+    "AlreadyExistsError",
+    "ConflictError",
+    "InvalidError",
+    "Event",
+    "WatchEvent",
+    "object_key",
+    "match_labels",
+    "controller_owner",
+]

@@ -1,0 +1,29 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh so multi-chip
+sharding paths compile and execute without TPU hardware (the dryrun strategy
+from the build brief; mirrors how the reference tests multi-node behavior
+against envtest without a real cluster — SURVEY.md §4)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from cron_operator_tpu.runtime.kube import APIServer  # noqa: E402
+from cron_operator_tpu.utils.clock import FakeClock  # noqa: E402
+
+
+@pytest.fixture
+def fake_clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def api(fake_clock):
+    """An empty embedded control plane on a deterministic clock."""
+    return APIServer(clock=fake_clock)

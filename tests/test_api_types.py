@@ -1,0 +1,181 @@
+"""API type round-trip and status-contract tests (reference analog:
+``api/v1alpha1`` types + ``cron_util_test.go`` status extraction specs)."""
+
+from datetime import datetime, timezone
+
+from cron_operator_tpu.api.v1alpha1 import (
+    API_VERSION,
+    ConcurrencyPolicy,
+    Cron,
+    JobStatus,
+    job_status_from_unstructured,
+    parse_time,
+    rfc3339,
+)
+from cron_operator_tpu.controller.workload import is_workload_finished
+
+
+def utc(*args):
+    return datetime(*args, tzinfo=timezone.utc)
+
+
+class TestRoundTrip:
+    def test_cron_round_trip(self):
+        src = {
+            "apiVersion": API_VERSION,
+            "kind": "Cron",
+            "metadata": {
+                "name": "demo",
+                "namespace": "default",
+                "uid": "u-1",
+                "creationTimestamp": "2026-03-01T10:00:00Z",
+                "labels": {"a": "b"},
+            },
+            "spec": {
+                "schedule": "*/5 * * * *",
+                "concurrencyPolicy": "Forbid",
+                "suspend": True,
+                "deadline": "2026-04-01T00:00:00Z",
+                "historyLimit": 3,
+                "template": {
+                    "workload": {
+                        "apiVersion": "kubeflow.org/v1",
+                        "kind": "JAXJob",
+                        "spec": {"replicas": 4},
+                    }
+                },
+            },
+            "status": {
+                "active": [
+                    {
+                        "apiVersion": "kubeflow.org/v1",
+                        "kind": "JAXJob",
+                        "name": "demo-123",
+                        "namespace": "default",
+                        "uid": "u-2",
+                        "resourceVersion": "7",
+                    }
+                ],
+                "history": [
+                    {
+                        "uid": "u-3",
+                        "object": {
+                            "apiGroup": "kubeflow.org/v1",
+                            "kind": "JAXJob",
+                            "name": "demo-120",
+                        },
+                        "status": "Succeeded",
+                        "created": "2026-03-01T10:00:00Z",
+                        "finished": "2026-03-01T10:05:00Z",
+                    }
+                ],
+                "lastScheduleTime": "2026-03-01T10:05:00Z",
+            },
+        }
+        cron = Cron.from_dict(src)
+        assert cron.spec.concurrency_policy == ConcurrencyPolicy.FORBID
+        assert cron.spec.history_limit == 3
+        assert cron.spec.suspend is True
+        assert cron.spec.template.workload["kind"] == "JAXJob"
+        assert cron.status.active[0].resource_version == "7"
+        assert cron.status.history[0].object.api_group == "kubeflow.org/v1"
+        out = cron.to_dict()
+        assert out == src
+
+    def test_defaults(self):
+        cron = Cron.from_dict(
+            {"metadata": {"name": "x"}, "spec": {"schedule": "* * * * *"}}
+        )
+        assert cron.spec.concurrency_policy == ConcurrencyPolicy.ALLOW
+        assert cron.spec.history_limit is None
+        assert cron.spec.suspend is None
+
+    def test_rfc3339(self):
+        t = utc(2026, 3, 1, 10, 0, 5)
+        assert rfc3339(t) == "2026-03-01T10:00:05Z"
+        assert parse_time("2026-03-01T10:00:05Z") == t
+        assert parse_time(None) is None
+
+
+def make_workload(conditions):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": "j", "namespace": "default"},
+        "status": {"conditions": conditions},
+    }
+
+
+class TestStatusContract:
+    """Parity with the terminal-state logic specs in
+    ``cron_util_test.go:151-231``."""
+
+    def test_no_status(self):
+        obj = {"apiVersion": "kubeflow.org/v1", "kind": "JAXJob", "metadata": {}}
+        assert job_status_from_unstructured(obj) is None
+        final, finished = is_workload_finished(obj)
+        assert finished is False and final == ""
+
+    def test_running_not_finished(self):
+        w = make_workload(
+            [
+                {"type": "Created", "status": "True"},
+                {"type": "Running", "status": "True"},
+            ]
+        )
+        _, finished = is_workload_finished(w)
+        assert finished is False
+
+    def test_succeeded(self):
+        w = make_workload(
+            [
+                {"type": "Created", "status": "True"},
+                {"type": "Running", "status": "True"},
+                {"type": "Succeeded", "status": "True"},
+            ]
+        )
+        final, finished = is_workload_finished(w)
+        assert finished is True and final == "Succeeded"
+
+    def test_failed(self):
+        w = make_workload(
+            [
+                {"type": "Created", "status": "True"},
+                {"type": "Failed", "status": "True"},
+            ]
+        )
+        final, finished = is_workload_finished(w)
+        assert finished is True and final == "Failed"
+
+    def test_false_terminal_condition_ignored(self):
+        w = make_workload(
+            [
+                {"type": "Succeeded", "status": "False"},
+                {"type": "Running", "status": "True"},
+            ]
+        )
+        _, finished = is_workload_finished(w)
+        assert finished is False
+
+    def test_final_status_is_last_condition(self):
+        # Succeeded=True present but a later Restarting entry is last:
+        # the recorded final status is the LAST condition type (reference
+        # quirk, ``cron_util.go:85``).
+        w = make_workload(
+            [
+                {"type": "Succeeded", "status": "True"},
+                {"type": "Restarting", "status": "True"},
+            ]
+        )
+        final, finished = is_workload_finished(w)
+        assert finished is True and final == "Restarting"
+
+    def test_job_status_fields(self):
+        status = JobStatus.from_dict(
+            {
+                "conditions": [{"type": "Running", "status": "True"}],
+                "startTime": "2026-03-01T10:00:00Z",
+            }
+        )
+        assert status.start_time == utc(2026, 3, 1, 10, 0)
+        assert status.is_finished() is False

@@ -1,0 +1,394 @@
+"""Cron reconciler behavior specs — the envtest suite analog
+(reference ``internal/controller/cron_controller_test.go`` and
+``cron_util_test.go`` scenarios, driven against the embedded control plane
+with a deterministic clock)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from cron_operator_tpu.api.v1alpha1 import (
+    API_VERSION,
+    KIND_CRON,
+    LABEL_CRON_NAME,
+)
+from cron_operator_tpu.controller.cron_controller import CronReconciler
+from cron_operator_tpu.controller.workload import (
+    WorkloadTemplateError,
+    get_default_job_name,
+    new_empty_workload,
+)
+
+T0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+JAX_AV, JAX_KIND = "kubeflow.org/v1", "JAXJob"
+
+
+def jax_template(name=None):
+    tpl = {
+        "apiVersion": JAX_AV,
+        "kind": JAX_KIND,
+        "metadata": {},
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+    }
+    if name:
+        tpl["metadata"]["name"] = name
+    return tpl
+
+
+def make_cron(
+    api,
+    name="demo",
+    schedule="*/1 * * * *",
+    policy=None,
+    suspend=None,
+    deadline=None,
+    history_limit=None,
+    template=None,
+):
+    spec = {"schedule": schedule, "template": {"workload": template or jax_template()}}
+    if policy:
+        spec["concurrencyPolicy"] = policy
+    if suspend is not None:
+        spec["suspend"] = suspend
+    if deadline is not None:
+        spec["deadline"] = deadline
+    if history_limit is not None:
+        spec["historyLimit"] = history_limit
+    return api.create(
+        {
+            "apiVersion": API_VERSION,
+            "kind": KIND_CRON,
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": spec,
+        }
+    )
+
+
+def get_cron(api, name="demo"):
+    return api.get(API_VERSION, KIND_CRON, "default", name)
+
+
+def list_jobs(api):
+    return api.list(JAX_AV, JAX_KIND, namespace="default")
+
+
+def finish_job(api, name, cond="Succeeded"):
+    api.patch_status(
+        JAX_AV, JAX_KIND, "default", name,
+        {"conditions": [
+            {"type": "Created", "status": "True"},
+            {"type": cond, "status": "True"},
+        ]},
+    )
+
+
+@pytest.fixture
+def reconciler(api):
+    return CronReconciler(api)
+
+
+class TestBasicReconcile:
+    def test_not_found_is_noop(self, reconciler):
+        result = reconciler.reconcile("default", "ghost")
+        assert result.requeue_after is None
+
+    def test_no_tick_due_requeues_at_next(self, api, fake_clock, reconciler):
+        make_cron(api)  # created at T0
+        result = reconciler.reconcile("default", "demo")
+        # next activation is T0+1min
+        assert result.requeue_after == timedelta(minutes=1)
+        assert list_jobs(api) == []
+
+    def test_schedule_fires_creates_workload(self, api, fake_clock, reconciler):
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        result = reconciler.reconcile("default", "demo")
+        jobs = list_jobs(api)
+        assert len(jobs) == 1
+        job = jobs[0]
+        meta = job["metadata"]
+        # deterministic name derived from *nextRun* (reference quirk,
+        # cron_controller.go:222)
+        next_run = T0 + timedelta(minutes=3)
+        assert meta["name"] == f"demo-{int(next_run.timestamp())}"
+        assert meta["labels"][LABEL_CRON_NAME] == "demo"
+        owner = meta["ownerReferences"][0]
+        assert owner["kind"] == KIND_CRON and owner["controller"] is True
+        # status updated
+        cron = get_cron(api)
+        assert cron["status"]["lastScheduleTime"] == "2026-01-01T00:02:00Z"
+        assert result.requeue_after == timedelta(minutes=1)
+
+    def test_tick_is_idempotent(self, api, fake_clock, reconciler):
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        # Second reconcile in the same instant: name collides → tolerated,
+        # no duplicate.
+        reconciler.reconcile("default", "demo")
+        assert len(list_jobs(api)) == 1
+
+    def test_new_tick_after_interval(self, api, fake_clock, reconciler):
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        assert len(list_jobs(api)) == 2
+
+
+class TestGates:
+    def test_suspend_no_workload_no_requeue(self, api, fake_clock, reconciler):
+        make_cron(api, suspend=True)
+        fake_clock.advance(timedelta(minutes=5))
+        result = reconciler.reconcile("default", "demo")
+        assert list_jobs(api) == []
+        assert result.requeue_after is None
+
+    def test_deadline_stops_scheduling(self, api, fake_clock, reconciler):
+        make_cron(api, deadline="2026-01-01T00:03:00Z")
+        fake_clock.advance(timedelta(minutes=5))
+        result = reconciler.reconcile("default", "demo")
+        assert list_jobs(api) == []
+        assert result.requeue_after is None
+        assert len(api.events(reason="Deadline")) == 1
+
+    def test_deadline_in_future_schedules(self, api, fake_clock, reconciler):
+        make_cron(api, deadline="2026-01-01T00:10:00Z")
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        assert len(list_jobs(api)) == 1
+
+    def test_unparsable_schedule_terminal(self, api, fake_clock, reconciler):
+        make_cron(api, schedule="not a cron")
+        fake_clock.advance(timedelta(minutes=2))
+        result = reconciler.reconcile("default", "demo")
+        assert result.requeue_after is None
+        assert list_jobs(api) == []
+
+    def test_unschedulable_schedule_terminal(self, api, fake_clock, reconciler):
+        make_cron(api, schedule="0 0 31 2 *")  # Feb 31
+        fake_clock.advance(timedelta(minutes=2))
+        result = reconciler.reconcile("default", "demo")
+        assert result.requeue_after is None
+
+    def test_invalid_template_terminal(self, api, fake_clock, reconciler):
+        make_cron(api, template={"metadata": {"name": "x"}})  # no GVK
+        result = reconciler.reconcile("default", "demo")
+        assert result.requeue_after is None
+
+
+class TestConcurrencyPolicies:
+    def _fire_once(self, api, fake_clock, reconciler):
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        jobs = list_jobs(api)
+        assert len(jobs) == 1
+        return jobs[0]["metadata"]["name"]
+
+    def test_allow_overlapping(self, api, fake_clock, reconciler):
+        make_cron(api, policy="Allow")
+        self._fire_once(api, fake_clock, reconciler)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        # first job still active (no terminal status) yet second created
+        assert len(list_jobs(api)) == 2
+        # active list is synced at reconcile start (before this tick's
+        # create — reference order, cron_controller.go:155 vs :229), so the
+        # second job lands in status.active on the NEXT pass.
+        reconciler.reconcile("default", "demo")
+        cron = get_cron(api)
+        assert len(cron["status"]["active"]) == 2
+
+    def test_forbid_skips_while_active(self, api, fake_clock, reconciler):
+        make_cron(api, policy="Forbid")
+        first = self._fire_once(api, fake_clock, reconciler)
+        fake_clock.advance(timedelta(minutes=2))
+        result = reconciler.reconcile("default", "demo")
+        assert [j["metadata"]["name"] for j in list_jobs(api)] == [first]
+        assert result.requeue_after is not None
+
+    def test_forbid_fires_after_completion(self, api, fake_clock, reconciler):
+        make_cron(api, policy="Forbid")
+        first = self._fire_once(api, fake_clock, reconciler)
+        finish_job(api, first)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        assert len(list_jobs(api)) == 2
+
+    def test_replace_deletes_active(self, api, fake_clock, reconciler):
+        make_cron(api, policy="Replace")
+        first = self._fire_once(api, fake_clock, reconciler)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        jobs = list_jobs(api)
+        assert len(jobs) == 1
+        assert jobs[0]["metadata"]["name"] != first
+
+
+class TestStatusSync:
+    def test_active_list_sorted_with_refs(self, api, fake_clock, reconciler):
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        reconciler.reconcile("default", "demo")  # fold this tick's job into active
+        cron = get_cron(api)
+        active = cron["status"]["active"]
+        assert len(active) == 2
+        assert active[0]["apiVersion"] == JAX_AV
+        assert active[0]["kind"] == JAX_KIND
+        assert active[0]["uid"]
+        assert active[0]["resourceVersion"]
+        # oldest first
+        names = [a["name"] for a in active]
+        assert names == sorted(names, key=lambda n: int(n.rsplit("-", 1)[1]))
+
+    def test_finished_job_moves_to_history(self, api, fake_clock, reconciler):
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        name = list_jobs(api)[0]["metadata"]["name"]
+        finish_job(api, name)
+        fake_clock.advance(timedelta(seconds=10))
+        reconciler.reconcile("default", "demo")
+        cron = get_cron(api)
+        assert cron["status"].get("active") in (None, [])
+        history = cron["status"]["history"]
+        assert len(history) == 1
+        entry = history[0]
+        assert entry["status"] == "Succeeded"
+        assert entry["object"]["name"] == name
+        # apiGroup carries group/version (reference back-compat quirk)
+        assert entry["object"]["apiGroup"] == JAX_AV
+        assert entry["finished"]  # stamped at sync time
+
+    def test_failed_status_recorded(self, api, fake_clock, reconciler):
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        name = list_jobs(api)[0]["metadata"]["name"]
+        finish_job(api, name, cond="Failed")
+        reconciler.reconcile("default", "demo")
+        cron = get_cron(api)
+        assert cron["status"]["history"][0]["status"] == "Failed"
+
+    def test_history_limit_gc(self, api, fake_clock, reconciler):
+        make_cron(api, history_limit=2)
+        names = []
+        for _ in range(4):
+            fake_clock.advance(timedelta(minutes=2))
+            reconciler.reconcile("default", "demo")
+            jobs = [
+                j["metadata"]["name"] for j in list_jobs(api)
+                if j["metadata"]["name"] not in names
+            ]
+            assert len(jobs) == 1
+            names.append(jobs[0])
+            finish_job(api, jobs[0])
+        reconciler.reconcile("default", "demo")
+        cron = get_cron(api)
+        history = cron["status"]["history"]
+        assert len(history) == 2
+        # the two newest survive; oldest two workloads were deleted
+        kept = {h["object"]["name"] for h in history}
+        assert kept == set(names[-2:])
+        remaining = {j["metadata"]["name"] for j in list_jobs(api)}
+        assert remaining == set(names[-2:])
+
+
+class TestTemplateInstantiation:
+    def test_template_name_forces_forbid_event(self, api, fake_clock, reconciler):
+        make_cron(api, template=jax_template(name="pinned"))
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        jobs = list_jobs(api)
+        assert jobs[0]["metadata"]["name"] == "pinned"
+        assert len(api.events(reason="OverridePolicy")) == 1
+        # in-memory override only: persisted spec still Allow default
+        cron = get_cron(api)
+        assert "concurrencyPolicy" not in cron["spec"] or cron["spec"][
+            "concurrencyPolicy"
+        ] == "Allow"
+
+    def test_generate_name_cleared(self, api, fake_clock, reconciler):
+        tpl = jax_template()
+        tpl["metadata"]["generateName"] = "risky-"
+        make_cron(api, template=tpl)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        meta = list_jobs(api)[0]["metadata"]
+        assert meta["name"].startswith("demo-")
+        assert "generateName" not in meta or not meta["generateName"]
+
+    def test_default_job_name(self, api):
+        from cron_operator_tpu.api.v1alpha1 import Cron
+
+        cron = Cron.from_dict(
+            {"metadata": {"name": "mycron", "namespace": "default"}, "spec": {}}
+        )
+        t = datetime(2026, 3, 1, 10, 0, tzinfo=timezone.utc)
+        assert get_default_job_name(cron, t) == f"mycron-{int(t.timestamp())}"
+
+    def test_new_empty_workload_validation(self):
+        from cron_operator_tpu.api.v1alpha1 import Cron
+
+        for tpl in [None, {"metadata": {}}, {"apiVersion": "v1"}, {"kind": "Job"}]:
+            cron = Cron.from_dict(
+                {
+                    "metadata": {"name": "c", "namespace": "default"},
+                    "spec": {"template": {"workload": tpl}},
+                }
+            )
+            with pytest.raises(WorkloadTemplateError):
+                new_empty_workload(cron)
+
+
+class TestMissedRunCatchup:
+    def test_too_many_missed_emits_warning(self, api, fake_clock, reconciler):
+        make_cron(api)  # every minute
+        fake_clock.advance(timedelta(hours=3))  # 180 missed ticks
+        reconciler.reconcile("default", "demo")
+        assert len(api.events(reason="TooManyMissedTimes")) == 1
+        # still fires exactly one job for the catch-up
+        assert len(list_jobs(api)) == 1
+
+    def test_few_missed_no_warning(self, api, fake_clock, reconciler):
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=30))
+        reconciler.reconcile("default", "demo")
+        assert api.events(reason="TooManyMissedTimes") == []
+
+    def test_last_schedule_time_resumes(self, api, fake_clock, reconciler):
+        """Crash/fail-over recovery: lastScheduleTime persisted in status is
+        the recovery point (SURVEY.md §5 failure detection)."""
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        # "restart": new reconciler instance sees persisted status
+        fresh = CronReconciler(api)
+        fake_clock.advance(timedelta(minutes=2))
+        fresh.reconcile("default", "demo")
+        assert len(list_jobs(api)) == 2
+
+
+class TestMalformedStatus:
+    def test_malformed_status_workload_skipped(self, api, fake_clock, reconciler):
+        """A workload whose status fails conversion is skipped entirely —
+        it neither blocks Forbid policy forever nor enters history
+        (reference `continue` at cron_controller.go:139-143)."""
+        make_cron(api, policy="Forbid")
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        name = list_jobs(api)[0]["metadata"]["name"]
+        # corrupt the status
+        api.patch_status(JAX_AV, JAX_KIND, "default", name,
+                         {"conditions": "garbage"})
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        # the broken workload did not count as active → Forbid still fired
+        assert len(list_jobs(api)) == 2
+        cron = get_cron(api)
+        names_in_status = {a["name"] for a in cron["status"].get("active", [])}
+        assert name not in names_in_status

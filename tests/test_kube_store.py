@@ -1,0 +1,166 @@
+"""Embedded control-plane (APIServer) tests: CRUD, optimistic concurrency,
+label selection, watches, owner-reference GC cascade, events."""
+
+import pytest
+
+from cron_operator_tpu.runtime.kube import (
+    AlreadyExistsError,
+    APIServer,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+)
+
+
+def job(name, ns="default", labels=None, owners=None):
+    obj = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": ns},
+    }
+    if labels:
+        obj["metadata"]["labels"] = labels
+    if owners:
+        obj["metadata"]["ownerReferences"] = owners
+    return obj
+
+
+class TestCrud:
+    def test_create_sets_metadata(self, api):
+        created = api.create(job("a"))
+        meta = created["metadata"]
+        assert meta["uid"]
+        assert meta["resourceVersion"]
+        assert meta["creationTimestamp"]
+
+    def test_create_requires_gvk(self, api):
+        with pytest.raises(InvalidError):
+            api.create({"metadata": {"name": "a"}})
+
+    def test_duplicate_create(self, api):
+        api.create(job("a"))
+        with pytest.raises(AlreadyExistsError):
+            api.create(job("a"))
+
+    def test_generate_name(self, api):
+        created = api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"generateName": "worker-", "namespace": "default"},
+            }
+        )
+        assert created["metadata"]["name"].startswith("worker-")
+
+    def test_get_not_found(self, api):
+        with pytest.raises(NotFoundError):
+            api.get("kubeflow.org/v1", "JAXJob", "default", "nope")
+
+    def test_returns_copies(self, api):
+        api.create(job("a"))
+        got = api.get("kubeflow.org/v1", "JAXJob", "default", "a")
+        got["metadata"]["labels"] = {"mutated": "yes"}
+        again = api.get("kubeflow.org/v1", "JAXJob", "default", "a")
+        assert "labels" not in again["metadata"]
+
+    def test_update_conflict(self, api):
+        created = api.create(job("a"))
+        stale = dict(created)
+        api.update(created)  # bumps rv
+        with pytest.raises(ConflictError):
+            api.update(stale)
+
+    def test_list_label_selector(self, api):
+        api.create(job("a", labels={"kubedl.io/cron-name": "c1"}))
+        api.create(job("b", labels={"kubedl.io/cron-name": "c2"}))
+        api.create(job("c"))
+        out = api.list(
+            "kubeflow.org/v1",
+            "JAXJob",
+            namespace="default",
+            label_selector={"kubedl.io/cron-name": "c1"},
+        )
+        assert [o["metadata"]["name"] for o in out] == ["a"]
+
+    def test_list_namespace_scoping(self, api):
+        api.create(job("a", ns="ns1"))
+        api.create(job("a", ns="ns2"))
+        assert len(api.list("kubeflow.org/v1", "JAXJob")) == 2
+        assert len(api.list("kubeflow.org/v1", "JAXJob", namespace="ns1")) == 1
+
+
+class TestStatusPatch:
+    def test_patch_and_noop_shortcircuit(self, api):
+        created = api.create(job("a"))
+        rv0 = created["metadata"]["resourceVersion"]
+        patched = api.patch_status(
+            "kubeflow.org/v1", "JAXJob", "default", "a",
+            {"conditions": [{"type": "Running", "status": "True"}]},
+        )
+        rv1 = patched["metadata"]["resourceVersion"]
+        assert rv1 != rv0
+        # semantically equal patch → no rv bump
+        again = api.patch_status(
+            "kubeflow.org/v1", "JAXJob", "default", "a",
+            {"conditions": [{"type": "Running", "status": "True"}]},
+        )
+        assert again["metadata"]["resourceVersion"] == rv1
+
+
+class TestGarbageCollection:
+    def test_cascade_delete(self, api):
+        owner = api.create(job("parent"))
+        uid = owner["metadata"]["uid"]
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "child",
+                    "namespace": "default",
+                    "ownerReferences": [
+                        {"kind": "JAXJob", "uid": uid, "controller": True}
+                    ],
+                },
+            }
+        )
+        api.delete("kubeflow.org/v1", "JAXJob", "default", "parent")
+        assert api.try_get("v1", "Pod", "default", "child") is None
+
+    def test_orphan_propagation(self, api):
+        owner = api.create(job("parent"))
+        uid = owner["metadata"]["uid"]
+        api.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "child",
+                    "namespace": "default",
+                    "ownerReferences": [{"kind": "JAXJob", "uid": uid}],
+                },
+            }
+        )
+        api.delete(
+            "kubeflow.org/v1", "JAXJob", "default", "parent", propagation="Orphan"
+        )
+        assert api.try_get("v1", "Pod", "default", "child") is not None
+
+
+class TestWatchAndEvents:
+    def test_watch_stream(self, api):
+        seen = []
+        api.add_watcher(lambda ev: seen.append((ev.type, ev.object["metadata"]["name"])))
+        api.create(job("a"))
+        api.patch_status("kubeflow.org/v1", "JAXJob", "default", "a", {"x": 1})
+        api.delete("kubeflow.org/v1", "JAXJob", "default", "a")
+        assert seen == [("ADDED", "a"), ("MODIFIED", "a"), ("DELETED", "a")]
+
+    def test_events(self, api):
+        cron = {"apiVersion": "apps.kubedl.io/v1alpha1", "kind": "Cron",
+                "metadata": {"name": "c", "namespace": "default"}}
+        api.record_event(cron, "Warning", "FailedCreate", "boom")
+        evs = api.events(reason="FailedCreate")
+        assert len(evs) == 1
+        assert evs[0].involved_name == "c"
+        assert evs[0].type == "Warning"

@@ -1,0 +1,215 @@
+"""Local training runtime tests: condition lifecycle, pod-group gang
+modeling, entrypoint execution, cancellation, preemption recovery.
+
+These run against a real-time clock (the executor uses threads); workloads
+are millisecond-scale so the suite stays fast.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cron_operator_tpu.backends.local import LocalExecutor
+from cron_operator_tpu.backends.registry import register_entrypoint
+from cron_operator_tpu.runtime.kube import APIServer
+
+JAX_AV, JAX_KIND = "kubeflow.org/v1", "JAXJob"
+
+
+@pytest.fixture
+def rt_api():
+    return APIServer()  # real clock
+
+
+@pytest.fixture
+def executor(rt_api):
+    ex = LocalExecutor(rt_api)
+    ex.start()
+    yield ex
+    ex.stop()
+
+
+def jax_job(name, annotations=None, replicas=1):
+    return {
+        "apiVersion": JAX_AV,
+        "kind": JAX_KIND,
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "annotations": annotations or {},
+        },
+        "spec": {"replicaSpecs": {"Worker": {"replicas": replicas}}},
+    }
+
+
+def wait_for(fn, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError("condition not met in time")
+
+
+def conditions_of(api, name):
+    obj = api.try_get(JAX_AV, JAX_KIND, "default", name)
+    if obj is None:
+        return []
+    return [c["type"] for c in (obj.get("status") or {}).get("conditions") or []]
+
+
+class TestLifecycle:
+    def test_condition_lifecycle(self, rt_api, executor):
+        rt_api.create(jax_job("j1"))
+        wait_for(lambda: "Succeeded" in conditions_of(rt_api, "j1"))
+        conds = conditions_of(rt_api, "j1")
+        assert conds[:2] == ["Created", "Running"]
+        assert conds[-1] == "Succeeded"
+        status = rt_api.get(JAX_AV, JAX_KIND, "default", "j1")["status"]
+        assert status["startTime"] and status["completionTime"]
+
+    def test_entrypoint_runs_with_params(self, rt_api, executor):
+        ran = {}
+
+        @register_entrypoint("test-entry")
+        def entry(ctx):
+            ran["params"] = ctx.params
+            ran["name"] = ctx.name
+
+        rt_api.create(
+            jax_job(
+                "j2",
+                annotations={
+                    "tpu.kubedl.io/entrypoint": "test-entry",
+                    "tpu.kubedl.io/param.steps": "5",
+                },
+            )
+        )
+        wait_for(lambda: "Succeeded" in conditions_of(rt_api, "j2"))
+        assert ran["params"] == {"steps": "5"}
+        assert ran["name"] == "j2"
+
+    def test_failing_entrypoint_marks_failed(self, rt_api, executor):
+        @register_entrypoint("test-boom")
+        def boom(ctx):
+            raise RuntimeError("kaboom")
+
+        rt_api.create(
+            jax_job("j3", annotations={"tpu.kubedl.io/entrypoint": "test-boom"})
+        )
+        wait_for(lambda: "Failed" in conditions_of(rt_api, "j3"))
+        obj = rt_api.get(JAX_AV, JAX_KIND, "default", "j3")
+        failed = [c for c in obj["status"]["conditions"] if c["type"] == "Failed"]
+        assert "kaboom" in failed[0]["message"]
+
+    def test_unknown_entrypoint_fails(self, rt_api, executor):
+        rt_api.create(
+            jax_job("j4", annotations={"tpu.kubedl.io/entrypoint": "no-such"})
+        )
+        wait_for(lambda: "Failed" in conditions_of(rt_api, "j4"))
+
+
+class TestPodGroup:
+    def test_pods_per_host_gang(self, rt_api, executor):
+        rt_api.create(
+            jax_job(
+                "gang",
+                annotations={
+                    "tpu.kubedl.io/accelerator": "v5e",
+                    "tpu.kubedl.io/topology": "4x4",
+                    "tpu.kubedl.io/simulate-duration": "300ms",
+                },
+            )
+        )
+        pods = wait_for(
+            lambda: (
+                p := rt_api.list(
+                    "v1", "Pod", namespace="default",
+                    label_selector={"tpu.kubedl.io/job-name": "gang"},
+                )
+            )
+            and len(p) == 4
+            and p
+        )
+        indices = sorted(p["metadata"]["labels"]["tpu.kubedl.io/worker-index"] for p in pods)
+        assert indices == ["0", "1", "2", "3"]
+        # all owned by the job → deleting the job cascades the pod group
+        wait_for(lambda: "Succeeded" in conditions_of(rt_api, "gang"))
+        rt_api.delete(JAX_AV, JAX_KIND, "default", "gang")
+        assert rt_api.list(
+            "v1", "Pod", namespace="default",
+            label_selector={"tpu.kubedl.io/job-name": "gang"},
+        ) == []
+
+    def test_job_deletion_cancels_run(self, rt_api, executor):
+        started = threading.Event()
+        stopped = threading.Event()
+
+        @register_entrypoint("test-long")
+        def long_run(ctx):
+            started.set()
+            ctx.cancel.wait(10)
+            if ctx.should_stop():
+                stopped.set()
+
+        rt_api.create(
+            jax_job("doomed", annotations={"tpu.kubedl.io/entrypoint": "test-long"})
+        )
+        assert started.wait(5)
+        rt_api.delete(JAX_AV, JAX_KIND, "default", "doomed")
+        assert stopped.wait(5)
+
+
+class TestPreemption:
+    def test_preemption_fails_job(self, rt_api, executor):
+        rt_api.create(
+            jax_job(
+                "victim",
+                annotations={
+                    "tpu.kubedl.io/accelerator": "v5e",
+                    "tpu.kubedl.io/topology": "4x4",
+                    "tpu.kubedl.io/simulate-duration": "10s",
+                },
+            )
+        )
+        wait_for(lambda: "Running" in conditions_of(rt_api, "victim"))
+        executor.preempt("default", "victim")
+        wait_for(lambda: "Failed" in conditions_of(rt_api, "victim"))
+        # slice-atomic: every host pod gone
+        assert rt_api.list(
+            "v1", "Pod", namespace="default",
+            label_selector={"tpu.kubedl.io/job-name": "victim"},
+        ) == []
+        # terminal for the cron status contract
+        from cron_operator_tpu.controller.workload import is_workload_finished
+
+        _, finished = is_workload_finished(
+            rt_api.get(JAX_AV, JAX_KIND, "default", "victim")
+        )
+        assert finished
+
+    def test_preemption_with_restart_reruns(self, rt_api, executor):
+        runs = []
+
+        @register_entrypoint("test-restarty")
+        def restarty(ctx):
+            runs.append(time.monotonic())
+            ctx.cancel.wait(0.2)
+
+        rt_api.create(
+            jax_job(
+                "phoenix",
+                annotations={
+                    "tpu.kubedl.io/entrypoint": "test-restarty",
+                    "tpu.kubedl.io/restart-on-preemption": "true",
+                },
+            )
+        )
+        wait_for(lambda: len(runs) >= 1)
+        executor.preempt("default", "phoenix")
+        wait_for(lambda: len(runs) >= 2)
+        wait_for(lambda: "Succeeded" in conditions_of(rt_api, "phoenix"))
+        conds = conditions_of(rt_api, "phoenix")
+        assert "Restarting" in conds

@@ -1,0 +1,205 @@
+"""Cron schedule engine tests — semantics parity with robfig/cron/v3
+ParseStandard (the reference's parser, ``cron_controller.go:392``)."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from cron_operator_tpu.controller.schedule import (
+    CronSchedule,
+    EverySchedule,
+    parse_go_duration,
+    parse_standard,
+)
+
+
+def utc(*args):
+    return datetime(*args, tzinfo=timezone.utc)
+
+
+class TestParseErrors:
+    @pytest.mark.parametrize(
+        "expr",
+        [
+            "",
+            "* * * *",  # 4 fields
+            "* * * * * *",  # 6 fields (no seconds in standard)
+            "60 * * * *",  # minute out of range
+            "* 24 * * *",  # hour out of range
+            "* * 0 * *",  # dom out of range
+            "* * * 13 *",  # month out of range
+            "* * * * 8",  # dow out of range
+            "*/0 * * * *",  # zero step
+            "a * * * *",  # garbage
+            "@reboot",  # unsupported descriptor
+            "@every",  # missing duration
+            "1-0 * * * *",  # inverted range
+        ],
+    )
+    def test_invalid(self, expr):
+        with pytest.raises(ValueError):
+            parse_standard(expr)
+
+    def test_valid_do_not_raise(self):
+        for expr in [
+            "* * * * *",
+            "*/5 * * * *",
+            "0 0 1 1 *",
+            "0 9-17 * * MON-FRI",
+            "15,45 */2 1-15 JAN,jul *",
+            "0 0 * * 7",  # 7 == Sunday
+            "@hourly",
+            "@daily",
+            "@weekly",
+            "@monthly",
+            "@yearly",
+            "@annually",
+            "@midnight",
+            "@every 90s",
+            "@every 1h30m",
+        ]:
+            parse_standard(expr)
+
+
+class TestNext:
+    def test_every_minute(self):
+        s = parse_standard("* * * * *")
+        assert s.next(utc(2026, 3, 1, 10, 30, 15)) == utc(2026, 3, 1, 10, 31)
+
+    def test_strictly_after(self):
+        s = parse_standard("* * * * *")
+        # exactly on an activation → the next one
+        assert s.next(utc(2026, 3, 1, 10, 30)) == utc(2026, 3, 1, 10, 31)
+
+    def test_every_5_minutes(self):
+        s = parse_standard("*/5 * * * *")
+        assert s.next(utc(2026, 3, 1, 10, 2)) == utc(2026, 3, 1, 10, 5)
+        assert s.next(utc(2026, 3, 1, 10, 5)) == utc(2026, 3, 1, 10, 10)
+        assert s.next(utc(2026, 3, 1, 23, 58)) == utc(2026, 3, 2, 0, 0)
+
+    def test_hour_rollover(self):
+        s = parse_standard("30 14 * * *")
+        assert s.next(utc(2026, 3, 1, 15, 0)) == utc(2026, 3, 2, 14, 30)
+        assert s.next(utc(2026, 3, 1, 14, 0)) == utc(2026, 3, 1, 14, 30)
+
+    def test_month_names_and_rollover(self):
+        s = parse_standard("0 0 1 mar *")
+        assert s.next(utc(2026, 3, 5)) == utc(2027, 3, 1)
+        assert s.next(utc(2026, 1, 5)) == utc(2026, 3, 1)
+
+    def test_dow(self):
+        # Sunday (2026-03-01 is a Sunday)
+        s = parse_standard("0 12 * * SUN")
+        assert s.next(utc(2026, 3, 1, 13, 0)) == utc(2026, 3, 8, 12, 0)
+        assert s.next(utc(2026, 2, 28)) == utc(2026, 3, 1, 12, 0)
+
+    def test_dow_7_is_sunday(self):
+        a = parse_standard("0 12 * * 0")
+        b = parse_standard("0 12 * * 7")
+        t = utc(2026, 3, 2)
+        assert a.next(t) == b.next(t)
+
+    def test_vixie_dom_dow_or_rule(self):
+        # Both restricted: fires on the 15th OR on Mondays.
+        s = parse_standard("0 0 15 * MON")
+        # 2026-03-01 Sun → next is Mon 2026-03-02
+        assert s.next(utc(2026, 3, 1, 1, 0)) == utc(2026, 3, 2, 0, 0)
+        # From Mon 3-02 00:30 → Mon 3-09? no — dom 15 vs next Monday 3-09: min is 3-09
+        assert s.next(utc(2026, 3, 2, 0, 30)) == utc(2026, 3, 9, 0, 0)
+        # From 3-13 (Fri) → dom 15 (Sunday 3-15) before Monday 3-16
+        assert s.next(utc(2026, 3, 13)) == utc(2026, 3, 15, 0, 0)
+
+    def test_dom_restricted_only(self):
+        s = parse_standard("0 0 15 * *")
+        assert s.next(utc(2026, 3, 1)) == utc(2026, 3, 15)
+
+    def test_step_range(self):
+        s = parse_standard("10-30/10 * * * *")
+        assert s.next(utc(2026, 3, 1, 9, 0)) == utc(2026, 3, 1, 9, 10)
+        assert s.next(utc(2026, 3, 1, 9, 10)) == utc(2026, 3, 1, 9, 20)
+        assert s.next(utc(2026, 3, 1, 9, 30)) == utc(2026, 3, 1, 10, 10)
+
+    def test_leap_day(self):
+        s = parse_standard("0 0 29 2 *")
+        assert s.next(utc(2026, 1, 1)) == utc(2028, 2, 29)
+
+    def test_unschedulable_raises(self):
+        s = parse_standard("0 0 31 2 *")  # Feb 31 never exists
+        with pytest.raises(ValueError):
+            s.next(utc(2026, 1, 1))
+
+    def test_descriptor_hourly(self):
+        s = parse_standard("@hourly")
+        assert s.next(utc(2026, 3, 1, 10, 30)) == utc(2026, 3, 1, 11, 0)
+
+    def test_every_schedule(self):
+        s = parse_standard("@every 90s")
+        assert isinstance(s, EverySchedule)
+        assert s.next(utc(2026, 3, 1, 10, 0, 0)) == utc(2026, 3, 1, 10, 1, 30)
+
+    def test_preserves_timezone(self):
+        from zoneinfo import ZoneInfo
+
+        tz = ZoneInfo("America/New_York")
+        s = parse_standard("0 9 * * *")
+        t = datetime(2026, 3, 2, 10, 0, tzinfo=tz)
+        nxt = s.next(t)
+        assert nxt.hour == 9 and nxt.day == 3
+        assert nxt.tzinfo is tz
+
+
+class TestGoDuration:
+    def test_units(self):
+        assert parse_go_duration("90s") == timedelta(seconds=90)
+        assert parse_go_duration("1h30m") == timedelta(hours=1, minutes=30)
+        assert parse_go_duration("250ms") == timedelta(milliseconds=250)
+
+    def test_invalid(self):
+        for bad in ["", "5", "h", "1x"]:
+            with pytest.raises(ValueError):
+                parse_go_duration(bad)
+
+
+class TestReviewRegressions:
+    """Fixes from code review: dow step across the 7-wrap, '@every -'."""
+
+    def test_dow_range_with_step_ending_at_7(self):
+        from datetime import datetime, timezone
+
+        s = parse_standard("0 0 * * 4-7/2")  # Thu, Sat... 7 unreachable by step
+        # mask: 4(Thu), 6(Sat) — never Sunday, never Friday
+        hits = []
+        t = datetime(2026, 3, 1, tzinfo=timezone.utc)
+        for _ in range(6):
+            t = s.next(t)
+            hits.append(t.strftime("%a"))
+        assert set(hits) == {"Thu", "Sat"}
+
+    def test_dow_range_step_reaching_7_maps_to_sunday(self):
+        from datetime import datetime, timezone
+
+        s = parse_standard("0 0 * * 5-7/2")  # Fri, Sun
+        hits = []
+        t = datetime(2026, 3, 1, tzinfo=timezone.utc)
+        for _ in range(6):
+            t = s.next(t)
+            hits.append(t.strftime("%a"))
+        assert set(hits) == {"Fri", "Sun"}
+
+    def test_bare_dash_duration_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            parse_go_duration("-")
+        with _pytest.raises(ValueError):
+            parse_standard("@every -")
+
+
+class TestBackoffClamp:
+    def test_no_overflow_on_persistent_failure(self):
+        from cron_operator_tpu.runtime.workqueue import ItemExponentialBackoff
+
+        b = ItemExponentialBackoff()
+        for _ in range(1200):
+            delay = b.when("stuck")
+        assert delay == b.cap_s

@@ -1,0 +1,127 @@
+"""TPU slice topology + admission injection tests (SURVEY.md §7 step 4b)."""
+
+import pytest
+
+from cron_operator_tpu.backends.tpu import (
+    NODESEL_ACCELERATOR,
+    NODESEL_TOPOLOGY,
+    RESOURCE_TPU,
+    SliceSpec,
+    TopologyError,
+    inject_tpu_topology,
+    render_coordinator_env,
+    slice_for,
+    slice_for_shorthand,
+)
+
+
+class TestSliceResolution:
+    @pytest.mark.parametrize(
+        "family,topology,chips,hosts,per_host",
+        [
+            ("v5e", "1x1", 1, 1, 1),
+            ("v5e", "2x2", 4, 1, 4),
+            ("v5e", "2x4", 8, 1, 8),
+            ("v5e", "4x4", 16, 4, 4),
+            ("v5e", "4x8", 32, 8, 4),
+            ("v5e", "8x8", 64, 16, 4),
+            ("v5e", "16x16", 256, 64, 4),
+            ("v5p", "2x2x1", 4, 1, 4),
+            ("v5p", "2x2x2", 8, 2, 4),
+            ("v5p", "2x2x4", 16, 4, 4),
+            ("v4", "2x2x2", 8, 2, 4),
+            ("v6e", "4x4", 16, 4, 4),
+        ],
+    )
+    def test_shapes(self, family, topology, chips, hosts, per_host):
+        s = slice_for(family, topology)
+        assert (s.chips, s.hosts, s.chips_per_host) == (chips, hosts, per_host)
+        assert s.multi_host == (hosts > 1)
+
+    def test_accelerator_label_roundtrip(self):
+        s = slice_for("tpu-v5-lite-podslice", "4x4")
+        assert s.accelerator == "tpu-v5-lite-podslice"
+        assert s.hosts == 4
+
+    def test_shorthand(self):
+        s = slice_for_shorthand("v5e-16")
+        assert (s.chips, s.hosts) == (16, 4)
+        s = slice_for_shorthand("v5e-64")
+        assert (s.chips, s.hosts) == (64, 16)
+        s = slice_for_shorthand("v5e-1")
+        assert (s.chips, s.hosts) == (1, 1)
+
+    def test_errors(self):
+        with pytest.raises(TopologyError):
+            slice_for("v9x", "4x4")
+        with pytest.raises(TopologyError):
+            slice_for("v5e", "4x4x4")  # v5e is 2D
+        with pytest.raises(TopologyError):
+            slice_for("v5p", "4x4")  # v5p is 3D
+        with pytest.raises(TopologyError):
+            slice_for("v5e", "bananas")
+        with pytest.raises(TopologyError):
+            slice_for_shorthand("v5e-3")
+
+
+def tpu_job(accel="v5e", topo="4x4"):
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {
+            "name": "train",
+            "namespace": "default",
+            "annotations": {
+                "tpu.kubedl.io/accelerator": accel,
+                "tpu.kubedl.io/topology": topo,
+            },
+        },
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+    }
+
+
+class TestInjection:
+    def test_multi_host_injection(self):
+        job = tpu_job("v5e", "4x4")
+        spec = inject_tpu_topology(job)
+        assert spec is not None and spec.hosts == 4
+        worker = job["spec"]["replicaSpecs"]["Worker"]
+        # replicas forced to host count (gang: one pod per host)
+        assert worker["replicas"] == 4
+        pod_spec = worker["template"]["spec"]
+        assert pod_spec["nodeSelector"][NODESEL_ACCELERATOR] == "tpu-v5-lite-podslice"
+        assert pod_spec["nodeSelector"][NODESEL_TOPOLOGY] == "4x4"
+        c = pod_spec["containers"][0]
+        assert c["resources"]["requests"][RESOURCE_TPU] == "4"
+        assert c["resources"]["limits"][RESOURCE_TPU] == "4"
+        env_names = [e["name"] for e in c["env"]]
+        assert "JAX_COORDINATOR_ADDRESS" in env_names
+        assert "JAX_NUM_PROCESSES" in env_names
+        assert job["metadata"]["annotations"]["tpu.kubedl.io/gang-size"] == "4"
+
+    def test_single_host(self):
+        job = tpu_job("v5e", "1x1")
+        spec = inject_tpu_topology(job)
+        assert spec.hosts == 1
+        assert job["spec"]["replicaSpecs"]["Worker"]["replicas"] == 1
+
+    def test_non_tpu_job_untouched(self):
+        job = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "PyTorchJob",
+            "metadata": {"name": "gpu", "namespace": "default"},
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 2}}},
+        }
+        import copy
+
+        before = copy.deepcopy(job)
+        assert inject_tpu_topology(job) is None
+        assert job == before
+
+    def test_coordinator_env(self):
+        spec = slice_for("v5e", "4x4")
+        env = render_coordinator_env("train", "ns1", spec)
+        addr = next(e for e in env if e["name"] == "JAX_COORDINATOR_ADDRESS")
+        assert addr["value"] == "train-worker-0.train.ns1.svc:8476"
+        nproc = next(e for e in env if e["name"] == "JAX_NUM_PROCESSES")
+        assert nproc["value"] == "4"
