@@ -63,6 +63,10 @@ class LocalExecutor:
         self._lock = threading.Lock()
         self._running = False
         self._dispatcher: Optional[threading.Thread] = None
+        # Events enqueued but not yet fully handled. Counted at ENQUEUE time
+        # (not at dequeue) so there is no window where an event is in
+        # neither the queue nor the counter — wait_idle keys off this.
+        self._inflight = 0
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -76,7 +80,7 @@ class LocalExecutor:
         # Adopt pre-existing jobs (informer initial list).
         for av, kind in self._handled_kinds:
             for obj in self.api.list(av, kind):
-                self._events.put(WatchEvent(type="ADDED", object=obj))
+                self._enqueue(WatchEvent(type="ADDED", object=obj))
 
     def stop(self) -> None:
         self._running = False
@@ -85,8 +89,11 @@ class LocalExecutor:
                 ctx.cancel.set()
             threads = list(self._threads.values())
         self._events.put(None)
+        # Generous join: killing a daemon thread mid-XLA-compile at
+        # interpreter exit aborts the process (uncatchable C++ teardown);
+        # entrypoints poll ctx.cancel between steps, so they exit soon.
         for t in threads:
-            t.join(timeout=2.0)
+            t.join(timeout=30.0)
         if self._dispatcher:
             self._dispatcher.join(timeout=2.0)
 
@@ -97,18 +104,26 @@ class LocalExecutor:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                if not any(t.is_alive() for t in self._threads.values()):
-                    return True
+                busy = self._inflight > 0 or any(
+                    t.is_alive() for t in self._threads.values()
+                )
+            if not busy:
+                return True
             time.sleep(0.02)
         return False
 
     # ---- watch dispatch ---------------------------------------------------
 
+    def _enqueue(self, ev: WatchEvent) -> None:
+        with self._lock:
+            self._inflight += 1
+        self._events.put(ev)
+
     def _on_event(self, ev: WatchEvent) -> None:
         # Called under the store lock — enqueue only, mutate nothing here.
         gvk = (ev.object.get("apiVersion", ""), ev.object.get("kind", ""))
         if gvk in self._handled_kinds:
-            self._events.put(ev)
+            self._enqueue(ev)
 
     def _dispatch_loop(self) -> None:
         while self._running:
@@ -119,6 +134,9 @@ class LocalExecutor:
                 self._handle(ev)
             except Exception:
                 logger.error("executor dispatch failed:\n%s", traceback.format_exc())
+            finally:
+                with self._lock:
+                    self._inflight -= 1
 
     def _handle(self, ev: WatchEvent) -> None:
         obj = ev.object
@@ -200,6 +218,7 @@ class LocalExecutor:
             )
 
             self._execute_entrypoint(ctx)
+            self._publish_progress(key, ctx)
 
             if ctx.should_stop():
                 return  # deleted/preempted mid-run; status handled elsewhere
@@ -301,6 +320,20 @@ class LocalExecutor:
             except NotFoundError:
                 pass
 
+    def _publish_progress(self, key: JobKey, ctx: JobContext) -> None:
+        """Fold the entrypoint's progress dict into status.trainingProgress
+        (observability for the tick→first-step north-star metric)."""
+        if not ctx.progress:
+            return
+        av, kind, ns, name = key
+        try:
+            obj = self.api.get(av, kind, ns, name)
+            status = obj.get("status") or {}
+            status["trainingProgress"] = dict(ctx.progress)
+            self.api.patch_status(av, kind, ns, name, status)
+        except NotFoundError:
+            pass
+
     # ---- status helpers ---------------------------------------------------
 
     def _append_condition(
@@ -360,7 +393,7 @@ class LocalExecutor:
                 self._threads.pop(key, None)
             # Re-admit as a fresh run (checkpoint restore is the workload's
             # job — Orbax in the entrypoint; SURVEY.md §5).
-            self._events.put(WatchEvent(type="ADDED", object=obj))
+            self._enqueue(WatchEvent(type="ADDED", object=obj))
         else:
             self._append_condition(
                 key, "Failed", "TPUSlicePreempted",

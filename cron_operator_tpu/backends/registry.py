@@ -55,6 +55,19 @@ def register_entrypoint(name: str, fn: Optional[Callable] = None):
 
 def resolve_entrypoint(ref: str) -> Callable[["JobContext"], Any]:
     """Resolve a registry name or ``module.path:function`` string."""
+    if ref not in _REGISTRY and ":" not in ref:
+        # Lazy-load the standard workloads (mnist/resnet50/bert) on first
+        # use — keeps jax/flax out of pure control-plane processes.
+        try:
+            importlib.import_module("cron_operator_tpu.workloads.entrypoints")
+        except ImportError:
+            import logging
+
+            logging.getLogger("backends.registry").warning(
+                "standard workload entrypoints unavailable "
+                "(cron_operator_tpu.workloads failed to import)",
+                exc_info=True,
+            )
     if ref in _REGISTRY:
         return _REGISTRY[ref]
     if ":" in ref:

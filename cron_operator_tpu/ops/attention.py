@@ -1,0 +1,80 @@
+"""Attention dispatch: one public op, three execution strategies.
+
+- ``"flash"`` — Pallas TPU kernel (:mod:`ops.flash_attention`); picked
+  automatically on TPU backends when shapes are tile-aligned.
+- ``"xla"``   — plain jnp attention (f32 accumulation); XLA fuses it well
+  enough for short sequences and is the CPU/GPU fallback.
+- ``"ring"``  — sequence-parallel ring attention over a mesh ``seq`` axis
+  (:mod:`parallel.ring`); picked when the caller passes a mesh whose
+  ``seq`` axis is >1 — long-context training where one device cannot hold
+  the sequence.
+
+Models call :func:`multi_head_attention` and stay strategy-agnostic; the
+choice is a deployment concern (slice shape + sequence length), exactly
+like the operator's workload-backend seam (SURVEY.md §1 "key architectural
+decision").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from cron_operator_tpu.ops.flash_attention import flash_attention
+from cron_operator_tpu.parallel.mesh import SEQ_AXIS
+from cron_operator_tpu.parallel.ring import (
+    _single_device_attention,
+    ring_attention,
+)
+
+
+def reference_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False
+) -> jax.Array:
+    """Naive full attention on ``[b, s, h, d]`` — the numeric ground truth
+    the kernels are tested against."""
+    return _single_device_attention(q, k, v, causal=causal)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu", "gpu")
+    except Exception:
+        return False
+
+
+def multi_head_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    impl: str = "auto",
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> jax.Array:
+    """Dispatching multi-head attention on ``[batch, seq, heads, head_dim]``.
+
+    ``impl``: ``"auto" | "flash" | "xla" | "ring"``.
+    """
+    if impl == "auto":
+        if mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1:
+            impl = "ring"
+        elif _on_tpu() and q.shape[1] % 128 == 0 and q.shape[-1] <= 256:
+            impl = "flash"
+        else:
+            impl = "xla"
+
+    if impl == "ring":
+        if mesh is None:
+            raise ValueError("impl='ring' needs a mesh with a seq axis")
+        return ring_attention(q, k, v, mesh, causal=causal)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "xla":
+        return _single_device_attention(q, k, v, causal=causal)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+__all__ = ["multi_head_attention", "reference_attention"]

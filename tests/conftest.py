@@ -5,12 +5,20 @@ against envtest without a real cluster — SURVEY.md §4)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# Force CPU-only via config, which beats both the env var and any TPU
+# plugin's own config.update (some environments register a tunneled TPU
+# backend at interpreter startup; unit tests must never touch it — the
+# real chip is for bench.py).
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,66 @@
+"""Model-zoo shape/dtype tests (CPU, tiny shapes)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cron_operator_tpu.models import MLP, Bert, BertConfig, ResNet18, ResNet50
+
+
+@pytest.fixture(scope="module")
+def cpu0():
+    return jax.devices("cpu")[0]
+
+
+def test_mlp_shapes(cpu0):
+    with jax.default_device(cpu0):
+        m = MLP()
+        x = jnp.zeros((4, 28, 28, 1))
+        params = m.init(jax.random.PRNGKey(0), x)["params"]
+        out = m.apply({"params": params}, x)
+    assert out.shape == (4, 10)
+    assert out.dtype == jnp.float32  # logits come back f32 for a stable loss
+
+
+def test_resnet18_shapes(cpu0):
+    with jax.default_device(cpu0):
+        m = ResNet18(num_classes=10)
+        x = jnp.zeros((2, 64, 64, 3))
+        params = m.init(jax.random.PRNGKey(0), x)["params"]
+        out = m.apply({"params": params}, x)
+    assert out.shape == (2, 10)
+
+
+def test_resnet50_param_count(cpu0):
+    """ResNet-50 should have ~25.5M params (sanity check the architecture)."""
+    with jax.default_device(cpu0):
+        m = ResNet50()
+        params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))[
+            "params"
+        ]
+        n = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    assert 24e6 < n < 27e6, f"unexpected ResNet-50 param count {n}"
+
+
+def test_bert_tiny_shapes(cpu0):
+    with jax.default_device(cpu0):
+        cfg = BertConfig.tiny(max_len=64, attention_impl="xla")
+        m = Bert(cfg)
+        ids = jnp.zeros((2, 64), jnp.int32)
+        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        out = m.apply({"params": params}, ids)
+    assert out.shape == (2, 64, cfg.vocab_size)
+    assert out.dtype == jnp.float32
+
+
+def test_bert_params_are_bf16_compute_f32_store(cpu0):
+    with jax.default_device(cpu0):
+        cfg = BertConfig.tiny(max_len=32, attention_impl="xla")
+        m = Bert(cfg)
+        params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32), jnp.int32))[
+            "params"
+        ]
+        leaves = jax.tree_util.tree_leaves(params)
+    assert all(
+        p.dtype == jnp.float32 for p in leaves
+    ), "params must be stored f32 (bf16 compute)"

@@ -1,0 +1,79 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU) and
+attention dispatch."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cron_operator_tpu.ops.attention import (
+    multi_head_attention,
+    reference_attention,
+)
+from cron_operator_tpu.ops.flash_attention import flash_attention
+
+
+@pytest.fixture(scope="module")
+def cpu0():
+    return jax.devices("cpu")[0]
+
+
+@pytest.fixture(scope="module")
+def qkv(cpu0):
+    with jax.default_device(cpu0):
+        key = jax.random.PRNGKey(7)
+        b, s, h, d = 2, 256, 2, 64
+        return tuple(
+            jax.random.normal(k, (b, s, h, d), jnp.float32)
+            for k in jax.random.split(key, 3)
+        )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, qkv, cpu0, causal):
+        q, k, v = qkv
+        with jax.default_device(cpu0):
+            ref = reference_attention(q, k, v, causal=causal)
+            out = flash_attention(q, k, v, causal=causal, interpret=True)
+        assert jnp.max(jnp.abs(out - ref)) < 2e-5
+
+    def test_small_blocks(self, qkv, cpu0):
+        q, k, v = qkv
+        with jax.default_device(cpu0):
+            ref = reference_attention(q, k, v, causal=True)
+            out = flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64, interpret=True
+            )
+        assert jnp.max(jnp.abs(out - ref)) < 2e-5
+
+    def test_rejects_unaligned_seq(self, cpu0):
+        with jax.default_device(cpu0):
+            q = jnp.ones((1, 100, 1, 8))
+            with pytest.raises(ValueError, match="multiple of block sizes"):
+                flash_attention(q, q, q)
+
+
+class TestDispatch:
+    def test_xla_impl(self, qkv, cpu0):
+        q, k, v = qkv
+        with jax.default_device(cpu0):
+            out = multi_head_attention(q, k, v, impl="xla")
+            ref = reference_attention(q, k, v)
+        assert jnp.max(jnp.abs(out - ref)) == 0.0
+
+    def test_auto_off_tpu_is_xla(self, qkv, cpu0):
+        # On the CPU test platform auto must not pick the pallas kernel.
+        q, k, v = qkv
+        with jax.default_device(cpu0):
+            out = multi_head_attention(q, k, v, impl="auto", mesh=None)
+        assert out.shape == q.shape
+
+    def test_ring_requires_mesh(self, qkv):
+        q, k, v = qkv
+        with pytest.raises(ValueError, match="needs a mesh"):
+            multi_head_attention(q, k, v, impl="ring")
+
+    def test_unknown_impl(self, qkv):
+        q, k, v = qkv
+        with pytest.raises(ValueError, match="unknown attention impl"):
+            multi_head_attention(q, k, v, impl="nope")

@@ -1,0 +1,127 @@
+"""Training-harness tests: sharded Trainer across parallelism modes, and the
+executor→entrypoint integration (the local analog of the reference's
+envtest-with-hand-set-status strategy, except here the training REALLY runs
+— closing the e2e gap the reference left, SURVEY.md §4 item 2)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cron_operator_tpu.backends.local import LocalExecutor
+from cron_operator_tpu.models import MLP, Bert, BertConfig
+from cron_operator_tpu.parallel.mesh import mesh_for_devices
+from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.utils.clock import RealClock
+from cron_operator_tpu.workloads import data as datasets
+from cron_operator_tpu.workloads.train import TrainConfig, Trainer
+
+
+@pytest.fixture(scope="module")
+def cpus():
+    return jax.devices("cpu")
+
+
+def _mlp_trainer(mesh, cpus):
+    with jax.default_device(cpus[0]):
+        m = MLP(features=(64,))
+        params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))[
+            "params"
+        ]
+        return Trainer(
+            lambda p, x: m.apply({"params": p}, x), params, mesh,
+            TrainConfig(optimizer="sgd", learning_rate=0.05),
+        )
+
+
+class TestTrainer:
+    def test_dp_loss_decreases(self, cpus):
+        mesh = mesh_for_devices(cpus)
+        tr = _mlp_trainer(mesh, cpus)
+        it = datasets.mnist_batches(64, seed=3)
+        batch = next(it)  # overfit one batch: loss must drop
+        losses = [tr.step(batch).loss for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+    def test_state_is_sharded_fsdp(self, cpus):
+        mesh = mesh_for_devices(cpus, fsdp=2)
+        tr = _mlp_trainer(mesh, cpus)
+        # The first Dense kernel is (784, 64): 784 % 2 == 0 → fsdp-sharded.
+        leaf = tr.state.params["Dense_0"]["kernel"]
+        assert "fsdp" in str(leaf.sharding.spec)
+
+    def test_bert_tp_sp_step(self, cpus):
+        mesh = mesh_for_devices(cpus, seq=2, tensor=2)
+        with jax.default_device(cpus[0]):
+            cfg = BertConfig.tiny(max_len=64, attention_impl="ring")
+            m = Bert(cfg, mesh=mesh)
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 64), jnp.int32)
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(seq_dim_in_batch=1, labels_follow_seq=True),
+            )
+            it = datasets.token_batches(4, 64, cfg.vocab_size)
+            s1, s2 = tr.step(next(it)), tr.step(next(it))
+        assert jnp.isfinite(s1.loss) and jnp.isfinite(s2.loss)
+
+    def test_remat_matches_no_remat(self, cpus):
+        """jax.checkpoint must not change the math."""
+        mesh = mesh_for_devices(cpus)
+        with jax.default_device(cpus[0]):
+            m = MLP(features=(32,))
+
+            def init():
+                # Separate trees per trainer: Trainer donates its state, so
+                # sharing one params tree across two trainers would leave
+                # the second holding deleted buffers.
+                return m.init(
+                    jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+                )["params"]
+
+            apply = lambda p, x: m.apply({"params": p}, x)  # noqa: E731
+            t1 = Trainer(apply, init(), mesh,
+                         TrainConfig(optimizer="sgd", remat=False))
+            t2 = Trainer(apply, init(), mesh,
+                         TrainConfig(optimizer="sgd", remat=True))
+            batch = next(datasets.mnist_batches(32, seed=5))
+            l1, l2 = t1.step(batch).loss, t2.step(batch).loss
+        assert abs(l1 - l2) < 1e-5
+
+
+class TestExecutorRunsTraining:
+    """Full loop: JAXJob object → executor → real JAX training → status."""
+
+    def _jaxjob(self, name, params):
+        ann = {"tpu.kubedl.io/entrypoint": "mnist"}
+        ann.update({
+            f"tpu.kubedl.io/param.{k}": str(v) for k, v in params.items()
+        })
+        return {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {
+                "name": name, "namespace": "default", "annotations": ann,
+            },
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+        }
+
+    def test_mnist_job_trains_and_succeeds(self):
+        api = APIServer(clock=RealClock())
+        ex = LocalExecutor(api)
+        ex.start()
+        try:
+            api.create(self._jaxjob(
+                "mnist-e2e",
+                {"steps": 2, "batch_size": 16, "platform": "cpu"},
+            ))
+            assert ex.wait_idle(timeout=120.0)
+        finally:
+            ex.stop()
+        job = api.get("kubeflow.org/v1", "JAXJob", "default", "mnist-e2e")
+        conds = [c["type"] for c in job["status"]["conditions"]]
+        assert conds[-1] == "Succeeded"
+        prog = job["status"]["trainingProgress"]
+        assert prog["steps_done"] == 2
+        assert prog["first_step_at"] >= prog["started_at"]
+        assert jnp.isfinite(prog["last_loss"])
