@@ -1,0 +1,224 @@
+"""CustomResourceDefinition generation for the Cron API.
+
+The reference ships a controller-gen-generated CRD manifest
+(``/root/reference/charts/cron-operator/crds/apps.kubedl.io_crons.yaml``,
+duplicated under ``config/crd/bases/``). Here the CRD is generated from the
+API types in code — ``python -m cron_operator_tpu.api.crd`` regenerates
+``deploy/crds/apps.kubedl.io_crons.yaml``, and a test pins the two in sync
+(the analog of the reference CI's ``make manifests`` drift check,
+``.github/workflows/integration.yaml``).
+
+Schema parity notes (reference CRD properties):
+- ``spec.schedule`` string (required),
+- ``spec.template.workload`` object with
+  ``x-kubernetes-preserve-unknown-fields`` (the RawExtension seam),
+- ``spec.concurrencyPolicy`` enum Allow/Forbid/Replace,
+- ``spec.suspend`` bool, ``spec.deadline`` date-time, ``spec.historyLimit``
+  int (+ our ``spec.timezone`` extension),
+- status subresource with active/history/lastScheduleTime,
+- printcolumns Schedule/Suspend/Last Schedule/Age.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from cron_operator_tpu.api.v1alpha1 import GROUP, VERSION
+
+PLURAL = "crons"
+SINGULAR = "cron"
+KIND = "Cron"
+LIST_KIND = "CronList"
+
+
+def _object_ref_schema() -> Dict[str, Any]:
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": {"type": "string"},
+            "kind": {"type": "string"},
+            "name": {"type": "string"},
+            "namespace": {"type": "string"},
+            "uid": {"type": "string"},
+            "resourceVersion": {"type": "string"},
+            "fieldPath": {"type": "string"},
+        },
+        "x-kubernetes-map-type": "atomic",
+    }
+
+
+def _history_schema() -> Dict[str, Any]:
+    return {
+        "type": "object",
+        "required": ["object", "uid"],
+        "properties": {
+            "uid": {"type": "string"},
+            "object": {
+                "type": "object",
+                "required": ["kind", "name"],
+                "properties": {
+                    "apiGroup": {"type": "string"},
+                    "kind": {"type": "string"},
+                    "name": {"type": "string"},
+                },
+                "x-kubernetes-map-type": "atomic",
+            },
+            "status": {"type": "string"},
+            "created": {"type": "string", "format": "date-time"},
+            "finished": {"type": "string", "format": "date-time"},
+        },
+    }
+
+
+def crd_manifest() -> Dict[str, Any]:
+    """The full CRD as an unstructured dict (YAML-serializable)."""
+    spec_schema: Dict[str, Any] = {
+        "type": "object",
+        "required": ["schedule", "template"],
+        "properties": {
+            "schedule": {
+                "type": "string",
+                "description": (
+                    "Standard 5-field cron schedule (minute hour dom month "
+                    "dow), plus @descriptors and '@every <duration>'."
+                ),
+            },
+            "template": {
+                "type": "object",
+                "properties": {
+                    "workload": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                        "description": (
+                            "Workload object of any schedulable GVK; "
+                            "opaque to the operator except apiVersion/kind "
+                            "and the JobStatus condition convention."
+                        ),
+                    }
+                },
+            },
+            "concurrencyPolicy": {
+                "type": "string",
+                "enum": ["Allow", "Forbid", "Replace"],
+                "description": (
+                    "How to treat concurrent executions; defaults to Allow."
+                ),
+            },
+            "suspend": {
+                "type": "boolean",
+                "description": "Suspend subsequent executions.",
+            },
+            "deadline": {
+                "type": "string",
+                "format": "date-time",
+                "description": "Timestamp after which no workload is started.",
+            },
+            "historyLimit": {
+                "type": "integer",
+                "format": "int64",
+                "description": (
+                    "Number of finished workloads to retain (oldest beyond "
+                    "the limit are deleted)."
+                ),
+            },
+            "timezone": {
+                "type": "string",
+                "description": (
+                    "IANA timezone for schedule evaluation (extension; the "
+                    "reference can only inherit the container timezone)."
+                ),
+            },
+        },
+    }
+    status_schema: Dict[str, Any] = {
+        "type": "object",
+        "properties": {
+            "active": {"type": "array", "items": _object_ref_schema()},
+            "history": {"type": "array", "items": _history_schema()},
+            "lastScheduleTime": {"type": "string", "format": "date-time"},
+        },
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": LIST_KIND,
+                "plural": PLURAL,
+                "singular": SINGULAR,
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "jsonPath": ".spec.schedule",
+                            "name": "Schedule",
+                            "type": "string",
+                        },
+                        {
+                            "jsonPath": ".spec.suspend",
+                            "name": "Suspend",
+                            "type": "boolean",
+                        },
+                        {
+                            "jsonPath": ".status.lastScheduleTime",
+                            "name": "Last Schedule",
+                            "type": "date",
+                        },
+                        {
+                            "jsonPath": ".metadata.creationTimestamp",
+                            "name": "Age",
+                            "type": "date",
+                        },
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "description": (
+                                "Cron launches an ML training workload on a "
+                                "cron schedule."
+                            ),
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": spec_schema,
+                                "status": status_schema,
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def render_yaml() -> str:
+    import yaml
+
+    return yaml.safe_dump(crd_manifest(), sort_keys=True, width=80)
+
+
+def main() -> None:
+    import pathlib
+
+    out = pathlib.Path(__file__).resolve().parents[2] / "deploy" / "crds"
+    out.mkdir(parents=True, exist_ok=True)
+    path = out / f"{GROUP}_{PLURAL}.yaml"
+    path.write_text(render_yaml())
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
+
+
+__all__ = ["crd_manifest", "render_yaml"]

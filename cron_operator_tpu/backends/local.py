@@ -180,8 +180,11 @@ class LocalExecutor:
     def _make_context(self, obj: Dict[str, Any]) -> JobContext:
         meta = obj.get("metadata") or {}
         ann = meta.get("annotations") or {}
+        # Param keys are lowercased everywhere (the env-var transport of the
+        # real-pod path cannot round-trip case; keeping both paths identical
+        # means a Cron behaves the same under either backend).
         params = {
-            k[len(ANNOTATION_PARAM_PREFIX):]: v
+            k[len(ANNOTATION_PARAM_PREFIX):].lower(): v
             for k, v in ann.items()
             if k.startswith(ANNOTATION_PARAM_PREFIX)
         }
@@ -280,6 +283,10 @@ class LocalExecutor:
                     "labels": {
                         "tpu.kubedl.io/job-name": name,
                         "tpu.kubedl.io/worker-index": str(i),
+                        # the shared identity contract (backends/tpu.py
+                        # LABEL_REPLICA_INDEX): real pods get this from the
+                        # training-operator, local pods from here
+                        "training.kubeflow.org/replica-index": str(i),
                     },
                     "ownerReferences": [
                         {

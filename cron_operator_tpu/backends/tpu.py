@@ -141,35 +141,63 @@ def slice_for_shorthand(name: str) -> SliceSpec:
     return slice_for(*entry)
 
 
+# Per-replica identity label. The Kubeflow training-operator stamps
+# ``training.kubeflow.org/replica-index`` on every pod it creates from a
+# ReplicaSpec — that is the one per-pod value available to the downward API
+# in the real-cluster path; the LocalExecutor stamps the same label on its
+# simulated pods (backends/local.py) so both paths share one contract.
+LABEL_REPLICA_INDEX = "training.kubeflow.org/replica-index"
+# Kept on local pods for back-compat with earlier annotations.
+LABEL_WORKER_INDEX = "tpu.kubedl.io/worker-index"
+
+
 def render_coordinator_env(
-    job_name: str, namespace: str, spec: SliceSpec, worker_index_var: str = "TPU_WORKER_ID"
+    job_name: str, namespace: str, spec: SliceSpec
 ) -> List[Dict[str, Any]]:
     """Env the JAX workload needs for ``jax.distributed.initialize``.
 
     Coordinator = worker 0's pod DNS behind the job's headless service —
     mirroring the training-operator's ``MASTER_ADDR`` rendering for PyTorch
-    (SURVEY.md §5 communication backend). GKE injects ``TPU_WORKER_ID`` /
-    ``TPU_WORKER_HOSTNAMES`` on real TPU node pools; we render the JAX-level
-    variables that work regardless.
+    (SURVEY.md §5 communication backend). Process identity comes from the
+    ``training.kubeflow.org/replica-index`` pod label via the downward API
+    (see LABEL_REPLICA_INDEX above).
     """
     coordinator = f"{job_name}-worker-0.{job_name}.{namespace}.svc:8476"
+    index_ref = {
+        "valueFrom": {
+            "fieldRef": {
+                "fieldPath": f"metadata.labels['{LABEL_REPLICA_INDEX}']"
+            }
+        }
+    }
     return [
         {"name": "JAX_COORDINATOR_ADDRESS", "value": coordinator},
         {"name": "JAX_NUM_PROCESSES", "value": str(spec.hosts)},
-        {
-            "name": "JAX_PROCESS_ID",
-            "valueFrom": {
-                "fieldRef": {
-                    "fieldPath": (
-                        "metadata.annotations"
-                        "['batch.kubernetes.io/job-completion-index']"
-                    )
-                }
-            },
-        },
-        {"name": worker_index_var, "valueFrom": {"fieldRef": {
-            "fieldPath": "metadata.labels['tpu.kubedl.io/worker-index']"}}},
+        {"name": "JAX_PROCESS_ID", **index_ref},
+        {"name": "TPU_WORKER_ID", **index_ref},
     ]
+
+
+def render_job_env(job: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Job identity + hyperparameter env for the container runner.
+
+    ``tpu.kubedl.io/param.<key>`` annotations become ``TPU_PARAM_<KEY>``
+    vars, which ``workloads.runner`` folds back into JobContext.params — so
+    real pods train with the Cron's configured hyperparameters, same as the
+    in-process path. Param keys are case-insensitive: every consumer
+    normalizes to lowercase (env vars cannot round-trip case).
+    """
+    meta = job.get("metadata") or {}
+    ann = meta.get("annotations") or {}
+    env: List[Dict[str, Any]] = [
+        {"name": "TPU_JOB_NAME", "value": meta.get("name", "")},
+        {"name": "TPU_JOB_NAMESPACE", "value": meta.get("namespace", "default")},
+    ]
+    for key, value in sorted(ann.items()):
+        if key.startswith("tpu.kubedl.io/param."):
+            name = key[len("tpu.kubedl.io/param."):].lower()
+            env.append({"name": f"TPU_PARAM_{name.upper()}", "value": value})
+    return env
 
 
 def _resolve_slice_from_job(job: Dict[str, Any]) -> Optional[SliceSpec]:
@@ -217,7 +245,7 @@ def inject_tpu_topology(job: Dict[str, Any]) -> Optional[SliceSpec]:
         have = {e.get("name") for e in env}
         for e in render_coordinator_env(
             meta.get("name", "job"), meta.get("namespace", "default"), spec
-        ):
+        ) + render_job_env(job):
             if e["name"] not in have:
                 env.append(e)
 
@@ -233,7 +261,10 @@ __all__ = [
     "slice_for",
     "slice_for_shorthand",
     "render_coordinator_env",
+    "render_job_env",
     "inject_tpu_topology",
+    "LABEL_REPLICA_INDEX",
+    "LABEL_WORKER_INDEX",
     "ANNOTATION_ACCELERATOR",
     "ANNOTATION_TOPOLOGY",
     "NODESEL_ACCELERATOR",

@@ -80,15 +80,32 @@ def build_parser() -> argparse.ArgumentParser:
     start.add_argument("--zap-encoder", default="console",
                        choices=["console", "json"])
     # TPU-native flags:
+    start.add_argument("--api-server", default="embedded",
+                       choices=["embedded", "cluster"],
+                       help="'embedded' runs the in-process control plane "
+                            "(standalone mode); 'cluster' reconciles CRs in "
+                            "a real Kubernetes cluster (in-cluster config "
+                            "or --kube-* flags)")
+    start.add_argument("--kube-server", default=None,
+                       help="kube-apiserver URL (default: in-cluster "
+                            "discovery)")
+    start.add_argument("--kube-token-file", default=None,
+                       help="bearer-token file for --kube-server")
+    start.add_argument("--kube-ca-file", default=None,
+                       help="CA bundle for --kube-server")
+    start.add_argument("--kube-insecure", action="store_true", default=False,
+                       help="skip TLS verification (dev only)")
     start.add_argument("--load", action="append", default=[],
                        metavar="MANIFEST.yaml",
                        help="apply YAML manifest(s) into the embedded control "
                             "plane at startup (repeatable)")
-    start.add_argument("--backend", default="local",
+    start.add_argument("--backend", default=None,
                        choices=["local", "none"],
                        help="JAXJob execution backend: 'local' runs training "
                             "in-process on the available TPU/CPU devices; "
-                            "'none' schedules objects only")
+                            "'none' schedules objects only. Defaults to "
+                            "'local' in embedded mode, 'none' in cluster "
+                            "mode (real workloads run as pods there)")
     start.add_argument("--run-for", type=float, default=None,
                        metavar="SECONDS",
                        help="exit after N seconds (default: run until signal)")
@@ -112,9 +129,36 @@ def cmd_start(args: argparse.Namespace) -> int:
     from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
     from cron_operator_tpu.controller import CronReconciler
     from cron_operator_tpu.runtime import APIServer, Manager
+    from cron_operator_tpu.runtime.kube import AlreadyExistsError
 
-    api = APIServer()
     scheme = default_scheme()
+    if args.api_server == "cluster":
+        from cron_operator_tpu.runtime.cluster import (
+            ClusterAPIServer,
+            ClusterConfig,
+        )
+
+        if args.kube_server:
+            cfg = ClusterConfig(args.kube_server)
+        else:
+            cfg = ClusterConfig.in_cluster()
+        # Explicit --kube-* flags override either base config.
+        if args.kube_token_file:
+            with open(args.kube_token_file) as f:
+                cfg.token = f.read().strip()
+        if args.kube_ca_file:
+            cfg.ca_file = args.kube_ca_file
+        if args.kube_insecure:
+            cfg.insecure = True
+        api = ClusterAPIServer(cfg, scheme=scheme)
+        log.info("cluster mode: reconciling against %s", cfg.server)
+    else:
+        api = APIServer()
+
+    if args.backend is None:
+        # In cluster mode workloads run as real pods; executing them
+        # in-process inside the operator is opt-in only.
+        args.backend = "none" if args.api_server == "cluster" else "local"
     manager = Manager(
         api,
         max_concurrent_reconciles=args.max_concurrent_reconciles,
@@ -171,24 +215,41 @@ def cmd_start(args: argparse.Namespace) -> int:
                 if not doc:
                     continue
                 doc.setdefault("metadata", {}).setdefault("namespace", "default")
-                api.create(doc)
+                try:
+                    api.create(doc)
+                except AlreadyExistsError:
+                    # Idempotent apply: restarts/replicas must not crash on
+                    # manifests already in the cluster.
+                    log.info(
+                        "%s %s/%s already exists; leaving as-is",
+                        doc.get("kind"), doc["metadata"]["namespace"],
+                        doc["metadata"].get("name"),
+                    )
+                    continue
                 log.info(
                     "applied %s %s/%s", doc.get("kind"),
                     doc["metadata"]["namespace"], doc["metadata"].get("name"),
                 )
 
     stop = threading.Event()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
 
     log.info("starting manager (version %s)", __version__)
     manager.start()
+    if args.api_server == "cluster":
+        from cron_operator_tpu.api.scheme import GVK_CRON as _cron_gvk
+
+        api.start_watches([_cron_gvk] + scheme.workload_kinds())
     stop.wait(timeout=args.run_for)
 
     log.info("shutting down")
     manager.stop()
     if executor is not None:
         executor.stop()
+    if args.api_server == "cluster":
+        api.stop()
     for s in servers:
         s.shutdown()
     return 0

@@ -1,0 +1,94 @@
+"""Standalone workload runner — the container entrypoint for scheduled pods.
+
+When a JAXJob runs as real pods on a GKE TPU slice (rather than in-process
+under the embedded LocalExecutor), each host pod executes
+``python -m cron_operator_tpu.workloads.runner <entrypoint>``. The runner:
+
+1. initializes ``jax.distributed`` from the env the operator rendered at
+   admission (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+   ``JAX_PROCESS_ID`` — backends/tpu.py ``render_coordinator_env``; the
+   analog of the training-operator's ``MASTER_ADDR`` rendering for the GPU
+   path, SURVEY.md §5 "Distributed communication backend"),
+2. builds a JobContext from ``TPU_JOB_*`` env + CLI params,
+3. runs the registered entrypoint across all hosts (ICI collectives inside
+   the slice, DCN between slices — all via XLA; no comm code here).
+
+Params come as ``key=value`` args or ``TPU_PARAM_<KEY>`` env vars.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+from typing import Dict, List
+
+logger = logging.getLogger("workloads.runner")
+
+
+def _gather_params(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for key, value in os.environ.items():
+        if key.startswith("TPU_PARAM_"):
+            params[key[len("TPU_PARAM_"):].lower()] = value
+    for arg in argv:
+        if "=" in arg:
+            k, v = arg.split("=", 1)
+            params[k.lower()] = v  # same normalization as the env path
+    return params
+
+
+def _maybe_init_distributed() -> None:
+    """Multi-host wiring: coordinator env present → jax.distributed."""
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    n = int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1)
+    if not coordinator or n <= 1:
+        return
+    import jax
+
+    pid = int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
+    logger.info(
+        "initializing jax.distributed: coordinator=%s processes=%d id=%d",
+        coordinator, n, pid,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=n, process_id=pid
+    )
+
+
+def main(argv: List[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s",
+    )
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(
+            "usage: python -m cron_operator_tpu.workloads.runner "
+            "<entrypoint> [key=value ...]",
+            file=sys.stderr,
+        )
+        return 2
+    entry_name, rest = argv[0], argv[1:]
+
+    from cron_operator_tpu.backends.registry import (
+        JobContext,
+        resolve_entrypoint,
+    )
+
+    _maybe_init_distributed()
+    fn = resolve_entrypoint(entry_name)
+    ctx = JobContext(
+        name=os.environ.get("TPU_JOB_NAME", entry_name),
+        namespace=os.environ.get("TPU_JOB_NAMESPACE", "default"),
+        job={"metadata": {"name": os.environ.get("TPU_JOB_NAME", entry_name)}},
+        params=_gather_params(rest),
+    )
+    fn(ctx)
+    print(json.dumps({"progress": ctx.progress}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

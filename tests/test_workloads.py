@@ -89,6 +89,25 @@ class TestTrainer:
         assert abs(l1 - l2) < 1e-5
 
 
+class TestRunner:
+    """The container-side entrypoint (workloads/runner.py)."""
+
+    def test_single_process_run(self, capsys):
+        from cron_operator_tpu.workloads import runner
+
+        rc = runner.main(
+            ["mnist", "steps=1", "batch_size=8", "platform=cpu"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert '"steps_done": 1' in out
+
+    def test_usage_error(self):
+        from cron_operator_tpu.workloads import runner
+
+        assert runner.main([]) == 2
+
+
 class TestExecutorRunsTraining:
     """Full loop: JAXJob object → executor → real JAX training → status."""
 
