@@ -36,7 +36,7 @@ def main() -> int:
     api = APIServer()
     scheme = default_scheme()
     manager = Manager(api, max_concurrent_reconciles=10)
-    reconciler = CronReconciler(api)
+    reconciler = CronReconciler(api, metrics=manager.metrics)
     manager.add_controller(
         "cron", reconciler.reconcile, for_gvk=GVK_CRON,
         owns=scheme.workload_kinds(),

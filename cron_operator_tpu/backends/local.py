@@ -211,6 +211,7 @@ class LocalExecutor:
                     obj = self.api.try_get(av, kind, ns, name) or obj
             ctx.job = obj
 
+            ctx.publish = lambda: self._publish_progress(key, ctx)
             self._append_condition(key, "Created", "JobCreated",
                                    f"{kind} {name} is created.")
             self._create_pods(key, obj, ctx)

@@ -33,6 +33,9 @@ class JobContext:
     # entrypoints may publish progress here; the executor folds it into
     # the workload's status (e.g. step counters for observability)
     progress: Dict[str, Any] = field(default_factory=dict)
+    # set by the executor: flushes `progress` into the workload's status
+    # mid-run (entrypoints call it throttled; also called once at job end)
+    publish: Optional[Callable[[], None]] = None
 
     def should_stop(self) -> bool:
         return self.cancel.is_set()

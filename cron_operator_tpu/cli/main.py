@@ -164,7 +164,7 @@ def cmd_start(args: argparse.Namespace) -> int:
         max_concurrent_reconciles=args.max_concurrent_reconciles,
         leader_elect=args.leader_elect,
     )
-    reconciler = CronReconciler(api)
+    reconciler = CronReconciler(api, metrics=manager.metrics)
     manager.add_controller(
         "cron",
         reconciler.reconcile,

@@ -89,9 +89,22 @@ class ReconcileResult:
 class CronReconciler:
     """Reconciles Cron objects against the embedded control plane."""
 
-    def __init__(self, api: APIServer, clock: Optional[Clock] = None):
+    def __init__(self, api: APIServer, clock: Optional[Clock] = None,
+                 metrics: Optional[Any] = None):
         self.api = api
         self.clock = clock or api.clock
+        # Domain metrics (runtime.manager.Metrics-compatible). The reference
+        # exposes only controller-runtime built-ins (SURVEY.md §5 "No custom
+        # metrics are registered — build should add domain metrics").
+        self.metrics = metrics
+        # De-dup state for per-tick (not per-reconcile) metric counting: the
+        # same missed tick is re-observed by every reconcile until it fires
+        # or is superseded.
+        self._last_skipped_tick: Dict[Tuple[str, str], datetime] = {}
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, value)
 
     # -- entry point --------------------------------------------------------
 
@@ -186,7 +199,9 @@ class CronReconciler:
             return ReconcileResult()
 
         try:
-            missed_run, next_run = self._get_next_schedule(cron, now)
+            missed_run, next_run, missed_count = self._get_next_schedule(
+                cron, now
+            )
         except ValueError as err:
             # Bad schedule: don't requeue until a spec update fixes it.
             log.error("cron %s/%s: %s", ns, name, err)
@@ -205,6 +220,11 @@ class CronReconciler:
                 "cron %s/%s: skip tick, concurrency policy Forbid with %d active",
                 ns, name, len(active),
             )
+            # Count each distinct skipped tick once, not once per reconcile
+            # (the same pending tick is re-seen until it fires/expires).
+            if self._last_skipped_tick.get((ns, name)) != missed_run:
+                self._last_skipped_tick[(ns, name)] = missed_run
+                self._count('cron_ticks_skipped_total{policy="Forbid"}')
             return scheduled
 
         if cron.spec.concurrency_policy == ConcurrencyPolicy.REPLACE:
@@ -216,6 +236,7 @@ class CronReconciler:
                         meta.get("namespace", ns), meta.get("name", ""),
                         propagation="Background",
                     )
+                    self._count("cron_workloads_replaced_total")
                 except NotFoundError:
                     pass  # already gone is fine
 
@@ -223,6 +244,12 @@ class CronReconciler:
 
         try:
             self.api.create(workload)
+            self._count("cron_ticks_fired_total")
+            if missed_count > 1:
+                # Ticks the catch-up loop passed over; counted only when the
+                # latest one actually fires (lastScheduleTime advances), so
+                # repeated reconciles of one pending tick don't re-count.
+                self._count("cron_missed_runs_total", float(missed_count - 1))
             log.info(
                 "cron %s/%s: created %s %s",
                 ns, name, gvk.kind, workload["metadata"]["name"],
@@ -310,6 +337,7 @@ class CronReconciler:
                         meta.get("namespace", ""), meta.get("name", ""),
                         propagation="Background",
                     )
+                    self._count("cron_history_gc_deleted_total")
                 except NotFoundError:
                     pass
                 continue
@@ -375,8 +403,8 @@ class CronReconciler:
 
     def _get_next_schedule(
         self, cron: Cron, now: datetime
-    ) -> Tuple[Optional[datetime], datetime]:
-        """(last missed activation or None, next activation) —
+    ) -> Tuple[Optional[datetime], datetime, int]:
+        """(last missed activation or None, next activation, missed count) —
         ``cron_controller.go:389-437``. Evaluates in ``spec.timezone`` when
         set (TPU-native extension; the reference only inherits the container
         timezone)."""
@@ -407,7 +435,7 @@ class CronReconciler:
             earliest = cron.metadata.creation_timestamp or now
 
         if earliest > now:
-            return None, sched.next(localize(now)).astimezone(timezone.utc)
+            return None, sched.next(localize(now)).astimezone(timezone.utc), 0
 
         last_missed: Optional[datetime] = None
         missed = 0
@@ -433,7 +461,7 @@ class CronReconciler:
             )
 
         next_run = sched.next(localize(now)).astimezone(timezone.utc)
-        return last_missed, next_run
+        return last_missed, next_run, missed
 
 
 __all__ = ["CronReconciler", "ReconcileResult", "TOO_MANY_MISSED"]

@@ -44,6 +44,27 @@ def _mesh(ctx: JobContext, devs=None):
     )
 
 
+def _checkpoint_store(ctx: JobContext):
+    """CheckpointStore when the job opts in via param.checkpoint=1; the
+    preemption-recovery path (restart-on-preemption re-runs the entrypoint,
+    which then resumes from the last saved step). param.checkpoint_lineage
+    ("job" default, "family" to continue one run across Forbid ticks)."""
+    if ctx.params.get("checkpoint", "0") not in ("1", "true", "yes"):
+        return None
+    from cron_operator_tpu.workloads.checkpoint import CheckpointStore
+
+    return CheckpointStore(
+        ctx.namespace or "default",
+        ctx.name,
+        root=ctx.params.get("checkpoint_dir"),
+        lineage=ctx.params.get("checkpoint_lineage", "job"),
+    )
+
+
+def _save_every(ctx: JobContext) -> int:
+    return int(ctx.params.get("save_every", 10))
+
+
 def _run(
     ctx: JobContext,
     trainer: Trainer,
@@ -51,19 +72,35 @@ def _run(
     steps: int,
 ) -> None:
     ctx.progress["started_at"] = time.time()
+    if trainer.steps_done:
+        ctx.progress["resumed_from_step"] = trainer.steps_done
+    first_local_step = trainer.steps_done + 1
+    last_publish = [0.0]
 
     def on_step(s: StepStats) -> None:
-        if s.step == 1:
+        if s.step == first_local_step:
             # The north-star timestamp: first optimizer step finished
             # (device-synced — Trainer.step blocks on the loss).
             ctx.progress["first_step_at"] = time.time()
         ctx.progress["steps_done"] = s.step
         ctx.progress["last_loss"] = s.loss
         ctx.progress["last_step_time_s"] = round(s.step_time_s, 4)
+        now = time.time()
+        if ctx.publish is not None and (
+            s.step == first_local_step or now - last_publish[0] > 1.0
+        ):
+            last_publish[0] = now
+            ctx.publish()
 
-    stats = trainer.run(
-        batches, steps, should_stop=ctx.should_stop, on_step=on_step
-    )
+    try:
+        stats = trainer.run(
+            batches, steps, should_stop=ctx.should_stop, on_step=on_step
+        )
+    finally:
+        if trainer.checkpoint is not None:
+            # Orbax managers own background threads; a long-lived executor
+            # runs many ticks, so every store must be released.
+            trainer.checkpoint.close()
     # Steady-state throughput: drop the compile-laden first step.
     tail = stats[1:] if len(stats) > 1 else stats
     if tail:
@@ -88,7 +125,9 @@ def mnist(ctx: JobContext) -> None:
         )["params"]
         trainer = Trainer(
             lambda p, x: model.apply({"params": p}, x), params, mesh,
-            TrainConfig(optimizer="sgd", learning_rate=0.01),
+            TrainConfig(optimizer="sgd", learning_rate=0.01,
+                        save_every=_save_every(ctx)),
+            checkpoint=_checkpoint_store(ctx),
         )
         _run(ctx, trainer, datasets.mnist_batches(batch_size), steps)
 
@@ -111,7 +150,9 @@ def resnet50(ctx: JobContext) -> None:
         )["params"]
         trainer = Trainer(
             lambda p, x: model.apply({"params": p}, x), params, mesh,
-            TrainConfig(optimizer="sgd", learning_rate=0.1),
+            TrainConfig(optimizer="sgd", learning_rate=0.1,
+                        save_every=_save_every(ctx)),
+            checkpoint=_checkpoint_store(ctx),
         )
         _run(
             ctx, trainer, datasets.imagenet_batches(batch_size, image_size),
@@ -147,7 +188,9 @@ def bert(ctx: JobContext) -> None:
                 remat=ctx.params.get("remat", "0") in ("1", "true"),
                 seq_dim_in_batch=1,
                 labels_follow_seq=True,
+                save_every=_save_every(ctx),
             ),
+            checkpoint=_checkpoint_store(ctx),
         )
         _run(
             ctx, trainer,

@@ -44,6 +44,7 @@ class TrainConfig:
     remat: bool = False  # jax.checkpoint the forward (HBM ↔ FLOPs trade)
     seq_dim_in_batch: Optional[int] = None  # dim of x sharded over `seq`
     labels_follow_seq: bool = False  # labels carry the seq dim too (MLM)
+    save_every: int = 0  # checkpoint cadence in steps (0 = never)
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer == "adamw":
@@ -74,9 +75,11 @@ class Trainer:
         mesh: Mesh,
         config: Optional[TrainConfig] = None,
         loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = cross_entropy_loss,
+        checkpoint: Optional[Any] = None,  # workloads.checkpoint.CheckpointStore
     ):
         self.mesh = mesh
         self.config = config or TrainConfig()
+        self.checkpoint = checkpoint
         tx = self.config.make_optimizer()
 
         fwd = apply_fn
@@ -96,6 +99,14 @@ class Trainer:
         self.state_sharding = sharding_for_tree(state, mesh)
         # Lay the state out per the sharding plan before the first step.
         self.state = jax.device_put(state, self.state_sharding)
+        self.steps_done = 0
+        if self.checkpoint is not None:
+            latest = self.checkpoint.latest_step()
+            if latest is not None:
+                # Resume: restore directly into the mesh layout (no host
+                # gather) and continue from the recorded step.
+                self.state = self.checkpoint.restore(latest, self.state)
+                self.steps_done = int(self.state.step)
 
         x_spec = batch_pspec(mesh, seq_dim=self.config.seq_dim_in_batch)
         y_spec = (
@@ -113,7 +124,6 @@ class Trainer:
             out_shardings=(self.state_sharding, NamedSharding(mesh, jax.sharding.PartitionSpec())),
             donate_argnums=(0,),
         )
-        self.steps_done = 0
 
     def put_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
         return {
@@ -126,6 +136,12 @@ class Trainer:
         self.state, loss = self._step(self.state, self.put_batch(batch))
         loss = float(loss)  # blocks; keeps step-time numbers honest
         self.steps_done += 1
+        if (
+            self.checkpoint is not None
+            and self.config.save_every > 0
+            and self.steps_done % self.config.save_every == 0
+        ):
+            self.checkpoint.save(self.steps_done, self.state)
         return StepStats(self.steps_done, loss, time.perf_counter() - t0)
 
     def run(
@@ -135,14 +151,19 @@ class Trainer:
         should_stop: Optional[Callable[[], bool]] = None,
         on_step: Optional[Callable[[StepStats], None]] = None,
     ) -> list:
+        """Train until ``steps_done`` reaches ``steps`` (a TOTAL-step
+        target, so a checkpoint-restored trainer only runs the remainder —
+        preempted work is not repeated)."""
         stats = []
-        for _ in range(steps):
+        while self.steps_done < steps:
             if should_stop is not None and should_stop():
                 break
             s = self.step(next(batches))
             stats.append(s)
             if on_step is not None:
                 on_step(s)
+        if self.checkpoint is not None:
+            self.checkpoint.wait()
         return stats
 
 

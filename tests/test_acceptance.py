@@ -48,7 +48,7 @@ def _workload(kind="JAXJob", annotations=None, replicas=1):
 def stack():
     api = APIServer()
     mgr = Manager(api, max_concurrent_reconciles=10)
-    rec = CronReconciler(api)
+    rec = CronReconciler(api, metrics=mgr.metrics)
     mgr.add_controller(
         "cron", rec.reconcile, for_gvk=GVK_CRON,
         owns=default_scheme().workload_kinds(),
@@ -93,6 +93,11 @@ class TestConfig1TFJobForbid:
         assert max_active == 1
         total = len(_jobs(api, "TFJob"))
         assert 1 <= total <= 3  # ~2.5s each over ~6s, ticks skipped between
+        # Domain metrics: fired ticks and Forbid skips were counted.
+        _, mgr, _ = stack
+        snap = mgr.metrics.snapshot()
+        assert snap.get("cron_ticks_fired_total", 0) == total
+        assert snap.get('cron_ticks_skipped_total{policy="Forbid"}', 0) >= 1
 
 
 class TestConfig2JaxMnistV5e1:

@@ -1,0 +1,140 @@
+"""Checkpoint/resume tests: Orbax-backed TrainState persistence, lineage
+naming, and the full preemption→restart→resume loop through the executor
+(BASELINE.md acceptance config 5's recovery half)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cron_operator_tpu.backends.local import LocalExecutor
+from cron_operator_tpu.models import MLP
+from cron_operator_tpu.parallel.mesh import mesh_for_devices
+from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.utils.clock import RealClock
+from cron_operator_tpu.workloads import data as datasets
+from cron_operator_tpu.workloads.checkpoint import CheckpointStore, job_family
+from cron_operator_tpu.workloads.train import TrainConfig, Trainer
+
+
+def test_job_family_strips_tick_suffix():
+    assert job_family("bert-1785339801") == "bert"
+    assert job_family("my-cron-name-1785339801") == "my-cron-name"
+    # non-tick numeric suffixes stay (too short to be a unix timestamp)
+    assert job_family("resnet-50") == "resnet-50"
+    assert job_family("plain") == "plain"
+
+
+@pytest.fixture
+def cpus():
+    return jax.devices("cpu")
+
+
+def _trainer(cpus, store, save_every=1):
+    mesh = mesh_for_devices(cpus)
+    with jax.default_device(cpus[0]):
+        m = MLP(features=(32,))
+        params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))[
+            "params"
+        ]
+        return Trainer(
+            lambda p, x: m.apply({"params": p}, x), params, mesh,
+            TrainConfig(optimizer="sgd", save_every=save_every),
+            checkpoint=store,
+        )
+
+
+class TestTrainerResume:
+    def test_restore_continues_from_saved_step(self, cpus, tmp_path):
+        it = datasets.mnist_batches(16, seed=9)
+        t1 = _trainer(cpus, CheckpointStore("ns", "job-1785339000",
+                                            root=str(tmp_path)))
+        t1.run(it, steps=3)
+        assert t1.steps_done == 3
+        t1.checkpoint.close()
+
+        # Same cron family, next tick: restores step 3 and runs only 4-5.
+        t2 = _trainer(cpus, CheckpointStore("ns", "job-1785339060",
+                                            root=str(tmp_path)))
+        assert t2.steps_done == 3
+        np.testing.assert_allclose(
+            np.asarray(t1.state.params["Dense_0"]["kernel"]),
+            np.asarray(t2.state.params["Dense_0"]["kernel"]),
+        )
+        stats = t2.run(datasets.mnist_batches(16, seed=9), steps=5)
+        assert [s.step for s in stats] == [4, 5]
+        t2.checkpoint.close()
+
+    def test_target_reached_runs_nothing(self, cpus, tmp_path):
+        store = CheckpointStore("ns", "done-1785339000", root=str(tmp_path))
+        t1 = _trainer(cpus, store)
+        t1.run(datasets.mnist_batches(16), steps=2)
+        t1.checkpoint.close()
+        t2 = _trainer(cpus, CheckpointStore("ns", "done-1785339099",
+                                            root=str(tmp_path)))
+        stats = t2.run(datasets.mnist_batches(16), steps=2)
+        assert stats == [] and t2.steps_done == 2
+        t2.checkpoint.close()
+
+
+class TestPreemptionResume:
+    """Executor loop: preempt a checkpointing job mid-run; the restarted
+    run resumes from the saved step instead of starting over."""
+
+    def test_preempt_then_resume(self, tmp_path):
+        api = APIServer(clock=RealClock())
+        ex = LocalExecutor(api)
+        ex.start()
+        job = {
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {
+                "name": "mnist-pre", "namespace": "default",
+                "annotations": {
+                    "tpu.kubedl.io/entrypoint": "mnist",
+                    "tpu.kubedl.io/restart-on-preemption": "true",
+                    "tpu.kubedl.io/param.steps": "400",
+                    "tpu.kubedl.io/param.batch_size": "8",
+                    "tpu.kubedl.io/param.platform": "cpu",
+                    "tpu.kubedl.io/param.checkpoint": "1",
+                    "tpu.kubedl.io/param.save_every": "5",
+                    "tpu.kubedl.io/param.checkpoint_dir": str(tmp_path),
+                },
+            },
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+        }
+        try:
+            api.create(job)
+            # Wait until some steps are checkpointed.
+            deadline = time.time() + 90.0
+            progressed = 0
+            while time.time() < deadline and progressed < 10:
+                j = api.get("kubeflow.org/v1", "JAXJob", "default", "mnist-pre")
+                progressed = (
+                    (j.get("status") or {})
+                    .get("trainingProgress", {})  # published only at end
+                    .get("steps_done", 0)
+                )
+                store = CheckpointStore("default", "mnist-pre",
+                                        root=str(tmp_path))
+                progressed = store.latest_step() or 0
+                time.sleep(0.3)
+            assert progressed >= 10, "job never checkpointed progress"
+
+            ex.preempt("default", "mnist-pre")
+            # The re-run resumes; wait for resumed_from_step to appear.
+            deadline = time.time() + 90.0
+            resumed = None
+            while time.time() < deadline and resumed is None:
+                j = api.get("kubeflow.org/v1", "JAXJob", "default", "mnist-pre")
+                prog = (j.get("status") or {}).get("trainingProgress") or {}
+                resumed = prog.get("resumed_from_step")
+                # stop the long re-run once we've seen the resume marker
+                time.sleep(0.3)
+            assert resumed is not None and resumed >= 10
+        finally:
+            # Cancel the (long) re-run and shut down.
+            api.delete("kubeflow.org/v1", "JAXJob", "default", "mnist-pre")
+            ex.stop()

@@ -133,7 +133,20 @@ def _make_handler(store: FakeKube):
         def _serve_watch(self, parsed, parts):
             prefix = "/".join(parts)
             sink, event = [], threading.Event()
+            q = parse_qs(parsed.query)
+            from_rv = int(q.get("resourceVersion", ["0"])[0] or 0)
             with store.lock:
+                # Replay anything newer than the requested resourceVersion
+                # (kube watch semantics — events between LIST and WATCH
+                # registration must not be lost).
+                for (p, _), o in store.objects.items():
+                    orv = int((o.get("metadata") or {}).get(
+                        "resourceVersion", 0
+                    ))
+                    if self._prefix_matches(p, prefix) and orv > from_rv:
+                        sink.append({"type": "ADDED", "object": o})
+                if sink:
+                    event.set()
                 store.watchers.append((prefix, sink, event))
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
