@@ -180,11 +180,14 @@ class LocalExecutor:
     def _make_context(self, obj: Dict[str, Any]) -> JobContext:
         meta = obj.get("metadata") or {}
         ann = meta.get("annotations") or {}
-        # Param keys are lowercased everywhere (the env-var transport of the
-        # real-pod path cannot round-trip case; keeping both paths identical
-        # means a Cron behaves the same under either backend).
+        # Param keys share one normalization with the real-pod path (the
+        # env-var transport cannot round-trip case or punctuation; keeping
+        # both paths identical means a Cron behaves the same under either
+        # backend).
+        from cron_operator_tpu.backends.tpu import normalize_param_key
+
         params = {
-            k[len(ANNOTATION_PARAM_PREFIX):].lower(): v
+            normalize_param_key(k[len(ANNOTATION_PARAM_PREFIX):]): v
             for k, v in ann.items()
             if k.startswith(ANNOTATION_PARAM_PREFIX)
         }

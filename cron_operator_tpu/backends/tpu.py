@@ -20,9 +20,17 @@ ct6e). Single source of truth for both the operator and the local runtime.
 
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass
 from math import prod
 from typing import Any, Dict, List, Optional
+
+
+def normalize_param_key(key: str) -> str:
+    """Canonical param-key form shared by every producer/consumer:
+    lowercase, non-identifier chars → ``_`` (env-var-safe)."""
+    return re.sub(r"[^a-z0-9_]", "_", key.lower())
+
 
 ANNOTATION_ACCELERATOR = "tpu.kubedl.io/accelerator"
 ANNOTATION_TOPOLOGY = "tpu.kubedl.io/topology"
@@ -184,8 +192,11 @@ def render_job_env(job: Dict[str, Any]) -> List[Dict[str, Any]]:
     ``tpu.kubedl.io/param.<key>`` annotations become ``TPU_PARAM_<KEY>``
     vars, which ``workloads.runner`` folds back into JobContext.params — so
     real pods train with the Cron's configured hyperparameters, same as the
-    in-process path. Param keys are case-insensitive: every consumer
-    normalizes to lowercase (env vars cannot round-trip case).
+    in-process path. Param keys are case-insensitive and non-identifier
+    characters (``-``, ``.``) map to ``_``: every consumer applies the same
+    normalization (``normalize_param_key``), because env var names cannot
+    round-trip case or punctuation and the kube-apiserver rejects pods whose
+    env names aren't C identifiers.
     """
     meta = job.get("metadata") or {}
     ann = meta.get("annotations") or {}
@@ -193,9 +204,18 @@ def render_job_env(job: Dict[str, Any]) -> List[Dict[str, Any]]:
         {"name": "TPU_JOB_NAME", "value": meta.get("name", "")},
         {"name": "TPU_JOB_NAMESPACE", "value": meta.get("namespace", "default")},
     ]
+    seen: Dict[str, str] = {}
     for key, value in sorted(ann.items()):
         if key.startswith("tpu.kubedl.io/param."):
-            name = key[len("tpu.kubedl.io/param."):].lower()
+            name = normalize_param_key(key[len("tpu.kubedl.io/param."):])
+            if name in seen:
+                # Distinct annotation keys that normalize identically would
+                # silently shadow each other (kubelet last-one-wins).
+                raise ValueError(
+                    f"param annotations {seen[name]!r} and {key!r} both "
+                    f"normalize to {name!r}; rename one"
+                )
+            seen[name] = key
             env.append({"name": f"TPU_PARAM_{name.upper()}", "value": value})
     return env
 

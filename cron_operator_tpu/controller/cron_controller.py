@@ -113,6 +113,9 @@ class CronReconciler:
         raw = self.api.try_get(API_VERSION, KIND_CRON, namespace, name)
         if raw is None:
             log.debug("cron %s/%s not found; skipping", namespace, name)
+            # Drop per-Cron dedup state so a long-lived operator churning
+            # many Crons doesn't leak (ADVICE r1).
+            self._last_skipped_tick.pop((namespace, name), None)
             return ReconcileResult()
 
         old_cron = Cron.from_dict(raw)
@@ -182,6 +185,7 @@ class CronReconciler:
 
         if cron.metadata.deletion_timestamp is not None:
             log.info("cron %s/%s is being deleted", ns, name)
+            self._last_skipped_tick.pop((ns, name), None)
             return ReconcileResult()
 
         if bool(cron.spec.suspend):

@@ -121,9 +121,20 @@ def ring_attention(
     ``seq_axis`` — same code path either way.
     """
     ring = mesh.shape.get(seq_axis, 1)
-    if ring <= 1 or q.shape[1] % ring != 0:
-        # Degenerate ring (or a sequence that doesn't divide — e.g. the
-        # batch-of-1 trace during model.init): plain local attention.
+    if ring > 1 and q.shape[1] % ring != 0:
+        if q.shape[0] > 1:
+            # A real batch with an indivisible sequence would silently
+            # materialize full S×S attention — exactly the OOM/perf cliff
+            # this op exists to avoid. Fail loudly; pad upstream.
+            raise ValueError(
+                f"ring_attention: seq len {q.shape[1]} does not divide the "
+                f"{ring}-way {seq_axis!r} axis; pad the sequence or resize "
+                "the mesh (silent fallback is allowed only for batch-of-1 "
+                "init traces)"
+            )
+        # Batch-of-1 trace during model.init: plain local attention.
+        return _single_device_attention(q, k, v, causal=causal)
+    if ring <= 1:
         return _single_device_attention(q, k, v, causal=causal)
 
     batch_axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)

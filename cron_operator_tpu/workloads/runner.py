@@ -28,14 +28,16 @@ logger = logging.getLogger("workloads.runner")
 
 
 def _gather_params(argv: List[str]) -> Dict[str, str]:
+    from cron_operator_tpu.backends.tpu import normalize_param_key
+
     params: Dict[str, str] = {}
     for key, value in os.environ.items():
         if key.startswith("TPU_PARAM_"):
-            params[key[len("TPU_PARAM_"):].lower()] = value
+            params[normalize_param_key(key[len("TPU_PARAM_"):])] = value
     for arg in argv:
         if "=" in arg:
             k, v = arg.split("=", 1)
-            params[k.lower()] = v  # same normalization as the env path
+            params[normalize_param_key(k)] = v  # same normalization as env
     return params
 
 

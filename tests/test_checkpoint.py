@@ -48,16 +48,20 @@ def _trainer(cpus, store, save_every=1):
 
 class TestTrainerResume:
     def test_restore_continues_from_saved_step(self, cpus, tmp_path):
+        # Cross-tick resume is opt-in (lineage="family"): the default
+        # per-job lineage keeps concurrent Allow/Replace ticks isolated.
         it = datasets.mnist_batches(16, seed=9)
         t1 = _trainer(cpus, CheckpointStore("ns", "job-1785339000",
-                                            root=str(tmp_path)))
+                                            root=str(tmp_path),
+                                            lineage="family"))
         t1.run(it, steps=3)
         assert t1.steps_done == 3
         t1.checkpoint.close()
 
         # Same cron family, next tick: restores step 3 and runs only 4-5.
         t2 = _trainer(cpus, CheckpointStore("ns", "job-1785339060",
-                                            root=str(tmp_path)))
+                                            root=str(tmp_path),
+                                            lineage="family"))
         assert t2.steps_done == 3
         np.testing.assert_allclose(
             np.asarray(t1.state.params["Dense_0"]["kernel"]),
@@ -68,14 +72,29 @@ class TestTrainerResume:
         t2.checkpoint.close()
 
     def test_target_reached_runs_nothing(self, cpus, tmp_path):
-        store = CheckpointStore("ns", "done-1785339000", root=str(tmp_path))
+        store = CheckpointStore("ns", "done-1785339000", root=str(tmp_path),
+                                lineage="family")
         t1 = _trainer(cpus, store)
         t1.run(datasets.mnist_batches(16), steps=2)
         t1.checkpoint.close()
         t2 = _trainer(cpus, CheckpointStore("ns", "done-1785339099",
-                                            root=str(tmp_path)))
+                                            root=str(tmp_path),
+                                            lineage="family"))
         stats = t2.run(datasets.mnist_batches(16), steps=2)
         assert stats == [] and t2.steps_done == 2
+        t2.checkpoint.close()
+
+
+    def test_default_lineage_isolates_ticks(self, cpus, tmp_path):
+        # Default (per-job) lineage: a later tick must NOT see an earlier
+        # tick's checkpoints — Allow/Replace concurrency safety.
+        t1 = _trainer(cpus, CheckpointStore("ns", "iso-1785339000",
+                                            root=str(tmp_path)))
+        t1.run(datasets.mnist_batches(16), steps=2)
+        t1.checkpoint.close()
+        t2 = _trainer(cpus, CheckpointStore("ns", "iso-1785339060",
+                                            root=str(tmp_path)))
+        assert t2.steps_done == 0
         t2.checkpoint.close()
 
 

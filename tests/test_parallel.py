@@ -122,6 +122,18 @@ class TestRingAttention:
             out = ring_attention(q, q, q, mesh)
         assert out.shape == (2, 16, 2, 8)
 
+    def test_indivisible_seq_raises_for_real_batch(self, cpus):
+        """A real batch whose sequence doesn't divide the ring must fail
+        loudly instead of silently materializing S×S attention (ADVICE r1)."""
+        mesh = mesh_for_devices(cpus, seq=8)
+        with jax.default_device(cpus[0]):
+            q = jnp.ones((2, 100, 2, 8))  # 100 % 8 != 0, batch > 1
+            with pytest.raises(ValueError, match="does not divide"):
+                ring_attention(q, q, q, mesh)
+            # batch-of-1 init trace keeps the documented silent fallback
+            q1 = jnp.ones((1, 100, 2, 8))
+            assert ring_attention(q1, q1, q1, mesh).shape == (1, 100, 2, 8)
+
     def test_grad_flows_through_ring(self, cpus):
         """Ring attention must be differentiable (it sits in the train step)."""
         mesh = mesh_for_devices(cpus, seq=2)

@@ -125,3 +125,40 @@ class TestInjection:
         assert addr["value"] == "train-worker-0.train.ns1.svc:8476"
         nproc = next(e for e in env if e["name"] == "JAX_NUM_PROCESSES")
         assert nproc["value"] == "4"
+
+    def test_param_env_names_sanitized(self):
+        """Annotation keys with '-'/'.' must render to C-identifier env names
+        (the kube-apiserver rejects anything else at pod admission) and
+        round-trip through the runner's normalization."""
+        from cron_operator_tpu.backends.tpu import render_job_env
+        from cron_operator_tpu.workloads.runner import _gather_params
+
+        job = {
+            "metadata": {
+                "name": "j", "namespace": "ns",
+                "annotations": {
+                    "tpu.kubedl.io/param.checkpoint-dir": "/ckpt",
+                    "tpu.kubedl.io/param.lr.schedule": "cosine",
+                },
+            }
+        }
+        env = render_job_env(job)
+        names = [e["name"] for e in env if e["name"].startswith("TPU_PARAM_")]
+        assert names == ["TPU_PARAM_CHECKPOINT_DIR", "TPU_PARAM_LR_SCHEDULE"]
+        import re
+        for n in names:
+            assert re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", n)
+        # CLI-arg path applies the same normalization.
+        params = _gather_params(["checkpoint-dir=/ckpt", "lr.schedule=cosine"])
+        assert params == {"checkpoint_dir": "/ckpt", "lr_schedule": "cosine"}
+        # Distinct keys that collide after normalization fail loudly
+        # instead of silently shadowing (kubelet last-one-wins).
+        bad = {
+            "metadata": {"name": "j", "annotations": {
+                "tpu.kubedl.io/param.lr-schedule": "linear",
+                "tpu.kubedl.io/param.lr.schedule": "cosine",
+            }}
+        }
+        import pytest
+        with pytest.raises(ValueError, match="normalize"):
+            render_job_env(bad)
