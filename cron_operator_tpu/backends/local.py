@@ -44,14 +44,35 @@ logger = logging.getLogger("backends.local")
 ANNOTATION_SIMULATE = "tpu.kubedl.io/simulate-duration"
 ANNOTATION_RESTART_ON_PREEMPTION = "tpu.kubedl.io/restart-on-preemption"
 ANNOTATION_PARAM_PREFIX = "tpu.kubedl.io/param."
+# Per-job override of the executor's isolation mode ("thread"|"subprocess").
+ANNOTATION_ISOLATION = "tpu.kubedl.io/isolation"
+# Hard wall-clock budget for one run of the entrypoint (go duration). In
+# subprocess isolation an overrun is a clean SIGTERM→SIGKILL of the child;
+# the operator process is never at risk.
+ANNOTATION_JOB_TIMEOUT = "tpu.kubedl.io/job-timeout"
 
 JobKey = Tuple[str, str, str, str]  # apiVersion, kind, namespace, name
 
+_TERM_GRACE_S = 20.0  # SIGTERM → SIGKILL escalation window
+
 
 class LocalExecutor:
-    """Executes workload objects in-process. See module docstring."""
+    """Executes workload objects in-process. See module docstring.
 
-    def __init__(self, api: APIServer, scheme=None):
+    ``isolation`` picks how entrypoints execute:
+
+    - ``"thread"`` (default): in a worker thread of this process — fastest,
+      shares the warm JAX runtime; cancellation is cooperative only.
+    - ``"subprocess"``: via ``workloads.runner`` in a child process —
+      crash/timeout isolation (a wedged XLA compile is killable without
+      aborting the operator), progress streamed back as JSON lines. This is
+      what bench.py uses so a timed-out job can't poison later runs.
+    """
+
+    def __init__(self, api: APIServer, scheme=None, isolation: str = "thread"):
+        if isolation not in ("thread", "subprocess"):
+            raise ValueError(f"unknown isolation mode {isolation!r}")
+        self.isolation = isolation
         self.api = api
         self.scheme = scheme or default_scheme()
         self._handled_kinds = {
@@ -251,8 +272,12 @@ class LocalExecutor:
         ann = (ctx.job.get("metadata") or {}).get("annotations") or {}
         entry_ref = ann.get(ANNOTATION_ENTRYPOINT)
         if entry_ref:
-            fn = resolve_entrypoint(entry_ref)
-            fn(ctx)
+            mode = ann.get(ANNOTATION_ISOLATION, self.isolation)
+            if mode == "subprocess":
+                self._execute_subprocess(ctx, entry_ref, ann)
+            else:
+                fn = resolve_entrypoint(entry_ref)
+                fn(ctx)
             return
         sim = ann.get(ANNOTATION_SIMULATE)
         if sim:
@@ -261,6 +286,124 @@ class LocalExecutor:
             ctx.cancel.wait(timeout=total)
             return
         # No entrypoint: trivially succeeds (pure scheduling-object mode).
+
+    def _execute_subprocess(
+        self, ctx: JobContext, entry_ref: str, ann: Dict[str, Any]
+    ) -> None:
+        """Run the entrypoint via ``workloads.runner`` in a child process.
+
+        Progress arrives as ``@@CRON_TPU@@ {json}`` stdout lines and is
+        folded into ``ctx.progress`` (then published like the thread path).
+        Cancellation/timeout: SIGTERM (graceful, trainer stops between
+        steps) then SIGKILL after a grace window.
+        """
+        import json as _json
+        import os
+        import subprocess
+        import sys
+        import tempfile
+
+        from cron_operator_tpu.backends.tpu import render_job_env
+        from cron_operator_tpu.workloads.runner import PROGRESS_PREFIX
+
+        env = dict(os.environ)
+        for e in render_job_env(ctx.job):
+            if "value" in e:
+                env[e["name"]] = e["value"]
+
+        timeout: Optional[float] = None
+        if ann.get(ANNOTATION_JOB_TIMEOUT):
+            timeout = parse_go_duration(
+                ann[ANNOTATION_JOB_TIMEOUT]
+            ).total_seconds()
+
+        stderr_file = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=".stderr", prefix=f"{ctx.name}-", delete=False
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cron_operator_tpu.workloads.runner",
+             entry_ref],
+            stdout=subprocess.PIPE, stderr=stderr_file, env=env, text=True,
+        )
+
+        timed_out = threading.Event()
+
+        def _reap() -> None:
+            # SIGTERM on cancel/timeout; SIGKILL if it lingers past grace.
+            import time as _time
+
+            deadline = (
+                _time.monotonic() + timeout if timeout is not None else None
+            )
+            while proc.poll() is None:
+                if ctx.cancel.wait(timeout=0.2):
+                    break
+                if deadline is not None and _time.monotonic() > deadline:
+                    timed_out.set()
+                    break
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=_TERM_GRACE_S)
+                except subprocess.TimeoutExpired:
+                    logger.warning(
+                        "job %s runner pid %d ignored SIGTERM; killing",
+                        ctx.name, proc.pid,
+                    )
+                    proc.kill()
+
+        reaper = threading.Thread(
+            target=_reap, name=f"reap-{ctx.name}", daemon=True
+        )
+        reaper.start()
+
+        error: Optional[Dict[str, Any]] = None
+        try:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                if not line.startswith(PROGRESS_PREFIX):
+                    continue
+                try:
+                    msg = _json.loads(line[len(PROGRESS_PREFIX):])
+                except ValueError:
+                    continue
+                ctx.progress.update(msg.get("progress") or {})
+                if msg.get("type") == "error":
+                    error = msg
+                elif ctx.publish is not None:
+                    ctx.publish()
+        finally:
+            rc = proc.wait()
+            reaper.join(timeout=_TERM_GRACE_S + 5)
+            stderr_file.flush()
+
+        def _stderr_tail(n: int = 30) -> str:
+            try:
+                with open(stderr_file.name) as f:
+                    return "".join(f.readlines()[-n:])
+            except OSError:
+                return ""
+
+        if timed_out.is_set():
+            raise RuntimeError(
+                f"entrypoint {entry_ref!r} exceeded its "
+                f"{ANNOTATION_JOB_TIMEOUT}={ann.get(ANNOTATION_JOB_TIMEOUT)} "
+                f"budget and was terminated; stderr tail:\n{_stderr_tail()}"
+            )
+        if error is not None:
+            raise RuntimeError(
+                f"entrypoint {entry_ref!r} failed in subprocess: "
+                f"{error.get('error')}\n{error.get('traceback', '')}"
+            )
+        if rc != 0 and not ctx.should_stop():
+            raise RuntimeError(
+                f"entrypoint {entry_ref!r} subprocess exited rc={rc}; "
+                f"stderr tail:\n{_stderr_tail()}"
+            )
+        try:
+            os.unlink(stderr_file.name)  # clean exit: nothing to diagnose
+        except OSError:
+            pass
 
     # ---- pod-group modeling ----------------------------------------------
 
@@ -413,4 +556,10 @@ class LocalExecutor:
             )
 
 
-__all__ = ["LocalExecutor", "ANNOTATION_SIMULATE", "ANNOTATION_RESTART_ON_PREEMPTION"]
+__all__ = [
+    "LocalExecutor",
+    "ANNOTATION_SIMULATE",
+    "ANNOTATION_RESTART_ON_PREEMPTION",
+    "ANNOTATION_ISOLATION",
+    "ANNOTATION_JOB_TIMEOUT",
+]

@@ -6,10 +6,19 @@ accumulator — the S×S score matrix never materializes in HBM, so memory is
 O(block_q × block_k) instead of O(S²) and the matmuls stay MXU-shaped
 (block sizes are multiples of the 128-lane tile).
 
+Blocking (round-2 rework of the VMEM-scaling flaw): the grid is
+``(batch*heads, seq/block_q, seq/block_k)`` with the K axis innermost —
+on TPU the grid is executed sequentially minor-to-major, so each program
+sees ONE ``block_k`` slice of K/V in VMEM (Pallas double-buffers the next
+block's DMA behind the current compute) while the running (acc, m, l)
+state lives in VMEM scratch that persists across the K iterations of a
+query block. Peak VMEM is O(block_q·d + 2·block_k·d) regardless of
+sequence length — long-context capable, which is the kernel's reason to
+exist. Causal blocks above the diagonal skip their compute via
+``pl.when`` (the DMA still streams, the MXU work is skipped).
+
 Layout: ``[batch*heads, seq, head_dim]`` inside the kernel (the public
-wrapper reshapes from ``[batch, seq, heads, head_dim]``). Grid =
-``(batch*heads, seq/block_q)``; the K/V block loop is a ``lax.fori_loop``
-with causal early-exit (upper-triangular K blocks are skipped entirely).
+wrapper reshapes from ``[batch, seq, heads, head_dim]``).
 
 On non-TPU backends the same kernel runs under ``interpret=True`` (used by
 the CPU test suite); production CPU paths should call
@@ -33,53 +42,60 @@ NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() exact-zero
                  # without -inf − -inf = nan hazards inside the kernel
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
-    """One (batch*head, q-block) program: stream K/V blocks, online softmax."""
-    block_q, head_dim = q_ref.shape[-2], q_ref.shape[-1]
-    seq_k = k_ref.shape[-2]
-    n_kblocks = seq_k // block_k
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, block_q: int, block_k: int, n_kblocks: int, causal: bool, scale: float,
+):
+    """One (bh, qi, ki) program: fold K/V block ``ki`` into the running
+    online-softmax state for query block ``qi``; emit on the last ``ki``."""
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, d]
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        o, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    # Causal: a K block strictly above the diagonal contributes nothing.
+    q_last = (qi + 1) * block_q - 1  # last query position in this block
+    k_first = ki * block_k
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale        # [block_q, d]
+        k_blk = k_ref[0].astype(jnp.float32)            # [block_k, d]
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
 
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = j * block_k + lax.broadcasted_iota(
+            k_pos = k_first + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
 
+        m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        o_new = o * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
-        return o_new, m_new, l_new
-
-    o0 = jnp.zeros((block_q, head_dim), jnp.float32)
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
 
     if causal:
-        # Blocks strictly above the diagonal contribute nothing — skip them.
-        last = jnp.minimum(
-            ((qi + 1) * block_q + block_k - 1) // block_k, n_kblocks
-        )
-        o, m, l = lax.fori_loop(0, last, body, (o0, m0, l0))
+        pl.when(k_first <= q_last)(compute)
     else:
-        o, m, l = lax.fori_loop(0, n_kblocks, body, (o0, m0, l0))
+        compute()
 
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (o / l).astype(o_ref.dtype)
+    @pl.when(ki == n_kblocks - 1)
+    def _emit():
+        l = l_ref[...]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros, not NaN
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -116,29 +132,41 @@ def flash_attention(
 
     qr, kr, vr = to_bhsd(q), to_bhsd(k), to_bhsd(v)
 
-    grid = (b * h, s // block_q)
+    n_kblocks = s // block_k
+    grid = (b * h, s // block_q, n_kblocks)
     out = pl.pallas_call(
         functools.partial(
-            _flash_kernel, block_k=block_k, causal=causal, scale=scale
+            _flash_kernel,
+            block_q=block_q, block_k=block_k, n_kblocks=n_kblocks,
+            causal=causal, scale=scale,
         ),
         grid=grid,
         in_specs=[
+            # Q block: constant across the (innermost) K iterations — the
+            # pipeline keeps it resident, only K/V re-DMA per step.
             pl.BlockSpec(
-                (1, block_q, d), lambda bh, i: (bh, i, 0),
+                (1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, s, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM
+                (1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
+                memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
-                (1, s, d), lambda bh, i: (bh, 0, 0), memory_space=pltpu.VMEM
+                (1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
+                memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda bh, i: (bh, i, 0),
+            (1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),  # normalizer l
+        ],
         interpret=interpret,
     )(qr, kr, vr)
 

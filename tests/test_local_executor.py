@@ -213,3 +213,53 @@ class TestPreemption:
         wait_for(lambda: "Succeeded" in conditions_of(rt_api, "phoenix"))
         conds = conditions_of(rt_api, "phoenix")
         assert "Restarting" in conds
+
+
+class TestSubprocessIsolation:
+    """Subprocess execution mode: progress streams back as JSON lines, and
+    a wedged/slow child is killable without touching the operator process
+    (the round-1 bench postmortem's fix)."""
+
+    def test_mnist_runs_and_streams_progress(self, rt_api):
+        ex = LocalExecutor(rt_api, isolation="subprocess")
+        ex.start()
+        try:
+            rt_api.create(jax_job("sub-mnist", annotations={
+                "tpu.kubedl.io/entrypoint": "mnist",
+                "tpu.kubedl.io/param.steps": "3",
+                "tpu.kubedl.io/param.batch_size": "8",
+                "tpu.kubedl.io/param.platform": "cpu",
+            }))
+            wait_for(
+                lambda: "Succeeded" in conditions_of(rt_api, "sub-mnist"),
+                timeout=120.0, interval=0.2,
+            )
+            prog = rt_api.get(JAX_AV, JAX_KIND, "default", "sub-mnist")[
+                "status"]["trainingProgress"]
+            assert prog["steps_done"] == 3
+            assert prog["first_step_at"] > 0
+        finally:
+            ex.stop()
+
+    def test_timeout_kills_child_and_fails_job(self, rt_api):
+        ex = LocalExecutor(rt_api, isolation="subprocess")
+        ex.start()
+        try:
+            rt_api.create(jax_job("sub-slow", annotations={
+                "tpu.kubedl.io/entrypoint": "mnist",
+                "tpu.kubedl.io/param.steps": "100000",
+                "tpu.kubedl.io/param.batch_size": "8",
+                "tpu.kubedl.io/param.platform": "cpu",
+                "tpu.kubedl.io/job-timeout": "3s",
+            }))
+            wait_for(
+                lambda: "Failed" in conditions_of(rt_api, "sub-slow"),
+                timeout=120.0, interval=0.2,
+            )
+            status = rt_api.get(JAX_AV, JAX_KIND, "default", "sub-slow")[
+                "status"]
+            failed = [c for c in status["conditions"]
+                      if c["type"] == "Failed"][0]
+            assert "budget" in failed["message"]
+        finally:
+            ex.stop()
