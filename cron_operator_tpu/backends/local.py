@@ -43,7 +43,6 @@ logger = logging.getLogger("backends.local")
 
 ANNOTATION_SIMULATE = "tpu.kubedl.io/simulate-duration"
 ANNOTATION_RESTART_ON_PREEMPTION = "tpu.kubedl.io/restart-on-preemption"
-ANNOTATION_PARAM_PREFIX = "tpu.kubedl.io/param."
 # Per-job override of the executor's isolation mode ("thread"|"subprocess").
 ANNOTATION_ISOLATION = "tpu.kubedl.io/isolation"
 # Hard wall-clock budget for one run of the entrypoint (go duration). In
@@ -187,7 +186,22 @@ class LocalExecutor:
         with self._lock:
             if key in self._jobs:
                 return
+        try:
             ctx = self._make_context(obj)
+        except ValueError as err:
+            # Malformed annotations (e.g. colliding param keys): the job
+            # fails visibly instead of running with shadowed params.
+            try:
+                self._append_condition(
+                    key, "Failed", "InvalidJobSpec", str(err),
+                    extra={"completionTime": rfc3339(self.api.clock.now())},
+                )
+            except NotFoundError:
+                pass
+            return
+        with self._lock:
+            if key in self._jobs:
+                return
             self._jobs[key] = ctx
             t = threading.Thread(
                 target=self._run_job, args=(key, ctx),
@@ -201,17 +215,13 @@ class LocalExecutor:
     def _make_context(self, obj: Dict[str, Any]) -> JobContext:
         meta = obj.get("metadata") or {}
         ann = meta.get("annotations") or {}
-        # Param keys share one normalization with the real-pod path (the
-        # env-var transport cannot round-trip case or punctuation; keeping
-        # both paths identical means a Cron behaves the same under either
-        # backend).
-        from cron_operator_tpu.backends.tpu import normalize_param_key
+        # Params share one producer with the real-pod/subprocess path
+        # (ADVICE r2: both isolation modes must agree — this raises on
+        # colliding keys exactly like render_job_env does, so a Cron behaves
+        # the same under either backend).
+        from cron_operator_tpu.backends.tpu import params_from_annotations
 
-        params = {
-            normalize_param_key(k[len(ANNOTATION_PARAM_PREFIX):]): v
-            for k, v in ann.items()
-            if k.startswith(ANNOTATION_PARAM_PREFIX)
-        }
+        params = params_from_annotations(ann)
         return JobContext(
             name=meta.get("name", ""),
             namespace=meta.get("namespace", ""),
@@ -335,13 +345,21 @@ class LocalExecutor:
             deadline = (
                 _time.monotonic() + timeout if timeout is not None else None
             )
+            deadline_lapsed = False
             while proc.poll() is None:
                 if ctx.cancel.wait(timeout=0.2):
                     break
                 if deadline is not None and _time.monotonic() > deadline:
-                    timed_out.set()
+                    deadline_lapsed = True
                     break
             if proc.poll() is None:
+                # Flag the timeout only when we are actually cutting a live
+                # child short — one that exited right at the deadline
+                # completed its work (ADVICE r2). A SIGTERM'd trainer may
+                # still exit rc=0 (graceful stop between steps); timed_out,
+                # not rc, is what marks the run truncated.
+                if deadline_lapsed:
+                    timed_out.set()
                 proc.terminate()
                 try:
                     proc.wait(timeout=_TERM_GRACE_S)
@@ -384,26 +402,32 @@ class LocalExecutor:
             except OSError:
                 return ""
 
-        if timed_out.is_set():
-            raise RuntimeError(
-                f"entrypoint {entry_ref!r} exceeded its "
-                f"{ANNOTATION_JOB_TIMEOUT}={ann.get(ANNOTATION_JOB_TIMEOUT)} "
-                f"budget and was terminated; stderr tail:\n{_stderr_tail()}"
-            )
-        if error is not None:
-            raise RuntimeError(
-                f"entrypoint {entry_ref!r} failed in subprocess: "
-                f"{error.get('error')}\n{error.get('traceback', '')}"
-            )
-        if rc != 0 and not ctx.should_stop():
-            raise RuntimeError(
-                f"entrypoint {entry_ref!r} subprocess exited rc={rc}; "
-                f"stderr tail:\n{_stderr_tail()}"
-            )
         try:
-            os.unlink(stderr_file.name)  # clean exit: nothing to diagnose
-        except OSError:
-            pass
+            if timed_out.is_set():
+                raise RuntimeError(
+                    f"entrypoint {entry_ref!r} exceeded its "
+                    f"{ANNOTATION_JOB_TIMEOUT}="
+                    f"{ann.get(ANNOTATION_JOB_TIMEOUT)} "
+                    f"budget and was terminated; stderr tail:\n{_stderr_tail()}"
+                )
+            if error is not None:
+                raise RuntimeError(
+                    f"entrypoint {entry_ref!r} failed in subprocess: "
+                    f"{error.get('error')}\n{error.get('traceback', '')}"
+                )
+            if rc != 0 and not ctx.should_stop():
+                raise RuntimeError(
+                    f"entrypoint {entry_ref!r} subprocess exited rc={rc}; "
+                    f"stderr tail:\n{_stderr_tail()}"
+                )
+        finally:
+            # The tail is folded into the raised message (and thence the
+            # Failed condition); the file itself must not leak per run of a
+            # long-lived operator with a repeatedly failing cron (ADVICE r2).
+            try:
+                os.unlink(stderr_file.name)
+            except OSError:
+                pass
 
     # ---- pod-group modeling ----------------------------------------------
 

@@ -186,6 +186,31 @@ def render_coordinator_env(
     ]
 
 
+PARAM_ANNOTATION_PREFIX = "tpu.kubedl.io/param."
+
+
+def params_from_annotations(ann: Dict[str, str]) -> Dict[str, str]:
+    """Normalized hyperparameter dict from ``tpu.kubedl.io/param.<key>``
+    annotations — the ONE producer both isolation modes use (ADVICE r2:
+    thread and subprocess paths must agree on collision handling). Distinct
+    annotation keys that normalize identically would silently shadow each
+    other (kubelet last-one-wins), so that raises."""
+    params: Dict[str, str] = {}
+    seen: Dict[str, str] = {}
+    for key, value in sorted(ann.items()):
+        if not key.startswith(PARAM_ANNOTATION_PREFIX):
+            continue
+        name = normalize_param_key(key[len(PARAM_ANNOTATION_PREFIX):])
+        if name in seen:
+            raise ValueError(
+                f"param annotations {seen[name]!r} and {key!r} both "
+                f"normalize to {name!r}; rename one"
+            )
+        seen[name] = key
+        params[name] = value
+    return params
+
+
 def render_job_env(job: Dict[str, Any]) -> List[Dict[str, Any]]:
     """Job identity + hyperparameter env for the container runner.
 
@@ -204,19 +229,8 @@ def render_job_env(job: Dict[str, Any]) -> List[Dict[str, Any]]:
         {"name": "TPU_JOB_NAME", "value": meta.get("name", "")},
         {"name": "TPU_JOB_NAMESPACE", "value": meta.get("namespace", "default")},
     ]
-    seen: Dict[str, str] = {}
-    for key, value in sorted(ann.items()):
-        if key.startswith("tpu.kubedl.io/param."):
-            name = normalize_param_key(key[len("tpu.kubedl.io/param."):])
-            if name in seen:
-                # Distinct annotation keys that normalize identically would
-                # silently shadow each other (kubelet last-one-wins).
-                raise ValueError(
-                    f"param annotations {seen[name]!r} and {key!r} both "
-                    f"normalize to {name!r}; rename one"
-                )
-            seen[name] = key
-            env.append({"name": f"TPU_PARAM_{name.upper()}", "value": value})
+    for name, value in params_from_annotations(ann).items():
+        env.append({"name": f"TPU_PARAM_{name.upper()}", "value": value})
     return env
 
 
@@ -282,6 +296,7 @@ __all__ = [
     "slice_for_shorthand",
     "render_coordinator_env",
     "render_job_env",
+    "params_from_annotations",
     "inject_tpu_topology",
     "LABEL_REPLICA_INDEX",
     "LABEL_WORKER_INDEX",

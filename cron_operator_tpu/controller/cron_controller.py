@@ -57,6 +57,7 @@ from cron_operator_tpu.controller.workload import (
     new_empty_workload,
     sort_by_creation_timestamp,
 )
+from cron_operator_tpu.backends.tpu import inject_tpu_topology
 from cron_operator_tpu.runtime.kube import (
     AlreadyExistsError,
     APIServer,
@@ -231,6 +232,23 @@ class CronReconciler:
                 self._count('cron_ticks_skipped_total{policy="Forbid"}')
             return scheduled
 
+        # Validate TPU annotations BEFORE any destructive concurrency action:
+        # with Replace policy, deleting the healthy active workload and then
+        # failing admission would leave nothing running. Dry-run on a copy —
+        # the real injection below only differs in instance name/namespace,
+        # which cannot affect validity.
+        try:
+            inject_tpu_topology(copy.deepcopy(workload_tpl))
+        except ValueError as err:
+            self.api.record_event(
+                cron.to_dict(),
+                "Warning",
+                "FailedTPUAdmission",
+                f"invalid TPU annotations on workload template: {err}",
+            )
+            log.error("cron %s/%s: TPU admission failed: %s", ns, name, err)
+            return scheduled
+
         if cron.spec.concurrency_policy == ConcurrencyPolicy.REPLACE:
             for w in active:
                 meta = w.get("metadata") or {}
@@ -245,6 +263,23 @@ class CronReconciler:
                     pass  # already gone is fine
 
         workload = self._new_workload_from_template(cron, workload_tpl, next_run)
+
+        # TPU admission (SURVEY.md §7 step 4b). The reference hands its
+        # template to the external training-operator verbatim
+        # (``cron_controller.go:349-387``); our build owns the TPU seam, so
+        # scheduling metadata (nodeSelectors, chip resources, replicas=hosts,
+        # coordinator env) must be present on the object we POST — in BOTH
+        # cluster and embedded modes. inject_tpu_topology is idempotent and a
+        # no-op for non-TPU workloads, so the LocalExecutor's own call (which
+        # covers workloads created outside this controller) stays safe.
+        # Cannot raise: the template was dry-run-validated above.
+        tpu_spec = inject_tpu_topology(workload)
+        if tpu_spec is not None:
+            log.debug(
+                "cron %s/%s: TPU admission %s %s → %d host(s) × %d chip(s)",
+                ns, name, tpu_spec.accelerator, tpu_spec.topology,
+                tpu_spec.hosts, tpu_spec.chips_per_host,
+            )
 
         try:
             self.api.create(workload)

@@ -34,6 +34,9 @@ class BertConfig:
     max_len: int = 512
     dtype: Any = jnp.bfloat16
     attention_impl: str = "auto"  # auto | flash | xla | ring
+    # Run the Pallas kernels under the interpreter — CPU tests of the flash
+    # path (forward AND backward) through the full model; never set on TPU.
+    attention_interpret: bool = False
 
     @staticmethod
     def base(**overrides) -> "BertConfig":
@@ -67,7 +70,8 @@ class EncoderLayer(nn.Module):
         )(y)
         q, k, v = (qkv[:, :, i] for i in range(3))  # each [b, s, h, d]
         attn = multi_head_attention(
-            q, k, v, impl=cfg.attention_impl, mesh=self.mesh
+            q, k, v, impl=cfg.attention_impl, mesh=self.mesh,
+            interpret=cfg.attention_interpret,
         )
         attn = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"

@@ -226,6 +226,73 @@ class TestConcurrencyPolicies:
         assert jobs[0]["metadata"]["name"] != first
 
 
+class TestTPUAdmissionOnControllerPath:
+    """The controller-side admission seam (VERDICT r2 #1): workloads the
+    reconciler creates already carry TPU scheduling metadata, and invalid
+    TPU templates never destroy a healthy Replace-policy workload."""
+
+    def tpu_template(self, extra_ann=None):
+        tpl = jax_template()
+        ann = {
+            "tpu.kubedl.io/accelerator": "v5e",
+            "tpu.kubedl.io/topology": "4x4",
+        }
+        ann.update(extra_ann or {})
+        tpl["metadata"]["annotations"] = ann
+        return tpl
+
+    def test_created_workload_carries_tpu_metadata(
+        self, api, fake_clock, reconciler
+    ):
+        make_cron(api, template=self.tpu_template())
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        (job,) = list_jobs(api)
+        worker = job["spec"]["replicaSpecs"]["Worker"]
+        assert worker["replicas"] == 4  # v5e 4x4 = 4 hosts
+        sel = worker["template"]["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x4"
+        env = {e["name"] for e in
+               worker["template"]["spec"]["containers"][0]["env"]}
+        assert {"JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"} <= env
+
+    def test_invalid_tpu_template_fires_event_no_create(
+        self, api, fake_clock, reconciler
+    ):
+        make_cron(api, template=self.tpu_template(
+            {"tpu.kubedl.io/param.lr": "1", "tpu.kubedl.io/param.LR": "2"}
+        ))
+        fake_clock.advance(timedelta(minutes=2))
+        result = reconciler.reconcile("default", "demo")
+        assert list_jobs(api) == []
+        assert result.requeue_after is not None  # keeps ticking
+        assert any(e.reason == "FailedTPUAdmission" for e in api.events())
+
+    def test_replace_not_destructive_on_invalid_template(
+        self, api, fake_clock, reconciler
+    ):
+        """Replace must validate before it deletes: a healthy active job
+        survives a tick whose template cannot pass admission."""
+        make_cron(api, policy="Replace", template=self.tpu_template())
+        fake_clock.advance(timedelta(minutes=2))
+        reconciler.reconcile("default", "demo")
+        (job,) = list_jobs(api)
+        running = job["metadata"]["name"]
+        # Break the template: two param keys that normalize identically.
+        cron = get_cron(api)
+        ann = cron["spec"]["template"]["workload"]["metadata"]["annotations"]
+        ann["tpu.kubedl.io/param.lr"] = "1"
+        ann["tpu.kubedl.io/param.LR"] = "2"
+        api.update(cron)
+        fake_clock.advance(timedelta(minutes=1))
+        reconciler.reconcile("default", "demo")
+        names = [j["metadata"]["name"] for j in list_jobs(api)]
+        assert names == [running], (
+            "active workload must survive failed admission"
+        )
+
+
 class TestStatusSync:
     def test_active_list_sorted_with_refs(self, api, fake_clock, reconciler):
         make_cron(api)

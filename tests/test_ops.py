@@ -105,6 +105,121 @@ class TestFlashUnderSharding:
         assert jnp.max(jnp.abs(out - ref)) < 2e-5
 
 
+class TestFlashGrad:
+    """VERDICT r2 #2: the kernel must be differentiable — BERT's train step
+    auto-selects flash inside value_and_grad on TPU. Gradients of the Pallas
+    flash-2 backward vs the dense reference, interpret mode on CPU."""
+
+    @pytest.fixture(scope="class")
+    def small_qkv(self, cpu0):
+        with jax.default_device(cpu0):
+            key = jax.random.PRNGKey(11)
+            b, s, h, d = 1, 256, 1, 32
+            return tuple(
+                jax.random.normal(k, (b, s, h, d), jnp.float32)
+                for k in jax.random.split(key, 3)
+            )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, small_qkv, cpu0, causal):
+        q, k, v = small_qkv
+        with jax.default_device(cpu0):
+            def loss_flash(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, causal=causal,
+                                    interpret=True) ** 2
+                )
+
+            def loss_ref(q, k, v):
+                return jnp.sum(
+                    reference_attention(q, k, v, causal=causal) ** 2
+                )
+
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            denom = jnp.max(jnp.abs(b))
+            assert jnp.max(jnp.abs(a - b)) / denom < 1e-4
+
+    def test_grads_small_blocks(self, small_qkv, cpu0):
+        # block 64 < seq 256: the accumulators fold multiple blocks on both
+        # grid axes in the backward passes too.
+        q, k, v = small_qkv
+        with jax.default_device(cpu0):
+            def loss_flash(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, causal=True, block_q=64,
+                                    block_k=64, interpret=True) ** 2
+                )
+
+            def loss_ref(q, k, v):
+                return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+            gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b)) < 1e-4
+
+    def test_grad_under_shard_map(self, cpu0):
+        # custom_vjp must compose with the shard_map placement wrapper —
+        # the sharded train step differentiates through _sharded_flash.
+        from cron_operator_tpu.parallel.mesh import mesh_for_devices
+
+        mesh = mesh_for_devices(jax.devices("cpu"))  # 8-way data axis
+        key = jax.random.PRNGKey(5)
+        q, k, v = (
+            jax.random.normal(kk, (8, 128, 1, 32), jnp.float32)
+            for kk in jax.random.split(key, 3)
+        )
+
+        def loss_flash(q, k, v):
+            return jnp.sum(multi_head_attention(
+                q, k, v, causal=True, impl="flash", mesh=mesh,
+                interpret=True,
+            ) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b)) < 1e-4
+
+    def test_bert_train_grads_flash_vs_xla(self, cpu0):
+        """The done-criterion from VERDICT r2 #2: jax.grad through BERT with
+        attention=flash matches the xla path numerically."""
+        import numpy as np
+
+        from cron_operator_tpu.models.bert import Bert, BertConfig
+
+        with jax.default_device(cpu0):
+            ids = jnp.asarray(
+                np.random.RandomState(0).randint(0, 1024, (2, 128))
+            )
+            grads = {}
+            for impl in ("flash", "xla"):
+                cfg = BertConfig.tiny(
+                    dtype=jnp.float32, attention_impl=impl,
+                    attention_interpret=(impl == "flash"),
+                )
+                model = Bert(cfg)
+                params = model.init(jax.random.PRNGKey(0), ids)
+
+                def loss(p):
+                    logits = model.apply(p, ids)
+                    return jnp.mean(
+                        jnp.sum(jax.nn.log_softmax(logits) ** 2, axis=-1)
+                    )
+
+                grads[impl] = jax.grad(loss)(params)
+        flat_f = jax.tree_util.tree_leaves(grads["flash"])
+        flat_x = jax.tree_util.tree_leaves(grads["xla"])
+        for a, b in zip(flat_f, flat_x):
+            scale = float(jnp.max(jnp.abs(b))) or 1.0
+            assert float(jnp.max(jnp.abs(a - b))) / scale < 5e-4
+
+
 class TestDispatch:
     def test_xla_impl(self, qkv, cpu0):
         q, k, v = qkv
