@@ -1,0 +1,26 @@
+# Operator image — the reference's two-stage build (Dockerfile:1-29,
+# ENTRYPOINT ["/manager"]) re-done for the Python control plane: build a
+# wheel in a throwaway stage, install it into a slim runtime, run as the
+# unprivileged nobody user. The operator's cluster mode needs only stdlib +
+# PyYAML (the JAX compute stack lives in the workload image), so this image
+# stays small.
+#
+# Build:  docker build -t cron-operator-tpu:latest .
+# The chart (charts/cron-operator-tpu) and deploy/operator.yaml reference
+# this image name.
+FROM python:3.12-slim AS builder
+
+WORKDIR /src
+COPY pyproject.toml ./
+COPY cron_operator_tpu/ cron_operator_tpu/
+RUN pip wheel --no-cache-dir --no-deps --wheel-dir /wheels .
+
+FROM python:3.12-slim
+
+COPY --from=builder /wheels /wheels
+RUN pip install --no-cache-dir /wheels/*.whl pyyaml && rm -rf /wheels
+
+USER 65534:65534
+
+ENTRYPOINT ["cron-operator-tpu"]
+CMD ["start", "--api-server=cluster", "--backend=none"]

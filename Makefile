@@ -1,0 +1,34 @@
+# Development entry points — reference Makefile analog (its test/build
+# targets, minus the Go toolchain).
+
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench
+
+all: gate
+
+# Full commit gate: syntax, codegen drift, chart render, test suite.
+gate:
+	bash hack/ci_gate.sh
+
+test:
+	python -m pytest tests/ -q
+
+# Regenerate CRD manifests into deploy/crds and the chart (make manifests).
+manifests:
+	python -m cron_operator_tpu.api.crd
+
+# Render the chart with default values (helm template analog).
+chart:
+	python -m cron_operator_tpu.utils.helmtmpl charts/cron-operator-tpu
+
+docker-build:
+	docker build -t cron-operator-tpu:latest .
+
+docker-build-workloads:
+	docker build -f Dockerfile.workloads -t cron-operator-tpu-workloads:latest .
+
+# Multi-chip sharding compile check on a virtual 8-device CPU mesh.
+dryrun:
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	python bench.py

@@ -51,6 +51,8 @@ IMAGE = int(os.environ.get("BENCH_IMAGE", "224"))
 CPU_BATCH = int(os.environ.get("BENCH_CPU_BATCH", "8"))
 CPU_IMAGE = int(os.environ.get("BENCH_CPU_IMAGE", "128"))
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "2"))
+PROBE_BACKOFF_S = float(os.environ.get("BENCH_PROBE_BACKOFF", "15"))
 PREWARM_TIMEOUT_S = float(os.environ.get("BENCH_PREWARM_TIMEOUT", "600"))
 MEASURE_TIMEOUT_S = float(os.environ.get("BENCH_MEASURE_TIMEOUT", "240"))
 
@@ -68,11 +70,17 @@ def _flops_per_image(image: int) -> float:
     return RESNET50_TRAIN_FLOPS_224 * (image / 224.0) ** 2
 
 
-def _probe_devices(timeout: float):
+def _probe_devices(timeout: float, attempts: int = PROBE_ATTEMPTS):
     """Ask a child process what accelerator is actually reachable.
 
     Returns (platform_arg, info dict). ``platform_arg`` is None for the
     default (TPU) platform or "cpu" for the fallback.
+
+    Bounded retry ladder (VERDICT r2 #3): the tunneled TPU init sometimes
+    hangs transiently; every attempt's timing/stderr is recorded in
+    ``info["attempts"]`` so the artifact is self-evidencing — a CPU number
+    carries the proof that the device never initialized (infra, not
+    framework).
     """
     code = (
         "import json, jax\n"
@@ -80,30 +88,49 @@ def _probe_devices(timeout: float):
         "print(json.dumps({'backend': jax.default_backend(),"
         " 'n': len(d), 'kind': d[0].device_kind}))\n"
     )
-    t0 = time.time()
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        return "cpu", {
-            "ok": False,
-            "error": f"device init exceeded {timeout:.0f}s (tunnel hang); "
-                     "falling back to cpu",
-        }
-    if out.returncode != 0:
-        return "cpu", {
-            "ok": False,
-            "error": f"device probe rc={out.returncode}: "
-                     f"{(out.stderr or '').strip()[-500:]}",
-        }
-    info = json.loads(out.stdout.strip().splitlines()[-1])
-    info["ok"] = True
-    info["init_s"] = round(time.time() - t0, 1)
-    if info["backend"] == "cpu":
-        return "cpu", info
-    return None, info
+    history = []
+    for attempt in range(1, attempts + 1):
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired as exc:
+            history.append({
+                "attempt": attempt,
+                "elapsed_s": round(time.time() - t0, 1),
+                "error": f"device init exceeded {timeout:.0f}s (tunnel hang)",
+                "stderr_tail": ((exc.stderr or b"").decode("utf-8", "replace")
+                                if isinstance(exc.stderr, bytes)
+                                else (exc.stderr or ""))[-300:],
+            })
+            if attempt < attempts:
+                time.sleep(PROBE_BACKOFF_S)
+            continue
+        if out.returncode != 0:
+            history.append({
+                "attempt": attempt,
+                "elapsed_s": round(time.time() - t0, 1),
+                "error": f"device probe rc={out.returncode}",
+                "stderr_tail": (out.stderr or "").strip()[-500:],
+            })
+            # A non-zero exit is deterministic (import/plugin failure), not
+            # a tunnel hang — retrying would fail identically; fall back now.
+            break
+        info = json.loads(out.stdout.strip().splitlines()[-1])
+        info["ok"] = True
+        info["init_s"] = round(time.time() - t0, 1)
+        info["attempts"] = history + [
+            {"attempt": attempt, "elapsed_s": info["init_s"], "ok": True}
+        ]
+        return ("cpu" if info["backend"] == "cpu" else None), info
+    return "cpu", {
+        "ok": False,
+        "error": f"device init failed in {attempts} attempt(s); "
+                 "falling back to cpu",
+        "attempts": history,
+    }
 
 
 def _prewarm(platform, batch: int, image: int, timeout: float):
@@ -347,8 +374,12 @@ def main() -> int:
     peak = next(
         (v for k, v in PEAK_FLOPS.items() if k in kind), None
     )
+    # images_per_s is whole-job throughput across the mesh; peak is
+    # per-chip, so scale by device count or multi-chip MFU inflates by
+    # n_devices× (ADVICE r2).
+    n_chips = probe.get("n") or 1
     mfu = (
-        round(images_per_s * _flops_per_image(image) / peak, 4)
+        round(images_per_s * _flops_per_image(image) / (peak * n_chips), 4)
         if images_per_s and peak else None
     )
     extra.update({

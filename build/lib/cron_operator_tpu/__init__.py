@@ -1,0 +1,31 @@
+"""cron_operator_tpu — a TPU-native cron-scheduling framework for ML training.
+
+A from-scratch rebuild of the capability set of
+``AliyunContainerService/cron-operator`` (a Kubernetes operator that launches
+Kubeflow training jobs on cron schedules), redesigned TPU-first:
+
+- ``api``        — the ``Cron`` resource model (group ``apps.kubedl.io/v1alpha1``)
+                   and the Kubeflow-compatible JobStatus condition contract.
+- ``controller`` — the reconciler (concurrency policies, missed-run catch-up,
+                   history retention/GC) and the cron schedule engine.
+- ``runtime``    — the embedded control-plane runtime: an in-memory
+                   Kubernetes-style object store with watches, owner-reference
+                   garbage collection and events, plus the manager that wires
+                   controllers to it.
+- ``backends``   — workload backends. Unlike the reference (which hands
+                   workloads to an external training-operator), this framework
+                   ships a local training runtime that executes JAXJobs
+                   in-process on TPU, plus TPU slice topology modeling
+                   (v5e/v5p shapes, gang semantics, preemption).
+- ``models``     — flagship JAX/Flax training workloads (MNIST, ResNet-50,
+                   BERT) used by examples, benchmarks and tests.
+- ``parallel``   — device-mesh construction and sharding strategies
+                   (DP/FSDP/TP/SP) over ``jax.sharding`` + ``shard_map``.
+- ``ops``        — Pallas TPU kernels and fused ops (ring attention, ...).
+- ``utils``      — logging, metrics, checkpointing helpers.
+
+Reference parity map lives in SURVEY.md; citations in docstrings point at
+``/root/reference`` file:line.
+"""
+
+__version__ = "0.1.0"

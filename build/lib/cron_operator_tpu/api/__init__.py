@@ -1,0 +1,47 @@
+"""API layer: resource types, group/version registration, status contract.
+
+Mirrors the reference's ``api/v1alpha1`` package
+(``/root/reference/api/v1alpha1/cron_types.go``) in capability, re-expressed
+as Python dataclasses over k8s-style unstructured dicts.
+"""
+
+from cron_operator_tpu.api.v1alpha1 import (
+    GROUP,
+    VERSION,
+    API_VERSION,
+    KIND_CRON,
+    ConcurrencyPolicy,
+    JobConditionType,
+    JobCondition,
+    JobStatus,
+    ObjectMeta,
+    ObjectReference,
+    TypedLocalObjectReference,
+    CronHistory,
+    CronTemplateSpec,
+    CronSpec,
+    CronStatus,
+    Cron,
+)
+from cron_operator_tpu.api.scheme import Scheme, default_scheme
+
+__all__ = [
+    "GROUP",
+    "VERSION",
+    "API_VERSION",
+    "KIND_CRON",
+    "ConcurrencyPolicy",
+    "JobConditionType",
+    "JobCondition",
+    "JobStatus",
+    "ObjectMeta",
+    "ObjectReference",
+    "TypedLocalObjectReference",
+    "CronHistory",
+    "CronTemplateSpec",
+    "CronSpec",
+    "CronStatus",
+    "Cron",
+    "Scheme",
+    "default_scheme",
+]

@@ -208,21 +208,13 @@ def render_yaml() -> str:
 
 
 def main() -> None:
-    """Regenerate every shipped copy of the CRD (``deploy/crds`` and the
-    Helm chart's ``charts/cron-operator-tpu/crds`` — the reference keeps the
-    same duplication between config/crd/bases and its chart's crds/).
-    ``make manifests`` analog; drift is pinned by tests/test_deploy.py and
-    tests/test_chart.py and checked by the CI gate."""
     import pathlib
 
-    root = pathlib.Path(__file__).resolve().parents[2]
-    text = render_yaml()
-    for rel in ("deploy/crds", "charts/cron-operator-tpu/crds"):
-        out = root / rel
-        out.mkdir(parents=True, exist_ok=True)
-        path = out / f"{GROUP}_{PLURAL}.yaml"
-        path.write_text(text)
-        print(f"wrote {path}")
+    out = pathlib.Path(__file__).resolve().parents[2] / "deploy" / "crds"
+    out.mkdir(parents=True, exist_ok=True)
+    path = out / f"{GROUP}_{PLURAL}.yaml"
+    path.write_text(render_yaml())
+    print(f"wrote {path}")
 
 
 if __name__ == "__main__":

@@ -80,10 +80,6 @@ class Scheme:
     def workload_kinds(self) -> list[GVK]:
         return sorted(self._workload_kinds, key=lambda g: (g.group, g.kind))
 
-    def items(self) -> list[tuple[GVK, str]]:
-        """All registered (GVK, plural) pairs — REST-path reverse mapping."""
-        return sorted(self._plurals.items(), key=lambda kv: str(kv[0]))
-
 
 KUBEFLOW_GROUP = "kubeflow.org"
 KUBEFLOW_V1 = "v1"

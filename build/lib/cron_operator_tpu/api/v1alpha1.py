@@ -1,0 +1,494 @@
+"""``apps.kubedl.io/v1alpha1`` resource types.
+
+Capability parity with the reference CRD types
+(``/root/reference/api/v1alpha1/cron_types.go:40-182``), re-designed as
+dataclasses that round-trip to k8s-style unstructured dicts (camelCase keys,
+RFC3339 timestamps). The workload template stays an opaque dict — the analog
+of the reference's ``runtime.RawExtension`` with
+``x-kubernetes-preserve-unknown-fields`` (``cron_types.go:110-119``) — so any
+GVK can be scheduled without compile-time knowledge of it.
+
+The JobStatus condition convention (``JobConditionType`` strings
+Created/Running/Restarting/Succeeded/Suspended/Failed) is deliberately
+compatible with Kubeflow's ``training-operator`` so Kubeflow-style workloads
+(PyTorchJob/TFJob/MPIJob/JAXJob) interoperate, without depending on it
+(reference depends on the real module at ``go.mod:8``; our build re-states the
+contract, see SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+GROUP = "apps.kubedl.io"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND_CRON = "Cron"
+
+# Ownership-tracking label (reference: pkg/common/constants.go:20-24).
+LABEL_PREFIX_KUBEDL = "kubedl.io"
+LABEL_CRON_NAME = "kubedl.io/cron-name"
+
+
+def rfc3339(dt: datetime) -> str:
+    """Serialize a datetime as k8s RFC3339 (second precision, Z suffix)."""
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.astimezone(timezone.utc).replace(microsecond=0).isoformat().replace(
+        "+00:00", "Z"
+    )
+
+
+def parse_time(value: Optional[str]) -> Optional[datetime]:
+    """Parse an RFC3339 timestamp; returns tz-aware UTC datetime."""
+    if value is None or value == "":
+        return None
+    if isinstance(value, datetime):
+        return value if value.tzinfo else value.replace(tzinfo=timezone.utc)
+    text = value.replace("Z", "+00:00")
+    dt = datetime.fromisoformat(text)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.astimezone(timezone.utc)
+
+
+class ConcurrencyPolicy(str, Enum):
+    """How to treat concurrent executions of a workload started by this cron.
+
+    Reference: ``cron_types.go:121-139`` (enum + default Allow).
+    """
+
+    ALLOW = "Allow"
+    FORBID = "Forbid"
+    REPLACE = "Replace"
+
+
+class JobConditionType(str, Enum):
+    """Kubeflow-compatible workload condition types (SURVEY.md §3.3)."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    SUSPENDED = "Suspended"
+    FAILED = "Failed"
+
+
+@dataclass
+class JobCondition:
+    """One entry of a workload's ``status.conditions``."""
+
+    type: str
+    status: str = "True"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_update_time: Optional[datetime] = None
+    last_transition_time: Optional[datetime] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"type": str(self.type), "status": self.status}
+        if self.reason:
+            out["reason"] = self.reason
+        if self.message:
+            out["message"] = self.message
+        if self.last_update_time:
+            out["lastUpdateTime"] = rfc3339(self.last_update_time)
+        if self.last_transition_time:
+            out["lastTransitionTime"] = rfc3339(self.last_transition_time)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "JobCondition":
+        return cls(
+            type=d.get("type", ""),
+            status=d.get("status", ""),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_update_time=parse_time(d.get("lastUpdateTime")),
+            last_transition_time=parse_time(d.get("lastTransitionTime")),
+        )
+
+
+@dataclass
+class JobStatus:
+    """The cross-workload status contract.
+
+    Any workload kind whose ``status`` follows this convention can be
+    scheduled and tracked (reference extracts it from unstructured objects at
+    ``internal/controller/cron_util.go:92-114``).
+    """
+
+    conditions: List[JobCondition] = field(default_factory=list)
+    start_time: Optional[datetime] = None
+    completion_time: Optional[datetime] = None
+    last_reconcile_time: Optional[datetime] = None
+
+    def _has_true_condition(self, cond_type: JobConditionType) -> bool:
+        for c in self.conditions:
+            if c.type == cond_type.value and c.status == "True":
+                return True
+        return False
+
+    def is_succeeded(self) -> bool:
+        return self._has_true_condition(JobConditionType.SUCCEEDED)
+
+    def is_failed(self) -> bool:
+        return self._has_true_condition(JobConditionType.FAILED)
+
+    def is_finished(self) -> bool:
+        return self.is_succeeded() or self.is_failed()
+
+    def last_condition_type(self) -> Optional[str]:
+        """Type of the most recent condition (reference ``cron_util.go:85``
+        records the *last* list element as the job's final status)."""
+        if not self.conditions:
+            return None
+        return self.conditions[-1].type
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.conditions:
+            out["conditions"] = [c.to_dict() for c in self.conditions]
+        if self.start_time:
+            out["startTime"] = rfc3339(self.start_time)
+        if self.completion_time:
+            out["completionTime"] = rfc3339(self.completion_time)
+        if self.last_reconcile_time:
+            out["lastReconcileTime"] = rfc3339(self.last_reconcile_time)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "JobStatus":
+        d = d or {}
+        raw_conds = d.get("conditions") or []
+        conds = [JobCondition.from_dict(c) for c in raw_conds if isinstance(c, dict)]
+        return cls(
+            conditions=conds,
+            start_time=parse_time(d.get("startTime")),
+            completion_time=parse_time(d.get("completionTime")),
+            last_reconcile_time=parse_time(d.get("lastReconcileTime")),
+        )
+
+
+@dataclass
+class ObjectMeta:
+    """Subset of k8s ObjectMeta the framework uses."""
+
+    name: str = ""
+    generate_name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    creation_timestamp: Optional[datetime] = None
+    deletion_timestamp: Optional[datetime] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.name:
+            out["name"] = self.name
+        if self.generate_name:
+            out["generateName"] = self.generate_name
+        if self.namespace:
+            out["namespace"] = self.namespace
+        if self.uid:
+            out["uid"] = self.uid
+        if self.resource_version:
+            out["resourceVersion"] = self.resource_version
+        if self.creation_timestamp:
+            out["creationTimestamp"] = rfc3339(self.creation_timestamp)
+        if self.deletion_timestamp:
+            out["deletionTimestamp"] = rfc3339(self.deletion_timestamp)
+        if self.labels:
+            out["labels"] = dict(self.labels)
+        if self.annotations:
+            out["annotations"] = dict(self.annotations)
+        if self.owner_references:
+            out["ownerReferences"] = copy.deepcopy(self.owner_references)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ObjectMeta":
+        d = d or {}
+        return cls(
+            name=d.get("name", "") or "",
+            generate_name=d.get("generateName", "") or "",
+            namespace=d.get("namespace", "") or "",
+            uid=d.get("uid", "") or "",
+            resource_version=str(d.get("resourceVersion", "") or ""),
+            creation_timestamp=parse_time(d.get("creationTimestamp")),
+            deletion_timestamp=parse_time(d.get("deletionTimestamp")),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            owner_references=copy.deepcopy(d.get("ownerReferences") or []),
+        )
+
+
+@dataclass
+class ObjectReference:
+    """corev1.ObjectReference subset used in ``status.active``
+    (reference ``cron_types.go:143-146``, built at
+    ``cron_controller.go:285-304``)."""
+
+    api_version: str = ""
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+    resource_version: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.api_version:
+            out["apiVersion"] = self.api_version
+        if self.kind:
+            out["kind"] = self.kind
+        if self.namespace:
+            out["namespace"] = self.namespace
+        if self.name:
+            out["name"] = self.name
+        if self.uid:
+            out["uid"] = self.uid
+        if self.resource_version:
+            out["resourceVersion"] = self.resource_version
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ObjectReference":
+        return cls(
+            api_version=d.get("apiVersion", ""),
+            kind=d.get("kind", ""),
+            namespace=d.get("namespace", ""),
+            name=d.get("name", ""),
+            uid=d.get("uid", ""),
+            resource_version=str(d.get("resourceVersion", "") or ""),
+        )
+
+
+@dataclass
+class TypedLocalObjectReference:
+    """corev1.TypedLocalObjectReference used in history entries.
+
+    Note: the reference populates ``apiGroup`` with the full ``group/version``
+    string, not just the group (``cron_controller.go:330-334``) — replicated
+    deliberately for status parity; see SURVEY.md §7 hard-part (5) discussion.
+    """
+
+    api_group: Optional[str] = None
+    kind: str = ""
+    name: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"kind": self.kind, "name": self.name}
+        if self.api_group is not None:
+            out["apiGroup"] = self.api_group
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TypedLocalObjectReference":
+        return cls(
+            api_group=d.get("apiGroup"),
+            kind=d.get("kind", ""),
+            name=d.get("name", ""),
+        )
+
+
+@dataclass
+class CronHistory:
+    """One finished (or observed) execution (reference ``cron_types.go:160-182``)."""
+
+    uid: str = ""
+    object: TypedLocalObjectReference = field(default_factory=TypedLocalObjectReference)
+    status: str = ""  # JobConditionType string
+    created: Optional[datetime] = None
+    finished: Optional[datetime] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"uid": self.uid, "object": self.object.to_dict()}
+        if self.status:
+            out["status"] = str(self.status)
+        if self.created:
+            out["created"] = rfc3339(self.created)
+        if self.finished:
+            out["finished"] = rfc3339(self.finished)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "CronHistory":
+        return cls(
+            uid=d.get("uid", ""),
+            object=TypedLocalObjectReference.from_dict(d.get("object") or {}),
+            status=d.get("status", ""),
+            created=parse_time(d.get("created")),
+            finished=parse_time(d.get("finished")),
+        )
+
+
+@dataclass
+class CronTemplateSpec:
+    """The workload template. ``workload`` is an opaque unstructured object
+    (apiVersion + kind + metadata + spec of ANY schedulable GVK) — the analog
+    of the reference's RawExtension (``cron_types.go:110-119``)."""
+
+    workload: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.workload is not None:
+            out["workload"] = copy.deepcopy(self.workload)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CronTemplateSpec":
+        d = d or {}
+        wl = d.get("workload")
+        return cls(workload=copy.deepcopy(wl) if wl is not None else None)
+
+
+@dataclass
+class CronSpec:
+    """Desired cron behavior (reference ``cron_types.go:70-108``)."""
+
+    schedule: str = ""
+    template: CronTemplateSpec = field(default_factory=CronTemplateSpec)
+    concurrency_policy: ConcurrencyPolicy = ConcurrencyPolicy.ALLOW
+    suspend: Optional[bool] = None
+    deadline: Optional[datetime] = None
+    history_limit: Optional[int] = None
+    # TPU-native extension: optional IANA timezone for schedule evaluation.
+    # The reference can only inherit the container timezone via a hostPath
+    # mount of /etc/localtime (chart `useHostTimezone`); a spec field is the
+    # declarative version of the same capability.
+    timezone: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "schedule": self.schedule,
+            "template": self.template.to_dict(),
+        }
+        if self.concurrency_policy:
+            out["concurrencyPolicy"] = str(
+                self.concurrency_policy.value
+                if isinstance(self.concurrency_policy, ConcurrencyPolicy)
+                else self.concurrency_policy
+            )
+        if self.suspend is not None:
+            out["suspend"] = self.suspend
+        if self.deadline is not None:
+            out["deadline"] = rfc3339(self.deadline)
+        if self.history_limit is not None:
+            out["historyLimit"] = self.history_limit
+        if self.timezone is not None:
+            out["timezone"] = self.timezone
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CronSpec":
+        d = d or {}
+        policy_raw = d.get("concurrencyPolicy") or ConcurrencyPolicy.ALLOW.value
+        try:
+            policy = ConcurrencyPolicy(policy_raw)
+        except ValueError:
+            policy = ConcurrencyPolicy.ALLOW
+        hl = d.get("historyLimit")
+        return cls(
+            schedule=d.get("schedule", ""),
+            template=CronTemplateSpec.from_dict(d.get("template")),
+            concurrency_policy=policy,
+            suspend=d.get("suspend"),
+            deadline=parse_time(d.get("deadline")),
+            history_limit=int(hl) if hl is not None else None,
+            timezone=d.get("timezone"),
+        )
+
+
+@dataclass
+class CronStatus:
+    """Observed state (reference ``cron_types.go:142-157``)."""
+
+    active: List[ObjectReference] = field(default_factory=list)
+    history: List[CronHistory] = field(default_factory=list)
+    last_schedule_time: Optional[datetime] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.active:
+            out["active"] = [a.to_dict() for a in self.active]
+        if self.history:
+            out["history"] = [h.to_dict() for h in self.history]
+        if self.last_schedule_time:
+            out["lastScheduleTime"] = rfc3339(self.last_schedule_time)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CronStatus":
+        d = d or {}
+        return cls(
+            active=[ObjectReference.from_dict(a) for a in d.get("active") or []],
+            history=[CronHistory.from_dict(h) for h in d.get("history") or []],
+            last_schedule_time=parse_time(d.get("lastScheduleTime")),
+        )
+
+
+@dataclass
+class Cron:
+    """The Cron resource (reference ``cron_types.go:40-51``)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: CronSpec = field(default_factory=CronSpec)
+    status: CronStatus = field(default_factory=CronStatus)
+
+    api_version: str = API_VERSION
+    kind: str = KIND_CRON
+
+    def deepcopy(self) -> "Cron":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Cron":
+        return cls(
+            api_version=d.get("apiVersion", API_VERSION),
+            kind=d.get("kind", KIND_CRON),
+            metadata=ObjectMeta.from_dict(d.get("metadata")),
+            spec=CronSpec.from_dict(d.get("spec")),
+            status=CronStatus.from_dict(d.get("status")),
+        )
+
+
+def job_status_from_unstructured(obj: Dict[str, Any]) -> Optional[JobStatus]:
+    """Extract the typed JobStatus from an unstructured workload.
+
+    Reference: ``internal/controller/cron_util.go:92-114`` (unstructured →
+    ``kubeflowv1.JobStatus`` conversion). Returns None when the workload has
+    no status yet; raises ValueError when a status exists but fails
+    conversion (the reference's converter error, which the reconciler
+    answers by skipping the workload — ``cron_controller.go:139-143``).
+    """
+    status = obj.get("status")
+    if status is None or status == {}:
+        return None
+    if not isinstance(status, dict):
+        raise ValueError(f"workload status is not an object: {type(status).__name__}")
+    conds = status.get("conditions")
+    if conds is not None and not isinstance(conds, list):
+        raise ValueError("workload status.conditions is not a list")
+    return JobStatus.from_dict(status)
+
+
+__all__ = [name for name in dir() if not name.startswith("_")]

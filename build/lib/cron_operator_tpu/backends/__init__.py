@@ -1,0 +1,45 @@
+"""Workload backends.
+
+The reference hands created workloads to the *external* Kubeflow
+training-operator and only watches status conditions come back
+(SURVEY.md §3.2 hand-off). This framework ships that half too:
+
+- ``tpu``   — TPU slice topology model (v4/v5e/v5p/v6e shapes, hosts,
+              chips-per-host), GKE nodeSelector/resource injection, and JAX
+              distributed-coordinator env rendering — the operator-side
+              machinery that makes a JAXJob land on a multi-host TPU slice
+              as one gang.
+- ``local`` — the local training runtime: watches JAXJob-convention
+              workloads in the embedded control plane and actually executes
+              them in-process on the available TPU/CPU devices, driving the
+              Kubeflow JobStatus condition lifecycle
+              (Created→Running→Succeeded/Failed) that the reconciler's
+              status contract consumes.
+- ``registry`` — maps workload entrypoints to Python callables.
+"""
+
+from cron_operator_tpu.backends.tpu import (
+    SliceSpec,
+    TopologyError,
+    slice_for,
+    inject_tpu_topology,
+    render_coordinator_env,
+)
+from cron_operator_tpu.backends.local import LocalExecutor
+from cron_operator_tpu.backends.registry import (
+    register_entrypoint,
+    resolve_entrypoint,
+    JobContext,
+)
+
+__all__ = [
+    "SliceSpec",
+    "TopologyError",
+    "slice_for",
+    "inject_tpu_topology",
+    "render_coordinator_env",
+    "LocalExecutor",
+    "register_entrypoint",
+    "resolve_entrypoint",
+    "JobContext",
+]

@@ -1,0 +1,99 @@
+"""Workload entrypoint registry.
+
+In the reference's world a workload's behavior lives in its container image;
+the training-operator never looks inside. In the local TPU runtime the
+equivalent seam is an *entrypoint*: a Python callable resolved from the
+workload's ``tpu.kubedl.io/entrypoint`` annotation, either a registered name
+(``"mnist"``) or a ``"module.path:function"`` import string. The callable
+receives a :class:`JobContext` and runs the actual training.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+ANNOTATION_ENTRYPOINT = "tpu.kubedl.io/entrypoint"
+
+_REGISTRY: Dict[str, Callable[["JobContext"], Any]] = {}
+
+
+@dataclass
+class JobContext:
+    """Everything an entrypoint gets about its job."""
+
+    name: str
+    namespace: str
+    job: Dict[str, Any]  # full unstructured workload
+    params: Dict[str, str]  # tpu.kubedl.io/param.* annotations, stripped
+    slice_spec: Optional[Any] = None  # backends.tpu.SliceSpec when TPU-bound
+    cancel: threading.Event = field(default_factory=threading.Event)
+    # entrypoints may publish progress here; the executor folds it into
+    # the workload's status (e.g. step counters for observability)
+    progress: Dict[str, Any] = field(default_factory=dict)
+    # set by the executor: flushes `progress` into the workload's status
+    # mid-run (entrypoints call it throttled; also called once at job end)
+    publish: Optional[Callable[[], None]] = None
+
+    def should_stop(self) -> bool:
+        return self.cancel.is_set()
+
+
+def register_entrypoint(name: str, fn: Optional[Callable] = None):
+    """Register a training entrypoint under a short name.
+
+    Usable as a decorator (``@register_entrypoint("mnist")``) or a call.
+    """
+
+    def _register(f):
+        _REGISTRY[name] = f
+        return f
+
+    if fn is not None:
+        return _register(fn)
+    return _register
+
+
+def resolve_entrypoint(ref: str) -> Callable[["JobContext"], Any]:
+    """Resolve a registry name or ``module.path:function`` string."""
+    if ref not in _REGISTRY and ":" not in ref:
+        # Lazy-load the standard workloads (mnist/resnet50/bert) on first
+        # use — keeps jax/flax out of pure control-plane processes.
+        try:
+            importlib.import_module("cron_operator_tpu.workloads.entrypoints")
+        except ImportError:
+            import logging
+
+            logging.getLogger("backends.registry").warning(
+                "standard workload entrypoints unavailable "
+                "(cron_operator_tpu.workloads failed to import)",
+                exc_info=True,
+            )
+    if ref in _REGISTRY:
+        return _REGISTRY[ref]
+    if ":" in ref:
+        module_name, fn_name = ref.split(":", 1)
+        module = importlib.import_module(module_name)
+        fn = getattr(module, fn_name, None)
+        if fn is None:
+            raise ValueError(f"no function {fn_name!r} in module {module_name!r}")
+        return fn
+    raise ValueError(
+        f"unknown entrypoint {ref!r}; registered: {sorted(_REGISTRY)} "
+        "(or use 'module.path:function')"
+    )
+
+
+def registered_entrypoints() -> Dict[str, Callable]:
+    return dict(_REGISTRY)
+
+
+__all__ = [
+    "ANNOTATION_ENTRYPOINT",
+    "JobContext",
+    "register_entrypoint",
+    "resolve_entrypoint",
+    "registered_entrypoints",
+]

@@ -1,0 +1,308 @@
+"""TPU slice topology model and GKE scheduling metadata injection.
+
+This is the operator-side half of the "JAXJob on TPU slices" capability the
+reference lacks (BASELINE.json north star): given an accelerator family and a
+slice shape, compute hosts/chips-per-host, and rewrite a JAXJob's pod
+template so GKE gang-schedules the whole slice:
+
+- nodeSelectors ``cloud.google.com/gke-tpu-accelerator`` +
+  ``cloud.google.com/gke-tpu-topology``,
+- ``google.com/tpu`` chip requests/limits per container,
+- worker replicas = number of hosts (every host of a multi-host slice must
+  run exactly one pod — a v5e-16 is 4 hosts × 4 chips and is atomic),
+- JAX distributed-initialization env (coordinator = pod 0 via the job's
+  headless service; the ``MASTER_ADDR``/``TF_CONFIG`` analog the external
+  training-operator renders for the GPU path — SURVEY.md §2.3, §5).
+
+Topology tables follow the public GKE TPU machine shapes (ct4p/ct5lp/ct5p/
+ct6e). Single source of truth for both the operator and the local runtime.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from math import prod
+from typing import Any, Dict, List, Optional
+
+
+def normalize_param_key(key: str) -> str:
+    """Canonical param-key form shared by every producer/consumer:
+    lowercase, non-identifier chars → ``_`` (env-var-safe)."""
+    return re.sub(r"[^a-z0-9_]", "_", key.lower())
+
+
+ANNOTATION_ACCELERATOR = "tpu.kubedl.io/accelerator"
+ANNOTATION_TOPOLOGY = "tpu.kubedl.io/topology"
+NODESEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODESEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+RESOURCE_TPU = "google.com/tpu"
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class SliceSpec:
+    """One TPU slice: accelerator family + topology → gang shape."""
+
+    accelerator: str  # GKE accelerator label value, e.g. "tpu-v5-lite-podslice"
+    topology: str  # e.g. "4x4" or "2x2x2"
+    chips: int
+    hosts: int
+    chips_per_host: int
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+    @property
+    def devices(self) -> int:
+        return self.chips
+
+
+# family key → (GKE accelerator label, chips per host for multi-host slices,
+#               max chips on one host, 3D topology?)
+_FAMILIES = {
+    "v4": ("tpu-v4-podslice", 4, 4, True),
+    "v5e": ("tpu-v5-lite-podslice", 4, 8, False),
+    "v5p": ("tpu-v5p-slice", 4, 4, True),
+    "v6e": ("tpu-v6e-slice", 4, 8, False),
+}
+
+_ACCEL_TO_FAMILY = {accel: fam for fam, (accel, _, _, _) in _FAMILIES.items()}
+
+
+def _parse_topology(topology: str) -> List[int]:
+    try:
+        dims = [int(d) for d in topology.lower().split("x")]
+    except ValueError:
+        raise TopologyError(f"invalid topology {topology!r}") from None
+    if not dims or any(d <= 0 for d in dims):
+        raise TopologyError(f"invalid topology {topology!r}")
+    return dims
+
+
+def slice_for(family_or_accelerator: str, topology: str) -> SliceSpec:
+    """Resolve a family ("v5e") or GKE accelerator label
+    ("tpu-v5-lite-podslice") + topology string into a SliceSpec."""
+    key = family_or_accelerator.lower()
+    fam = key if key in _FAMILIES else _ACCEL_TO_FAMILY.get(key)
+    if fam is None:
+        raise TopologyError(
+            f"unknown TPU family/accelerator {family_or_accelerator!r}; "
+            f"known: {sorted(_FAMILIES)} / {sorted(_ACCEL_TO_FAMILY)}"
+        )
+    accel, mh_chips_per_host, max_single_host, is_3d = _FAMILIES[fam]
+    dims = _parse_topology(topology)
+    if is_3d and len(dims) != 3:
+        raise TopologyError(f"{fam} topologies are 3D, got {topology!r}")
+    if not is_3d and len(dims) != 2:
+        raise TopologyError(f"{fam} topologies are 2D, got {topology!r}")
+    chips = prod(dims)
+    if chips <= max_single_host and _fits_single_host(dims, max_single_host):
+        return SliceSpec(accel, topology, chips, 1, chips)
+    if chips % mh_chips_per_host != 0:
+        raise TopologyError(
+            f"{fam} topology {topology!r}: {chips} chips not divisible by "
+            f"{mh_chips_per_host} chips/host"
+        )
+    return SliceSpec(accel, topology, chips, chips // mh_chips_per_host,
+                     mh_chips_per_host)
+
+
+def _fits_single_host(dims: List[int], max_single_host: int) -> bool:
+    # Single-host shapes: 2D up to 2x4 (v5e/v6e 8-chip host) or 3D 2x2x1.
+    return prod(dims) <= max_single_host and all(d <= 4 for d in dims)
+
+
+# Convenience names used by BASELINE.md acceptance configs ("v5e-16" etc.).
+_SHORTHAND = {
+    "v5e-1": ("v5e", "1x1"),
+    "v5e-4": ("v5e", "2x2"),
+    "v5e-8": ("v5e", "2x4"),
+    "v5e-16": ("v5e", "4x4"),
+    "v5e-32": ("v5e", "4x8"),
+    "v5e-64": ("v5e", "8x8"),
+    "v5e-128": ("v5e", "8x16"),
+    "v5e-256": ("v5e", "16x16"),
+    "v6e-1": ("v6e", "1x1"),
+    "v6e-4": ("v6e", "2x2"),
+    "v6e-8": ("v6e", "2x4"),
+    "v6e-16": ("v6e", "4x4"),
+    "v6e-64": ("v6e", "8x8"),
+    "v6e-256": ("v6e", "16x16"),
+    "v5p-8": ("v5p", "2x2x2"),
+    "v5p-16": ("v5p", "2x2x4"),
+    "v4-8": ("v4", "2x2x2"),
+}
+
+
+def slice_for_shorthand(name: str) -> SliceSpec:
+    """Resolve "v5e-16"-style shorthand (family-chipcount)."""
+    entry = _SHORTHAND.get(name.lower())
+    if entry is None:
+        raise TopologyError(
+            f"unknown slice shorthand {name!r}; known: {sorted(_SHORTHAND)}"
+        )
+    return slice_for(*entry)
+
+
+# Per-replica identity label. The Kubeflow training-operator stamps
+# ``training.kubeflow.org/replica-index`` on every pod it creates from a
+# ReplicaSpec — that is the one per-pod value available to the downward API
+# in the real-cluster path; the LocalExecutor stamps the same label on its
+# simulated pods (backends/local.py) so both paths share one contract.
+LABEL_REPLICA_INDEX = "training.kubeflow.org/replica-index"
+# Kept on local pods for back-compat with earlier annotations.
+LABEL_WORKER_INDEX = "tpu.kubedl.io/worker-index"
+
+
+def render_coordinator_env(
+    job_name: str, namespace: str, spec: SliceSpec
+) -> List[Dict[str, Any]]:
+    """Env the JAX workload needs for ``jax.distributed.initialize``.
+
+    Coordinator = worker 0's pod DNS behind the job's headless service —
+    mirroring the training-operator's ``MASTER_ADDR`` rendering for PyTorch
+    (SURVEY.md §5 communication backend). Process identity comes from the
+    ``training.kubeflow.org/replica-index`` pod label via the downward API
+    (see LABEL_REPLICA_INDEX above).
+    """
+    coordinator = f"{job_name}-worker-0.{job_name}.{namespace}.svc:8476"
+    index_ref = {
+        "valueFrom": {
+            "fieldRef": {
+                "fieldPath": f"metadata.labels['{LABEL_REPLICA_INDEX}']"
+            }
+        }
+    }
+    return [
+        {"name": "JAX_COORDINATOR_ADDRESS", "value": coordinator},
+        {"name": "JAX_NUM_PROCESSES", "value": str(spec.hosts)},
+        {"name": "JAX_PROCESS_ID", **index_ref},
+        {"name": "TPU_WORKER_ID", **index_ref},
+    ]
+
+
+PARAM_ANNOTATION_PREFIX = "tpu.kubedl.io/param."
+
+
+def params_from_annotations(ann: Dict[str, str]) -> Dict[str, str]:
+    """Normalized hyperparameter dict from ``tpu.kubedl.io/param.<key>``
+    annotations — the ONE producer both isolation modes use (ADVICE r2:
+    thread and subprocess paths must agree on collision handling). Distinct
+    annotation keys that normalize identically would silently shadow each
+    other (kubelet last-one-wins), so that raises."""
+    params: Dict[str, str] = {}
+    seen: Dict[str, str] = {}
+    for key, value in sorted(ann.items()):
+        if not key.startswith(PARAM_ANNOTATION_PREFIX):
+            continue
+        name = normalize_param_key(key[len(PARAM_ANNOTATION_PREFIX):])
+        if name in seen:
+            raise ValueError(
+                f"param annotations {seen[name]!r} and {key!r} both "
+                f"normalize to {name!r}; rename one"
+            )
+        seen[name] = key
+        params[name] = value
+    return params
+
+
+def render_job_env(job: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Job identity + hyperparameter env for the container runner.
+
+    ``tpu.kubedl.io/param.<key>`` annotations become ``TPU_PARAM_<KEY>``
+    vars, which ``workloads.runner`` folds back into JobContext.params — so
+    real pods train with the Cron's configured hyperparameters, same as the
+    in-process path. Param keys are case-insensitive and non-identifier
+    characters (``-``, ``.``) map to ``_``: every consumer applies the same
+    normalization (``normalize_param_key``), because env var names cannot
+    round-trip case or punctuation and the kube-apiserver rejects pods whose
+    env names aren't C identifiers.
+    """
+    meta = job.get("metadata") or {}
+    ann = meta.get("annotations") or {}
+    env: List[Dict[str, Any]] = [
+        {"name": "TPU_JOB_NAME", "value": meta.get("name", "")},
+        {"name": "TPU_JOB_NAMESPACE", "value": meta.get("namespace", "default")},
+    ]
+    for name, value in params_from_annotations(ann).items():
+        env.append({"name": f"TPU_PARAM_{name.upper()}", "value": value})
+    return env
+
+
+def _resolve_slice_from_job(job: Dict[str, Any]) -> Optional[SliceSpec]:
+    meta = job.get("metadata") or {}
+    ann = meta.get("annotations") or {}
+    accel = ann.get(ANNOTATION_ACCELERATOR)
+    topo = ann.get(ANNOTATION_TOPOLOGY)
+    if accel and topo:
+        return slice_for(accel, topo)
+    if accel and "-" in accel and not topo:
+        return slice_for_shorthand(accel)
+    return None
+
+
+def inject_tpu_topology(job: Dict[str, Any]) -> Optional[SliceSpec]:
+    """Admission-time mutation (the defaulting-webhook analog, SURVEY.md §7
+    step 4b): if the job requests a TPU slice via annotations, rewrite its
+    Worker replica spec in place — nodeSelectors, chip resources, replicas =
+    hosts, coordinator env. Returns the resolved SliceSpec, or None when the
+    job doesn't request TPU."""
+    spec = _resolve_slice_from_job(job)
+    if spec is None:
+        return None
+
+    meta = job.get("metadata") or {}
+    job_spec = job.setdefault("spec", {})
+    replica_specs = job_spec.setdefault("replicaSpecs", {})
+    worker = replica_specs.setdefault("Worker", {})
+    worker["replicas"] = spec.hosts
+
+    template = worker.setdefault("template", {})
+    pod_spec = template.setdefault("spec", {})
+    node_selector = pod_spec.setdefault("nodeSelector", {})
+    node_selector[NODESEL_ACCELERATOR] = spec.accelerator
+    node_selector[NODESEL_TOPOLOGY] = spec.topology
+
+    containers = pod_spec.setdefault("containers", [{"name": "worker"}])
+    for c in containers:
+        resources = c.setdefault("resources", {})
+        for section in ("requests", "limits"):
+            resources.setdefault(section, {})[RESOURCE_TPU] = str(
+                spec.chips_per_host
+            )
+        env = c.setdefault("env", [])
+        have = {e.get("name") for e in env}
+        for e in render_coordinator_env(
+            meta.get("name", "job"), meta.get("namespace", "default"), spec
+        ) + render_job_env(job):
+            if e["name"] not in have:
+                env.append(e)
+
+    # Gang marker: all hosts or nothing (JobSet/podgroup analog).
+    ann = meta.setdefault("annotations", {})
+    ann.setdefault("tpu.kubedl.io/gang-size", str(spec.hosts))
+    return spec
+
+
+__all__ = [
+    "SliceSpec",
+    "TopologyError",
+    "slice_for",
+    "slice_for_shorthand",
+    "render_coordinator_env",
+    "render_job_env",
+    "params_from_annotations",
+    "inject_tpu_topology",
+    "LABEL_REPLICA_INDEX",
+    "LABEL_WORKER_INDEX",
+    "ANNOTATION_ACCELERATOR",
+    "ANNOTATION_TOPOLOGY",
+    "NODESEL_ACCELERATOR",
+    "NODESEL_TOPOLOGY",
+    "RESOURCE_TPU",
+]

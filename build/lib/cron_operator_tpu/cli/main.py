@@ -106,13 +106,6 @@ def build_parser() -> argparse.ArgumentParser:
                             "'none' schedules objects only. Defaults to "
                             "'local' in embedded mode, 'none' in cluster "
                             "mode (real workloads run as pods there)")
-    start.add_argument("--serve-api", default=None, metavar="[HOST]:PORT",
-                       help="embedded mode only: serve the control plane "
-                            "over the Kubernetes REST protocol (apply Crons "
-                            "with any kube-style client instead of --load)")
-    start.add_argument("--serve-api-token", default=None,
-                       help="bearer token required by --serve-api "
-                            "(default: unauthenticated on localhost)")
     start.add_argument("--run-for", type=float, default=None,
                        metavar="SECONDS",
                        help="exit after N seconds (default: run until signal)")
@@ -178,26 +171,6 @@ def cmd_start(args: argparse.Namespace) -> int:
         for_gvk=GVK_CRON,
         owns=scheme.workload_kinds(),
     )
-
-    api_http = None
-    if args.serve_api:
-        if args.api_server == "cluster":
-            log.error("--serve-api applies to the embedded control plane "
-                      "only; cluster mode already has an apiserver")
-            return 2
-        from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
-
-        host, _, port = args.serve_api.rpartition(":")
-        if not port.isdigit():
-            log.error("--serve-api expects [HOST]:PORT, got %r",
-                      args.serve_api)
-            return 2
-        api_http = HTTPAPIServer(
-            api=api, scheme=scheme, host=host or "127.0.0.1",
-            port=int(port), token=args.serve_api_token,
-        )
-        api_http.start()
-        log.info("embedded API serving on %s", api_http.url)
 
     executor = None
     if args.backend == "local":
@@ -273,8 +246,6 @@ def cmd_start(args: argparse.Namespace) -> int:
 
     log.info("shutting down")
     manager.stop()
-    if api_http is not None:
-        api_http.stop()
     if executor is not None:
         executor.stop()
     if args.api_server == "cluster":

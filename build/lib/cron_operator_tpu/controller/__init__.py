@@ -1,0 +1,24 @@
+"""Controller layer: the cron schedule engine and the Cron reconciler.
+
+Parity targets: ``/root/reference/internal/controller/`` (reconciler, workload
+helpers) and the ``robfig/cron/v3`` standard parser the reference uses at
+``cron_controller.go:392``.
+"""
+
+from cron_operator_tpu.controller.schedule import (
+    CronSchedule,
+    EverySchedule,
+    parse_standard,
+)
+from cron_operator_tpu.controller.cron_controller import (
+    CronReconciler,
+    ReconcileResult,
+)
+
+__all__ = [
+    "CronSchedule",
+    "EverySchedule",
+    "parse_standard",
+    "CronReconciler",
+    "ReconcileResult",
+]

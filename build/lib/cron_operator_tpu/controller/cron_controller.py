@@ -1,0 +1,506 @@
+"""The Cron reconciler — semantics parity with the reference's single control
+loop (``/root/reference/internal/controller/cron_controller.go:90-239``),
+re-expressed against the embedded control plane.
+
+Flow per reconcile (see SURVEY.md §3.2):
+
+1.  fetch Cron (NotFound → done);
+2.  status is patched at exit iff semantically changed (deferred patch,
+    ``cron_controller.go:107-120``);
+3.  resolve workload GVK from the template (invalid → terminal, no requeue);
+4.  list workloads by GVK + ``kubedl.io/cron-name`` label in the namespace;
+5.  partition active vs terminated via the JobStatus contract;
+6.  sync status: rebuild ``status.active`` (sorted, with resourceVersion) and
+    rebuild ``status.history`` from terminated workloads, deleting the oldest
+    beyond ``historyLimit`` (history entries live only as long as the
+    workload object — deliberate parity, ``cron_controller.go:307-346``);
+7.  gates: deletionTimestamp → stop; suspend → stop with NO requeue (an
+    update to the Cron re-triggers); deadline passed → Normal/Deadline event,
+    stop;
+8.  schedule math with missed-run catch-up (>100 missed → Warning/
+    TooManyMissedTimes);
+9.  tick due? apply concurrency policy: Forbid+active → skip; Replace →
+    delete all active (background propagation); then instantiate the
+    template: deterministic name ``<cron>-<unix(nextRun)>`` (name derived
+    from *nextRun* — reference quirk at ``cron_controller.go:222``,
+    kept for parity), forced-empty generateName, cron-name label, controller
+    owner reference; create (AlreadyExists tolerated — fail-over guard);
+10. ``status.lastScheduleTime = now``; requeue at the next activation.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from dataclasses import dataclass
+from datetime import datetime, timedelta, timezone
+from typing import Any, Dict, List, Optional, Tuple
+
+from cron_operator_tpu.api.scheme import GVK, gvk_of
+from cron_operator_tpu.api.v1alpha1 import (
+    API_VERSION,
+    KIND_CRON,
+    LABEL_CRON_NAME,
+    ConcurrencyPolicy,
+    Cron,
+    CronHistory,
+    ObjectReference,
+    TypedLocalObjectReference,
+    parse_time,
+    rfc3339,
+)
+from cron_operator_tpu.controller.schedule import parse_standard
+from cron_operator_tpu.controller.workload import (
+    get_default_job_name,
+    is_workload_finished,
+    get_job_status,
+    new_empty_workload,
+    sort_by_creation_timestamp,
+)
+from cron_operator_tpu.backends.tpu import inject_tpu_topology
+from cron_operator_tpu.runtime.kube import (
+    AlreadyExistsError,
+    APIServer,
+    NotFoundError,
+)
+from cron_operator_tpu.utils.clock import Clock
+
+logger = logging.getLogger("controller.cron")
+
+Unstructured = Dict[str, Any]
+
+# Missed-tick count above which a clock-skew warning event fires
+# (reference ``cron_controller.go:431``).
+TOO_MANY_MISSED = 100
+# Catch-up loop iteration cap. The reference loop is unbounded
+# (``cron_controller.go:409-430``); we bound it because only the
+# *existence* of a missed run changes behavior (the created workload is
+# named after nextRun and lastScheduleTime is set to now), so capping
+# costs nothing but protects the control loop from decades-of-skew input.
+CATCHUP_ITERATION_CAP = 100_000
+
+
+@dataclass
+class ReconcileResult:
+    """Analog of ctrl.Result — requeue_after drives the schedule timer."""
+
+    requeue_after: Optional[timedelta] = None
+
+
+class CronReconciler:
+    """Reconciles Cron objects against the embedded control plane."""
+
+    def __init__(self, api: APIServer, clock: Optional[Clock] = None,
+                 metrics: Optional[Any] = None):
+        self.api = api
+        self.clock = clock or api.clock
+        # Domain metrics (runtime.manager.Metrics-compatible). The reference
+        # exposes only controller-runtime built-ins (SURVEY.md §5 "No custom
+        # metrics are registered — build should add domain metrics").
+        self.metrics = metrics
+        # De-dup state for per-tick (not per-reconcile) metric counting: the
+        # same missed tick is re-observed by every reconcile until it fires
+        # or is superseded.
+        self._last_skipped_tick: Dict[Tuple[str, str], datetime] = {}
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, value)
+
+    # -- entry point --------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> ReconcileResult:
+        log = logger
+        raw = self.api.try_get(API_VERSION, KIND_CRON, namespace, name)
+        if raw is None:
+            log.debug("cron %s/%s not found; skipping", namespace, name)
+            # Drop per-Cron dedup state so a long-lived operator churning
+            # many Crons doesn't leak (ADVICE r1).
+            self._last_skipped_tick.pop((namespace, name), None)
+            return ReconcileResult()
+
+        old_cron = Cron.from_dict(raw)
+        cron = old_cron.deepcopy()
+
+        try:
+            return self._reconcile(cron)
+        finally:
+            # Deferred status patch iff semantically changed.
+            if cron.status.to_dict() != old_cron.status.to_dict():
+                try:
+                    self.api.patch_status(
+                        API_VERSION,
+                        KIND_CRON,
+                        namespace,
+                        name,
+                        cron.status.to_dict(),
+                    )
+                except NotFoundError:
+                    pass
+
+    # -- core ---------------------------------------------------------------
+
+    def _reconcile(self, cron: Cron) -> ReconcileResult:
+        log = logger
+        ns, name = cron.metadata.namespace, cron.metadata.name
+
+        try:
+            workload_tpl = new_empty_workload(cron)
+        except ValueError as err:
+            # Invalid template: terminal until the spec is edited.
+            log.error("cron %s/%s: %s", ns, name, err)
+            return ReconcileResult()
+
+        gvk = gvk_of(workload_tpl)
+        assert gvk is not None
+
+        workloads = self._list_workloads(cron, gvk)
+
+        active: List[Unstructured] = []
+        terminated: List[Unstructured] = []
+        for w in workloads:
+            try:
+                status = get_job_status(w)
+            except ValueError as err:
+                # Malformed status: skip the workload entirely (reference
+                # `continue` on conversion error, cron_controller.go:139-143)
+                # rather than pinning it active forever.
+                log.error(
+                    "cron %s/%s: bad %s status on %s: %s",
+                    ns, name, gvk.kind,
+                    (w.get("metadata") or {}).get("name", "?"), err,
+                )
+                continue
+            if status is not None and (status.is_succeeded() or status.is_failed()):
+                terminated.append(w)
+            else:
+                active.append(w)
+        log.debug(
+            "cron %s/%s: %s active=%d terminated=%d",
+            ns, name, gvk.kind, len(active), len(terminated),
+        )
+
+        self._sync_status(cron, gvk, active, terminated)
+
+        now = self.clock.now()
+
+        if cron.metadata.deletion_timestamp is not None:
+            log.info("cron %s/%s is being deleted", ns, name)
+            self._last_skipped_tick.pop((ns, name), None)
+            return ReconcileResult()
+
+        if bool(cron.spec.suspend):
+            log.info("cron %s/%s is suspended", ns, name)
+            return ReconcileResult()  # no requeue; spec edits re-trigger
+
+        if cron.spec.deadline is not None and now > cron.spec.deadline:
+            log.info("cron %s/%s reached deadline; stop scheduling", ns, name)
+            self.api.record_event(
+                cron.to_dict(),
+                "Normal",
+                "Deadline",
+                "cron has reach deadline and stop scheduling",
+            )
+            return ReconcileResult()
+
+        try:
+            missed_run, next_run, missed_count = self._get_next_schedule(
+                cron, now
+            )
+        except ValueError as err:
+            # Bad schedule: don't requeue until a spec update fixes it.
+            log.error("cron %s/%s: %s", ns, name, err)
+            return ReconcileResult()
+
+        scheduled = ReconcileResult(requeue_after=next_run - now)
+
+        if missed_run is None:
+            return scheduled
+
+        if (
+            cron.spec.concurrency_policy == ConcurrencyPolicy.FORBID
+            and len(active) > 0
+        ):
+            log.debug(
+                "cron %s/%s: skip tick, concurrency policy Forbid with %d active",
+                ns, name, len(active),
+            )
+            # Count each distinct skipped tick once, not once per reconcile
+            # (the same pending tick is re-seen until it fires/expires).
+            if self._last_skipped_tick.get((ns, name)) != missed_run:
+                self._last_skipped_tick[(ns, name)] = missed_run
+                self._count('cron_ticks_skipped_total{policy="Forbid"}')
+            return scheduled
+
+        # Validate TPU annotations BEFORE any destructive concurrency action:
+        # with Replace policy, deleting the healthy active workload and then
+        # failing admission would leave nothing running. Dry-run on a copy —
+        # the real injection below only differs in instance name/namespace,
+        # which cannot affect validity.
+        try:
+            inject_tpu_topology(copy.deepcopy(workload_tpl))
+        except ValueError as err:
+            self.api.record_event(
+                cron.to_dict(),
+                "Warning",
+                "FailedTPUAdmission",
+                f"invalid TPU annotations on workload template: {err}",
+            )
+            log.error("cron %s/%s: TPU admission failed: %s", ns, name, err)
+            return scheduled
+
+        if cron.spec.concurrency_policy == ConcurrencyPolicy.REPLACE:
+            for w in active:
+                meta = w.get("metadata") or {}
+                try:
+                    self.api.delete(
+                        w["apiVersion"], w["kind"],
+                        meta.get("namespace", ns), meta.get("name", ""),
+                        propagation="Background",
+                    )
+                    self._count("cron_workloads_replaced_total")
+                except NotFoundError:
+                    pass  # already gone is fine
+
+        workload = self._new_workload_from_template(cron, workload_tpl, next_run)
+
+        # TPU admission (SURVEY.md §7 step 4b). The reference hands its
+        # template to the external training-operator verbatim
+        # (``cron_controller.go:349-387``); our build owns the TPU seam, so
+        # scheduling metadata (nodeSelectors, chip resources, replicas=hosts,
+        # coordinator env) must be present on the object we POST — in BOTH
+        # cluster and embedded modes. inject_tpu_topology is idempotent and a
+        # no-op for non-TPU workloads, so the LocalExecutor's own call (which
+        # covers workloads created outside this controller) stays safe.
+        # Cannot raise: the template was dry-run-validated above.
+        tpu_spec = inject_tpu_topology(workload)
+        if tpu_spec is not None:
+            log.debug(
+                "cron %s/%s: TPU admission %s %s → %d host(s) × %d chip(s)",
+                ns, name, tpu_spec.accelerator, tpu_spec.topology,
+                tpu_spec.hosts, tpu_spec.chips_per_host,
+            )
+
+        try:
+            self.api.create(workload)
+            self._count("cron_ticks_fired_total")
+            if missed_count > 1:
+                # Ticks the catch-up loop passed over; counted only when the
+                # latest one actually fires (lastScheduleTime advances), so
+                # repeated reconciles of one pending tick don't re-count.
+                self._count("cron_missed_runs_total", float(missed_count - 1))
+            log.info(
+                "cron %s/%s: created %s %s",
+                ns, name, gvk.kind, workload["metadata"]["name"],
+            )
+        except AlreadyExistsError:
+            log.info(
+                "cron %s/%s: %s %s already exists",
+                ns, name, gvk.kind, workload["metadata"]["name"],
+            )
+        except Exception as err:
+            self.api.record_event(
+                cron.to_dict(),
+                "Warning",
+                "FailedCreate",
+                f"Error creating {gvk.kind}: {err}",
+            )
+            raise
+
+        cron.status.last_schedule_time = now
+        return scheduled
+
+    # -- helpers ------------------------------------------------------------
+
+    def _list_workloads(self, cron: Cron, gvk: GVK) -> List[Unstructured]:
+        """List workloads of the template's GVK carrying this cron's label
+        in the cron's namespace (``cron_controller.go:242-266``)."""
+        return self.api.list(
+            gvk.api_version,
+            gvk.kind,
+            namespace=cron.metadata.namespace,
+            label_selector={LABEL_CRON_NAME: cron.metadata.name},
+        )
+
+    def _sync_status(
+        self,
+        cron: Cron,
+        gvk: GVK,
+        active: List[Unstructured],
+        terminated: List[Unstructured],
+    ) -> None:
+        self._sync_active_list(cron, gvk, active)
+        self._sync_history(cron, gvk, terminated)
+
+    def _sync_active_list(
+        self, cron: Cron, gvk: GVK, active: List[Unstructured]
+    ) -> None:
+        sort_by_creation_timestamp(active)
+        refs = []
+        for w in active:
+            meta = w.get("metadata") or {}
+            refs.append(
+                ObjectReference(
+                    api_version=w.get("apiVersion", gvk.api_version),
+                    kind=w.get("kind", gvk.kind),
+                    name=meta.get("name", ""),
+                    namespace=meta.get("namespace", ""),
+                    uid=meta.get("uid", ""),
+                    resource_version=str(meta.get("resourceVersion", "")),
+                )
+            )
+        cron.status.active = refs
+
+    def _sync_history(
+        self, cron: Cron, gvk: GVK, terminated: List[Unstructured]
+    ) -> None:
+        """Rebuild ``status.history``; delete the oldest terminated workloads
+        beyond historyLimit (their history entries disappear with them —
+        parity with ``cron_controller.go:307-346``). ``finished`` is stamped
+        with the sync time, not read from job conditions (reference quirk,
+        kept so history output matches)."""
+        sort_by_creation_timestamp(terminated)
+        n = len(terminated)
+        limit = (
+            cron.spec.history_limit
+            if cron.spec.history_limit is not None
+            else n  # no limit → keep all
+        )
+        history: List[CronHistory] = []
+        for i, w in enumerate(terminated):
+            meta = w.get("metadata") or {}
+            if i < n - limit:
+                try:
+                    self.api.delete(
+                        w["apiVersion"], w["kind"],
+                        meta.get("namespace", ""), meta.get("name", ""),
+                        propagation="Background",
+                    )
+                    self._count("cron_history_gc_deleted_total")
+                except NotFoundError:
+                    pass
+                continue
+            status_str, finished = is_workload_finished(w)
+            entry = CronHistory(
+                uid=meta.get("uid", ""),
+                object=TypedLocalObjectReference(
+                    # group/version rather than group alone — reference
+                    # back-compat quirk (``cron_controller.go:329-330``).
+                    api_group=gvk.api_version,
+                    kind=w.get("kind", gvk.kind),
+                    name=meta.get("name", ""),
+                ),
+                status=status_str,
+                created=parse_time(meta.get("creationTimestamp")),
+            )
+            if finished:
+                entry.finished = self.clock.now()
+            history.append(entry)
+        cron.status.history = history
+
+    def _new_workload_from_template(
+        self, cron: Cron, template: Unstructured, schedule_time: datetime
+    ) -> Unstructured:
+        """Instantiate the template for one tick
+        (``cron_controller.go:349-387``)."""
+        w = copy.deepcopy(template)
+        meta = w.setdefault("metadata", {})
+
+        # Randomized generateName would break the deterministic-name
+        # duplicate-launch guard across fail-overs; forcibly cleared.
+        meta.pop("generateName", None)
+
+        if not meta.get("name"):
+            meta["name"] = get_default_job_name(cron, schedule_time)
+        else:
+            self.api.record_event(
+                cron.to_dict(),
+                "Normal",
+                "OverridePolicy",
+                "metadata.name has been specified in workload template, "
+                "override cron concurrency policy as Forbidden",
+            )
+            # In-memory only — not persisted to spec (parity with the
+            # reference, which mutates its deepcopy at :369).
+            cron.spec.concurrency_policy = ConcurrencyPolicy.FORBID
+
+        meta["namespace"] = cron.metadata.namespace
+        labels = meta.get("labels") or {}
+        labels[LABEL_CRON_NAME] = cron.metadata.name
+        meta["labels"] = labels
+        meta["ownerReferences"] = [
+            {
+                "apiVersion": API_VERSION,
+                "kind": KIND_CRON,
+                "name": cron.metadata.name,
+                "uid": cron.metadata.uid,
+                "controller": True,
+                "blockOwnerDeletion": True,
+            }
+        ]
+        return w
+
+    def _get_next_schedule(
+        self, cron: Cron, now: datetime
+    ) -> Tuple[Optional[datetime], datetime, int]:
+        """(last missed activation or None, next activation, missed count) —
+        ``cron_controller.go:389-437``. Evaluates in ``spec.timezone`` when
+        set (TPU-native extension; the reference only inherits the container
+        timezone)."""
+        try:
+            sched = parse_standard(cron.spec.schedule)
+        except ValueError as err:
+            raise ValueError(
+                f"unparsable cron {cron.spec.schedule!r}: {err}"
+            ) from err
+
+        tz = timezone.utc
+        if cron.spec.timezone:
+            try:
+                from zoneinfo import ZoneInfo
+
+                tz = ZoneInfo(cron.spec.timezone)
+            except Exception as err:
+                raise ValueError(
+                    f"invalid timezone {cron.spec.timezone!r}: {err}"
+                ) from err
+
+        def localize(t: datetime) -> datetime:
+            return t.astimezone(tz)
+
+        if cron.status.last_schedule_time is not None:
+            earliest = cron.status.last_schedule_time
+        else:
+            earliest = cron.metadata.creation_timestamp or now
+
+        if earliest > now:
+            return None, sched.next(localize(now)).astimezone(timezone.utc), 0
+
+        last_missed: Optional[datetime] = None
+        missed = 0
+        try:
+            t = sched.next(localize(earliest))
+            while t.astimezone(timezone.utc) <= now:
+                last_missed = t.astimezone(timezone.utc)
+                missed += 1
+                if missed >= CATCHUP_ITERATION_CAP:
+                    break
+                t = sched.next(t)
+        except ValueError as err:
+            raise ValueError(
+                f"unschedulable cron {cron.spec.schedule!r}: {err}"
+            ) from err
+
+        if missed > TOO_MANY_MISSED:
+            self.api.record_event(
+                cron.to_dict(),
+                "Warning",
+                "TooManyMissedTimes",
+                f"too many missed start times: {missed}. Check clock skew",
+            )
+
+        next_run = sched.next(localize(now)).astimezone(timezone.utc)
+        return last_missed, next_run, missed
+
+
+__all__ = ["CronReconciler", "ReconcileResult", "TOO_MANY_MISSED"]

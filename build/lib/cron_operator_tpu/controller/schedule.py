@@ -1,0 +1,243 @@
+"""Standard 5-field cron schedule engine.
+
+From-scratch implementation of the scheduling semantics the reference gets
+from ``robfig/cron/v3 ParseStandard`` (used at
+``/root/reference/internal/controller/cron_controller.go:392``):
+
+- five fields: minute hour day-of-month month day-of-week (no seconds field);
+- ``*``, lists (``a,b,c``), ranges (``a-b``), steps (``*/n``, ``a-b/n``, ``a/n``),
+  month and weekday names (``JAN``..``DEC``, ``SUN``..``SAT``), ``?`` as ``*``;
+- vixie-cron day matching: when BOTH day-of-month and day-of-week are
+  restricted, a time matches if EITHER matches; otherwise the restricted one
+  must match;
+- descriptors: ``@yearly @annually @monthly @weekly @daily @midnight @hourly``
+  and ``@every <duration>`` (Go-style durations, e.g. ``1h30m``);
+- 1-minute granularity: ``next(t)`` returns the first activation strictly
+  after ``t``.
+
+Timezone-aware: evaluation happens in the wall-clock of the datetime passed
+in (callers localize; the reconciler handles ``spec.timezone``).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from datetime import datetime, timedelta
+from typing import Optional
+
+MONTH_NAMES = {
+    "jan": 1, "feb": 2, "mar": 3, "apr": 4, "may": 5, "jun": 6,
+    "jul": 7, "aug": 8, "sep": 9, "oct": 10, "nov": 11, "dec": 12,
+}
+DOW_NAMES = {"sun": 0, "mon": 1, "tue": 2, "wed": 3, "thu": 4, "fri": 5, "sat": 6}
+
+DESCRIPTORS = {
+    "@yearly": "0 0 1 1 *",
+    "@annually": "0 0 1 1 *",
+    "@monthly": "0 0 1 * *",
+    "@weekly": "0 0 * * 0",
+    "@daily": "0 0 * * *",
+    "@midnight": "0 0 * * *",
+    "@hourly": "0 * * * *",
+}
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0,
+}
+
+# Search horizon: like robfig, give up after ~5 years of no match
+# (protects against impossible schedules like Feb 30).
+_MAX_SEARCH = timedelta(days=365 * 5 + 2)
+
+
+def parse_go_duration(text: str) -> timedelta:
+    """Parse a Go-style duration string ("1h30m", "90s", "300ms")."""
+    text = text.strip()
+    if not text:
+        raise ValueError("empty duration")
+    negative = text.startswith("-")
+    if negative:
+        text = text[1:]
+    pos = 0
+    total = 0.0
+    matched = 0
+    for m in _DURATION_RE.finditer(text):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration {text!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+        matched += 1
+    if pos != len(text) or matched == 0:
+        raise ValueError(f"invalid duration {text!r}")
+    return timedelta(seconds=-total if negative else total)
+
+
+def _parse_field(expr: str, lo: int, hi: int, names: Optional[dict] = None) -> tuple[int, bool]:
+    """Parse one cron field into (bitmask, is_star).
+
+    is_star is True when the field is ``*`` or ``*/n`` — needed for the
+    vixie dom/dow rule (robfig tracks this with an internal star bit).
+    """
+    mask = 0
+    is_star = False
+    for part in expr.split(","):
+        part = part.strip()
+        if not part:
+            raise ValueError(f"empty list item in field {expr!r}")
+        step = 1
+        has_step = False
+        if "/" in part:
+            rng, step_s = part.rsplit("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise ValueError(f"invalid step {step_s!r} in {expr!r}") from None
+            if step <= 0:
+                raise ValueError(f"step must be positive in {expr!r}")
+            part = rng
+            has_step = True
+
+        def resolve(token: str) -> int:
+            token = token.strip().lower()
+            if names and token in names:
+                return names[token]
+            try:
+                return int(token)
+            except ValueError:
+                raise ValueError(f"invalid value {token!r} in field {expr!r}") from None
+
+        if part in ("*", "?"):
+            start, end = lo, hi
+            if not has_step:
+                is_star = True
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            start, end = resolve(a), resolve(b)
+        else:
+            start = resolve(part)
+            # "a/n" means a-hi/n (vixie extension robfig supports)
+            end = hi if has_step else start
+
+        # dow: accept 7 as Sunday (values normalized modulo 7 below, so a
+        # range like "5-7/2" steps through 5,7 and lands on Fri,Sun — the
+        # step is honored across the wrap).
+        effective_hi = hi
+        if names is DOW_NAMES:
+            if start == 7 and end == 7:
+                start = end = 0
+            elif end == 7:
+                effective_hi = 7
+        if start > end:
+            raise ValueError(f"range start beyond end in field {expr!r}")
+        if start < lo or end > effective_hi:
+            raise ValueError(
+                f"value out of range [{lo},{hi}] in field {expr!r}"
+            )
+        for v in range(start, end + 1, step):
+            mask |= 1 << (0 if (names is DOW_NAMES and v == 7) else v)
+    if mask == 0:
+        raise ValueError(f"field {expr!r} matches nothing")
+    return mask, is_star
+
+
+@dataclass(frozen=True)
+class EverySchedule:
+    """``@every <duration>`` — constant-delay schedule, second precision."""
+
+    interval: timedelta
+
+    def next(self, after: datetime) -> datetime:
+        interval = self.interval
+        if interval < timedelta(seconds=1):
+            interval = timedelta(seconds=1)
+        # t + interval with sub-second truncated (robfig ConstantDelaySchedule
+        # subtracts t's nanoseconds) — rounding *up* here would stretch every
+        # cycle by a second.
+        return after.replace(microsecond=0) + interval
+
+
+class CronSchedule:
+    """Compiled 5-field schedule; ``next(t)`` is the activation strictly after t."""
+
+    __slots__ = ("minute", "hour", "dom", "month", "dow", "dom_star", "dow_star", "source")
+
+    def __init__(self, expr: str):
+        fields = expr.split()
+        if len(fields) != 5:
+            raise ValueError(
+                f"expected exactly 5 fields, found {len(fields)}: {expr!r}"
+            )
+        self.source = expr
+        self.minute, _ = _parse_field(fields[0], 0, 59)
+        self.hour, _ = _parse_field(fields[1], 0, 23)
+        self.dom, self.dom_star = _parse_field(fields[2], 1, 31)
+        self.month, _ = _parse_field(fields[3], 1, 12, MONTH_NAMES)
+        self.dow, self.dow_star = _parse_field(fields[4], 0, 6, DOW_NAMES)
+
+    def _day_matches(self, t: datetime) -> bool:
+        dom_ok = bool(self.dom & (1 << t.day))
+        dow_ok = bool(self.dow & (1 << ((t.weekday() + 1) % 7)))
+        if self.dom_star and self.dow_star:
+            return True
+        if self.dom_star:
+            return dow_ok
+        if self.dow_star:
+            return dom_ok
+        return dom_ok or dow_ok  # both restricted → vixie OR rule
+
+    def next(self, after: datetime) -> datetime:
+        # First candidate: the next whole minute strictly after `after`.
+        t = after.replace(second=0, microsecond=0) + timedelta(minutes=1)
+        limit = after + _MAX_SEARCH
+        while t <= limit:
+            if not (self.month & (1 << t.month)):
+                # advance to the 1st of the next month, 00:00
+                if t.month == 12:
+                    t = t.replace(year=t.year + 1, month=1, day=1,
+                                  hour=0, minute=0)
+                else:
+                    t = t.replace(month=t.month + 1, day=1, hour=0, minute=0)
+                continue
+            if not self._day_matches(t):
+                t = (t.replace(hour=0, minute=0)) + timedelta(days=1)
+                continue
+            if not (self.hour & (1 << t.hour)):
+                t = t.replace(minute=0) + timedelta(hours=1)
+                continue
+            if not (self.minute & (1 << t.minute)):
+                t = t + timedelta(minutes=1)
+                continue
+            return t
+        raise ValueError(
+            f"schedule {self.source!r} has no activation within 5 years"
+        )
+
+
+def parse_standard(expr: str):
+    """Parse a standard cron spec — the ``cron.ParseStandard`` equivalent.
+
+    Returns an object with a ``next(after: datetime) -> datetime`` method.
+    Raises ValueError on anything unparsable (the reconciler surfaces this as
+    a terminal "unparseable schedule" error, matching
+    ``cron_controller.go:392-395``).
+    """
+    expr = expr.strip()
+    if not expr:
+        raise ValueError("empty spec string")
+    if expr.startswith("@"):
+        if expr in DESCRIPTORS:
+            return CronSchedule(DESCRIPTORS[expr])
+        if expr.startswith("@every "):
+            return EverySchedule(parse_go_duration(expr[len("@every "):]))
+        raise ValueError(f"unrecognized descriptor: {expr!r}")
+    return CronSchedule(expr)
+
+
+__all__ = [
+    "CronSchedule",
+    "EverySchedule",
+    "parse_standard",
+    "parse_go_duration",
+]

@@ -1,0 +1,113 @@
+"""Generic workload helpers — the ``internal/controller/cron_util.go`` analog.
+
+The framework handles workloads as unstructured dicts so ANY group/version/
+kind can be scheduled (the template is opaque — reference
+``cron_util.go:37-56``); only status interpretation is typed, through the
+Kubeflow-compatible JobStatus convention in
+:mod:`cron_operator_tpu.api.v1alpha1`.
+"""
+
+from __future__ import annotations
+
+import copy
+from datetime import datetime, timezone
+from typing import Any, Dict, List, Optional, Tuple
+
+from cron_operator_tpu.api.scheme import GVK, gvk_of
+from cron_operator_tpu.api.v1alpha1 import (
+    Cron,
+    JobStatus,
+    job_status_from_unstructured,
+    parse_time,
+)
+
+Unstructured = Dict[str, Any]
+
+
+class WorkloadTemplateError(ValueError):
+    """Raised when the Cron's workload template is missing or invalid."""
+
+
+def new_empty_workload(cron: Cron) -> Unstructured:
+    """Instantiate a fresh unstructured workload from the Cron's template.
+
+    Validation parity with ``newEmptyWorkload`` (``cron_util.go:40-56``):
+    the template must be present, be an object, and carry a full GVK.
+    """
+    workload = cron.spec.template.workload
+    if workload is None:
+        raise WorkloadTemplateError(
+            f"cron {cron.metadata.namespace}/{cron.metadata.name}: "
+            "workload template is empty"
+        )
+    if not isinstance(workload, dict):
+        raise WorkloadTemplateError(
+            f"cron {cron.metadata.namespace}/{cron.metadata.name}: "
+            "workload template is not an object"
+        )
+    obj = copy.deepcopy(workload)
+    if gvk_of(obj) is None:
+        raise WorkloadTemplateError(
+            f"cron {cron.metadata.namespace}/{cron.metadata.name}: "
+            "workload template has empty group/version/kind"
+        )
+    return obj
+
+
+def get_workload_gvk(cron: Cron) -> GVK:
+    """GVK declared by the Cron's workload template (``cron_util.go:59-65``)."""
+    obj = new_empty_workload(cron)
+    gvk = gvk_of(obj)
+    assert gvk is not None  # validated by new_empty_workload
+    return gvk
+
+
+def get_default_job_name(cron: Cron, schedule_time: datetime) -> str:
+    """Deterministic per-tick name ``<cron>-<unixTs>`` (``cron_util.go:69-71``).
+
+    Determinism is the fail-over duplicate-launch guard: a re-run of the same
+    tick collides on AlreadyExists instead of double-launching.
+    """
+    if schedule_time.tzinfo is None:
+        schedule_time = schedule_time.replace(tzinfo=timezone.utc)
+    return f"{cron.metadata.name}-{int(schedule_time.timestamp())}"
+
+
+def is_workload_finished(obj: Unstructured) -> Tuple[str, bool]:
+    """(final condition type, finished?) — terminal iff a Succeeded or Failed
+    condition with status True exists; the reported status is the *last*
+    condition entry's type (``cron_util.go:75-88``)."""
+    status = job_status_from_unstructured(obj)
+    if status is None:
+        return "", False
+    if not (status.is_succeeded() or status.is_failed()):
+        return "", False
+    return status.last_condition_type() or "", True
+
+
+def get_job_status(obj: Unstructured) -> Optional[JobStatus]:
+    """Typed JobStatus of an unstructured workload (``cron_util.go:92-114``).
+
+    Returns None when no status is set yet (a just-created workload)."""
+    return job_status_from_unstructured(obj)
+
+
+def _creation_ts(obj: Unstructured) -> datetime:
+    ts = parse_time((obj.get("metadata") or {}).get("creationTimestamp"))
+    return ts or datetime.min.replace(tzinfo=timezone.utc)
+
+
+def sort_by_creation_timestamp(workloads: List[Unstructured]) -> None:
+    """Stable in-place sort, oldest first (``cron_util.go:117-129``)."""
+    workloads.sort(key=_creation_ts)
+
+
+__all__ = [
+    "WorkloadTemplateError",
+    "new_empty_workload",
+    "get_workload_gvk",
+    "get_default_job_name",
+    "is_workload_finished",
+    "get_job_status",
+    "sort_by_creation_timestamp",
+]

@@ -1,0 +1,115 @@
+"""BERT encoder — the long-context / multi-slice workload.
+
+Acceptance config 5 (BASELINE.md: BERT-base JAXJob on v5e-64 with
+suspend/deadline/preemption) schedules this model. The attention strategy is
+pluggable through :func:`ops.attention.multi_head_attention`: XLA attention
+for short sequences, the Pallas flash kernel on TPU, and ring attention over
+the mesh's ``seq`` axis for sequences too long for one device — the model
+code is identical in all three cases.
+
+Masked-LM objective (tied output embedding) so the loss path ends in a
+vocab-sized matmul — the realistic MXU load profile for a scheduling
+benchmark.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cron_operator_tpu.ops.attention import multi_head_attention
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"  # auto | flash | xla | ring
+    # Run the Pallas kernels under the interpreter — CPU tests of the flash
+    # path (forward AND backward) through the full model; never set on TPU.
+    attention_interpret: bool = False
+
+    @staticmethod
+    def base(**overrides) -> "BertConfig":
+        return BertConfig(**overrides)
+
+    @staticmethod
+    def tiny(**overrides) -> "BertConfig":
+        defaults = dict(
+            vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+            mlp_dim=512, max_len=512,
+        )
+        defaults.update(overrides)
+        return BertConfig(**defaults)
+
+
+class EncoderLayer(nn.Module):
+    config: BertConfig
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        b, s, _ = x.shape
+
+        # Pre-LN (trains stably without warmup — fine for benchmarks).
+        y = nn.LayerNorm(dtype=cfg.dtype)(x)
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
+            name="qkv",
+        )(y)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # each [b, s, h, d]
+        attn = multi_head_attention(
+            q, k, v, impl=cfg.attention_impl, mesh=self.mesh,
+            interpret=cfg.attention_interpret,
+        )
+        attn = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(attn)
+        x = x + attn
+
+        y = nn.LayerNorm(dtype=cfg.dtype)(x)
+        y = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(y)
+        return x + y
+
+
+class Bert(nn.Module):
+    """Token ids ``[batch, seq]`` → MLM logits ``[batch, seq, vocab]``."""
+
+    config: BertConfig = field(default_factory=BertConfig)
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        tok = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
+        )
+        pos = self.param(
+            "pos_emb",
+            nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.hidden_size),
+        )
+        s = input_ids.shape[1]
+        x = tok(input_ids) + pos[None, :s].astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, mesh=self.mesh, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        # Tied output embedding: project back onto the token table.
+        logits = tok.attend(x)
+        return logits.astype(jnp.float32)
+
+
+__all__ = ["Bert", "BertConfig", "EncoderLayer"]

@@ -1,0 +1,34 @@
+"""MNIST-scale MLP — the smallest schedulable workload.
+
+Fills the role of the reference's MNIST example images
+(``/root/reference/examples/v1alpha1/cron/cron-pytorch.yaml`` runs
+``pytorch-dist-mnist``): acceptance configs 1-2 in BASELINE.md schedule this
+model on CPU / a single v5e chip.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Dense → relu stack over flattened images."""
+
+    features: Sequence[int] = (512, 256)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.reshape(x.shape[0], -1).astype(self.dtype)
+        for width in self.features:
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+__all__ = ["MLP"]

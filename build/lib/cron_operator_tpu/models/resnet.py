@@ -1,0 +1,111 @@
+"""ResNet (v1.5) — the flagship benchmark model.
+
+The north-star config (BASELINE.md: ResNet-50 JAXJob on a v5e-16 slice,
+tick→first-step ≤ 90 s) schedules this model. TPU-first choices:
+
+- bf16 compute / f32 params: convs land on the MXU at full rate;
+- GroupNorm instead of BatchNorm: keeps the train step a pure function of
+  (params, batch) — no mutable batch statistics to thread through pjit, no
+  cross-device stat sync, and XLA fuses it into the conv epilogue (same
+  accuracy class for the scheduling benchmarks this framework runs);
+- NHWC layout (TPU conv native), strided 1×1 downsampling on the shortcut
+  (v1.5 puts the stride on the 3×3 — both shapes tile cleanly).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Conv = partial(nn.Conv, use_bias=False, dtype=jnp.bfloat16)
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1 bottleneck with projection shortcut when shapes change."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: Callable[..., Any] = nn.GroupNorm
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = Conv(self.filters, (1, 1), dtype=self.dtype)(x)
+        y = self.norm(dtype=self.dtype)(y)
+        y = nn.relu(y)
+        # v1.5: stride lives on the 3x3, not the 1x1.
+        y = Conv(self.filters, (3, 3), self.strides, dtype=self.dtype)(y)
+        y = self.norm(dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = Conv(self.filters * 4, (1, 1), dtype=self.dtype)(y)
+        y = self.norm(dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = Conv(
+                self.filters * 4, (1, 1), self.strides, dtype=self.dtype
+            )(x)
+            residual = self.norm(dtype=self.dtype)(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """Two 3×3 convs (ResNet-18/34)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: Callable[..., Any] = nn.GroupNorm
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        residual = x
+        y = Conv(self.filters, (3, 3), self.strides, dtype=self.dtype)(x)
+        y = self.norm(dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = Conv(self.filters, (3, 3), dtype=self.dtype)(y)
+        y = self.norm(dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = Conv(
+                self.filters, (1, 1), self.strides, dtype=self.dtype
+            )(x)
+            residual = self.norm(dtype=self.dtype)(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Stage-configurable ResNet over NHWC images."""
+
+    stage_sizes: Sequence[int]
+    block: Any = BottleneckBlock
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 dtype=self.dtype)(x)
+        x = nn.GroupNorm(dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for b in range(n_blocks):
+                strides = (2, 2) if stage > 0 and b == 0 else (1, 1)
+                x = self.block(
+                    filters=self.width * (2 ** stage),
+                    strides=strides,
+                    dtype=self.dtype,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=(2, 2, 2, 2), block=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=(3, 4, 6, 3), block=BottleneckBlock)
+
+__all__ = ["ResNet", "ResNet18", "ResNet50", "BottleneckBlock", "BasicBlock"]

@@ -1,0 +1,12 @@
+"""Hot-op kernel layer: Pallas TPU kernels with XLA fallbacks.
+
+The compute path of the workloads this framework schedules. Attention is the
+one op worth hand-scheduling on TPU (everything else — convs, matmuls,
+norms — XLA already tiles onto the MXU and fuses well); the flash kernel
+keeps the S×S score matrix out of HBM entirely.
+"""
+
+from cron_operator_tpu.ops.attention import multi_head_attention, reference_attention
+from cron_operator_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["multi_head_attention", "reference_attention", "flash_attention"]

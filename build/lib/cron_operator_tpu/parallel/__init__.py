@@ -1,0 +1,35 @@
+"""SPMD parallelism layer: device meshes, sharding rules, and sequence
+parallelism (ring attention) for the JAX workloads this framework schedules.
+
+The reference operator contains no parallelism code of its own (SURVEY.md
+§2.3) — DP/TP/SP live inside the workload containers it launches. In the
+TPU-native build those workloads are first-class framework citizens, so the
+parallel layer lives here: mesh construction from TPU slice topologies,
+shape-driven parameter sharding (FSDP/TP), and ring attention over an ICI
+ring for long-context sequence parallelism.
+"""
+
+from cron_operator_tpu.parallel.mesh import (
+    MeshPlan,
+    batch_pspec,
+    make_mesh,
+    mesh_for_devices,
+    mesh_for_slice,
+    plan_for_devices,
+    pspec_for_shape,
+    sharding_for_tree,
+)
+from cron_operator_tpu.parallel.ring import ring_attention, ring_attention_local
+
+__all__ = [
+    "MeshPlan",
+    "batch_pspec",
+    "make_mesh",
+    "mesh_for_devices",
+    "mesh_for_slice",
+    "plan_for_devices",
+    "pspec_for_shape",
+    "sharding_for_tree",
+    "ring_attention",
+    "ring_attention_local",
+]

@@ -1,0 +1,232 @@
+"""Device-mesh construction and shape-driven sharding rules.
+
+TPU-first design: parallelism is expressed as a `jax.sharding.Mesh` with
+named axes plus `NamedSharding` annotations; XLA GSPMD inserts the
+collectives (all-gather/reduce-scatter/psum) that ride the ICI. Nothing here
+issues a collective by hand — that is the scaling-book recipe (pick a mesh,
+annotate shardings, let XLA do the rest).
+
+Axis convention used across the framework:
+
+- ``data``   — data parallelism (batch axis; gradients all-reduced).
+- ``fsdp``   — parameter sharding (ZeRO-3 style; params/opt-state sharded,
+  all-gathered per layer by GSPMD). Batches are also split over this axis
+  (it is a second data axis from the batch's point of view).
+- ``tensor`` — tensor parallelism (feature/head dimension of weight
+  matrices).
+- ``seq``    — sequence/context parallelism (ring attention over the
+  sequence axis; see :mod:`cron_operator_tpu.parallel.ring`).
+
+The reference operator's analog of this file is *nothing* — it delegates all
+parallelism to workload containers (SURVEY.md §2.3); here the workloads are
+part of the framework.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TENSOR_AXIS = "tensor"
+SEQ_AXIS = "seq"
+
+# Axes over which a batch's leading dimension is split (both are "data" from
+# the input pipeline's perspective).
+BATCH_AXES: Tuple[str, ...] = (DATA_AXIS, FSDP_AXIS)
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A named-axis factorization of a device count."""
+
+    axis_sizes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for s in self.axis_sizes.values():
+            n *= s
+        return n
+
+    def axis(self, name: str) -> int:
+        return self.axis_sizes.get(name, 1)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(self.axis_sizes.keys())
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.axis_sizes.values())
+
+
+def plan_for_devices(
+    n_devices: int,
+    *,
+    tensor: int = 1,
+    seq: int = 1,
+    fsdp: int = 1,
+    data: Optional[int] = None,
+) -> MeshPlan:
+    """Factor ``n_devices`` into the standard axes.
+
+    ``data`` is inferred as the remainder unless given. Raises ValueError if
+    the factorization does not multiply out to ``n_devices``.
+    """
+    model_par = tensor * seq * fsdp
+    if n_devices % model_par != 0:
+        raise ValueError(
+            f"{n_devices} devices not divisible by tensor*seq*fsdp={model_par}"
+        )
+    inferred_data = n_devices // model_par
+    if data is not None and data != inferred_data:
+        raise ValueError(
+            f"data={data} inconsistent: {n_devices} devices / {model_par} = "
+            f"{inferred_data}"
+        )
+    sizes: Dict[str, int] = {DATA_AXIS: inferred_data}
+    if fsdp > 1:
+        sizes[FSDP_AXIS] = fsdp
+    if seq > 1:
+        sizes[SEQ_AXIS] = seq
+    if tensor > 1:
+        sizes[TENSOR_AXIS] = tensor
+    return MeshPlan(sizes)
+
+
+def make_mesh(plan: MeshPlan, devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """Build a Mesh from a plan over the given (or all local) devices.
+
+    Device order follows ``jax.devices()`` reshaped row-major; on real TPU
+    slices that order is topology-contiguous, so the innermost mesh axis
+    lands on ICI-adjacent chips (put ``tensor``/``seq`` innermost — they
+    carry the chattiest collectives).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if plan.n_devices != len(devices):
+        raise ValueError(
+            f"mesh plan needs {plan.n_devices} devices, got {len(devices)}"
+        )
+    arr = np.array(devices, dtype=object).reshape(plan.shape)
+    return Mesh(arr, plan.axis_names)
+
+
+def mesh_for_devices(
+    devices: Optional[Sequence[Any]] = None,
+    *,
+    tensor: int = 1,
+    seq: int = 1,
+    fsdp: int = 1,
+) -> Mesh:
+    """One-call helper: factor the local devices and build the mesh."""
+    devices = list(devices if devices is not None else jax.devices())
+    plan = plan_for_devices(len(devices), tensor=tensor, seq=seq, fsdp=fsdp)
+    return make_mesh(plan, devices)
+
+
+def mesh_for_slice(
+    slice_spec: Any,
+    *,
+    tensor: int = 1,
+    seq: int = 1,
+    fsdp: int = 1,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Mesh over the chips of a :class:`backends.tpu.SliceSpec`.
+
+    The operator side resolves a Cron's TPU annotation into a SliceSpec
+    (hosts × chips/host); the workload side turns the same spec into the
+    mesh its train step is jitted over — one source of truth for topology.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != slice_spec.chips:
+        raise ValueError(
+            f"slice {slice_spec.topology!r} has {slice_spec.chips} chips but "
+            f"{len(devices)} devices are visible"
+        )
+    plan = plan_for_devices(
+        slice_spec.chips, tensor=tensor, seq=seq, fsdp=fsdp
+    )
+    return make_mesh(plan, devices)
+
+
+# ---- sharding rules --------------------------------------------------------
+
+
+def batch_pspec(mesh: Mesh, *, seq_dim: Optional[int] = None) -> P:
+    """PartitionSpec for a batch: leading dim over data axes, optionally a
+    sequence dim over the seq axis."""
+    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    lead = batch_axes if batch_axes else None
+    if seq_dim is None:
+        return P(lead)
+    if seq_dim <= 0:
+        raise ValueError("seq_dim must be a positive dim index")
+    entries: list = [lead] + [None] * seq_dim
+    if SEQ_AXIS in mesh.axis_names:
+        entries[seq_dim] = SEQ_AXIS
+    return P(*entries)
+
+
+def pspec_for_shape(shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Shape-driven parameter sharding rule.
+
+    - rank 0/1 leaves (biases, scales, scalars): replicated;
+    - if the mesh has a ``tensor`` axis and the last dim divides by it:
+      shard last dim on ``tensor`` (megatron-style column split; GSPMD
+      derives the matching row split and psum for the next matmul);
+    - if the mesh has an ``fsdp`` axis: shard the largest remaining dim
+      divisible by it (ZeRO-3 parameter sharding).
+
+    Deliberately metadata-free: works for any pytree of arrays (params AND
+    optimizer state, which mirrors param shapes), so a model needs no
+    per-layer annotations to scale. Models can still override hot tensors
+    with explicit ``with_sharding_constraint``.
+    """
+    spec: list = [None] * len(shape)
+    if len(shape) >= 2:
+        t = mesh.shape.get(TENSOR_AXIS, 1)
+        if t > 1 and shape[-1] % t == 0:
+            spec[-1] = TENSOR_AXIS
+        f = mesh.shape.get(FSDP_AXIS, 1)
+        if f > 1:
+            for i in sorted(range(len(shape)), key=lambda i: -shape[i]):
+                if spec[i] is None and shape[i] % f == 0:
+                    spec[i] = FSDP_AXIS
+                    break
+    return P(*spec)
+
+
+def sharding_for_tree(tree: Any, mesh: Mesh) -> Any:
+    """Map a pytree of arrays/ShapeDtypeStructs to NamedShardings via
+    :func:`pspec_for_shape`. Use with ``jax.jit(in_shardings=...)`` or
+    ``jax.device_put``."""
+
+    def _one(leaf: Any) -> NamedSharding:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        return NamedSharding(mesh, pspec_for_shape(shape, mesh))
+
+    return jax.tree_util.tree_map(_one, tree)
+
+
+__all__ = [
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "TENSOR_AXIS",
+    "SEQ_AXIS",
+    "BATCH_AXES",
+    "MeshPlan",
+    "plan_for_devices",
+    "make_mesh",
+    "mesh_for_devices",
+    "mesh_for_slice",
+    "batch_pspec",
+    "pspec_for_shape",
+    "sharding_for_tree",
+]

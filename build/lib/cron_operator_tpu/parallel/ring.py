@@ -1,0 +1,179 @@
+"""Ring attention: exact sequence-parallel attention over an ICI ring.
+
+Long sequences are split over the mesh's ``seq`` axis; each device holds a
+local block of Q, K, V. K/V blocks rotate around the ring with
+``lax.ppermute`` (nearest-neighbor — rides ICI links, never DCN) while each
+device folds every block into its local queries' attention with a
+numerically-stable online softmax (flash-attention style running max /
+normalizer). After ``ring_size`` steps every Q block has seen every K/V
+block exactly once: the result is *bitwise-equivalent math* to full
+attention, with O(seq/ring) memory per device and communication overlapped
+with compute by XLA.
+
+This is the capability the reference delegates entirely to workload
+containers (SURVEY.md §2.3: "sequence/context parallelism — absent,
+delegated"); here it is a framework primitive the BERT workload composes
+via ``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from cron_operator_tpu.parallel.mesh import BATCH_AXES, SEQ_AXIS
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Per-device body (call under ``shard_map`` with ``q/k/v`` local blocks).
+
+    Args:
+      q, k, v: ``[batch, seq_local, heads, head_dim]`` — this device's block
+        of the sequence.
+      axis_name: the mesh axis forming the ring.
+      causal: apply a causal mask in *global* sequence coordinates (block
+        offsets are derived from ``lax.axis_index``).
+
+    Returns ``[batch, seq_local, heads, head_dim]`` in ``q.dtype``.
+    """
+    ring = lax.psum(1, axis_name)
+    my_block = lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my_block * t + lax.broadcasted_iota(jnp.int32, (t, 1), 0)
+
+    # One hop around the ring: i → i+1 (nearest neighbor).
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def step(carry, step_idx):
+        o, m, l, k_cur, v_cur = carry
+        # The block this device holds after `step_idx` hops originated at
+        # device (my_block - step_idx) mod ring.
+        src = (my_block - step_idx) % ring
+
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            k_pos = src * t + lax.broadcasted_iota(jnp.int32, (1, t), 1)
+            mask = (k_pos <= q_pos)[None, None, :, :]  # [1,1,q,k]
+            s = jnp.where(mask, s, -jnp.inf)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # With full masking a row can be all -inf on this block; keep the
+        # running max finite so exp() stays well-defined.
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        alpha = jnp.exp(jnp.where(jnp.isneginf(m), m_safe, m) - m_safe)
+        alpha = jnp.where(jnp.isneginf(m), 0.0, alpha)
+
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros((b, h, t, d), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    (o, _, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(ring)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → zeros, not NaN
+    out = (o / l[..., None]).transpose(0, 2, 1, 3)  # [b,t,h,d]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    seq_axis: str = SEQ_AXIS,
+) -> jax.Array:
+    """Sequence-parallel attention on global ``[batch, seq, heads, head_dim]``
+    arrays. Call inside ``jit``; ``shard_map`` splits the sequence over
+    ``seq_axis`` (and batch over the data axes) and runs the ring body.
+
+    Falls back to a single-block ring (plain attention) when the mesh has no
+    ``seq_axis`` — same code path either way.
+    """
+    ring = mesh.shape.get(seq_axis, 1)
+    if ring > 1 and q.shape[1] % ring != 0:
+        if q.shape[0] > 1:
+            # A real batch with an indivisible sequence would silently
+            # materialize full S×S attention — exactly the OOM/perf cliff
+            # this op exists to avoid. Fail loudly; pad upstream.
+            raise ValueError(
+                f"ring_attention: seq len {q.shape[1]} does not divide the "
+                f"{ring}-way {seq_axis!r} axis; pad the sequence or resize "
+                "the mesh (silent fallback is allowed only for batch-of-1 "
+                "init traces)"
+            )
+        # Batch-of-1 trace during model.init: plain local attention.
+        return _single_device_attention(q, k, v, causal=causal)
+    if ring <= 1:
+        return _single_device_attention(q, k, v, causal=causal)
+
+    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    batch_size = 1
+    for a in batch_axes:
+        batch_size *= mesh.shape[a]
+    # Keep the batch replicated when it doesn't divide (init-time traces).
+    lead = batch_axes if batch_axes and q.shape[0] % batch_size == 0 else None
+    spec = P(lead, seq_axis, None, None)
+
+    fn = partial(ring_attention_local, axis_name=seq_axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _single_device_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool
+) -> jax.Array:
+    """Plain attention reference ([b,s,h,d] layout), f32 accumulation."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1) <= (
+            lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
+        )
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+__all__ = ["ring_attention", "ring_attention_local"]

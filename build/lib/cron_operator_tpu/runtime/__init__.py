@@ -1,0 +1,34 @@
+"""Runtime layer: embedded control plane (object store, watches, events,
+owner-reference GC) and the manager that wires controllers to it.
+
+The reference runs against a real kube-apiserver through controller-runtime
+(caches/informers/workqueues, ``cmd/operator/start.go:156-206``); this
+framework embeds an equivalent control plane in-process so the scheduling
+loop, the training runtime and the tests all run against one consistent,
+dependency-free substrate (swappable later for a real cluster client).
+"""
+
+from cron_operator_tpu.runtime.kube import (
+    APIServer,
+    ApiError,
+    NotFoundError,
+    AlreadyExistsError,
+    ConflictError,
+    InvalidError,
+    Event,
+    WatchEvent,
+)
+from cron_operator_tpu.runtime.manager import Manager, Request
+
+__all__ = [
+    "APIServer",
+    "ApiError",
+    "NotFoundError",
+    "AlreadyExistsError",
+    "ConflictError",
+    "InvalidError",
+    "Event",
+    "WatchEvent",
+    "Manager",
+    "Request",
+]

@@ -215,20 +215,6 @@ class APIServer:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[Unstructured]:
-        return self.list_with_rv(api_version, kind, namespace,
-                                 label_selector)[0]
-
-    def list_with_rv(
-        self,
-        api_version: str,
-        kind: str,
-        namespace: Optional[str] = None,
-        label_selector: Optional[Dict[str, str]] = None,
-    ) -> Tuple[List[Unstructured], str]:
-        """List plus the store resourceVersion of the SAME snapshot — the
-        list-then-watch contract: a watch resuming from this rv must see
-        every event after the snapshot, so both must be read under one
-        lock."""
         with self._lock:
             out = []
             for (av, k, ns, _), obj in self._objects.items():
@@ -239,7 +225,7 @@ class APIServer:
                 if not match_labels(obj, label_selector):
                     continue
                 out.append(copy.deepcopy(obj))
-            return out, str(self._rv)
+            return out
 
     def update(self, obj: Unstructured) -> Unstructured:
         """Full-object replace with optimistic-concurrency check."""
@@ -305,10 +291,6 @@ class APIServer:
             obj = self._objects.pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            # Deletion advances the store version and the final DELETED
-            # object carries it (etcd semantics) — watch clients resuming
-            # from their last-seen rv must not miss deletions.
-            obj["metadata"]["resourceVersion"] = self._next_rv()
             self._notify("DELETED", obj)
             if propagation in ("Background", "Foreground"):
                 self._cascade_delete(obj["metadata"].get("uid"), namespace)
@@ -328,7 +310,6 @@ class APIServer:
         for k in dependents:
             dep = self._objects.pop(k, None)
             if dep is not None:
-                dep["metadata"]["resourceVersion"] = self._next_rv()
                 self._notify("DELETED", dep)
                 self._cascade_delete(dep["metadata"].get("uid"), namespace)
 

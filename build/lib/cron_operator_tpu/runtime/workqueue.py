@@ -1,0 +1,154 @@
+"""Rate-limited, deduplicating work queue with delayed adds.
+
+The client-go workqueue analog the reference gets via controller-runtime
+(``cmd/operator/start.go:174-176`` configures up to 10 concurrent workers
+draining it). Semantics preserved from client-go:
+
+- an item present in the queue is not added twice (dedup),
+- an item re-added while being processed is re-queued when done,
+- per-item exponential backoff for failures (5ms base → 1000s cap, the
+  client-go DefaultItemBasedRateLimiter curve),
+- ``add_after`` schedules a future add (RequeueAfter timer path).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Any, Dict, Generic, Hashable, List, Optional, Tuple, TypeVar
+
+T = TypeVar("T", bound=Hashable)
+
+
+class ItemExponentialBackoff:
+    def __init__(self, base_s: float = 0.005, cap_s: float = 1000.0):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self._failures: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Any) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        # clamp the exponent: 2**n overflows float for persistent failures
+        if n > 64:
+            return self.cap_s
+        return min(self.base_s * (2**n), self.cap_s)
+
+    def forget(self, item: Any) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Any) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class WorkQueue(Generic[T]):
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._queue: List[T] = []
+        self._dirty: set = set()
+        self._processing: set = set()
+        self._shutdown = False
+        # delayed adds: heap of (deadline_monotonic, seq, item)
+        self._delayed: List[Tuple[float, int, T]] = []
+        self._seq = itertools.count()
+        self._delay_thread = threading.Thread(
+            target=self._delay_loop, name="workqueue-delay", daemon=True
+        )
+        self._delay_thread.start()
+        self.rate_limiter = ItemExponentialBackoff()
+
+    # ---- core add/get/done ------------------------------------------------
+
+    def add(self, item: T) -> None:
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # will be re-queued on done()
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[T]:
+        """Block until an item is available; None on shutdown/timeout."""
+        with self._cond:
+            deadline = time.monotonic() + timeout if timeout is not None else None
+            while not self._queue and not self._shutdown:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                self._cond.wait(remaining)
+            if self._shutdown and not self._queue:
+                return None
+            item = self._queue.pop(0)
+            self._processing.add(item)
+            self._dirty.discard(item)
+            return item
+
+    def done(self, item: T) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # ---- delayed / rate-limited adds --------------------------------------
+
+    def add_after(self, item: T, delay_s: float) -> None:
+        if delay_s <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            heapq.heappush(
+                self._delayed, (time.monotonic() + delay_s, next(self._seq), item)
+            )
+            self._cond.notify()
+
+    def add_rate_limited(self, item: T) -> None:
+        self.add_after(item, self.rate_limiter.when(item))
+
+    def forget(self, item: T) -> None:
+        self.rate_limiter.forget(item)
+
+    def _delay_loop(self) -> None:
+        while True:
+            due: List[T] = []
+            with self._cond:
+                if self._shutdown:
+                    return
+                now = time.monotonic()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, item = heapq.heappop(self._delayed)
+                    due.append(item)
+            for item in due:
+                self.add(item)
+            time.sleep(0.005)
+
+    # ---- shutdown ---------------------------------------------------------
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    @property
+    def is_shut_down(self) -> bool:
+        with self._cond:
+            return self._shutdown
+
+
+__all__ = ["WorkQueue", "ItemExponentialBackoff"]

@@ -1,0 +1,5 @@
+"""Utilities: clocks, logging, metrics, checkpointing helpers."""
+
+from cron_operator_tpu.utils.clock import Clock, RealClock, FakeClock
+
+__all__ = ["Clock", "RealClock", "FakeClock"]

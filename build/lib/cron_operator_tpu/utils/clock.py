@@ -1,0 +1,57 @@
+"""Injectable clock.
+
+The reference reconciler takes ``now`` explicitly in its schedule math
+(``cron_controller.go:184,389``) which is what makes it testable without
+sleeping; we push that one step further with a process-wide injectable clock
+so the manager loop, executor and tests share one time source.
+"""
+
+from __future__ import annotations
+
+import threading
+from datetime import datetime, timedelta, timezone
+
+
+class Clock:
+    def now(self) -> datetime:
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+
+class RealClock(Clock):
+    def now(self) -> datetime:
+        return datetime.now(timezone.utc)
+
+    def sleep(self, seconds: float) -> None:
+        import time
+
+        time.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Deterministic clock for tests; ``sleep`` advances virtual time."""
+
+    def __init__(self, start: datetime | None = None):
+        self._now = start or datetime(2026, 1, 1, tzinfo=timezone.utc)
+        self._lock = threading.Lock()
+
+    def now(self) -> datetime:
+        with self._lock:
+            return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(timedelta(seconds=seconds))
+
+    def advance(self, delta: timedelta) -> datetime:
+        with self._lock:
+            self._now += delta
+            return self._now
+
+    def set(self, t: datetime) -> None:
+        with self._lock:
+            self._now = t
+
+
+__all__ = ["Clock", "RealClock", "FakeClock"]

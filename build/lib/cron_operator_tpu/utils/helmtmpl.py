@@ -1,0 +1,397 @@
+"""Minimal Helm-template renderer for the project chart.
+
+``helm template``-compatible rendering of ``charts/cron-operator-tpu`` in
+pure stdlib Python: the chart stays a standard Helm chart (installable with
+real helm), while environments without the helm binary — this build image,
+the CI gate, the chart unit tests — can still render and pin the
+values→flags mapping (the reference pins it with helm-unittest:
+``/root/reference/charts/cron-operator/tests/deployment_test.yaml``).
+
+Supported template subset (the chart is authored against exactly this):
+
+- actions ``{{ ... }}`` with ``{{-``/``-}}`` whitespace trimming;
+- paths ``.Values.a.b``, ``.Chart.Name``/``.Chart.Version``/``.Chart.AppVersion``,
+  ``.Release.Name``/``.Release.Namespace``, and bare ``.`` (current scope);
+- pipelines with ``default``, ``quote``, ``toYaml``, ``nindent``, ``indent``,
+  ``trunc``, ``trimSuffix``, ``lower``, ``toString``;
+- ``include "name" .`` of ``{{ define }}`` blocks from ``_helpers.tpl``;
+- ``printf "fmt" args...`` (%s/%d), ``eq``, ``not``;
+- blocks: ``if``/``else``/``end``, ``with``/``end`` (rebinds ``.``).
+
+``range`` is intentionally unsupported — list-valued values are emitted via
+``toYaml``, which keeps templates in the subset and output deterministic.
+
+CLI: ``python -m cron_operator_tpu.utils.helmtmpl CHART_DIR [--set k=v ...]
+[--values FILE] [--release NAME] [--namespace NS]`` prints the rendered
+multi-document YAML exactly like ``helm template``.
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+_ACTION = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.S)
+
+
+def _split_actions(src: str) -> List[Tuple[str, str]]:
+    """Template source → [(kind, payload)]: kind 'text' or 'action'.
+
+    ``{{-`` trims ALL trailing whitespace from the preceding text and
+    ``-}}`` ALL leading whitespace from the following text — Go template
+    semantics, which the chart's YAML layout relies on."""
+    parts: List[Tuple[str, str]] = []
+    pos = 0
+    trim_next = False
+    while True:
+        m = _ACTION.search(src, pos)
+        if not m:
+            text = src[pos:]
+            parts.append(("text", text.lstrip() if trim_next else text))
+            return parts
+        text = src[pos:m.start()]
+        if trim_next:
+            text = text.lstrip()
+        if m.group(0).startswith("{{-"):
+            text = text.rstrip()
+        parts.append(("text", text))
+        parts.append(("action", m.group(1).strip()))
+        trim_next = m.group(0).endswith("-}}")
+        pos = m.end()
+
+
+class _Scope:
+    """The template context: ``.`` plus Values/Chart/Release roots."""
+
+    def __init__(self, root: Dict[str, Any], dot: Any = None):
+        self.root = root
+        self.dot = root if dot is None else dot
+
+    def rebind(self, dot: Any) -> "_Scope":
+        return _Scope(self.root, dot)
+
+    def resolve(self, path: str) -> Any:
+        if path == ".":
+            return self.dot
+        cur: Any = self.root if path.startswith(".Values") or \
+            path.startswith(".Chart") or path.startswith(".Release") else None
+        if cur is None:
+            # relative to dot (e.g. inside `with`)
+            cur = self.dot
+            segments = path.lstrip(".").split(".")
+        else:
+            segments = path.lstrip(".").split(".")
+        for seg in segments:
+            if not seg:
+                continue
+            if isinstance(cur, dict):
+                cur = cur.get(seg)
+            else:
+                cur = getattr(cur, seg, None)
+            if cur is None:
+                return None
+        return cur
+
+
+def _truthy(v: Any) -> bool:
+    return bool(v) and v != {} and v != []
+
+
+def _to_yaml(v: Any) -> str:
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+class Renderer:
+    def __init__(self, chart_dir: Path, values: Dict[str, Any],
+                 release: str = "release-name", namespace: str = "default"):
+        self.chart_dir = Path(chart_dir)
+        meta = yaml.safe_load((self.chart_dir / "Chart.yaml").read_text())
+        self.context: Dict[str, Any] = {
+            "Values": values,
+            "Chart": {
+                "Name": meta.get("name", ""),
+                "Version": str(meta.get("version", "")),
+                "AppVersion": str(meta.get("appVersion", "")),
+            },
+            "Release": {"Name": release, "Namespace": namespace},
+        }
+        self.defines: Dict[str, List[Tuple[str, str]]] = {}
+        for tpl in sorted((self.chart_dir / "templates").glob("*.tpl")):
+            self._collect_defines(tpl.read_text())
+
+    # -- defines ------------------------------------------------------------
+
+    def _collect_defines(self, src: str) -> None:
+        parts = _split_actions(src)
+        i = 0
+        while i < len(parts):
+            kind, payload = parts[i]
+            if kind == "action" and payload.startswith("define "):
+                name = shlex.split(payload[len("define "):])[0]
+                depth, body = 1, []
+                i += 1
+                while i < len(parts):
+                    k, p = parts[i]
+                    if k == "action":
+                        head = p.split()[0] if p.split() else ""
+                        if head in ("define", "if", "with", "range"):
+                            depth += 1
+                        elif head == "end":
+                            depth -= 1
+                            if depth == 0:
+                                break
+                    body.append((k, p))
+                    i += 1
+                self.defines[name] = body
+            i += 1
+
+    # -- expression evaluation ----------------------------------------------
+
+    def _eval_atom(self, tokens: List[str], scope: _Scope) -> Any:
+        """Evaluate one function-call or literal from ``tokens``."""
+        head, args = tokens[0], tokens[1:]
+        if head.startswith('"') or head.startswith("'"):
+            assert not args, f"unexpected args after literal: {tokens}"
+            return head[1:-1]
+        if re.fullmatch(r"-?\d+", head):
+            return int(head)
+        if head in ("true", "false"):
+            return head == "true"
+        if head.startswith("."):
+            assert not args, f"unexpected args after path: {tokens}"
+            return scope.resolve(head)
+        if head == "include":
+            name = self._eval_atom([args[0]], scope)
+            assert args[1] == ".", "include supports only '.' context"
+            return self._render_parts(self.defines[name], scope)
+        if head == "printf":
+            fmt = self._eval_atom([args[0]], scope)
+            vals = [self._eval_atom([a], scope) for a in args[1:]]
+            return fmt.replace("%d", "%s") % tuple(_fmt(v) for v in vals)
+        if head == "not":
+            return not _truthy(self._eval_atom(args, scope))
+        if head == "eq":
+            a, b = (self._eval_atom([t], scope) for t in args[:2])
+            return a == b
+        if head == "toYaml":
+            return _to_yaml(self._eval_atom(args, scope))
+        raise ValueError(f"unsupported template function {head!r}")
+
+    def _eval(self, expr: str, scope: _Scope) -> Any:
+        stages = [shlex.split(s, posix=False)
+                  for s in self._split_pipeline(expr)]
+        value = self._eval_atom(stages[0], scope)
+        for stage in stages[1:]:
+            fn, args = stage[0], stage[1:]
+            if fn == "default":
+                dflt = self._eval_atom(args, scope)
+                value = value if _truthy(value) else dflt
+            elif fn == "quote":
+                value = '"%s"' % _fmt(value)
+            elif fn == "toYaml":
+                value = _to_yaml(value)
+            elif fn == "nindent":
+                n = int(args[0])
+                pad = " " * n
+                value = "\n" + "\n".join(
+                    pad + ln if ln else ln for ln in _fmt(value).split("\n")
+                )
+            elif fn == "indent":
+                n = int(args[0])
+                pad = " " * n
+                value = "\n".join(
+                    pad + ln if ln else ln for ln in _fmt(value).split("\n")
+                )
+            elif fn == "trunc":
+                value = _fmt(value)[: int(args[0])]
+            elif fn == "trimSuffix":
+                suf = self._eval_atom(args, scope)
+                v = _fmt(value)
+                value = v[: -len(suf)] if suf and v.endswith(suf) else v
+            elif fn == "lower":
+                value = _fmt(value).lower()
+            elif fn == "toString":
+                value = _fmt(value)
+            else:
+                raise ValueError(f"unsupported pipeline function {fn!r}")
+        return value
+
+    @staticmethod
+    def _split_pipeline(expr: str) -> List[str]:
+        out, depth, cur = [], 0, []
+        quote = None
+        for ch in expr:
+            if quote:
+                if ch == quote:
+                    quote = None
+                cur.append(ch)
+            elif ch in "\"'":
+                quote = ch
+                cur.append(ch)
+            elif ch == "(":
+                depth += 1
+                cur.append(ch)
+            elif ch == ")":
+                depth -= 1
+                cur.append(ch)
+            elif ch == "|" and depth == 0:
+                out.append("".join(cur).strip())
+                cur = []
+            else:
+                cur.append(ch)
+        out.append("".join(cur).strip())
+        return out
+
+    # -- block structure -----------------------------------------------------
+
+    def _render_parts(self, parts: List[Tuple[str, str]], scope: _Scope) -> str:
+        out: List[str] = []
+        i = 0
+        while i < len(parts):
+            kind, payload = parts[i]
+            if kind == "text":
+                out.append(payload)
+                i += 1
+                continue
+            head = payload.split()[0] if payload.split() else ""
+            if head in ("if", "with"):
+                block, else_block, i = self._collect_block(parts, i)
+                cond_expr = payload[len(head):].strip()
+                value = self._eval(cond_expr, scope)
+                if _truthy(value):
+                    inner = scope.rebind(value) if head == "with" else scope
+                    out.append(self._render_parts(block, inner))
+                elif else_block is not None:
+                    out.append(self._render_parts(else_block, scope))
+            elif head == "define":
+                # skip nested define bodies in output position
+                _, _, i = self._collect_block(parts, i)
+            elif head in ("end", "else"):
+                raise ValueError(f"unbalanced {head!r}")
+            else:
+                val = self._eval(payload, scope)
+                out.append(_fmt(val))
+                i += 1
+        return "".join(out)
+
+    def _collect_block(self, parts, i):
+        """From the opener at ``i``, collect body (and else-body) through the
+        matching end; returns (body, else_body_or_None, next_index)."""
+        depth = 1
+        body: List[Tuple[str, str]] = []
+        else_body: Optional[List[Tuple[str, str]]] = None
+        cur = body
+        i += 1
+        while i < len(parts):
+            k, p = parts[i]
+            if k == "action":
+                h = p.split()[0] if p.split() else ""
+                if h in ("if", "with", "range", "define"):
+                    depth += 1
+                elif h == "else" and depth == 1:
+                    else_body = []
+                    cur = else_body
+                    i += 1
+                    continue
+                elif h == "end":
+                    depth -= 1
+                    if depth == 0:
+                        return body, else_body, i + 1
+            cur.append((k, p))
+            i += 1
+        raise ValueError("unterminated block")
+
+    # -- entry ---------------------------------------------------------------
+
+    def render(self) -> Dict[str, str]:
+        """Render every non-helper template; returns {relative path: text}."""
+        scope = _Scope(self.context)
+        out: Dict[str, str] = {}
+        for tpl in sorted((self.chart_dir / "templates").glob("*.yaml")):
+            text = self._render_parts(_split_actions(tpl.read_text()), scope)
+            if text.strip():
+                out[f"templates/{tpl.name}"] = text
+        return out
+
+    def render_objects(self) -> List[Dict[str, Any]]:
+        objs: List[Dict[str, Any]] = []
+        for text in self.render().values():
+            for doc in yaml.safe_load_all(text):
+                if doc:
+                    objs.append(doc)
+        return objs
+
+
+def _deep_merge(base: Dict[str, Any], over: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def load_values(chart_dir: Path, overrides: Optional[Dict[str, Any]] = None,
+                extra_files: Optional[List[Path]] = None) -> Dict[str, Any]:
+    values = yaml.safe_load((Path(chart_dir) / "values.yaml").read_text()) or {}
+    for f in extra_files or []:
+        values = _deep_merge(values, yaml.safe_load(Path(f).read_text()) or {})
+    return _deep_merge(values, overrides or {})
+
+
+def _set_path(values: Dict[str, Any], dotted: str, raw: str) -> None:
+    keys = dotted.split(".")
+    cur = values
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+    try:
+        val: Any = yaml.safe_load(raw)
+    except yaml.YAMLError:
+        val = raw
+    cur[keys[-1]] = val
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="helmtmpl", description="render the project Helm chart"
+    )
+    p.add_argument("chart", help="chart directory")
+    p.add_argument("--set", action="append", default=[], metavar="K=V")
+    p.add_argument("--values", action="append", default=[], metavar="FILE")
+    p.add_argument("--release", default="cron-operator-tpu")
+    p.add_argument("--namespace", default="default")
+    args = p.parse_args(argv)
+
+    overrides: Dict[str, Any] = {}
+    for s in args.set:
+        k, _, v = s.partition("=")
+        _set_path(overrides, k, v)
+    values = load_values(Path(args.chart), overrides,
+                         [Path(f) for f in args.values])
+    r = Renderer(Path(args.chart), values, release=args.release,
+                 namespace=args.namespace)
+    for name, text in r.render().items():
+        sys.stdout.write(f"---\n# Source: {name}\n{text.strip()}\n")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""Schedulable training workloads.
+
+In the reference's world a workload is a container image the operator never
+looks inside (SURVEY.md §3.2 hand-off boundary). In the local TPU runtime a
+workload is a registered entrypoint (``backends.registry``) built from the
+pieces in this package: a model (:mod:`models`), a sharded train step
+(:mod:`workloads.train`), and synthetic data (:mod:`workloads.data`).
+
+Importing this package registers the standard entrypoints
+(``mnist`` / ``resnet50`` / ``bert``) used by the BASELINE.md acceptance
+configs and by ``bench.py``.
+"""
+
+from cron_operator_tpu.workloads.train import (
+    TrainConfig,
+    Trainer,
+    cross_entropy_loss,
+)
+from cron_operator_tpu.workloads import entrypoints as _entrypoints  # noqa: F401
+
+__all__ = ["TrainConfig", "Trainer", "cross_entropy_loss"]

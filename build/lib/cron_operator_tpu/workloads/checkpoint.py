@@ -1,0 +1,98 @@
+"""Workload checkpoint/resume via Orbax.
+
+The recovery half of the preemption story (SURVEY.md §5: "TPU preemption is
+the big new case... job-level restartPolicy + JAX in-workload checkpoint
+restore do the rest"; reference has NO model checkpointing — operator-level
+state is only ``status.lastScheduleTime`` in etcd). Flow:
+
+- the Trainer saves its full TrainState (params + optimizer state + step)
+  every ``save_every`` steps through an Orbax CheckpointManager;
+- after a slice preemption the executor re-admits the job
+  (``backends/local.py`` Restarting path) or the training-operator restarts
+  the pods; the entrypoint's Trainer restores the latest step and continues
+  — steps already done are not repeated;
+- checkpoints are sharding-aware: Orbax restores directly into the mesh
+  layout the Trainer hands it (no host-side gather), which is what makes
+  this viable for FSDP-sharded states on real slices.
+
+Directory convention: ``<root>/<namespace>/<lineage>``. Default lineage is
+the FULL job name — preemption restarts re-run the same job name, so they
+find their own checkpoints, while concurrent ticks (Allow/Replace) get
+distinct directories and can never collide. Opt-in ``lineage="family"``
+strips the per-tick unix suffix so successive Forbid ticks continue one
+long training run (each tick resumes where the last stopped; once the
+step target is reached further ticks are no-ops by design).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any, Optional
+
+logger = logging.getLogger("workloads.checkpoint")
+
+DEFAULT_ROOT = os.environ.get("TPU_CHECKPOINT_DIR", "/tmp/cron-operator-tpu/ckpt")
+
+_TICK_SUFFIX = re.compile(r"-\d{9,11}$")  # "<cron>-<unixTs>" → "<cron>"
+
+
+def job_family(name: str) -> str:
+    """Strip the per-tick unix-timestamp suffix from a deterministic job
+    name so successive runs share a checkpoint lineage."""
+    return _TICK_SUFFIX.sub("", name) or name
+
+
+class CheckpointStore:
+    """Thin Orbax CheckpointManager wrapper bound to one job family."""
+
+    def __init__(
+        self,
+        namespace: str,
+        job_name: str,
+        root: Optional[str] = None,
+        max_to_keep: int = 3,
+        lineage: str = "job",  # "job" | "family" — see module docstring
+    ):
+        import orbax.checkpoint as ocp
+
+        if lineage not in ("job", "family"):
+            raise ValueError(f"unknown checkpoint lineage {lineage!r}")
+        key = job_family(job_name) if lineage == "family" else job_name
+        self.directory = os.path.join(root or DEFAULT_ROOT, namespace, key)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def save(self, step: int, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, step: int, like: Any) -> Any:
+        """Restore ``step`` into the sharding/structure of ``like`` (an
+        abstract or concrete TrainState pytree)."""
+        import orbax.checkpoint as ocp
+
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(like))
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        try:
+            self._mgr.wait_until_finished()
+            self._mgr.close()
+        except Exception:
+            logger.warning("checkpoint manager close failed", exc_info=True)
+
+
+__all__ = ["CheckpointStore", "job_family", "DEFAULT_ROOT"]

@@ -1,0 +1,54 @@
+"""Synthetic data pipelines for the benchmark/acceptance workloads.
+
+Deterministic host-side numpy generation (seeded per workload), shaped like
+the real datasets (MNIST images, ImageNet crops, tokenized text). Synthetic
+data keeps ``bench.py`` hermetic — the metric under test is the scheduling
+and training machinery, not dataset IO — matching how the reference's CI
+exercises jobs without real training (SURVEY.md §4: jobs are created and
+listed but never run).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator
+
+import numpy as np
+
+
+def mnist_batches(batch_size: int, seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """28×28 grayscale images, 10 classes."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {
+            "x": rng.standard_normal((batch_size, 28, 28, 1), dtype=np.float32),
+            "y": rng.integers(0, 10, size=(batch_size,), dtype=np.int32),
+        }
+
+
+def imagenet_batches(
+    batch_size: int, image_size: int = 224, num_classes: int = 1000,
+    seed: int = 0,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """NHWC float images, ImageNet-shaped."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {
+            "x": rng.standard_normal(
+                (batch_size, image_size, image_size, 3), dtype=np.float32
+            ),
+            "y": rng.integers(0, num_classes, size=(batch_size,), dtype=np.int32),
+        }
+
+
+def token_batches(
+    batch_size: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Token-id sequences with MLM-style targets (predict every position)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        ids = rng.integers(0, vocab_size, size=(batch_size, seq_len),
+                           dtype=np.int32)
+        yield {"x": ids, "y": ids}
+
+
+__all__ = ["mnist_batches", "imagenet_batches", "token_batches"]

@@ -1,0 +1,214 @@
+"""Standard registered entrypoints: the workloads the acceptance configs run.
+
+Each entrypoint reads its hyperparameters from ``tpu.kubedl.io/param.*``
+annotations (stripped into ``ctx.params`` by the executor), builds a mesh
+over the visible devices, trains for ``steps`` steps on synthetic data, and
+publishes progress into ``ctx.progress`` — the executor folds that into the
+workload's ``status.trainingProgress`` so the tick→first-step north-star
+metric is observable from the control plane (the reference has no analog;
+its metrics stop at reconcile counts, SURVEY.md §5).
+
+Common params (all optional, all strings): ``steps``, ``batch_size``,
+``platform`` (force ``cpu`` for tests), ``tensor``/``seq``/``fsdp`` (mesh
+axis sizes). Model-specific params documented per entrypoint.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+from cron_operator_tpu.backends.registry import JobContext, register_entrypoint
+from cron_operator_tpu.models import MLP, Bert, BertConfig, ResNet50
+from cron_operator_tpu.parallel.mesh import mesh_for_devices
+from cron_operator_tpu.workloads import data as datasets
+from cron_operator_tpu.workloads.train import StepStats, TrainConfig, Trainer
+
+
+def _devices(ctx: JobContext):
+    platform = ctx.params.get("platform")
+    if platform:
+        return jax.devices(platform)
+    return jax.devices()
+
+
+def _mesh(ctx: JobContext, devs=None):
+    devs = devs if devs is not None else _devices(ctx)
+    return mesh_for_devices(
+        devs,
+        tensor=int(ctx.params.get("tensor", 1)),
+        seq=int(ctx.params.get("seq", 1)),
+        fsdp=int(ctx.params.get("fsdp", 1)),
+    )
+
+
+def _checkpoint_store(ctx: JobContext):
+    """CheckpointStore when the job opts in via param.checkpoint=1; the
+    preemption-recovery path (restart-on-preemption re-runs the entrypoint,
+    which then resumes from the last saved step). param.checkpoint_lineage
+    ("job" default, "family" to continue one run across Forbid ticks)."""
+    if ctx.params.get("checkpoint", "0") not in ("1", "true", "yes"):
+        return None
+    from cron_operator_tpu.workloads.checkpoint import CheckpointStore
+
+    return CheckpointStore(
+        ctx.namespace or "default",
+        ctx.name,
+        root=ctx.params.get("checkpoint_dir"),
+        lineage=ctx.params.get("checkpoint_lineage", "job"),
+    )
+
+
+def _save_every(ctx: JobContext) -> int:
+    return int(ctx.params.get("save_every", 10))
+
+
+def _jit_init(model, rng, x):
+    """``model.init`` under jit: eager init dispatches every conv/norm op
+    separately (tens of seconds for ResNet-50 on a cold process); one
+    compiled program is both faster and persistent-cacheable, which is how
+    the tick→first-step path stays inside the 90 s budget."""
+    return jax.jit(model.init)(rng, x)["params"]
+
+
+def _run(
+    ctx: JobContext,
+    trainer: Trainer,
+    batches: Iterator[Dict[str, Any]],
+    steps: int,
+) -> None:
+    ctx.progress["started_at"] = time.time()
+    if trainer.steps_done:
+        ctx.progress["resumed_from_step"] = trainer.steps_done
+    first_local_step = trainer.steps_done + 1
+    last_publish = [0.0]
+
+    def on_step(s: StepStats) -> None:
+        if s.step == first_local_step:
+            # The north-star timestamp: first optimizer step finished
+            # (device-synced — Trainer.step blocks on the loss).
+            ctx.progress["first_step_at"] = time.time()
+        ctx.progress["steps_done"] = s.step
+        ctx.progress["last_loss"] = s.loss
+        ctx.progress["last_step_time_s"] = round(s.step_time_s, 4)
+        now = time.time()
+        if ctx.publish is not None and (
+            s.step == first_local_step or now - last_publish[0] > 1.0
+        ):
+            last_publish[0] = now
+            ctx.publish()
+
+    try:
+        stats = trainer.run(
+            batches, steps, should_stop=ctx.should_stop, on_step=on_step
+        )
+    finally:
+        if trainer.checkpoint is not None:
+            # Orbax managers own background threads; a long-lived executor
+            # runs many ticks, so every store must be released.
+            trainer.checkpoint.close()
+    # Steady-state throughput: drop the compile-laden first step.
+    tail = stats[1:] if len(stats) > 1 else stats
+    if tail:
+        avg = sum(s.step_time_s for s in tail) / len(tail)
+        ctx.progress["avg_step_time_s"] = round(avg, 4)
+        ctx.progress["steps_per_s"] = round(1.0 / avg, 4) if avg > 0 else None
+
+
+@register_entrypoint("mnist")
+def mnist(ctx: JobContext) -> None:
+    """MLP on synthetic MNIST. Params: steps(=20), batch_size(=256)."""
+    steps = int(ctx.params.get("steps", 20))
+    batch_size = int(ctx.params.get("batch_size", 256))
+    devs = _devices(ctx)
+    # default_device is thread-local; entrypoints run in executor worker
+    # threads, so pin init/eager work to the requested platform here.
+    with jax.default_device(devs[0]):
+        mesh = _mesh(ctx, devs)
+        model = MLP()
+        params = _jit_init(model, jax.random.PRNGKey(0), _zeros((1, 28, 28, 1)))
+        trainer = Trainer(
+            lambda p, x: model.apply({"params": p}, x), params, mesh,
+            TrainConfig(optimizer="sgd", learning_rate=0.01,
+                        save_every=_save_every(ctx)),
+            checkpoint=_checkpoint_store(ctx),
+        )
+        _run(ctx, trainer, datasets.mnist_batches(batch_size), steps)
+
+
+@register_entrypoint("resnet50")
+def resnet50(ctx: JobContext) -> None:
+    """ResNet-50 on synthetic ImageNet — the north-star benchmark workload.
+
+    Params: steps(=10), batch_size(=128), image_size(=224).
+    """
+    steps = int(ctx.params.get("steps", 10))
+    batch_size = int(ctx.params.get("batch_size", 128))
+    image_size = int(ctx.params.get("image_size", 224))
+    devs = _devices(ctx)
+    with jax.default_device(devs[0]):
+        mesh = _mesh(ctx, devs)
+        model = ResNet50()
+        params = _jit_init(
+            model, jax.random.PRNGKey(0),
+            _zeros((1, image_size, image_size, 3)),
+        )
+        trainer = Trainer(
+            lambda p, x: model.apply({"params": p}, x), params, mesh,
+            TrainConfig(optimizer="sgd", learning_rate=0.1,
+                        save_every=_save_every(ctx)),
+            checkpoint=_checkpoint_store(ctx),
+        )
+        _run(
+            ctx, trainer, datasets.imagenet_batches(batch_size, image_size),
+            steps,
+        )
+
+
+@register_entrypoint("bert")
+def bert(ctx: JobContext) -> None:
+    """BERT MLM on synthetic tokens — the long-context workload.
+
+    Params: steps(=10), batch_size(=8), seq_len(=512), size(=base|tiny),
+    attention(=auto|flash|xla|ring), seq/tensor/fsdp mesh axes, remat(=0).
+    With ``seq`` > 1 the sequence axis is ring-sharded over the mesh.
+    """
+    steps = int(ctx.params.get("steps", 10))
+    batch_size = int(ctx.params.get("batch_size", 8))
+    seq_len = int(ctx.params.get("seq_len", 512))
+    size = ctx.params.get("size", "base")
+    attention = ctx.params.get("attention", "auto")
+    devs = _devices(ctx)
+    with jax.default_device(devs[0]):
+        mesh = _mesh(ctx, devs)
+        maker = BertConfig.tiny if size == "tiny" else BertConfig.base
+        cfg = maker(max_len=seq_len, attention_impl=attention)
+        model = Bert(cfg, mesh=mesh)
+        params = _jit_init(
+            model, jax.random.PRNGKey(0), _zeros((1, seq_len), dtype="int32")
+        )
+        trainer = Trainer(
+            lambda p, x: model.apply({"params": p}, x), params, mesh,
+            TrainConfig(
+                remat=ctx.params.get("remat", "0") in ("1", "true"),
+                seq_dim_in_batch=1,
+                labels_follow_seq=True,
+                save_every=_save_every(ctx),
+            ),
+            checkpoint=_checkpoint_store(ctx),
+        )
+        _run(
+            ctx, trainer,
+            datasets.token_batches(batch_size, seq_len, cfg.vocab_size), steps,
+        )
+
+
+def _zeros(shape, dtype: Optional[str] = None):
+    import jax.numpy as jnp
+
+    return jnp.zeros(shape, dtype or jnp.float32)
+
+
+__all__ = ["mnist", "resnet50", "bert"]

@@ -1,0 +1,149 @@
+"""Standalone workload runner — the container/subprocess entrypoint.
+
+When a JAXJob runs as real pods on a GKE TPU slice (rather than in-process
+under the embedded LocalExecutor), each host pod executes
+``python -m cron_operator_tpu.workloads.runner <entrypoint>``. The runner:
+
+1. initializes ``jax.distributed`` from the env the operator rendered at
+   admission (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+   ``JAX_PROCESS_ID`` — backends/tpu.py ``render_coordinator_env``; the
+   analog of the training-operator's ``MASTER_ADDR`` rendering for the GPU
+   path, SURVEY.md §5 "Distributed communication backend"),
+2. builds a JobContext from ``TPU_JOB_*`` env + CLI params,
+3. runs the registered entrypoint across all hosts (ICI collectives inside
+   the slice, DCN between slices — all via XLA; no comm code here).
+
+The same runner is the LocalExecutor's **subprocess isolation mode**: the
+executor launches it per job and reads progress from stdout as prefixed
+JSON lines (``@@CRON_TPU@@ {...}``). Subprocess isolation is what makes a
+timed-out/cancelled job killable without tearing down the operator process
+mid-XLA-compile (round-1 postmortem: killing a compile thread in-process
+wedged the TPU runtime for every later run). SIGTERM requests a graceful
+stop (the trainer exits between steps); the parent escalates to SIGKILL
+only after a grace period.
+
+Params come as ``key=value`` args or ``TPU_PARAM_<KEY>`` env vars.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import sys
+from typing import Dict, List
+
+logger = logging.getLogger("workloads.runner")
+
+# Prefix for machine-readable progress lines on stdout (everything else the
+# workload prints is passed through untouched).
+PROGRESS_PREFIX = "@@CRON_TPU@@ "
+
+
+def _gather_params(argv: List[str]) -> Dict[str, str]:
+    from cron_operator_tpu.backends.tpu import normalize_param_key
+
+    params: Dict[str, str] = {}
+    for key, value in os.environ.items():
+        if key.startswith("TPU_PARAM_"):
+            params[normalize_param_key(key[len("TPU_PARAM_"):])] = value
+    for arg in argv:
+        if "=" in arg:
+            k, v = arg.split("=", 1)
+            params[normalize_param_key(k)] = v  # same normalization as env
+    return params
+
+
+def _maybe_pin_platform(params: Dict[str, str]) -> None:
+    """``param.platform`` pins jax_platforms before first backend init.
+
+    Needed because some images register extra platforms at interpreter
+    startup (e.g. a tunneled TPU plugin) whose client init can block; a job
+    that asked for ``platform=cpu`` must never dial them.
+    """
+    platform = params.get("platform")
+    if not platform:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+
+def _maybe_init_distributed() -> None:
+    """Multi-host wiring: coordinator env present → jax.distributed."""
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    n = int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1)
+    if not coordinator or n <= 1:
+        return
+    import jax
+
+    pid = int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
+    logger.info(
+        "initializing jax.distributed: coordinator=%s processes=%d id=%d",
+        coordinator, n, pid,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=n, process_id=pid
+    )
+
+
+def _emit(kind: str, payload: Dict) -> None:
+    print(PROGRESS_PREFIX + json.dumps({"type": kind, **payload}), flush=True)
+
+
+def main(argv: List[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s",
+    )
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(
+            "usage: python -m cron_operator_tpu.workloads.runner "
+            "<entrypoint> [key=value ...]",
+            file=sys.stderr,
+        )
+        return 2
+    entry_name, rest = argv[0], argv[1:]
+
+    from cron_operator_tpu.backends.registry import (
+        JobContext,
+        resolve_entrypoint,
+    )
+
+    params = _gather_params(rest)
+    _maybe_pin_platform(params)
+    _maybe_init_distributed()
+    fn = resolve_entrypoint(entry_name)
+    ctx = JobContext(
+        name=os.environ.get("TPU_JOB_NAME", entry_name),
+        namespace=os.environ.get("TPU_JOB_NAMESPACE", "default"),
+        job={"metadata": {"name": os.environ.get("TPU_JOB_NAME", entry_name)}},
+        params=params,
+    )
+    # Stream progress to the parent (executor folds it into
+    # status.trainingProgress; a k8s sidecar could do the same).
+    ctx.publish = lambda: _emit("progress", {"progress": ctx.progress})
+
+    # SIGTERM = graceful stop request: the trainer exits between steps and
+    # the PJRT client tears down cleanly (never yank a live compile).
+    signal.signal(signal.SIGTERM, lambda *_: ctx.cancel.set())
+
+    try:
+        fn(ctx)
+    except Exception as err:  # noqa: BLE001 — report, then non-zero exit
+        import traceback
+
+        _emit("error", {
+            "error": f"{type(err).__name__}: {err}",
+            "traceback": traceback.format_exc(),
+            "progress": ctx.progress,
+        })
+        return 1
+    _emit("done", {"progress": ctx.progress, "cancelled": ctx.should_stop()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

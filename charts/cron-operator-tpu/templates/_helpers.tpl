@@ -1,0 +1,43 @@
+{{- define "cron-operator-tpu.name" -}}
+{{ .Chart.Name | trunc 63 | trimSuffix "-" }}
+{{- end -}}
+
+{{- define "cron-operator-tpu.fullname" -}}
+{{- if eq .Release.Name .Chart.Name -}}
+{{ .Release.Name | trunc 63 | trimSuffix "-" }}
+{{- else -}}
+{{ printf "%s-%s" .Release.Name .Chart.Name | trunc 63 | trimSuffix "-" }}
+{{- end -}}
+{{- end -}}
+
+{{- define "cron-operator-tpu.serviceAccountName" -}}
+{{- if .Values.serviceAccount.name -}}
+{{ .Values.serviceAccount.name }}
+{{- else -}}
+{{ include "cron-operator-tpu.fullname" . }}
+{{- end -}}
+{{- end -}}
+
+{{- define "cron-operator-tpu.imageTag" -}}
+{{ .Values.image.tag | default .Chart.AppVersion }}
+{{- end -}}
+
+{{- define "cron-operator-tpu.image" -}}
+{{- if .Values.image.registry -}}
+{{ .Values.image.registry }}/{{ .Values.image.repository }}:{{ include "cron-operator-tpu.imageTag" . }}
+{{- else -}}
+{{ .Values.image.repository }}:{{ include "cron-operator-tpu.imageTag" . }}
+{{- end -}}
+{{- end -}}
+
+{{- define "cron-operator-tpu.labels" -}}
+app.kubernetes.io/name: {{ include "cron-operator-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: Helm
+{{- end -}}
+
+{{- define "cron-operator-tpu.selectorLabels" -}}
+app.kubernetes.io/name: {{ include "cron-operator-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

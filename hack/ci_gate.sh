@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# The commit gate (VERDICT r2 #5) — the reference runs fmt/vet/lint/codegen-
+# drift + unit tests in .github/workflows/integration.yaml; this is the same
+# pyramid for this repo, runnable locally (`make gate`) and in CI. Round 1
+# shipped red tests because nothing gated commits; this would have caught it.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "==> syntax (compileall)"
+python -m compileall -q cron_operator_tpu tests bench.py __graft_entry__.py
+
+echo "==> codegen drift (CRD manifests)"
+python -m cron_operator_tpu.api.crd >/dev/null
+if ! git diff --quiet -- deploy/crds charts/cron-operator-tpu/crds; then
+    echo "ERROR: generated CRDs drifted from committed copies:" >&2
+    git --no-pager diff --stat -- deploy/crds charts/cron-operator-tpu/crds >&2
+    exit 1
+fi
+
+echo "==> chart renders (default + ci values)"
+python -m cron_operator_tpu.utils.helmtmpl charts/cron-operator-tpu >/dev/null
+python -m cron_operator_tpu.utils.helmtmpl charts/cron-operator-tpu \
+    --values charts/cron-operator-tpu/ci/values.yaml >/dev/null
+
+echo "==> unit + integration tests"
+python -m pytest tests/ -q
+
+echo "GATE: all checks passed"

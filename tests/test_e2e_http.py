@@ -1,0 +1,300 @@
+"""Real-apiserver e2e tier (VERDICT r2 #6) — the envtest analog.
+
+The reference never tests against request fakes: envtest boots a real
+etcd+apiserver (``suite_test.go:72-79``) and kind e2e installs the chart.
+No kube-apiserver binary exists in this image, so these tests run the FULL
+production stack over real sockets instead:
+
+    ClusterAPIServer (stdlib REST/auth/chunked-watch client)
+        ⇅ HTTP on 127.0.0.1
+    HTTPAPIServer (kube REST dialect over the embedded store)
+
+and drive the operator end-to-end: apply a Cron CR → reconciler POSTs the
+workload (with TPU admission) → status/history sync → history GC → Replace
+semantics — closing the e2e gap the reference itself left open
+(``test/e2e/e2e_test.go:281-289`` TODO).
+"""
+
+import json
+import time
+
+import pytest
+
+from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+from cron_operator_tpu.controller import CronReconciler
+from cron_operator_tpu.runtime import Manager
+from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
+from cron_operator_tpu.runtime.cluster import ClusterAPIServer, ClusterConfig
+from cron_operator_tpu.runtime.kube import (
+    AlreadyExistsError,
+    ApiError,
+    APIServer,
+    NotFoundError,
+)
+
+TOKEN = "e2e-bearer-token"
+
+
+@pytest.fixture
+def server():
+    srv = HTTPAPIServer(token=TOKEN)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    capi = ClusterAPIServer(
+        ClusterConfig(server.url, token=TOKEN), scheme=default_scheme()
+    )
+    yield capi
+    capi.stop()
+
+
+def make_cron(name="e2e", schedule="@every 1s", policy=None, history=None,
+              tpu=True, sim="50ms"):
+    ann = {"tpu.kubedl.io/simulate-duration": sim}
+    if tpu:
+        ann.update({
+            "tpu.kubedl.io/accelerator": "v5e",
+            "tpu.kubedl.io/topology": "2x2",
+        })
+    spec = {
+        "schedule": schedule,
+        "template": {"workload": {
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {"annotations": ann},
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+        }},
+    }
+    if policy:
+        spec["concurrencyPolicy"] = policy
+    if history is not None:
+        spec["historyLimit"] = history
+    return {
+        "apiVersion": "apps.kubedl.io/v1alpha1", "kind": "Cron",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def wait_for(fn, timeout=10.0, interval=0.1, message="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = fn()
+        if got:
+            return got
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+class TestProtocol:
+    """Client↔server protocol reality: auth, errors, subresources."""
+
+    def test_bearer_auth_enforced(self, server):
+        bad = ClusterAPIServer(
+            ClusterConfig(server.url, token="wrong"),
+            scheme=default_scheme(),
+        )
+        with pytest.raises(ApiError, match="401"):
+            bad.create(make_cron())
+        bad.stop()
+
+    def test_crud_roundtrip_with_server_side_fields(self, client):
+        created = client.create(make_cron())
+        assert created["metadata"]["uid"]
+        assert created["metadata"]["resourceVersion"]
+        assert created["metadata"]["creationTimestamp"]
+        got = client.get("apps.kubedl.io/v1alpha1", "Cron", "default", "e2e")
+        assert got["spec"]["schedule"] == "@every 1s"
+        with pytest.raises(AlreadyExistsError):
+            client.create(make_cron())
+        client.delete("apps.kubedl.io/v1alpha1", "Cron", "default", "e2e")
+        with pytest.raises(NotFoundError):
+            client.get("apps.kubedl.io/v1alpha1", "Cron", "default", "e2e")
+
+    def test_status_subresource_merge_patch(self, client):
+        client.create(make_cron())
+        client.patch_status(
+            "apps.kubedl.io/v1alpha1", "Cron", "default", "e2e",
+            {"lastScheduleTime": "2026-07-29T00:00:00Z"},
+        )
+        got = client.get("apps.kubedl.io/v1alpha1", "Cron", "default", "e2e")
+        assert got["status"]["lastScheduleTime"] == "2026-07-29T00:00:00Z"
+        # spec untouched by status writes
+        assert got["spec"]["schedule"] == "@every 1s"
+
+    def test_label_selector_list(self, client):
+        c1 = make_cron("a")
+        c1["metadata"]["labels"] = {"team": "ml"}
+        c2 = make_cron("b")
+        c2["metadata"]["labels"] = {"team": "infra"}
+        client.create(c1)
+        client.create(c2)
+        ml = client.list("apps.kubedl.io/v1alpha1", "Cron", "default",
+                         label_selector={"team": "ml"})
+        assert [c["metadata"]["name"] for c in ml] == ["a"]
+
+    def test_cascading_delete_via_owner_refs(self, client):
+        owner = client.create(make_cron())
+        client.create({
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {
+                "name": "child", "namespace": "default",
+                "ownerReferences": [{
+                    "apiVersion": "apps.kubedl.io/v1alpha1", "kind": "Cron",
+                    "name": "e2e", "uid": owner["metadata"]["uid"],
+                    "controller": True,
+                }],
+            },
+            "spec": {},
+        })
+        client.delete("apps.kubedl.io/v1alpha1", "Cron", "default", "e2e",
+                      propagation="Background")
+        assert client.try_get("kubeflow.org/v1", "JAXJob", "default",
+                              "child") is None
+
+    def test_events_recorded_as_objects(self, client):
+        cron = client.create(make_cron())
+        client.record_event(cron, "Warning", "E2ECheck", "hello")
+        events = client.list("v1", "Event", "default")
+        assert any(e.get("reason") == "E2ECheck" for e in events)
+
+
+class TestWatchStream:
+    def test_watch_delivers_adds_and_deletes(self, server, client):
+        seen = []
+        client.add_watcher(lambda ev: seen.append((ev.type,
+                                                   ev.object.get("kind"))))
+        client.start_watches([GVK_CRON])
+        time.sleep(0.3)  # initial LIST settles
+        client.create(make_cron())
+        wait_for(lambda: ("ADDED", "Cron") in seen, message="ADDED event")
+        client.delete("apps.kubedl.io/v1alpha1", "Cron", "default", "e2e")
+        wait_for(lambda: ("DELETED", "Cron") in seen, message="DELETED event")
+
+    def test_watch_survives_410_expiry_with_relist(self, server, client):
+        """Force the ring buffer past the client's resumption point; the
+        client must see the 410 ERROR and recover by re-listing."""
+        seen = []
+        client.add_watcher(
+            lambda ev: seen.append(ev.object["metadata"]["name"])
+        )
+        client.start_watches([GVK_CRON])
+        time.sleep(0.3)
+        client.create(make_cron("before-expiry"))
+        wait_for(lambda: "before-expiry" in seen, message="pre-expiry event")
+        # Evict history out from under any resumption rv.
+        server.hub._oldest_evicted_rv = 10_000_000
+        with server.hub._cond:
+            server.hub._events.clear()
+            server.hub._cond.notify_all()
+        # The stream gets ERROR/410 → watch loop re-lists; objects created
+        # after recovery must still arrive.
+        time.sleep(0.5)
+        client.create(make_cron("after-expiry"))
+        wait_for(lambda: "after-expiry" in seen, timeout=15.0,
+                 message="post-recovery event")
+
+
+class TestOperatorE2E:
+    """The full production loop over the wire."""
+
+    def _start_operator(self, client):
+        mgr = Manager(client, max_concurrent_reconciles=4)
+        rec = CronReconciler(client)
+        mgr.add_controller("cron", rec.reconcile, for_gvk=GVK_CRON,
+                           owns=default_scheme().workload_kinds())
+        mgr.start()
+        client.start_watches([GVK_CRON] + default_scheme().workload_kinds())
+        return mgr
+
+    def test_cron_cr_to_workload_with_tpu_admission(self, server, client):
+        mgr = self._start_operator(client)
+        try:
+            client.create(make_cron())
+            jobs = wait_for(
+                lambda: client.list("kubeflow.org/v1", "JAXJob", "default"),
+                message="JAXJob creation",
+            )
+            job = jobs[0]
+            assert job["metadata"]["labels"]["kubedl.io/cron-name"] == "e2e"
+            worker = job["spec"]["replicaSpecs"]["Worker"]
+            assert worker["replicas"] == 1  # v5e 2x2 = single host
+            sel = worker["template"]["spec"]["nodeSelector"]
+            assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+            res = worker["template"]["spec"]["containers"][0]["resources"]
+            assert res["limits"]["google.com/tpu"] == "4"
+            # status synced over the wire
+            wait_for(
+                lambda: (client.get("apps.kubedl.io/v1alpha1", "Cron",
+                                    "default", "e2e").get("status") or {}
+                         ).get("lastScheduleTime"),
+                message="lastScheduleTime patch",
+            )
+        finally:
+            mgr.stop()
+
+    def test_history_gc_over_the_wire(self, server, client):
+        mgr = self._start_operator(client)
+        try:
+            client.create(make_cron(history=2))
+            # Jobs have no executor here (envtest style: status is
+            # simulated), so mark each arrival terminal by hand; track
+            # cumulative names — the GC keeps the instantaneous LIST
+            # clamped, so only history can prove >historyLimit ticks fired.
+            seen = set()
+
+            def tick():
+                jobs = client.list("kubeflow.org/v1", "JAXJob", "default")
+                for j in jobs:
+                    seen.add(j["metadata"]["name"])
+                    if not (j.get("status") or {}).get("conditions"):
+                        client.patch_status(
+                            "kubeflow.org/v1", "JAXJob", "default",
+                            j["metadata"]["name"],
+                            {"conditions": [
+                                {"type": "Created", "status": "True"},
+                                {"type": "Succeeded", "status": "True"},
+                            ]},
+                        )
+                return jobs
+
+            wait_for(lambda: tick() and len(seen) >= 4, timeout=20.0,
+                     message="4+ distinct jobs fired")
+            # GC must clamp live terminated workloads and history to 2.
+            def gc_settled():
+                jobs = tick()
+                cron = client.get("apps.kubedl.io/v1alpha1", "Cron",
+                                  "default", "e2e")
+                hist = (cron.get("status") or {}).get("history") or []
+                terminated = [
+                    j for j in jobs
+                    if any(c["type"] == "Succeeded"
+                           for c in (j.get("status") or {})
+                           .get("conditions") or [])
+                ]
+                return 0 < len(hist) <= 2 and len(terminated) <= 2
+            wait_for(gc_settled, timeout=15.0, message="history GC to 2")
+            assert len(seen) >= 4  # GC deleted at least 2 old workloads
+        finally:
+            mgr.stop()
+
+    def test_replace_policy_over_the_wire(self, server, client):
+        mgr = self._start_operator(client)
+        try:
+            client.create(make_cron(policy="Replace"))
+            first = wait_for(
+                lambda: client.list("kubeflow.org/v1", "JAXJob", "default"),
+                message="first workload",
+            )[0]["metadata"]["name"]
+            # Leave it non-terminal: Replace must DELETE it on the next tick.
+            def replaced():
+                names = [j["metadata"]["name"] for j in
+                         client.list("kubeflow.org/v1", "JAXJob", "default")]
+                return names and first not in names
+            wait_for(replaced, timeout=15.0,
+                     message="active workload replaced")
+        finally:
+            mgr.stop()
