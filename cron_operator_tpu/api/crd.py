@@ -208,16 +208,18 @@ def render_yaml() -> str:
 
 
 def main() -> None:
-    """Regenerate every shipped copy of the CRD (``deploy/crds`` and the
-    Helm chart's ``charts/cron-operator-tpu/crds`` — the reference keeps the
-    same duplication between config/crd/bases and its chart's crds/).
-    ``make manifests`` analog; drift is pinned by tests/test_deploy.py and
-    tests/test_chart.py and checked by the CI gate."""
+    """Regenerate every shipped copy of the CRD (``deploy/crds``, the Helm
+    chart's ``charts/cron-operator-tpu/crds``, and the kustomize base
+    ``config/crd/bases`` — the reference keeps the same duplication between
+    config/crd/bases and its chart's crds/). ``make manifests`` analog;
+    drift is pinned by tests/test_deploy.py and tests/test_chart.py and
+    checked by the CI gate."""
     import pathlib
 
     root = pathlib.Path(__file__).resolve().parents[2]
     text = render_yaml()
-    for rel in ("deploy/crds", "charts/cron-operator-tpu/crds"):
+    for rel in ("deploy/crds", "charts/cron-operator-tpu/crds",
+                "config/crd/bases"):
         out = root / rel
         out.mkdir(parents=True, exist_ok=True)
         path = out / f"{GROUP}_{PLURAL}.yaml"

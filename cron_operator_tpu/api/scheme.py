@@ -66,7 +66,8 @@ class Scheme:
         self._plurals: Dict[GVK, str] = {}
         self._workload_kinds: set[GVK] = set()
 
-    def register(self, gvk: GVK, plural: Optional[str] = None, workload: bool = False) -> None:
+    def register(self, gvk: GVK, plural: Optional[str] = None,
+                 workload: bool = False) -> None:
         self._plurals[gvk] = plural or _default_plural(gvk.kind)
         if workload:
             self._workload_kinds.add(gvk)

@@ -28,7 +28,7 @@ import threading
 import traceback
 from typing import Any, Dict, Optional, Tuple
 
-from cron_operator_tpu.api.scheme import default_scheme, gvk_of
+from cron_operator_tpu.api.scheme import default_scheme
 from cron_operator_tpu.api.v1alpha1 import rfc3339
 from cron_operator_tpu.backends.registry import (
     ANNOTATION_ENTRYPOINT,
@@ -121,13 +121,24 @@ class LocalExecutor:
         """Block until no jobs are executing (test/bench helper)."""
         import time
 
+        # Watch delivery is async (APIServer dispatcher thread) — an event
+        # published but not yet delivered is work this executor hasn't
+        # even seen, so it must count as busy or wait_idle races ahead.
+        # Sample ORDER matters: backlog first, busy second. Delivery
+        # increments _inflight (via _on_event→_enqueue) BEFORE the
+        # dispatcher decrements _undelivered, so backlog==0 guarantees
+        # every already-published event is visible in _inflight by the
+        # time we read it; the reverse order leaves a window where an
+        # event drains between the two reads and both report zero.
+        backlog = getattr(self.api, "watch_backlog", lambda: 0)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            backlog_empty = backlog() == 0
             with self._lock:
                 busy = self._inflight > 0 or any(
                     t.is_alive() for t in self._threads.values()
                 )
-            if not busy:
+            if backlog_empty and not busy:
                 return True
             time.sleep(0.02)
         return False
@@ -292,6 +303,15 @@ class LocalExecutor:
         sim = ann.get(ANNOTATION_SIMULATE)
         if sim:
             total = parse_go_duration(sim).total_seconds()
+            # Simulated training still reports progress: the first "step"
+            # completes at start, so simulated workloads feed the
+            # tick→first-step latency histogram exactly like real ones.
+            import time as _time
+
+            ctx.progress.setdefault("first_step_at", _time.time())
+            ctx.progress.setdefault("started_at", _time.time())
+            if ctx.publish:
+                ctx.publish()
             # sleep in small increments so cancellation is prompt
             ctx.cancel.wait(timeout=total)
             return

@@ -123,7 +123,8 @@ def _configure_logging(level: str, encoder: str) -> None:
     lvl = {"debug": logging.DEBUG, "info": logging.INFO,
            "warn": logging.WARNING, "error": logging.ERROR}[level]
     if encoder == "json":
-        fmt = '{"ts":"%(asctime)s","level":"%(levelname)s","logger":"%(name)s","msg":"%(message)s"}'
+        fmt = ('{"ts":"%(asctime)s","level":"%(levelname)s",'
+               '"logger":"%(name)s","msg":"%(message)s"}')
     else:
         fmt = "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"
     logging.basicConfig(level=lvl, format=fmt, stream=sys.stderr)
@@ -278,7 +279,9 @@ def cmd_start(args: argparse.Namespace) -> int:
     if executor is not None:
         executor.stop()
     if args.api_server == "cluster":
-        api.stop()
+        api.stop()  # ClusterAPIServer: stop watch threads
+    else:
+        api.close()  # embedded store: stop the watch dispatcher
     for s in servers:
         s.shutdown()
     return 0

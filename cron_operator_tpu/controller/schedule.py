@@ -74,7 +74,8 @@ def parse_go_duration(text: str) -> timedelta:
     return timedelta(seconds=-total if negative else total)
 
 
-def _parse_field(expr: str, lo: int, hi: int, names: Optional[dict] = None) -> tuple[int, bool]:
+def _parse_field(expr: str, lo: int, hi: int,
+                 names: Optional[dict] = None) -> tuple[int, bool]:
     """Parse one cron field into (bitmask, is_star).
 
     is_star is True when the field is ``*`` or ``*/n`` — needed for the
@@ -161,7 +162,8 @@ class EverySchedule:
 class CronSchedule:
     """Compiled 5-field schedule; ``next(t)`` is the activation strictly after t."""
 
-    __slots__ = ("minute", "hour", "dom", "month", "dow", "dom_star", "dow_star", "source")
+    __slots__ = ("minute", "hour", "dom", "month", "dow", "dom_star",
+                 "dow_star", "source")
 
     def __init__(self, expr: str):
         fields = expr.split()

@@ -19,8 +19,6 @@ via ``shard_map``.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 from jax import lax

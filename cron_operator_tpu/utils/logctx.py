@@ -1,0 +1,56 @@
+"""Per-request structured log context.
+
+Python analog of the reference's per-controller log constructor
+(``/root/reference/internal/controller/util.go:28-41``): every log line a
+reconcile emits carries the controller name (lowercased kind, the same
+value the prometheus ``controller`` label uses) and the request's
+namespaced name — as structured ``key=value`` fields rendered ahead of the
+message, not hand-interpolated into each format string.
+
+Usage::
+
+    log = request_logger("cron", namespace=ns, name=name)
+    log.info("created %s %s", kind, wname)
+    # → [controller=cron cron=ns/name] created JAXJob x-123
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, MutableMapping, Optional, Tuple
+
+
+class _ContextAdapter(logging.LoggerAdapter):
+    """Prefixes every record with the adapter's key=value context."""
+
+    def process(
+        self, msg: str, kwargs: MutableMapping[str, Any]
+    ) -> Tuple[str, MutableMapping[str, Any]]:
+        ctx = " ".join(f"{k}={v}" for k, v in (self.extra or {}).items())
+        return (f"[{ctx}] {msg}", kwargs) if ctx else (msg, kwargs)
+
+
+def request_logger(
+    controller: str,
+    namespace: Optional[str] = None,
+    name: Optional[str] = None,
+    **fields: Any,
+) -> logging.LoggerAdapter:
+    """Logger for one reconcile request.
+
+    ``controller`` is the lowercased kind (prometheus-compatible — the
+    reference lowercases for the same reason, ``util.go:33-36``); the
+    namespaced name is recorded under the controller name as key, matching
+    the reference's ``WithValues(strings.ToLower(kind), req.NamespacedName)``.
+    Extra ``fields`` append verbatim (e.g. ``job="ns/x"``).
+    """
+    controller = controller.lower()
+    base = logging.getLogger(f"controller.{controller}")
+    extra: "dict[str, Any]" = {"controller": controller}
+    if name is not None:
+        extra[controller] = f"{namespace}/{name}" if namespace else name
+    extra.update(fields)
+    return _ContextAdapter(base, extra)
+
+
+__all__ = ["request_logger"]

@@ -16,8 +16,8 @@ mutable device state besides the TrainState it returns.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 import jax.numpy as jnp
@@ -121,7 +121,8 @@ class Trainer:
         self._step = jax.jit(
             step_fn,
             in_shardings=(self.state_sharding, self.batch_sharding),
-            out_shardings=(self.state_sharding, NamedSharding(mesh, jax.sharding.PartitionSpec())),
+            out_shardings=(self.state_sharding,
+                           NamedSharding(mesh, jax.sharding.PartitionSpec())),
             donate_argnums=(0,),
         )
 

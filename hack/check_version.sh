@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Version-consistency gate: VERSION is the single source of truth; the
+# Python package, pyproject, and Helm chart must all agree (the reference
+# release workflow enforces the same for its chart —
+# /root/reference/.github/workflows/release.yaml "Check whether chart
+# version and appVersion matches version").
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VERSION=$(cat VERSION)
+RAW=${VERSION#v}
+
+fail=0
+
+check() { # name actual
+    if [[ "$2" != "$RAW" ]]; then
+        echo "ERROR: $1 is '$2', expected '$RAW' (from VERSION)" >&2
+        fail=1
+    fi
+}
+
+check "pyproject.toml version" \
+    "$(grep -E '^version *= *' pyproject.toml | head -1 | sed -E 's/.*"(.*)".*/\1/')"
+check "package __version__" \
+    "$(python -c 'import cron_operator_tpu as m; print(m.__version__)')"
+check "chart version" \
+    "$(grep '^version:' charts/cron-operator-tpu/Chart.yaml | awk '{print $2}')"
+check "chart appVersion" \
+    "$(grep '^appVersion:' charts/cron-operator-tpu/Chart.yaml | awk '{print $2}' | tr -d '\"')"
+
+if [[ $fail -ne 0 ]]; then
+    exit 1
+fi
+echo "version consistency: all at ${RAW}"

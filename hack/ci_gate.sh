@@ -9,18 +9,40 @@ cd "$(dirname "$0")/.."
 echo "==> syntax (compileall)"
 python -m compileall -q cron_operator_tpu tests bench.py __graft_entry__.py
 
+echo "==> lint (hack/lint.py — the .golangci.yml analog)"
+python hack/lint.py
+
+echo "==> version consistency (VERSION ↔ pyproject ↔ package ↔ chart)"
+bash hack/check_version.sh
+
 echo "==> codegen drift (CRD manifests)"
 python -m cron_operator_tpu.api.crd >/dev/null
-if ! git diff --quiet -- deploy/crds charts/cron-operator-tpu/crds; then
+if ! git diff --quiet -- deploy/crds charts/cron-operator-tpu/crds \
+        config/crd/bases; then
     echo "ERROR: generated CRDs drifted from committed copies:" >&2
-    git --no-pager diff --stat -- deploy/crds charts/cron-operator-tpu/crds >&2
+    git --no-pager diff --stat -- deploy/crds charts/cron-operator-tpu/crds \
+        config/crd/bases >&2
     exit 1
 fi
 
-echo "==> chart renders (default + ci values)"
-python -m cron_operator_tpu.utils.helmtmpl charts/cron-operator-tpu >/dev/null
-python -m cron_operator_tpu.utils.helmtmpl charts/cron-operator-tpu \
-    --values charts/cron-operator-tpu/ci/values.yaml >/dev/null
+echo "==> chart renders match goldens (default + ci + full)"
+_golden() { # file renderer-args...
+    local golden="charts/cron-operator-tpu/tests/golden/$1"; shift
+    { sed -n '/^# GOLDEN RENDER/,/^# and diff against/p' "$golden"
+      python -m cron_operator_tpu.utils.helmtmpl charts/cron-operator-tpu \
+          "$@"; } | diff -u "$golden" - || {
+        echo "ERROR: chart render drifted from $golden — regenerate per" >&2
+        echo "       the golden's header and review the diff" >&2
+        exit 1
+    }
+}
+_golden default.yaml
+_golden ci.yaml --values charts/cron-operator-tpu/ci/values.yaml
+_golden full.yaml --set metrics.serviceMonitor.enable=true \
+    --set networkPolicy.enable=true
+
+echo "==> chart README in sync (helm-docs analog)"
+python hack/chart_docs.py --check
 
 echo "==> unit + integration tests"
 python -m pytest tests/ -q

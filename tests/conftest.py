@@ -34,4 +34,6 @@ def fake_clock():
 @pytest.fixture
 def api(fake_clock):
     """An empty embedded control plane on a deterministic clock."""
-    return APIServer(clock=fake_clock)
+    server = APIServer(clock=fake_clock)
+    yield server
+    server.close()  # stop the watch dispatcher; no thread leak per test

@@ -1,22 +1,32 @@
-"""The five BASELINE.md acceptance configs, end-to-end on the live stack
-(RealClock manager + executor; `@every` schedules keep wall time in
-seconds). This closes the e2e gap the reference left open — its e2e never
-applies a Cron CR (``/root/reference/test/e2e/e2e_test.go:281-289`` TODO);
-here every config drives Cron → reconcile → workload → (real or simulated)
-execution → status/history.
+"""The five BASELINE.md acceptance configs, deterministic.
+
+Round-4 rewrite (VERDICT r3 #3, asked since r1): the five configs used to
+drive the live manager with ``@every``-second schedules and wall-clock
+polling — green but load-sensitive. They now run the way the reference's
+own controller tests do (``cron_controller_test.go:90-129``: backdated
+``LastScheduleTime``, no sleeps): a FakeClock-backed APIServer, direct
+``reconciler.reconcile()`` calls, and workload terminal states hand-set
+through the status subresource (the reference hand-crafts JobStatus the
+same way — SURVEY.md §4 "jobs are created and listed but never run").
+
+The live-stack versions (real Manager worker pools + LocalExecutor
+threads + actual training) live in ``test_acceptance_smoke.py`` — one
+wall-clock smoke per concurrency policy.
 """
 
-import time
+from datetime import timedelta
 
 import pytest
 
-from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
-from cron_operator_tpu.backends.local import LocalExecutor
-from cron_operator_tpu.backends.tpu import NODESEL_ACCELERATOR, NODESEL_TOPOLOGY
+from cron_operator_tpu.backends.tpu import (
+    NODESEL_ACCELERATOR,
+    NODESEL_TOPOLOGY,
+)
 from cron_operator_tpu.controller import CronReconciler
-from cron_operator_tpu.runtime import APIServer, Manager
+from cron_operator_tpu.runtime.manager import Metrics
 
 JAX = "kubeflow.org/v1"
+CRON_API = "apps.kubedl.io/v1alpha1"
 
 
 def _cron(name, schedule, workload, policy="Allow", history=100, **spec_extra):
@@ -28,7 +38,7 @@ def _cron(name, schedule, workload, policy="Allow", history=100, **spec_extra):
     }
     spec.update(spec_extra)
     return {
-        "apiVersion": "apps.kubedl.io/v1alpha1",
+        "apiVersion": CRON_API,
         "kind": "Cron",
         "metadata": {"name": name, "namespace": "default"},
         "spec": spec,
@@ -45,20 +55,18 @@ def _workload(kind="JAXJob", annotations=None, replicas=1):
 
 
 @pytest.fixture
-def stack():
-    api = APIServer()
-    mgr = Manager(api, max_concurrent_reconciles=10)
-    rec = CronReconciler(api, metrics=mgr.metrics)
-    mgr.add_controller(
-        "cron", rec.reconcile, for_gvk=GVK_CRON,
-        owns=default_scheme().workload_kinds(),
-    )
-    ex = LocalExecutor(api)
-    ex.start()
-    mgr.start()
-    yield api, mgr, ex
-    mgr.stop()
-    ex.stop()
+def rig(api, fake_clock):
+    """(api, reconciler, clock, metrics) on deterministic time."""
+    metrics = Metrics()
+    rec = CronReconciler(api, metrics=metrics)
+    return api, rec, fake_clock, metrics
+
+
+def _tick(rig, name, seconds=61):
+    """Advance virtual time past the next activation and reconcile."""
+    api, rec, clock, _ = rig
+    clock.advance(timedelta(seconds=seconds))
+    return rec.reconcile("default", name)
 
 
 def _jobs(api, kind="JAXJob"):
@@ -74,186 +82,196 @@ def _active(api, kind="JAXJob"):
     return out
 
 
-class TestConfig1TFJobForbid:
-    """Single-replica TFJob (CPU), Forbid: ticks are skipped while a run is
-    active — never two overlapping workloads."""
+def _finish(api, name, kind="JAXJob", cond="Succeeded"):
+    """Hand-set a terminal JobStatus (reference test technique)."""
+    api.patch_status(
+        JAX, kind, "default", name,
+        {"conditions": [
+            {"type": "Running", "status": "True"},
+            {"type": cond, "status": "True"},
+        ]},
+    )
 
-    def test_forbid_prevents_overlap(self, stack):
-        api, _, _ = stack
-        api.create(_cron(
-            "tf-mnist", "@every 1s",
-            _workload("TFJob", {"tpu.kubedl.io/simulate-duration": "2500ms"}),
-            policy="Forbid",
-        ))
-        max_active = 0
-        deadline = time.time() + 6.0
-        while time.time() < deadline:
-            max_active = max(max_active, len(_active(api, "TFJob")))
-            time.sleep(0.1)
-        assert max_active == 1
-        total = len(_jobs(api, "TFJob"))
-        assert 1 <= total <= 3  # ~2.5s each over ~6s, ticks skipped between
-        # Domain metrics: fired ticks and Forbid skips were counted.
-        _, mgr, _ = stack
-        snap = mgr.metrics.snapshot()
-        assert snap.get("cron_ticks_fired_total", 0) == total
-        assert snap.get('cron_ticks_skipped_total{policy="Forbid"}', 0) >= 1
+
+class TestConfig1TFJobForbid:
+    """Single-replica TFJob (CPU), Forbid: a tick is skipped while a run
+    is active — never two overlapping workloads."""
+
+    def test_forbid_prevents_overlap(self, rig):
+        api, rec, clock, metrics = rig
+        api.create(_cron("tf-mnist", "@every 60s", _workload("TFJob"),
+                         policy="Forbid"))
+
+        _tick(rig, "tf-mnist")
+        assert len(_jobs(api, "TFJob")) == 1
+
+        # Next two ticks arrive while the first run is still active.
+        _tick(rig, "tf-mnist")
+        _tick(rig, "tf-mnist")
+        assert len(_jobs(api, "TFJob")) == 1, "Forbid must skip, not stack"
+        assert metrics.get('cron_ticks_skipped_total{policy="Forbid"}') >= 1
+
+        # Run finishes → the following tick fires again.
+        _finish(api, _jobs(api, "TFJob")[0]["metadata"]["name"], "TFJob")
+        _tick(rig, "tf-mnist")
+        assert len(_jobs(api, "TFJob")) == 2
+        assert len(_active(api, "TFJob")) == 1
+        assert metrics.get("cron_ticks_fired_total") == 2
 
 
 class TestConfig2JaxMnistV5e1:
-    """Single-host JAXJob MNIST on v5e-1: real training (CPU devices stand
-    in for the chip), TPU admission injects slice metadata."""
+    """Single-host JAXJob on v5e-1: TPU admission injects slice metadata
+    on the object the reconciler POSTs (executor-side training is covered
+    by the smoke tier + test_local_executor)."""
 
-    def test_trains_and_injects_topology(self, stack):
-        api, _, ex = stack
+    def test_admission_injects_topology(self, rig):
+        api, rec, clock, _ = rig
         api.create(_cron(
-            "jax-mnist", "@every 1s",
+            "jax-mnist", "@every 60s",
             _workload("JAXJob", {
                 "tpu.kubedl.io/accelerator": "v5e-1",
                 "tpu.kubedl.io/entrypoint": "mnist",
                 "tpu.kubedl.io/param.steps": "2",
-                "tpu.kubedl.io/param.batch_size": "16",
-                "tpu.kubedl.io/param.platform": "cpu",
             }),
             policy="Forbid",
         ))
-        deadline = time.time() + 60.0
-        done = None
-        while time.time() < deadline and done is None:
-            for j in _jobs(api):
-                st = j.get("status") or {}
-                if (st.get("trainingProgress") or {}).get("steps_done") == 2:
-                    done = j
-            time.sleep(0.2)
-        assert done is not None, "mnist job never finished training"
-        worker = done["spec"]["replicaSpecs"]["Worker"]
+        _tick(rig, "jax-mnist")
+        jobs = _jobs(api)
+        assert len(jobs) == 1
+        worker = jobs[0]["spec"]["replicaSpecs"]["Worker"]
         sel = worker["template"]["spec"]["nodeSelector"]
         assert sel[NODESEL_ACCELERATOR] == "tpu-v5-lite-podslice"
         assert sel[NODESEL_TOPOLOGY] == "1x1"
         assert worker["replicas"] == 1  # single host
         res = worker["template"]["spec"]["containers"][0]["resources"]
         assert res["limits"]["google.com/tpu"] == "1"
+        # Owner ref + label wire the job back to its cron.
+        meta = jobs[0]["metadata"]
+        assert meta["labels"]["kubedl.io/cron-name"] == "jax-mnist"
+        assert meta["ownerReferences"][0]["kind"] == "Cron"
+
+    def test_invalid_topology_fails_admission_not_cron(self, rig):
+        api, rec, clock, _ = rig
+        api.create(_cron(
+            "jax-bad", "@every 60s",
+            _workload("JAXJob", {"tpu.kubedl.io/accelerator": "v99-0"}),
+            policy="Forbid",
+        ))
+        _tick(rig, "jax-bad")
+        assert len(_jobs(api)) == 0
+        assert api.events(reason="FailedTPUAdmission")
 
 
 class TestConfig3ResnetV5e16Replace:
-    """Multi-host v5e-16 (4 hosts × 4 chips): the gang is 4 pods; Replace
-    deletes the whole previous pod group before launching the next run."""
+    """Multi-host v5e-16 (4 hosts × 4 chips): replicas = hosts; Replace
+    deletes the previous generation before launching the next."""
 
-    def test_gang_and_replace(self, stack):
-        api, _, _ = stack
+    def test_gang_and_replace(self, rig):
+        api, rec, clock, _ = rig
         api.create(_cron(
-            "resnet", "@every 2s",
+            "resnet", "@every 60s",
             _workload("JAXJob", {
                 "tpu.kubedl.io/accelerator": "tpu-v5-lite-podslice",
                 "tpu.kubedl.io/topology": "4x4",
-                "tpu.kubedl.io/simulate-duration": "30s",
             }, replicas=4),
             policy="Replace",
         ))
-        deadline = time.time() + 9.0
-        saw_pods = 0
-        while time.time() < deadline:
-            pods = api.list("v1", "Pod", namespace="default")
-            saw_pods = max(saw_pods, len(pods))
-            assert len(_active(api)) <= 1, "Replace must never stack runs"
-            time.sleep(0.2)
-        # one gang at a time: 4 host pods, never 8
-        assert saw_pods == 4
-        # replacement happened: the job name (tick timestamp) moved on
-        names = {j["metadata"]["name"] for j in _jobs(api)}
-        assert len(names) == 1  # exactly one generation alive
-        gang = (_jobs(api)[0]["metadata"]["annotations"] or {})
-        assert gang.get("tpu.kubedl.io/gang-size") == "4"
+        _tick(rig, "resnet")
+        gen1 = _jobs(api)
+        assert len(gen1) == 1
+        assert gen1[0]["spec"]["replicaSpecs"]["Worker"]["replicas"] == 4
+        ann = gen1[0]["metadata"]["annotations"]
+        assert ann["tpu.kubedl.io/gang-size"] == "4"
+
+        # Second tick with gen1 still active: Replace must swap, not stack.
+        _tick(rig, "resnet")
+        gen2 = _jobs(api)
+        assert len(gen2) == 1, "Replace must never stack runs"
+        assert gen2[0]["metadata"]["name"] != gen1[0]["metadata"]["name"]
+        assert api.try_get(
+            JAX, "JAXJob", "default", gen1[0]["metadata"]["name"]
+        ) is None, "previous generation must be deleted"
 
 
 class TestConfig4AllowHistoryLimit:
-    """Allow concurrency stacks overlapping runs; historyLimit=5 garbage
-    collects the oldest finished workloads."""
+    """Allow stacks overlapping runs; historyLimit=5 garbage-collects the
+    oldest finished workloads (their history entries go with them)."""
 
-    def test_overlap_and_history_gc(self, stack):
-        api, _, _ = stack
-        api.create(_cron(
-            "allow3", "@every 1s",
-            _workload("JAXJob", {"tpu.kubedl.io/simulate-duration": "2800ms"}),
-            policy="Allow", history=5,
-        ))
-        max_active = 0
-        deadline = time.time() + 12.0
-        while time.time() < deadline:
-            max_active = max(max_active, len(_active(api)))
-            time.sleep(0.1)
-        assert max_active >= 3, f"expected 3-way overlap, saw {max_active}"
-        # GC: retained finished jobs never exceed the limit by more than the
-        # one-reconcile-lag the reference design allows.
-        cron = api.get("apps.kubedl.io/v1alpha1", "Cron", "default", "allow3")
+    def test_overlap(self, rig):
+        api, rec, clock, metrics = rig
+        api.create(_cron("allow3", "@every 60s", _workload("JAXJob"),
+                         policy="Allow", history=5))
+        for _ in range(3):
+            _tick(rig, "allow3")
+        assert len(_active(api)) == 3, "Allow must stack overlapping runs"
+        assert metrics.get("cron_ticks_fired_total") == 3
+
+    def test_history_gc(self, rig):
+        api, rec, clock, _ = rig
+        api.create(_cron("gc5", "@every 60s", _workload("JAXJob"),
+                         policy="Allow", history=5))
+        # Eight completed generations, distinct creation times.
+        for _ in range(8):
+            _tick(rig, "gc5")
+            for j in _active(api):
+                _finish(api, j["metadata"]["name"])
+        # One more reconcile syncs history and GCs beyond the limit.
+        (api_, rec_, clock_, _m) = rig
+        rec_.reconcile("default", "gc5")
+        cron = api.get(CRON_API, "Cron", "default", "gc5")
         history = (cron.get("status") or {}).get("history") or []
-        assert len(history) <= 5
+        assert len(history) == 5
+        assert len(_jobs(api)) == 5, "GC must delete beyond historyLimit"
+        assert all(h["status"] == "Succeeded" for h in history)
 
 
 class TestConfig5SuspendDeadlinePreemption:
-    """Suspend gates ticks; preemption of a multi-host slice kills the gang
-    and (with restart-on-preemption) re-runs the job; a passed deadline
-    stops scheduling with a Deadline event."""
+    """Suspend gates ticks; a preempted (Restarting) job counts as active
+    so Forbid keeps skipping; a passed deadline stops scheduling with a
+    Deadline event."""
 
-    def test_suspend_then_resume(self, stack):
-        api, _, _ = stack
-        api.create(_cron(
-            "bert", "@every 1s",
-            _workload("JAXJob", {"tpu.kubedl.io/simulate-duration": "200ms"}),
-            policy="Forbid", suspend=True,
-        ))
-        time.sleep(2.5)
+    def test_suspend_then_resume(self, rig):
+        api, rec, clock, _ = rig
+        api.create(_cron("bert", "@every 60s", _workload("JAXJob"),
+                         policy="Forbid", suspend=True))
+        _tick(rig, "bert")
+        _tick(rig, "bert")
         assert len(_jobs(api)) == 0, "suspended cron must not fire"
-        cron = api.get("apps.kubedl.io/v1alpha1", "Cron", "default", "bert")
+
+        cron = api.get(CRON_API, "Cron", "default", "bert")
         cron["spec"]["suspend"] = False
         api.update(cron)
-        deadline = time.time() + 8.0
-        while time.time() < deadline and not _jobs(api):
-            time.sleep(0.1)
-        assert _jobs(api), "unsuspended cron must fire"
+        _tick(rig, "bert")
+        assert len(_jobs(api)) == 1, "unsuspended cron must fire"
 
-    def test_preemption_restart(self, stack):
-        api, _, ex = stack
-        api.create(_cron(
-            "bert-pre", "@every 1s",
-            _workload("JAXJob", {
-                "tpu.kubedl.io/accelerator": "v5e-16",
-                "tpu.kubedl.io/simulate-duration": "20s",
-                "tpu.kubedl.io/restart-on-preemption": "true",
-            }),
-            policy="Forbid",
-        ))
-        deadline = time.time() + 8.0
-        job = None
-        while time.time() < deadline and job is None:
-            running = [
-                j for j in _jobs(api)
-                if any(c["type"] == "Running"
-                       for c in (j.get("status") or {}).get("conditions") or [])
-            ]
-            job = running[0] if running else None
-            time.sleep(0.1)
-        assert job is not None
-        name = job["metadata"]["name"]
-        assert len(api.list("v1", "Pod", namespace="default")) == 4
+    def test_restarting_counts_as_active(self, rig):
+        """Slice preemption surfaces as Restarting (not terminal) — the
+        reconciler must treat it as active: Forbid skips, Replace would
+        delete. Terminal Failed then frees the next tick."""
+        api, rec, clock, _ = rig
+        api.create(_cron("bert-pre", "@every 60s", _workload("JAXJob"),
+                         policy="Forbid"))
+        _tick(rig, "bert-pre")
+        name = _jobs(api)[0]["metadata"]["name"]
+        api.patch_status(
+            JAX, "JAXJob", "default", name,
+            {"conditions": [
+                {"type": "Running", "status": "True"},
+                {"type": "Restarting", "status": "True"},
+            ]},
+        )
+        _tick(rig, "bert-pre")
+        assert len(_jobs(api)) == 1, "Restarting job is active; Forbid skips"
 
-        ex.preempt("default", name)
-        deadline = time.time() + 8.0
-        restarted = False
-        while time.time() < deadline and not restarted:
-            j = api.try_get(JAX, "JAXJob", "default", name)
-            conds = [c["type"] for c in (j.get("status") or {}).get("conditions") or []]
-            restarted = "Restarting" in conds and conds.count("Running") >= 2
-            time.sleep(0.1)
-        assert restarted, "preempted job must go Restarting and re-run"
+        _finish(api, name, cond="Failed")
+        _tick(rig, "bert-pre")
+        assert len(_jobs(api)) == 2, "terminal Failed frees the next tick"
 
-    def test_deadline_stops_scheduling(self, stack):
-        api, _, _ = stack
-        api.create(_cron(
-            "bert-dead", "@every 1s",
-            _workload("JAXJob", {"tpu.kubedl.io/simulate-duration": "100ms"}),
-            policy="Forbid", deadline="2020-01-01T00:00:00Z",
-        ))
-        time.sleep(2.5)
+    def test_deadline_stops_scheduling(self, rig):
+        api, rec, clock, _ = rig
+        api.create(_cron("bert-dead", "@every 60s", _workload("JAXJob"),
+                         policy="Forbid", deadline="2020-01-01T00:00:00Z"))
+        _tick(rig, "bert-dead")
+        _tick(rig, "bert-dead")
         assert len(_jobs(api)) == 0
         assert api.events(reason="Deadline"), "Deadline event must fire"

@@ -1,0 +1,204 @@
+"""Wall-clock acceptance smokes — one per concurrency policy, on the LIVE
+stack (RealClock Manager worker pools + LocalExecutor threads + actual
+training). The deterministic five-config matrix lives in
+``test_acceptance.py`` (FakeClock, no sleeps); this tier keeps the
+end-to-end proof that the real threads, timers and executor agree with
+it. Assertions here are existence-level (a thing happened), not
+count-exact (how many times in a window) — that's what made the old
+suite load-sensitive (VERDICT r3 #3).
+"""
+
+import time
+
+import pytest
+
+from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+from cron_operator_tpu.backends.local import LocalExecutor
+from cron_operator_tpu.backends.tpu import NODESEL_ACCELERATOR
+from cron_operator_tpu.controller import CronReconciler
+from cron_operator_tpu.runtime import APIServer, Manager
+
+JAX = "kubeflow.org/v1"
+
+
+def _cron(name, schedule, workload, policy="Allow", history=100, **spec_extra):
+    spec = {
+        "schedule": schedule,
+        "concurrencyPolicy": policy,
+        "historyLimit": history,
+        "template": {"workload": workload},
+    }
+    spec.update(spec_extra)
+    return {
+        "apiVersion": "apps.kubedl.io/v1alpha1",
+        "kind": "Cron",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def _workload(kind="JAXJob", annotations=None, replicas=1):
+    return {
+        "apiVersion": JAX,
+        "kind": kind,
+        "metadata": {"annotations": dict(annotations or {})},
+        "spec": {"replicaSpecs": {"Worker": {"replicas": replicas}}},
+    }
+
+
+@pytest.fixture
+def stack():
+    api = APIServer()
+    mgr = Manager(api, max_concurrent_reconciles=10)
+    rec = CronReconciler(api, metrics=mgr.metrics)
+    mgr.add_controller(
+        "cron", rec.reconcile, for_gvk=GVK_CRON,
+        owns=default_scheme().workload_kinds(),
+    )
+    ex = LocalExecutor(api)
+    ex.start()
+    mgr.start()
+    yield api, mgr, ex
+    mgr.stop()
+    ex.stop()
+    api.close()
+
+
+def _jobs(api, kind="JAXJob"):
+    return api.list(JAX, kind, namespace="default")
+
+
+def _active(api, kind="JAXJob"):
+    out = []
+    for j in _jobs(api, kind):
+        conds = [c["type"] for c in (j.get("status") or {}).get("conditions") or []]
+        if "Succeeded" not in conds and "Failed" not in conds:
+            out.append(j)
+    return out
+
+
+class TestForbidSmoke:
+    """Forbid + real JAX training end-to-end: the cron fires, TPU admission
+    injects the slice, the executor trains MNIST to completion, and no
+    overlap ever appears."""
+
+    def test_trains_without_overlap(self, stack):
+        api, _, ex = stack
+        api.create(_cron(
+            "jax-mnist", "@every 1s",
+            _workload("JAXJob", {
+                "tpu.kubedl.io/accelerator": "v5e-1",
+                "tpu.kubedl.io/entrypoint": "mnist",
+                "tpu.kubedl.io/param.steps": "2",
+                "tpu.kubedl.io/param.batch_size": "16",
+                "tpu.kubedl.io/param.platform": "cpu",
+            }),
+            policy="Forbid",
+        ))
+        deadline = time.time() + 60.0
+        done = None
+        while time.time() < deadline and done is None:
+            assert len(_active(api)) <= 1, "Forbid must never overlap"
+            for j in _jobs(api):
+                st = j.get("status") or {}
+                if (st.get("trainingProgress") or {}).get("steps_done") == 2:
+                    done = j
+            time.sleep(0.2)
+        assert done is not None, "mnist job never finished training"
+        sel = (done["spec"]["replicaSpecs"]["Worker"]["template"]["spec"]
+               ["nodeSelector"])
+        assert sel[NODESEL_ACCELERATOR] == "tpu-v5-lite-podslice"
+
+
+class TestReplaceSmoke:
+    """Replace on a multi-host gang: 4 host pods appear for the active
+    generation; generations swap rather than stack."""
+
+    def test_gang_pods_and_swap(self, stack):
+        api, _, _ = stack
+        api.create(_cron(
+            "resnet", "@every 2s",
+            _workload("JAXJob", {
+                "tpu.kubedl.io/accelerator": "tpu-v5-lite-podslice",
+                "tpu.kubedl.io/topology": "4x4",
+                "tpu.kubedl.io/simulate-duration": "30s",
+            }, replicas=4),
+            policy="Replace",
+        ))
+        # Wait until a gang is up, then assert its shape.
+        deadline = time.time() + 20.0
+        pods = []
+        while time.time() < deadline and len(pods) < 4:
+            assert len(_active(api)) <= 1, "Replace must never stack runs"
+            pods = api.list("v1", "Pod", namespace="default")
+            time.sleep(0.2)
+        assert len(pods) == 4, "one gang = 4 host pods"
+        gen1 = {j["metadata"]["name"] for j in _jobs(api)}
+        # Wait for at least one replacement generation.
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            names = {j["metadata"]["name"] for j in _jobs(api)}
+            if names and names != gen1:
+                break
+            time.sleep(0.2)
+        names = {j["metadata"]["name"] for j in _jobs(api)}
+        assert names != gen1, "Replace never swapped generations"
+        assert len(names) == 1, "exactly one generation alive"
+
+
+class TestAllowSmoke:
+    """Allow stacks overlapping runs on the live timer."""
+
+    def test_overlap_happens(self, stack):
+        api, _, _ = stack
+        api.create(_cron(
+            "allow3", "@every 1s",
+            _workload("JAXJob", {"tpu.kubedl.io/simulate-duration": "6s"}),
+            policy="Allow", history=5,
+        ))
+        deadline = time.time() + 15.0
+        max_active = 0
+        while time.time() < deadline and max_active < 2:
+            max_active = max(max_active, len(_active(api)))
+            time.sleep(0.1)
+        assert max_active >= 2, f"expected overlap under Allow, saw {max_active}"
+
+
+class TestPreemptionSmoke:
+    """Slice preemption kills the gang; restart-on-preemption re-runs the
+    job (Restarting → Running again) — BASELINE config 5's hard case."""
+
+    def test_preemption_restart(self, stack):
+        api, _, ex = stack
+        api.create(_cron(
+            "bert-pre", "@every 1s",
+            _workload("JAXJob", {
+                "tpu.kubedl.io/accelerator": "v5e-16",
+                "tpu.kubedl.io/simulate-duration": "20s",
+                "tpu.kubedl.io/restart-on-preemption": "true",
+            }),
+            policy="Forbid",
+        ))
+        deadline = time.time() + 20.0
+        job = None
+        while time.time() < deadline and job is None:
+            running = [
+                j for j in _jobs(api)
+                if any(c["type"] == "Running"
+                       for c in (j.get("status") or {}).get("conditions") or [])
+            ]
+            job = running[0] if running else None
+            time.sleep(0.1)
+        assert job is not None
+        name = job["metadata"]["name"]
+        assert len(api.list("v1", "Pod", namespace="default")) == 4
+
+        ex.preempt("default", name)
+        deadline = time.time() + 20.0
+        restarted = False
+        while time.time() < deadline and not restarted:
+            j = api.try_get(JAX, "JAXJob", "default", name)
+            conds = [c["type"] for c in (j.get("status") or {}).get("conditions") or []]
+            restarted = "Restarting" in conds and conds.count("Running") >= 2
+            time.sleep(0.1)
+        assert restarted, "preempted job must go Restarting and re-run"

@@ -64,3 +64,78 @@ def test_examples_parse_and_validate():
         parse_standard(cron.spec.schedule)  # raises on bad schedule
         workload = new_empty_workload(cron)  # raises on bad template
         assert workload.get("kind"), path.name
+
+
+class TestKustomizeTree:
+    """The second install path (`kubectl apply -k config/default`) —
+    reference config/default/kustomization.yaml analog. No kustomize
+    binary ships in this image, so validation is structural: every
+    kustomization parses, every referenced resource exists and is valid
+    YAML with a GVK, and the CRD base is generator-synced."""
+
+    CONFIG = REPO / "config"
+
+    def _kustomization(self, rel):
+        path = self.CONFIG / rel / "kustomization.yaml"
+        assert path.exists(), f"missing {path}"
+        return yaml.safe_load(path.read_text())
+
+    def test_overlays_reference_existing_resources(self):
+        for rel in ("crd", "rbac", "manager", "prometheus",
+                    "network-policy", "default"):
+            k = self._kustomization(rel)
+            assert k["kind"] == "Kustomization"
+            for res in k.get("resources", []):
+                target = (self.CONFIG / rel / res).resolve()
+                assert target.exists(), f"{rel}: dangling resource {res}"
+                if target.is_file():
+                    docs = [
+                        d for d in
+                        yaml.safe_load_all(target.read_text()) if d
+                    ]
+                    assert docs, f"{target} is empty"
+                    for d in docs:
+                        assert d.get("kind"), f"{target}: doc without kind"
+                        assert d.get("apiVersion"), (
+                            f"{target}: doc without apiVersion"
+                        )
+                else:
+                    assert (target / "kustomization.yaml").exists(), (
+                        f"{rel}: {res} is not a kustomization dir"
+                    )
+
+    def test_default_overlay_composition(self):
+        k = self._kustomization("default")
+        assert k["namespace"] == "cron-operator-tpu-system"
+        assert k["namePrefix"] == "cron-operator-tpu-"
+        assert "../crd" in k["resources"]
+        assert "../rbac" in k["resources"]
+        assert "../manager" in k["resources"]
+
+    def test_crd_base_in_sync(self):
+        on_disk = (
+            self.CONFIG / "crd" / "bases" / "apps.kubedl.io_crons.yaml"
+        ).read_text()
+        assert on_disk == render_yaml(), (
+            "config/crd/bases drifted from api/crd.py — regenerate with "
+            "`python -m cron_operator_tpu.api.crd`"
+        )
+
+    def test_manager_args_match_deploy_manifest(self):
+        """Both install paths must start the operator the same way."""
+        mgr = None
+        for d in yaml.safe_load_all(
+            (self.CONFIG / "manager" / "manager.yaml").read_text()
+        ):
+            if d and d.get("kind") == "Deployment":
+                mgr = d
+        assert mgr is not None
+        args = mgr["spec"]["template"]["spec"]["containers"][0]["args"]
+        deploy = None
+        for d in yaml.safe_load_all(
+            (REPO / "deploy" / "operator.yaml").read_text()
+        ):
+            if d and d.get("kind") == "Deployment":
+                deploy = d
+        dargs = deploy["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert args == dargs

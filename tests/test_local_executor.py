@@ -133,7 +133,9 @@ class TestPodGroup:
             and len(p) == 4
             and p
         )
-        indices = sorted(p["metadata"]["labels"]["tpu.kubedl.io/worker-index"] for p in pods)
+        indices = sorted(
+            p["metadata"]["labels"]["tpu.kubedl.io/worker-index"]
+            for p in pods)
         assert indices == ["0", "1", "2", "3"]
         # all owned by the job → deleting the job cascades the pod group
         wait_for(lambda: "Succeeded" in conditions_of(rt_api, "gang"))

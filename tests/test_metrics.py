@@ -1,0 +1,143 @@
+"""Metrics exposition + the north-star latency histogram (VERDICT r3 #5).
+
+The reference exposes only controller-runtime built-ins and registers no
+custom metrics (SURVEY.md §5); this build adds domain counters and —
+asserted here — ``cron_tick_to_first_step_seconds``, the quantity the
+BASELINE.md north star is stated in, derived operator-side from workload
+status and served with proper ``# HELP``/``# TYPE`` headers so a real
+Prometheus scrape (the chart's ServiceMonitor) ingests it.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+from cron_operator_tpu.controller import CronReconciler
+from cron_operator_tpu.runtime.manager import Metrics
+
+
+def _cron(name="c", schedule="*/5 * * * *"):
+    return {
+        "apiVersion": "apps.kubedl.io/v1alpha1",
+        "kind": "Cron",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "schedule": schedule,
+            "template": {
+                "workload": {
+                    "apiVersion": "kubeflow.org/v1",
+                    "kind": "JAXJob",
+                    "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+                }
+            },
+        },
+    }
+
+
+class TestMetricsRegistry:
+    def test_counter_families_get_type_and_help(self):
+        m = Metrics()
+        m.inc('cron_ticks_fired_total')
+        m.inc('controller_runtime_reconcile_total{controller="cron",'
+              'result="success"}', 2)
+        text = m.render_prometheus()
+        assert "# TYPE cron_ticks_fired_total counter" in text
+        assert "# HELP cron_ticks_fired_total" in text
+        assert "# TYPE controller_runtime_reconcile_total counter" in text
+        # one TYPE line per family even with multiple label sets
+        m.inc('controller_runtime_reconcile_total{controller="cron",'
+              'result="requeue_after"}')
+        text = m.render_prometheus()
+        assert text.count("# TYPE controller_runtime_reconcile_total") == 1
+
+    def test_histogram_cumulative_buckets(self):
+        m = Metrics()
+        m.observe("cron_tick_to_first_step_seconds", 3.0,
+                  buckets=(1.0, 5.0, 10.0))
+        m.observe("cron_tick_to_first_step_seconds", 7.0,
+                  buckets=(1.0, 5.0, 10.0))
+        m.observe("cron_tick_to_first_step_seconds", 99.0,
+                  buckets=(1.0, 5.0, 10.0))
+        text = m.render_prometheus()
+        assert "# TYPE cron_tick_to_first_step_seconds histogram" in text
+        assert 'cron_tick_to_first_step_seconds_bucket{le="1"} 0' in text
+        assert 'cron_tick_to_first_step_seconds_bucket{le="5"} 1' in text
+        assert 'cron_tick_to_first_step_seconds_bucket{le="10"} 2' in text
+        assert 'cron_tick_to_first_step_seconds_bucket{le="+Inf"} 3' in text
+        assert "cron_tick_to_first_step_seconds_sum 109.0" in text
+        assert "cron_tick_to_first_step_seconds_count 3" in text
+
+
+class TestNorthStarObservation:
+    def _workload_with_progress(self, api, cron_name, name, first_step_delay):
+        """Create a labeled workload, then stamp trainingProgress so its
+        first step lands `first_step_delay` seconds after creation."""
+        api.create({
+            "apiVersion": "kubeflow.org/v1",
+            "kind": "JAXJob",
+            "metadata": {
+                "name": name, "namespace": "default",
+                "labels": {"kubedl.io/cron-name": cron_name},
+            },
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+        })
+        created = api.get("kubeflow.org/v1", "JAXJob", "default", name)
+        from cron_operator_tpu.api.v1alpha1 import parse_time
+
+        t0 = parse_time(created["metadata"]["creationTimestamp"]).timestamp()
+        api.patch_status(
+            "kubeflow.org/v1", "JAXJob", "default", name,
+            {"trainingProgress": {"first_step_at": t0 + first_step_delay}},
+        )
+
+    def test_latency_observed_once_per_workload(self, api, fake_clock):
+        metrics = Metrics()
+        rec = CronReconciler(api, metrics=metrics)
+        api.create(_cron())
+        self._workload_with_progress(api, "c", "c-1111", 12.0)
+
+        rec.reconcile("default", "c")
+        h = metrics.histogram("cron_tick_to_first_step_seconds")
+        assert h is not None and h["count"] == 1
+        assert abs(h["sum"] - 12.0) < 1.5  # rfc3339 whole-second precision
+
+        # Re-reconciling must not double-count the same workload.
+        rec.reconcile("default", "c")
+        h = metrics.histogram("cron_tick_to_first_step_seconds")
+        assert h["count"] == 1
+
+        # A second workload contributes its own observation.
+        self._workload_with_progress(api, "c", "c-2222", 40.0)
+        rec.reconcile("default", "c")
+        h = metrics.histogram("cron_tick_to_first_step_seconds")
+        assert h["count"] == 2
+        assert abs(h["sum"] - 52.0) < 3.0
+
+    def test_endpoint_serves_the_north_star(self, api):
+        """The /metrics endpoint (what the chart's ServiceMonitor scrapes)
+        must contain the latency family, headers included."""
+        from cron_operator_tpu.cli.main import _serve
+
+        metrics = Metrics()
+        rec = CronReconciler(api, metrics=metrics)
+        api.create(_cron())
+        self._workload_with_progress(api, "c", "c-1111", 30.0)
+        rec.reconcile("default", "c")
+
+        server = _serve(
+            0,
+            {"/metrics": lambda: (metrics.render_prometheus(),
+                                  "text/plain")},
+            "test-metrics",
+        )
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as resp:
+                body = resp.read().decode()
+        finally:
+            server.shutdown()
+        assert "# TYPE cron_tick_to_first_step_seconds histogram" in body
+        assert 'cron_tick_to_first_step_seconds_bucket{le="30"} 1' in body
+        assert "cron_tick_to_first_step_seconds_count 1" in body

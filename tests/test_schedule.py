@@ -6,7 +6,6 @@ from datetime import datetime, timedelta, timezone
 import pytest
 
 from cron_operator_tpu.controller.schedule import (
-    CronSchedule,
     EverySchedule,
     parse_go_duration,
     parse_standard,

@@ -6,7 +6,6 @@ from cron_operator_tpu.backends.tpu import (
     NODESEL_ACCELERATOR,
     NODESEL_TOPOLOGY,
     RESOURCE_TPU,
-    SliceSpec,
     TopologyError,
     inject_tpu_topology,
     render_coordinator_env,
