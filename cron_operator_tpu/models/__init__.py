@@ -14,5 +14,9 @@ traced path.
 from cron_operator_tpu.models.mlp import MLP
 from cron_operator_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from cron_operator_tpu.models.bert import Bert, BertConfig
+from cron_operator_tpu.models.gpt import GPT, GPTConfig
 
-__all__ = ["MLP", "ResNet", "ResNet18", "ResNet50", "Bert", "BertConfig"]
+__all__ = [
+    "MLP", "ResNet", "ResNet18", "ResNet50", "Bert", "BertConfig",
+    "GPT", "GPTConfig",
+]

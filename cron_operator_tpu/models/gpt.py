@@ -1,0 +1,166 @@
+"""GPT-style decoder — the causal-LM / long-context flagship.
+
+The reference schedules third-party training images and never sees a
+model; this framework's workloads are first-class, and the decoder is
+where its long-context machinery composes: causal attention through the
+pluggable :func:`ops.attention.multi_head_attention` (XLA → Pallas flash →
+ring over the mesh ``seq`` axis — same model code for all three), and an
+optional Switch-MoE FFN every ``moe_every`` blocks using
+:mod:`parallel.moe` (expert weights shard over the ``expert`` mesh axis;
+GSPMD turns dispatch/combine into all-to-alls).
+
+Next-token objective with tied output embedding — the loss path ends in a
+vocab-sized matmul, the realistic MXU load profile.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cron_operator_tpu.ops.attention import multi_head_attention
+from cron_operator_tpu.parallel.moe import moe_ffn
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"  # auto | flash | xla | ring
+    attention_interpret: bool = False  # CPU tests of the Pallas path
+    # MoE: 0 disables; k > 0 replaces every k-th block's FFN with a
+    # Switch-MoE layer of ``num_experts`` experts.
+    moe_every: int = 0
+    num_experts: int = 8
+    moe_capacity_factor: float = 1.25
+    # Weight of the router load-balancing loss, folded into the model's
+    # scalar aux output (trainer adds it to the task loss).
+    moe_aux_weight: float = 0.01
+
+    @staticmethod
+    def tiny(**overrides) -> "GPTConfig":
+        defaults = dict(
+            vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+            mlp_dim=512, max_len=512,
+        )
+        defaults.update(overrides)
+        return GPTConfig(**defaults)
+
+
+class MoEBlock(nn.Module):
+    """Switch-MoE FFN as a flax module around :func:`parallel.moe.moe_ffn`.
+
+    Param shapes match ``init_moe_params``. Sharding: the module is named
+    ``"moe"``, which :func:`parallel.mesh.sharding_for_tree` recognizes —
+    on a mesh with an ``expert`` axis the [E, ...] weights get
+    ``P('expert')`` and GSPMD lowers dispatch/combine to all-to-alls.
+    Expert matmuls run in ``cfg.dtype`` (bf16 on TPU); only routing is f32.
+    """
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> tuple:
+        cfg = self.config
+        d, e = cfg.hidden_size, cfg.num_experts
+        params = {
+            "router": self.param(
+                "router", nn.initializers.normal(0.02), (d, e)
+            ),
+            "wi": self.param(
+                "wi", nn.initializers.lecun_normal(), (e, d, cfg.mlp_dim)
+            ),
+            "wo": self.param(
+                "wo", nn.initializers.lecun_normal(), (e, cfg.mlp_dim, d)
+            ),
+        }
+        b, s, _ = x.shape
+        flat = x.reshape(b * s, d)
+        y, aux = moe_ffn(
+            params, flat, capacity_factor=cfg.moe_capacity_factor,
+            compute_dtype=cfg.dtype,
+        )
+        return y.reshape(b, s, d).astype(cfg.dtype), aux
+
+
+class DecoderLayer(nn.Module):
+    config: GPTConfig
+    mesh: Optional[jax.sharding.Mesh] = None
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> tuple:
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        y = nn.LayerNorm(dtype=cfg.dtype)(x)
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
+            name="qkv",
+        )(y)
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        attn = multi_head_attention(
+            q, k, v, causal=True, impl=cfg.attention_impl, mesh=self.mesh,
+            interpret=cfg.attention_interpret,
+        )
+        attn = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(attn)
+        x = x + attn
+
+        y = nn.LayerNorm(dtype=cfg.dtype)(x)
+        aux = jnp.zeros((), jnp.float32)
+        if self.use_moe:
+            y, aux = MoEBlock(cfg, name="moe")(y)
+        else:
+            y = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype)(y)
+            y = nn.gelu(y)
+            y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(y)
+        return x + y, aux
+
+
+class GPT(nn.Module):
+    """Token ids ``[batch, seq]`` → (next-token logits ``[b, s, vocab]``,
+    aux loss scalar). The aux scalar is the weighted MoE router balance
+    loss (0.0 for dense configs) — trainers add it to the task loss."""
+
+    config: GPTConfig = field(default_factory=GPTConfig)
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray) -> tuple:
+        cfg = self.config
+        tok = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
+        )
+        pos = self.param(
+            "pos_emb",
+            nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.hidden_size),
+        )
+        s = input_ids.shape[1]
+        x = tok(input_ids) + pos[None, :s].astype(cfg.dtype)
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_layers):
+            use_moe = (
+                cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+            )
+            x, aux = DecoderLayer(
+                cfg, mesh=self.mesh, use_moe=use_moe, name=f"layer_{i}"
+            )(x)
+            aux_total = aux_total + aux
+        x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        logits = tok.attend(x)
+        return logits.astype(jnp.float32), cfg.moe_aux_weight * aux_total
+
+
+__all__ = ["GPT", "GPTConfig", "DecoderLayer", "MoEBlock"]

@@ -19,6 +19,15 @@ from cron_operator_tpu.parallel.mesh import (
     pspec_for_shape,
     sharding_for_tree,
 )
+from cron_operator_tpu.parallel.moe import (
+    init_moe_params,
+    moe_ffn,
+    moe_param_sharding,
+)
+from cron_operator_tpu.parallel.pipeline import (
+    spmd_pipeline,
+    stack_pipeline_stages,
+)
 from cron_operator_tpu.parallel.ring import ring_attention, ring_attention_local
 
 __all__ = [
@@ -32,4 +41,9 @@ __all__ = [
     "sharding_for_tree",
     "ring_attention",
     "ring_attention_local",
+    "spmd_pipeline",
+    "stack_pipeline_stages",
+    "init_moe_params",
+    "moe_ffn",
+    "moe_param_sharding",
 ]

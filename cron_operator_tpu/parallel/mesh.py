@@ -35,6 +35,8 @@ DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 TENSOR_AXIS = "tensor"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"  # pipeline stages (see parallel.pipeline)
+EXPERT_AXIS = "expert"  # expert parallelism (see parallel.moe)
 
 # Axes over which a batch's leading dimension is split (both are "data" from
 # the input pipeline's perspective).
@@ -72,17 +74,24 @@ def plan_for_devices(
     tensor: int = 1,
     seq: int = 1,
     fsdp: int = 1,
+    pipe: int = 1,
+    expert: int = 1,
     data: Optional[int] = None,
 ) -> MeshPlan:
     """Factor ``n_devices`` into the standard axes.
 
     ``data`` is inferred as the remainder unless given. Raises ValueError if
-    the factorization does not multiply out to ``n_devices``.
+    the factorization does not multiply out to ``n_devices``. Axis order
+    (outer→inner): pipe, data, fsdp, expert, seq, tensor — the chattiest
+    collectives (tensor/seq) land innermost on ICI-adjacent chips, the
+    per-tick ppermute of the pipeline outermost (it moves one activation
+    per microbatch tick, the least bandwidth-hungry traffic).
     """
-    model_par = tensor * seq * fsdp
+    model_par = tensor * seq * fsdp * pipe * expert
     if n_devices % model_par != 0:
         raise ValueError(
-            f"{n_devices} devices not divisible by tensor*seq*fsdp={model_par}"
+            f"{n_devices} devices not divisible by "
+            f"tensor*seq*fsdp*pipe*expert={model_par}"
         )
     inferred_data = n_devices // model_par
     if data is not None and data != inferred_data:
@@ -90,9 +99,14 @@ def plan_for_devices(
             f"data={data} inconsistent: {n_devices} devices / {model_par} = "
             f"{inferred_data}"
         )
-    sizes: Dict[str, int] = {DATA_AXIS: inferred_data}
+    sizes: Dict[str, int] = {}
+    if pipe > 1:
+        sizes[PIPE_AXIS] = pipe
+    sizes[DATA_AXIS] = inferred_data
     if fsdp > 1:
         sizes[FSDP_AXIS] = fsdp
+    if expert > 1:
+        sizes[EXPERT_AXIS] = expert
     if seq > 1:
         sizes[SEQ_AXIS] = seq
     if tensor > 1:
@@ -123,10 +137,13 @@ def mesh_for_devices(
     tensor: int = 1,
     seq: int = 1,
     fsdp: int = 1,
+    pipe: int = 1,
+    expert: int = 1,
 ) -> Mesh:
     """One-call helper: factor the local devices and build the mesh."""
     devices = list(devices if devices is not None else jax.devices())
-    plan = plan_for_devices(len(devices), tensor=tensor, seq=seq, fsdp=fsdp)
+    plan = plan_for_devices(len(devices), tensor=tensor, seq=seq, fsdp=fsdp,
+                            pipe=pipe, expert=expert)
     return make_mesh(plan, devices)
 
 
@@ -136,6 +153,8 @@ def mesh_for_slice(
     tensor: int = 1,
     seq: int = 1,
     fsdp: int = 1,
+    pipe: int = 1,
+    expert: int = 1,
     devices: Optional[Sequence[Any]] = None,
 ) -> Mesh:
     """Mesh over the chips of a :class:`backends.tpu.SliceSpec`.
@@ -151,7 +170,8 @@ def mesh_for_slice(
             f"{len(devices)} devices are visible"
         )
     plan = plan_for_devices(
-        slice_spec.chips, tensor=tensor, seq=seq, fsdp=fsdp
+        slice_spec.chips, tensor=tensor, seq=seq, fsdp=fsdp,
+        pipe=pipe, expert=expert,
     )
     return make_mesh(plan, devices)
 
@@ -203,16 +223,43 @@ def pspec_for_shape(shape: Tuple[int, ...], mesh: Mesh) -> P:
     return P(*spec)
 
 
+def expert_stacked(shape: Tuple[int, ...], expert_size: int) -> bool:
+    """Shape test for expert-stacked ``[E, ...]`` weights — the ONE rule
+    shared by :func:`sharding_for_tree` (which additionally requires the
+    ``"moe"`` tree-key convention) and ``moe.moe_param_sharding`` (which
+    owns its whole param dict, so the shape alone suffices there)."""
+    return (
+        expert_size > 1
+        and len(shape) >= 3
+        and shape[0] % expert_size == 0
+    )
+
+
 def sharding_for_tree(tree: Any, mesh: Mesh) -> Any:
     """Map a pytree of arrays/ShapeDtypeStructs to NamedShardings via
     :func:`pspec_for_shape`. Use with ``jax.jit(in_shardings=...)`` or
-    ``jax.device_put``."""
+    ``jax.device_put``.
 
-    def _one(leaf: Any) -> NamedSharding:
+    One path-aware rule on top of the shape rules: when the mesh has an
+    ``expert`` axis, leaves living under a tree key named ``"moe"``
+    (models.gpt's MoE block; optimizer state mirrors the same paths) with
+    rank ≥ 3 and a leading dim divisible by the axis are expert-stacked
+    ``[E, ...]`` weights — sharded ``P('expert')`` so GSPMD lowers the MoE
+    dispatch/combine einsums to all-to-alls. A pure shape rule can't see
+    this (any rank-3+ tensor might coincidentally divide), hence the
+    naming convention.
+    """
+    expert = mesh.shape.get(EXPERT_AXIS, 1)
+
+    def _path_one(path, leaf: Any) -> NamedSharding:
         shape = tuple(getattr(leaf, "shape", ()) or ())
+        if expert_stacked(shape, expert) and any(
+            getattr(k, "key", None) == "moe" for k in path
+        ):
+            return NamedSharding(mesh, P(EXPERT_AXIS))
         return NamedSharding(mesh, pspec_for_shape(shape, mesh))
 
-    return jax.tree_util.tree_map(_one, tree)
+    return jax.tree_util.tree_map_with_path(_path_one, tree)
 
 
 __all__ = [
@@ -220,6 +267,8 @@ __all__ = [
     "FSDP_AXIS",
     "TENSOR_AXIS",
     "SEQ_AXIS",
+    "PIPE_AXIS",
+    "EXPERT_AXIS",
     "BATCH_AXES",
     "MeshPlan",
     "plan_for_devices",
@@ -228,5 +277,6 @@ __all__ = [
     "mesh_for_slice",
     "batch_pspec",
     "pspec_for_shape",
+    "expert_stacked",
     "sharding_for_tree",
 ]

@@ -1,0 +1,149 @@
+"""Expert parallelism — Switch/GShard-style mixture-of-experts FFN.
+
+Absent from the reference (SURVEY.md §2.3 "EP: delegated to workload");
+here a framework primitive, built for how the MXU and GSPMD want it:
+
+- **Dense dispatch, static shapes.** Routing is expressed as two einsums
+  with a [tokens, experts, capacity] one-hot dispatch/combine tensor (the
+  GShard formulation) instead of gather/scatter: every shape is static,
+  everything lands on the MXU, and nothing blocks XLA fusion.
+- **Sharding does the communication.** Expert weights carry
+  ``P('expert')`` on their leading dim and the dispatched activations
+  ``[E, capacity, d]`` shard the same axis — GSPMD lowers the dispatch/
+  combine einsums to all-to-alls over ICI. No hand-written collective.
+- **Top-1 (Switch) routing** with a capacity factor: per-expert buffers
+  hold ``capacity = ceil(tokens/E · factor)`` tokens; overflow tokens are
+  dropped (combine weight 0 — they pass through the residual). The
+  standard Switch load-balancing auxiliary loss is returned for the
+  trainer to add.
+
+Usage::
+
+    params = init_moe_params(key, d_model=..., d_ff=..., n_experts=8)
+    y, aux = moe_ffn(params, x)               # x: [tokens, d_model]
+    shardings = moe_param_sharding(params, mesh)   # expert dim on 'expert'
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cron_operator_tpu.parallel.mesh import EXPERT_AXIS, expert_stacked
+
+
+def init_moe_params(
+    key: jax.Array, *, d_model: int, d_ff: int, n_experts: int
+) -> Dict[str, jax.Array]:
+    k_r, k_i, k_o = jax.random.split(key, 3)
+    return {
+        "router": jax.random.normal(k_r, (d_model, n_experts)) * 0.02,
+        "wi": jax.random.normal(k_i, (n_experts, d_model, d_ff))
+        / np.sqrt(d_model),
+        "wo": jax.random.normal(k_o, (n_experts, d_ff, d_model))
+        / np.sqrt(d_ff),
+    }
+
+
+def _capacity(tokens: int, n_experts: int, capacity_factor: float) -> int:
+    return max(1, int(np.ceil(tokens / n_experts * capacity_factor)))
+
+
+def router_top1(
+    logits: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Switch top-1 router.
+
+    ``logits``: [T, E]. Returns (combine [T, E, C], dispatch [T, E, C]
+    one-hot, aux load-balance loss). Position within an expert's buffer is
+    the token's rank among tokens routed to that expert (cumsum order);
+    rank ≥ capacity ⇒ dropped.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_index = jnp.argmax(probs, axis=-1)  # [T]
+    expert_mask = jax.nn.one_hot(expert_index, E, dtype=probs.dtype)  # [T,E]
+
+    # Switch aux loss: E · Σ_e (token fraction on e) · (mean router prob e).
+    density = expert_mask.mean(axis=0)
+    density_proxy = probs.mean(axis=0)
+    aux_loss = E * jnp.sum(density * density_proxy)
+
+    # Buffer slot = 0-based rank of this token among its expert's tokens
+    # (non-selected entries contribute 0 to the sum, so the one-hot picks
+    # out the selected expert's rank).
+    position_in_expert = (
+        (jnp.cumsum(expert_mask, axis=0) - 1.0) * expert_mask
+    ).sum(axis=-1).astype(jnp.int32)  # [T]
+    kept = position_in_expert < capacity
+
+    gate = (probs * expert_mask).sum(axis=-1) * kept  # [T]
+    slot_one_hot = jax.nn.one_hot(
+        jnp.where(kept, position_in_expert, capacity),  # overflow → C (oob)
+        capacity, dtype=probs.dtype,
+    )  # [T, C]
+    dispatch = expert_mask[:, :, None] * slot_one_hot[:, None, :]  # [T,E,C]
+    combine = gate[:, None, None] * dispatch
+    return combine, dispatch, aux_loss
+
+
+def moe_ffn(
+    params: Dict[str, jnp.ndarray],
+    x: jnp.ndarray,
+    *,
+    capacity_factor: float = 1.25,
+    compute_dtype: Any = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Mixture-of-experts FFN over a flat token batch.
+
+    ``x``: [T, d_model] → ([T, d_model], aux_loss). Dropped (overflow)
+    tokens produce zeros — compose with a residual connection.
+
+    Routing (logits, softmax, aux loss) always runs f32 — small tensors,
+    numerically sensitive. The expert matmuls — the FLOPs — run in
+    ``compute_dtype`` (default: ``x.dtype``; pass bf16 for the MXU path).
+    """
+    T = x.shape[0]
+    E = params["wi"].shape[0]
+    C = _capacity(T, E, capacity_factor)
+    cd = compute_dtype or x.dtype
+
+    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    combine, dispatch, aux_loss = router_top1(logits, C)
+
+    # Dispatch: [T,d],[T,E,C] → [E,C,d]; sharded on E ⇒ GSPMD all-to-all.
+    expert_in = jnp.einsum("td,tec->ecd", x.astype(cd), dispatch.astype(cd))
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["wi"].astype(cd))
+    )
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["wo"].astype(cd))
+    # Combine back to token order with the gate applied.
+    y = jnp.einsum("ecd,tec->td", expert_out, combine.astype(cd))
+    return y, aux_loss
+
+
+def moe_param_sharding(params: Any, mesh: Mesh) -> Any:
+    """NamedShardings for MoE params: expert-stacked weights (the shared
+    :func:`parallel.mesh.expert_stacked` rule) shard their leading dim on
+    ``expert`` when the mesh has that axis; the router is replicated."""
+    expert_size = mesh.shape.get(EXPERT_AXIS, 1)
+
+    def _one(leaf: jnp.ndarray) -> NamedSharding:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if expert_stacked(shape, expert_size):
+            return NamedSharding(mesh, P(EXPERT_AXIS))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(_one, params)
+
+
+__all__ = [
+    "init_moe_params",
+    "router_top1",
+    "moe_ffn",
+    "moe_param_sharding",
+]

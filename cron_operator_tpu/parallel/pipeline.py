@@ -1,0 +1,176 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over a
+``pipe`` mesh axis.
+
+The reference delegates every parallelism strategy to its workload
+containers (SURVEY.md §2.3 marks PP "absent — delegated"); here it is a
+framework primitive, built the TPU way: no scheduler process and no
+point-to-point sends — the whole pipeline is ONE jitted SPMD program under
+``shard_map`` where each pipe shard holds one stage's weights and
+activations hop stages via ``lax.ppermute`` over the ICI ring. Control flow
+is a ``lax.scan`` over ticks (static trip count → XLA unrolls/fuses and the
+loop is reverse-mode differentiable, so the backward pipeline falls out of
+autodiff instead of a hand-built 1F1B schedule).
+
+Schedule: fill-drain (GPipe). With S stages and M microbatches the loop
+runs T = M + S - 1 ticks; at tick t stage s processes microbatch t - s.
+Bubble fraction = (S-1)/T — pick M ≥ 4·S to keep it under ~20%.
+
+Usage::
+
+    params = stack_pipeline_stages([p_stage0, p_stage1, ...])  # [S, ...]
+    mesh = mesh_for_devices(pipe=4)           # optionally × data
+    y = spmd_pipeline(stage_fn, params, x, mesh=mesh, n_microbatches=8)
+
+``stage_fn(stage_params, x) -> y`` must map activations to activations of
+the SAME shape/dtype (the inter-stage buffer is one rotating tensor); wrap
+unequal-width stages in projections or pad to a common width.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cron_operator_tpu.parallel.mesh import BATCH_AXES, PIPE_AXIS
+
+
+def stack_pipeline_stages(stage_params: List[Any]) -> Any:
+    """Stack per-stage parameter pytrees along a new leading dim [S, ...].
+
+    Every stage must share one tree structure and leaf shapes (same-width
+    stages — the GPipe regime). The stacked tree is what
+    :func:`spmd_pipeline` consumes, sharded ``P('pipe')`` on dim 0 so each
+    pipe shard materializes only its own stage's weights.
+    """
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *stage_params
+    )
+
+
+def pipeline_param_sharding(tree: Any, mesh: Mesh) -> Any:
+    """NamedShardings placing stacked stage params: dim 0 on ``pipe``."""
+    spec = P(PIPE_AXIS)
+    return jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, spec), tree
+    )
+
+
+def _pipeline_loop(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    n_microbatches: int,
+    params_local: Any,
+    x_local: jnp.ndarray,
+) -> jnp.ndarray:
+    """Per-device body (runs inside shard_map over the pipe axis)."""
+    n_stages = lax.psum(1, PIPE_AXIS)
+    stage_id = lax.axis_index(PIPE_AXIS)
+    # This shard's stage weights: [1, ...] slice of the stacked tree.
+    p = jax.tree_util.tree_map(lambda a: a[0], params_local)
+
+    batch = x_local.shape[0]
+    mb = x_local.reshape(n_microbatches, batch // n_microbatches,
+                         *x_local.shape[1:])
+
+    ticks = n_microbatches + n_stages - 1
+    # Rotate stage→stage+1; the wrap edge (last→0) carries junk that tick
+    # arithmetic never reads (stage 0 only consumes fresh microbatches).
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (clamped; beyond M the pipeline is
+        # draining and the injected value is never collected).
+        inject = lax.dynamic_index_in_dim(
+            mb, jnp.clip(t, 0, n_microbatches - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where(stage_id == 0, inject, state)
+        y = stage_fn(p, x_in)
+        # Collect finished microbatch t-(S-1) at the last stage.
+        out_idx = t - (n_stages - 1)
+        collected = lax.dynamic_update_index_in_dim(
+            outputs, y, jnp.clip(out_idx, 0, n_microbatches - 1), axis=0
+        )
+        outputs = jnp.where(
+            (stage_id == n_stages - 1) & (out_idx >= 0), collected, outputs
+        )
+        state = lax.ppermute(y, PIPE_AXIS, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros_like(mb[0])
+    out0 = jnp.zeros_like(mb)
+    (_, outputs), _ = lax.scan(
+        tick, (state0, out0), jnp.arange(ticks)
+    )
+    # Only the last pipe shard holds real outputs (zeros elsewhere); psum
+    # over the pipe axis replicates them so the out_spec is honest. One
+    # [M, mb, ...] broadcast per step — noise next to the per-tick traffic.
+    outputs = lax.psum(outputs, PIPE_AXIS)
+    return outputs.reshape(batch, *x_local.shape[1:])
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Run ``x`` through ``n_stages`` pipelined stages (see module doc).
+
+    ``stacked_params``: pytree with leading dim ``n_stages`` on every leaf
+    (:func:`stack_pipeline_stages`). ``x``: [batch, ...] with batch
+    divisible by ``n_microbatches``; the batch dim is additionally split
+    over any data/fsdp axes present in the mesh. Fully differentiable.
+    """
+    if PIPE_AXIS not in mesh.axis_names:
+        raise ValueError(f"mesh has no {PIPE_AXIS!r} axis: {mesh.axis_names}")
+    n_stages = mesh.shape[PIPE_AXIS]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stages:
+            # shard_map would happily split any divisible leading dim and
+            # _pipeline_loop would then use only leaf[0] per shard —
+            # silently running a pipeline that ignores stages.
+            raise ValueError(
+                f"stacked params have {leaf.shape[0]} stage(s) but the "
+                f"mesh {PIPE_AXIS!r} axis has {n_stages}"
+            )
+    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    # The reshape happens INSIDE shard_map, so it is the per-data-shard
+    # batch that must divide into microbatches, not the global one.
+    shards = 1
+    for a in batch_axes:
+        shards *= mesh.shape[a]
+    if x.shape[0] % shards:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by the mesh's batch-axis "
+            f"product {shards}"
+        )
+    if (x.shape[0] // shards) % n_microbatches:
+        raise ValueError(
+            f"per-shard batch {x.shape[0] // shards} (global {x.shape[0]} "
+            f"over {shards} data shard(s)) not divisible by "
+            f"n_microbatches={n_microbatches}"
+        )
+    x_spec = P(batch_axes if batch_axes else None)
+    fn = shard_map(
+        partial(_pipeline_loop, stage_fn, n_microbatches),
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), x_spec),
+        out_specs=x_spec,
+        check_rep=False,
+    )
+    return fn(stacked_params, x)
+
+
+__all__ = [
+    "spmd_pipeline",
+    "stack_pipeline_stages",
+    "pipeline_param_sharding",
+]

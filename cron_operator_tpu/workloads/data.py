@@ -51,4 +51,18 @@ def token_batches(
         yield {"x": ids, "y": ids}
 
 
-__all__ = ["mnist_batches", "imagenet_batches", "token_batches"]
+def causal_token_batches(
+    batch_size: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Next-token pairs for causal LMs: draw ``seq_len + 1`` tokens and
+    shift — ``y[t] = x[t + 1]`` — so the objective is actual next-token
+    prediction, not the copy task causal attention can read off directly."""
+    rng = np.random.default_rng(seed)
+    while True:
+        ids = rng.integers(0, vocab_size, size=(batch_size, seq_len + 1),
+                           dtype=np.int32)
+        yield {"x": ids[:, :-1], "y": ids[:, 1:]}
+
+
+__all__ = ["mnist_batches", "imagenet_batches", "token_batches",
+           "causal_token_batches"]

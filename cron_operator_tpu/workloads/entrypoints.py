@@ -21,7 +21,7 @@ from typing import Any, Dict, Iterator, Optional
 import jax
 
 from cron_operator_tpu.backends.registry import JobContext, register_entrypoint
-from cron_operator_tpu.models import MLP, Bert, BertConfig, ResNet50
+from cron_operator_tpu.models import GPT, GPTConfig, MLP, Bert, BertConfig, ResNet50
 from cron_operator_tpu.parallel.mesh import mesh_for_devices
 from cron_operator_tpu.workloads import data as datasets
 from cron_operator_tpu.workloads.train import StepStats, TrainConfig, Trainer
@@ -41,6 +41,8 @@ def _mesh(ctx: JobContext, devs=None):
         tensor=int(ctx.params.get("tensor", 1)),
         seq=int(ctx.params.get("seq", 1)),
         fsdp=int(ctx.params.get("fsdp", 1)),
+        pipe=int(ctx.params.get("pipe", 1)),
+        expert=int(ctx.params.get("expert", 1)),
     )
 
 
@@ -84,12 +86,29 @@ def _run(
         ctx.progress["resumed_from_step"] = trainer.steps_done
     first_local_step = trainer.steps_done + 1
     last_publish = [0.0]
+    # Optional profiling (SURVEY.md §5 "tracing/profiling: none in the
+    # reference"): param.profile_dir=<path> captures a jax.profiler trace
+    # of the steady-state steps (started after the compile-laden first
+    # step) — the TensorBoard/XProf artifact for TPU perf work.
+    profile_dir = ctx.params.get("profile_dir")
+    profiling = [False]
 
     def on_step(s: StepStats) -> None:
         if s.step == first_local_step:
             # The north-star timestamp: first optimizer step finished
             # (device-synced — Trainer.step blocks on the loss).
             ctx.progress["first_step_at"] = time.time()
+            if profile_dir:
+                # The jax profiler is process-global; under thread
+                # isolation a concurrent profiled job would raise
+                # "already active". A diagnostic must never fail the
+                # training run — skip and say so instead.
+                try:
+                    jax.profiler.start_trace(profile_dir)
+                    profiling[0] = True
+                    ctx.progress["profile_dir"] = profile_dir
+                except Exception as exc:  # noqa: BLE001
+                    ctx.progress["profile_error"] = str(exc)
         ctx.progress["steps_done"] = s.step
         ctx.progress["last_loss"] = s.loss
         ctx.progress["last_step_time_s"] = round(s.step_time_s, 4)
@@ -105,6 +124,11 @@ def _run(
             batches, steps, should_stop=ctx.should_stop, on_step=on_step
         )
     finally:
+        if profiling[0]:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:  # noqa: BLE001 — see start_trace
+                ctx.progress["profile_error"] = str(exc)
         if trainer.checkpoint is not None:
             # Orbax managers own background threads; a long-lived executor
             # runs many ticks, so every store must be released.
@@ -205,10 +229,58 @@ def bert(ctx: JobContext) -> None:
         )
 
 
+@register_entrypoint("gpt")
+def gpt(ctx: JobContext) -> None:
+    """GPT causal LM on synthetic tokens — long-context + optional MoE.
+
+    Params: steps(=10), batch_size(=8), seq_len(=1024), size(=base|tiny),
+    attention(=auto|flash|xla|ring), moe_every(=0: dense),
+    num_experts(=8), seq/tensor/fsdp/expert mesh axes, remat(=0).
+    Targets are next-token shifted (causal_token_batches).
+    """
+    steps = int(ctx.params.get("steps", 10))
+    batch_size = int(ctx.params.get("batch_size", 8))
+    seq_len = int(ctx.params.get("seq_len", 1024))
+    size = ctx.params.get("size", "base")
+    attention = ctx.params.get("attention", "auto")
+    moe_every = int(ctx.params.get("moe_every", 0))
+    num_experts = int(ctx.params.get("num_experts", 8))
+    devs = _devices(ctx)
+    with jax.default_device(devs[0]):
+        mesh = _mesh(ctx, devs)
+        maker = GPTConfig.tiny if size == "tiny" else GPTConfig
+        cfg = maker(
+            max_len=seq_len, attention_impl=attention,
+            moe_every=moe_every, num_experts=num_experts,
+        )
+        model = GPT(cfg, mesh=mesh)
+        params = _jit_init(
+            model, jax.random.PRNGKey(0), _zeros((1, seq_len), dtype="int32")
+        )
+        trainer = Trainer(
+            lambda p, x: model.apply({"params": p}, x), params, mesh,
+            TrainConfig(
+                remat=ctx.params.get("remat", "0") in ("1", "true"),
+                seq_dim_in_batch=1,
+                labels_follow_seq=True,
+                aux_loss_in_output=True,
+                save_every=_save_every(ctx),
+            ),
+            checkpoint=_checkpoint_store(ctx),
+        )
+        _run(
+            ctx, trainer,
+            datasets.causal_token_batches(
+                batch_size, seq_len, cfg.vocab_size
+            ),
+            steps,
+        )
+
+
 def _zeros(shape, dtype: Optional[str] = None):
     import jax.numpy as jnp
 
     return jnp.zeros(shape, dtype or jnp.float32)
 
 
-__all__ = ["mnist", "resnet50", "bert"]
+__all__ = ["mnist", "resnet50", "bert", "gpt"]

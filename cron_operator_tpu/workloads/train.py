@@ -45,6 +45,10 @@ class TrainConfig:
     seq_dim_in_batch: Optional[int] = None  # dim of x sharded over `seq`
     labels_follow_seq: bool = False  # labels carry the seq dim too (MLM)
     save_every: int = 0  # checkpoint cadence in steps (0 = never)
+    # Model returns (logits, aux_loss) instead of bare logits; the scalar
+    # aux (e.g. MoE router balance loss, already weighted by the model) is
+    # added to the task loss.
+    aux_loss_in_output: bool = False
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer == "adamw":
@@ -86,10 +90,15 @@ class Trainer:
         if self.config.remat:
             fwd = jax.checkpoint(apply_fn)
 
+        aux_in_output = self.config.aux_loss_in_output
+
         def step_fn(state: train_state.TrainState, batch: Dict[str, jax.Array]):
             def loss_of(p):
-                logits = fwd(p, batch["x"])
-                return loss_fn(logits, batch["y"])
+                out = fwd(p, batch["x"])
+                if aux_in_output:
+                    logits, aux = out
+                    return loss_fn(logits, batch["y"]) + aux
+                return loss_fn(out, batch["y"])
 
             loss, grads = jax.value_and_grad(loss_of)(state.params)
             return state.apply_gradients(grads=grads), loss

@@ -65,6 +65,58 @@ class TestTrainer:
             s1, s2 = tr.step(next(it)), tr.step(next(it))
         assert jnp.isfinite(s1.loss) and jnp.isfinite(s2.loss)
 
+    def test_gpt_ring_sp_step_with_moe(self, cpus):
+        """GPT under seq-parallel ring attention with MoE blocks: the full
+        long-context + expert composition trains one sharded step."""
+        from cron_operator_tpu.models import GPT, GPTConfig
+
+        mesh = mesh_for_devices(cpus, seq=2)
+        with jax.default_device(cpus[0]):
+            cfg = GPTConfig.tiny(
+                max_len=64, attention_impl="ring",
+                moe_every=2, num_experts=4,
+            )
+            m = GPT(cfg, mesh=mesh)
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 64), jnp.int32)
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(seq_dim_in_batch=1, labels_follow_seq=True,
+                            aux_loss_in_output=True),
+            )
+            it = datasets.token_batches(4, 64, cfg.vocab_size)
+            s1, s2 = tr.step(next(it)), tr.step(next(it))
+        assert jnp.isfinite(s1.loss) and jnp.isfinite(s2.loss)
+
+    def test_profile_trace_written(self, tmp_path):
+        """param.profile_dir captures a jax.profiler trace of the
+        steady-state steps (SURVEY.md §5: the reference has no
+        tracing/profiling at all)."""
+        from cron_operator_tpu.backends.registry import (
+            JobContext,
+            resolve_entrypoint,
+        )
+
+        ctx = JobContext(
+            name="prof", namespace="default", job={},
+            params={
+                "steps": "2", "batch_size": "8", "platform": "cpu",
+                "profile_dir": str(tmp_path / "trace"),
+            },
+        )
+        resolve_entrypoint("mnist")(ctx)
+        assert ctx.progress["profile_dir"] == str(tmp_path / "trace")
+        produced = list((tmp_path / "trace").rglob("*"))
+        assert any(p.is_file() for p in produced), (
+            "profiler wrote no trace files"
+        )
+
+    def test_gpt_entrypoint_registered(self):
+        from cron_operator_tpu.backends.registry import resolve_entrypoint
+
+        assert resolve_entrypoint("gpt").__name__ == "gpt"
+
     def test_remat_matches_no_remat(self, cpus):
         """jax.checkpoint must not change the math."""
         mesh = mesh_for_devices(cpus)
