@@ -233,6 +233,66 @@ def _attention_microbench(platform, timeout: float):
         return {"error": f"unparseable output: {out.stdout[-200:]}"}
 
 
+def _control_plane_bench(n_crons: int = 300) -> dict:
+    """Scheduling-throughput microbench — no device involved.
+
+    The reference's operating envelope is 10 concurrent reconciles at
+    client QPS 30 (BASELINE.md table); this measures what OUR control
+    plane sustains: N due crons reconciled to workload creation (the full
+    hot loop: list, status sync, schedule math, TPU admission, create),
+    then the steady-state pass where no tick is due. FakeClock makes the
+    tick instant deterministic.
+    """
+    from cron_operator_tpu.controller import CronReconciler
+    from cron_operator_tpu.runtime import APIServer
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.utils.clock import FakeClock
+    from datetime import timedelta
+
+    clock = FakeClock()
+    api = APIServer(clock=clock)
+    rec = CronReconciler(api, metrics=Metrics())
+    for i in range(n_crons):
+        api.create({
+            "apiVersion": "apps.kubedl.io/v1alpha1", "kind": "Cron",
+            "metadata": {"name": f"cp-{i}", "namespace": "default"},
+            "spec": {
+                "schedule": "@every 60s",
+                "concurrencyPolicy": "Forbid",
+                "template": {"workload": {
+                    "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+                    "metadata": {"annotations": {
+                        "tpu.kubedl.io/accelerator": "v5e",
+                        "tpu.kubedl.io/topology": "2x2",
+                    }},
+                    "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+                }},
+            },
+        })
+    clock.advance(timedelta(seconds=61))  # every cron now has a due tick
+
+    t0 = time.perf_counter()
+    for i in range(n_crons):
+        rec.reconcile("default", f"cp-{i}")
+    fire_dt = time.perf_counter() - t0
+    created = len(api.list("kubeflow.org/v1", "JAXJob",
+                           namespace="default"))
+
+    t0 = time.perf_counter()
+    for i in range(n_crons):
+        rec.reconcile("default", f"cp-{i}")  # no tick due; Forbid+active
+    idle_dt = time.perf_counter() - t0
+    api.close()
+
+    return {
+        "n_crons": n_crons,
+        "workloads_created": created,
+        "fire_reconciles_per_s": round(n_crons / fire_dt, 1),
+        "steady_reconciles_per_s": round(n_crons / idle_dt, 1),
+        "reference_envelope": "10 concurrent reconciles @ client QPS 30",
+    }
+
+
 def _emit(value, extra, error=None) -> int:
     rec = {
         "metric": "tick_to_first_train_step_s",
@@ -297,6 +357,11 @@ def main() -> int:
         return _emit(None, extra, error=f"prewarm failed: {warm.get('error')}")
 
     extra["attention_bench"] = _attention_microbench(platform, timeout=300.0)
+    try:
+        extra["control_plane"] = _control_plane_bench()
+    except Exception as exc:  # noqa: BLE001 — a microbench must not
+        # poison the headline metric
+        extra["control_plane"] = {"error": str(exc)}
 
     # ---- the measured run: full stack, subprocess isolation ---------------
     from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme

@@ -12,6 +12,7 @@ ring for long-context sequence parallelism.
 from cron_operator_tpu.parallel.mesh import (
     MeshPlan,
     batch_pspec,
+    hybrid_mesh_for_slices,
     make_mesh,
     mesh_for_devices,
     mesh_for_slice,
@@ -36,6 +37,7 @@ __all__ = [
     "make_mesh",
     "mesh_for_devices",
     "mesh_for_slice",
+    "hybrid_mesh_for_slices",
     "plan_for_devices",
     "pspec_for_shape",
     "sharding_for_tree",

@@ -176,6 +176,90 @@ def mesh_for_slice(
     return make_mesh(plan, devices)
 
 
+def group_devices_by_slice(
+    devices: Sequence[Any], n_slices: int
+) -> "list[list[Any]]":
+    """Partition devices into their TPU slices.
+
+    Real multi-slice TPU devices carry ``slice_index`` (the PJRT attribute
+    GKE multislice exposes); grouped by it when present. CPU devices (and
+    single-slice tests) don't — fallback is contiguous equal chunks of the
+    ``jax.devices()`` order, which is slice-contiguous on real hardware
+    anyway.
+    """
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_slices} slices"
+        )
+    indices = [getattr(d, "slice_index", None) for d in devices]
+    if all(i is not None for i in indices):
+        groups: Dict[Any, list] = {}
+        for d in devices:
+            groups.setdefault(d.slice_index, []).append(d)
+        if len(groups) != n_slices:
+            raise ValueError(
+                f"devices span {len(groups)} slice(s), expected {n_slices}"
+            )
+        sizes = {len(g) for g in groups.values()}
+        if len(sizes) != 1:
+            raise ValueError(f"uneven slice sizes: {sorted(sizes)}")
+        return [groups[k] for k in sorted(groups)]
+    per = len(devices) // n_slices
+    return [list(devices[i * per:(i + 1) * per]) for i in range(n_slices)]
+
+
+def hybrid_mesh_for_slices(
+    n_slices: int,
+    *,
+    tensor: int = 1,
+    seq: int = 1,
+    fsdp: int = 1,
+    pipe: int = 1,
+    expert: int = 1,
+    devices: Optional[Sequence[Any]] = None,
+) -> Mesh:
+    """Multi-slice (DCN × ICI) mesh — the scaling-book multislice recipe.
+
+    The ``data`` axis is OUTERMOST and slice-major: consecutive data
+    indices stay within one slice and the axis crosses a slice boundary
+    every ``per_slice_data`` entries, so the only collectives that ride
+    the (slow) DCN are the data-parallel gradient reductions; every model
+    axis (pipe/fsdp/expert/seq/tensor) lives inside one slice's ICI.
+    Note this differs from :func:`plan_for_devices`' order (which puts
+    ``pipe`` outermost for the single-slice case) — across slices,
+    pipelining the per-tick ppermute over DCN would serialize on the slow
+    link, so the hybrid mesh confines it to ICI.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    groups = group_devices_by_slice(devices, n_slices)
+    per_slice = len(groups[0])
+    model_par = tensor * seq * fsdp * pipe * expert
+    if per_slice % model_par:
+        raise ValueError(
+            f"per-slice device count {per_slice} not divisible by "
+            f"tensor*seq*fsdp*pipe*expert={model_par}"
+        )
+    per_slice_data = per_slice // model_par
+
+    sizes: Dict[str, int] = {}
+    if pipe > 1:
+        sizes[PIPE_AXIS] = pipe
+    if fsdp > 1:
+        sizes[FSDP_AXIS] = fsdp
+    if expert > 1:
+        sizes[EXPERT_AXIS] = expert
+    if seq > 1:
+        sizes[SEQ_AXIS] = seq
+    if tensor > 1:
+        sizes[TENSOR_AXIS] = tensor
+    inner_shape = (per_slice_data, *sizes.values())
+    arrs = [
+        np.array(g, dtype=object).reshape(inner_shape) for g in groups
+    ]
+    full = np.concatenate(arrs, axis=0)  # data axis: slice-major
+    return Mesh(full, (DATA_AXIS, *sizes.keys()))
+
+
 # ---- sharding rules --------------------------------------------------------
 
 
@@ -275,6 +359,8 @@ __all__ = [
     "make_mesh",
     "mesh_for_devices",
     "mesh_for_slice",
+    "group_devices_by_slice",
+    "hybrid_mesh_for_slices",
     "batch_pspec",
     "pspec_for_shape",
     "expert_stacked",

@@ -36,14 +36,30 @@ def _devices(ctx: JobContext):
 
 def _mesh(ctx: JobContext, devs=None):
     devs = devs if devs is not None else _devices(ctx)
-    return mesh_for_devices(
-        devs,
+    if int(ctx.params.get("pipe", 1)) > 1:
+        # The standard entrypoints train one GSPMD step; none consumes a
+        # pipe axis, so accepting it would silently run every pipe shard
+        # redundantly. Pipeline parallelism is the spmd_pipeline primitive
+        # (parallel.pipeline) for custom entrypoints that stage their
+        # model.
+        raise ValueError(
+            "param.pipe is not supported by the standard entrypoints — "
+            "pipeline parallelism requires a staged model via "
+            "cron_operator_tpu.parallel.spmd_pipeline"
+        )
+    axes = dict(
         tensor=int(ctx.params.get("tensor", 1)),
         seq=int(ctx.params.get("seq", 1)),
         fsdp=int(ctx.params.get("fsdp", 1)),
-        pipe=int(ctx.params.get("pipe", 1)),
         expert=int(ctx.params.get("expert", 1)),
     )
+    slices = int(ctx.params.get("slices", 1))
+    if slices > 1:
+        # Multi-slice: DP over DCN, model axes within each slice's ICI.
+        from cron_operator_tpu.parallel.mesh import hybrid_mesh_for_slices
+
+        return hybrid_mesh_for_slices(slices, devices=devs, **axes)
+    return mesh_for_devices(devs, **axes)
 
 
 def _checkpoint_store(ctx: JobContext):

@@ -171,3 +171,23 @@ class TestChartCRDs:
             (CHART / "crds" / "apps.kubedl.io_crons.yaml").read_text()
         )
         assert shipped == crd_manifest()
+
+
+class TestHostTimezone:
+    """useHostTimezone parity with the reference chart: hostPath mount of
+    /etc/localtime, rendered only when enabled (the per-Cron
+    spec.timezone field is the preferred, mount-free mechanism)."""
+
+    def test_disabled_by_default(self):
+        dep = find(render(), "Deployment")
+        spec = dep["spec"]["template"]["spec"]
+        assert "volumes" not in spec
+        assert "volumeMounts" not in spec["containers"][0]
+
+    def test_enabled_mounts_localtime(self):
+        dep = find(render({"useHostTimezone": True}), "Deployment")
+        spec = dep["spec"]["template"]["spec"]
+        assert spec["volumes"][0]["hostPath"]["path"] == "/etc/localtime"
+        vm = spec["containers"][0]["volumeMounts"][0]
+        assert vm["mountPath"] == "/etc/localtime"
+        assert vm["readOnly"] is True

@@ -133,3 +133,27 @@ class TestPerShardDivisibility:
         with pytest.raises(ValueError, match="4 stage"):
             spmd_pipeline(_stage_fn, stacked, x, mesh=mesh,
                           n_microbatches=4)
+
+
+class TestParamPlacement:
+    def test_pipeline_param_sharding_places_stage_dim_on_pipe(self):
+        from cron_operator_tpu.parallel.pipeline import (
+            pipeline_param_sharding,
+        )
+        from cron_operator_tpu.parallel.mesh import PIPE_AXIS
+
+        mesh = mesh_for_devices(jax.devices()[:4], pipe=4)
+        sh = pipeline_param_sharding(
+            {"w": jnp.zeros((4, 2)), "b": jnp.zeros((4,))}, mesh)
+        assert sh["w"].spec == jax.sharding.PartitionSpec(PIPE_AXIS)
+
+    def test_pipe_param_rejected_by_standard_entrypoints(self):
+        from cron_operator_tpu.backends.registry import JobContext
+        from cron_operator_tpu.workloads.entrypoints import _mesh
+
+        ctx = JobContext(
+            name="p", namespace="default", job={},
+            params={"pipe": "2", "platform": "cpu"},
+        )
+        with pytest.raises(ValueError, match="spmd_pipeline"):
+            _mesh(ctx)

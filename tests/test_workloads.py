@@ -196,3 +196,21 @@ class TestExecutorRunsTraining:
         assert prog["steps_done"] == 2
         assert prog["first_step_at"] >= prog["started_at"]
         assert jnp.isfinite(prog["last_loss"])
+
+
+class TestMeshResolution:
+    def test_slices_param_builds_hybrid_mesh(self):
+        """param.slices=2 routes to the multi-slice hybrid mesh: data
+        outermost (DCN), model axes within a slice."""
+        from cron_operator_tpu.backends.registry import JobContext
+        from cron_operator_tpu.workloads.entrypoints import _mesh
+
+        ctx = JobContext(
+            name="m", namespace="default", job={},
+            params={"slices": "2", "tensor": "2", "platform": "cpu"},
+        )
+        mesh = _mesh(ctx)
+        assert mesh.axis_names[0] == "data"
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "data": 4, "tensor": 2,
+        }
