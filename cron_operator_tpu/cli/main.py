@@ -116,6 +116,26 @@ def build_parser() -> argparse.ArgumentParser:
     start.add_argument("--run-for", type=float, default=None,
                        metavar="SECONDS",
                        help="exit after N seconds (default: run until signal)")
+
+    # kubectl-style inspection for standalone mode: the reference relies
+    # on kubectl + CRD printcolumns (cron_types.go:33-36); with no
+    # kube-apiserver in the embedded deployment, `get` is that surface,
+    # speaking the same REST dialect --serve-api exposes.
+    get = sub.add_parser(
+        "get", help="list resources from a running operator's API"
+    )
+    get.add_argument("resource", choices=["crons", "workloads"],
+                     help="'crons' prints the reference printcolumns; "
+                          "'workloads' lists scheduled jobs with status")
+    get.add_argument("-n", "--namespace", default="default")
+    get.add_argument("--server", default="http://127.0.0.1:8443",
+                     help="operator --serve-api address (or a real "
+                          "kube-apiserver URL)")
+    get.add_argument("--token", default=None, help="bearer token")
+    get.add_argument("--ca-file", default=None,
+                     help="CA bundle for an HTTPS --server")
+    get.add_argument("--insecure", action="store_true", default=False,
+                     help="skip TLS verification (dev only)")
     return parser
 
 
@@ -287,11 +307,107 @@ def cmd_start(args: argparse.Namespace) -> int:
     return 0
 
 
+def _age(creation_ts: Optional[str], now=None) -> str:
+    """kubectl-style age: 42s / 7m / 3h / 5d."""
+    from datetime import datetime, timezone
+
+    from cron_operator_tpu.api.v1alpha1 import parse_time
+
+    created = parse_time(creation_ts)
+    if created is None:
+        return "<unknown>"
+    now = now or datetime.now(timezone.utc)
+    s = max(0, int((now - created).total_seconds()))
+    if s < 120:
+        return f"{s}s"
+    if s < 7200:
+        return f"{s // 60}m"
+    if s < 172800:
+        return f"{s // 3600}h"
+    return f"{s // 86400}d"
+
+
+def _print_table(headers: List[str], rows: List[List[str]]) -> None:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    for line in ([headers] + rows):
+        print("   ".join(c.ljust(w) for c, w in zip(line, widths)).rstrip())
+
+
+def cmd_get(args: argparse.Namespace) -> int:
+    from cron_operator_tpu.api.scheme import default_scheme
+    from cron_operator_tpu.controller.workload import get_job_status
+    from cron_operator_tpu.runtime.cluster import (
+        ClusterAPIServer,
+        ClusterConfig,
+    )
+    from cron_operator_tpu.runtime.kube import ApiError
+
+    scheme = default_scheme()
+    api = ClusterAPIServer(
+        ClusterConfig(args.server, token=args.token,
+                      ca_file=args.ca_file, insecure=args.insecure),
+        scheme=scheme,
+    )
+    try:
+        if args.resource == "crons":
+            crons = api.list("apps.kubedl.io/v1alpha1", "Cron",
+                             namespace=args.namespace)
+            rows = []
+            for c in crons:
+                meta = c.get("metadata") or {}
+                spec = c.get("spec") or {}
+                st = c.get("status") or {}
+                rows.append([
+                    meta.get("name", ""),
+                    spec.get("schedule", ""),
+                    str(bool(spec.get("suspend", False))).lower(),
+                    st.get("lastScheduleTime") or "<none>",
+                    _age(meta.get("creationTimestamp")),
+                ])
+            # Reference CRD printcolumns (cron_types.go:33-36).
+            _print_table(
+                ["NAME", "SCHEDULE", "SUSPEND", "LAST SCHEDULE", "AGE"],
+                rows,
+            )
+        else:
+            rows = []
+            for gvk in scheme.workload_kinds():
+                for w in api.list(gvk.api_version, gvk.kind,
+                                  namespace=args.namespace):
+                    meta = w.get("metadata") or {}
+                    status = get_job_status(w)
+                    last = (
+                        status.last_condition_type() if status else None
+                    )
+                    rows.append([
+                        meta.get("name", ""),
+                        gvk.kind,
+                        last or "Pending",
+                        (meta.get("labels") or {}).get(
+                            "kubedl.io/cron-name", "<none>"),
+                        _age(meta.get("creationTimestamp")),
+                    ])
+            _print_table(["NAME", "KIND", "STATUS", "CRON", "AGE"], rows)
+    except ApiError as err:
+        # Connection refused / 401 / missing CRD etc. — a CLI prints one
+        # line, not a traceback.
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    finally:
+        api.stop()
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
     if args.command == "start":
         return cmd_start(args)
+    if args.command == "get":
+        return cmd_get(args)
     parser.print_help()
     return 0
 

@@ -371,3 +371,58 @@ class TestLeaderElectionE2E:
             mgr2.stop()
             capi1.stop()
             capi2.stop()
+
+
+class TestGetSubcommand:
+    """`cron-operator-tpu get` — the kubectl-printcolumn surface for
+    standalone deployments (the reference delegates inspection to kubectl
+    + CRD printcolumns, cron_types.go:33-36)."""
+
+    def test_get_crons_and_workloads(self, server, client, capsys):
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        client.create(make_cron("inspect", schedule="*/5 * * * *"))
+        client.patch_status(
+            "apps.kubedl.io/v1alpha1", "Cron", "default", "inspect",
+            {"lastScheduleTime": "2026-07-29T10:00:00Z"},
+        )
+        client.create({
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {"name": "inspect-1", "namespace": "default",
+                         "labels": {"kubedl.io/cron-name": "inspect"}},
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+        })
+        client.patch_status(
+            "kubeflow.org/v1", "JAXJob", "default", "inspect-1",
+            {"conditions": [{"type": "Running", "status": "True"}]},
+        )
+
+        rc = cli_main(["get", "crons", "--server", server.url,
+                       "--token", TOKEN])
+        out = capsys.readouterr().out
+        assert rc == 0
+        lines = out.strip().splitlines()
+        assert lines[0].split() == [
+            "NAME", "SCHEDULE", "SUSPEND", "LAST", "SCHEDULE", "AGE",
+        ]
+        row = [l for l in lines if l.startswith("inspect")][0]
+        assert "*/5 * * * *" in row
+        assert "false" in row
+        assert "2026-07-29T10:00:00Z" in row
+
+        rc = cli_main(["get", "workloads", "--server", server.url,
+                       "--token", TOKEN])
+        out = capsys.readouterr().out
+        assert rc == 0
+        row = [l for l in out.splitlines() if "inspect-1" in l][0]
+        assert "JAXJob" in row and "Running" in row and "inspect" in row
+
+    def test_get_fails_cleanly_when_server_unreachable(self, capsys):
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        rc = cli_main(["get", "crons", "--server",
+                       "http://127.0.0.1:1"])  # nothing listens there
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert captured.err.startswith("error: ")
+        assert "Traceback" not in captured.err
