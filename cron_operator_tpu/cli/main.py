@@ -343,7 +343,7 @@ def cmd_get(args: argparse.Namespace) -> int:
         ClusterAPIServer,
         ClusterConfig,
     )
-    from cron_operator_tpu.runtime.kube import ApiError
+    from cron_operator_tpu.runtime.kube import ApiError, NotFoundError
 
     scheme = default_scheme()
     api = ClusterAPIServer(
@@ -375,8 +375,14 @@ def cmd_get(args: argparse.Namespace) -> int:
         else:
             rows = []
             for gvk in scheme.workload_kinds():
-                for w in api.list(gvk.api_version, gvk.kind,
-                                  namespace=args.namespace):
+                try:
+                    workloads = api.list(gvk.api_version, gvk.kind,
+                                         namespace=args.namespace)
+                except NotFoundError:
+                    # A real apiserver without this workload CRD installed
+                    # 404s the kind; list what exists instead of aborting.
+                    continue
+                for w in workloads:
                     meta = w.get("metadata") or {}
                     status = get_job_status(w)
                     last = (

@@ -97,6 +97,38 @@ def main(argv=None) -> int:
         jnp.max(jnp.abs(flash_out.astype(jnp.float32) - ref))
     )
 
+    # MoE dispatch throughput: the GShard dense-dispatch einsums are the
+    # EP hot path; fwd+bwd step time over a token batch sized like one
+    # device's share of a GPT-base MoE layer.
+    moe = None
+    if params.get("moe", "1") in ("1", "true"):
+        from cron_operator_tpu.parallel.moe import init_moe_params, moe_ffn
+
+        d_model = int(params.get("moe_d_model", 512))
+        tokens = int(params.get("moe_tokens", 4096))
+        n_exp = int(params.get("moe_experts", 8))
+        mp = init_moe_params(
+            jax.random.PRNGKey(1), d_model=d_model, d_ff=4 * d_model,
+            n_experts=n_exp,
+        )
+        x = jax.random.normal(
+            jax.random.PRNGKey(2), (tokens, d_model), jnp.bfloat16
+        )
+
+        def moe_loss(p, x):
+            y, aux = moe_ffn(p, x, compute_dtype=jnp.bfloat16)
+            return jnp.sum(y.astype(jnp.float32) ** 2) + aux
+
+        moe_fwd_t, _ = timed(jax.jit(
+            lambda p, x: moe_ffn(p, x, compute_dtype=jnp.bfloat16)[0]
+        ), mp, x)
+        moe_step_t, _ = timed(jax.jit(jax.grad(moe_loss)), mp, x)
+        moe = {
+            "tokens": tokens, "d_model": d_model, "experts": n_exp,
+            "fwd_ms": round(moe_fwd_t * 1e3, 3),
+            "grad_ms": round(moe_step_t * 1e3, 3),
+        }
+
     print(json.dumps({
         "backend": backend,
         "flash_mode": "mosaic" if on_tpu else "interpret",
@@ -113,6 +145,7 @@ def main(argv=None) -> int:
             round(xla_bwd_t / flash_bwd_t, 3) if flash_bwd_t > 0 else None
         ),
         "flash_max_abs_err_vs_f32_ref": round(max_err, 5),
+        "moe": moe,
     }))
     return 0
 

@@ -202,3 +202,106 @@ class TestBackoffClamp:
         for _ in range(1200):
             delay = b.when("stuck")
         assert delay == b.cap_s
+
+
+class TestScheduleProperties:
+    """Property-style checks over randomized cron expressions — the
+    from-scratch robfig equivalent must satisfy the cron invariants for
+    ANY valid expression, not just the handwritten cases above."""
+
+    def _random_exprs(self, n=200, seed=42):
+        import random
+
+        rng = random.Random(seed)
+
+        def field(lo, hi):
+            kind = rng.randrange(4)
+            if kind == 0:
+                return "*"
+            if kind == 1:
+                return str(rng.randint(lo, hi))
+            if kind == 2:  # range
+                a = rng.randint(lo, hi - 1)
+                b = rng.randint(a, hi)
+                return f"{a}-{b}"
+            step = rng.randint(2, 15)
+            return f"*/{step}"
+
+        out = []
+        for _ in range(n):
+            out.append(" ".join([
+                field(0, 59), field(0, 23), field(1, 31),
+                field(1, 12), field(0, 6),
+            ]))
+        return out
+
+    def test_next_is_strictly_future_and_matches_fields(self):
+        from datetime import datetime, timezone
+
+        start = datetime(2026, 3, 14, 15, 9, 26, tzinfo=timezone.utc)
+        for expr in self._random_exprs():
+            sched = parse_standard(expr)
+            t = start
+            for _ in range(3):
+                nxt = sched.next(t)
+                assert nxt > t, f"{expr}: next not in the future"
+                assert nxt.second == 0, f"{expr}: minute granularity"
+                # The activation instant must satisfy every field.
+                mi, hr, dom, mon, dow = expr.split()
+                for val, spec, lo in [
+                    (nxt.minute, mi, 0), (nxt.hour, hr, 0),
+                    (nxt.month, mon, 1),
+                ]:
+                    assert self._matches(val, spec, lo), (
+                        f"{expr}: {val} fails {spec} at {nxt}"
+                    )
+                t = nxt
+
+    @staticmethod
+    def _matches(value, spec, lo=0):
+        if spec == "*":
+            return True
+        if spec.startswith("*/"):
+            # steps count from the field's lower bound (vixie cron):
+            # months */11 over 1..12 matches {1, 12}.
+            return (value - lo) % int(spec[2:]) == 0
+        if "-" in spec:
+            a, b = spec.split("-")
+            return int(a) <= value <= int(b)
+        return value == int(spec)
+
+    def test_next_is_minimal(self):
+        """Consistency of "first match": for any probe strictly between t
+        and next(t), next(probe) must still be next(t) — if a nearer
+        match existed the two calls would disagree."""
+        from datetime import datetime, timedelta, timezone
+
+        start = datetime(2026, 6, 1, 0, 0, tzinfo=timezone.utc)
+        for expr in self._random_exprs(n=30, seed=7):
+            sched = parse_standard(expr)
+            nxt = sched.next(start)
+            span_min = int((nxt - start).total_seconds() // 60)
+            # a handful of probes across the gap (bounded for huge gaps)
+            for k in {1, 2, span_min // 2, span_min - 1} - {0}:
+                if k >= span_min:
+                    continue
+                probe = start + timedelta(minutes=k)
+                assert sched.next(probe) == nxt, (
+                    f"{expr}: next({probe}) != next({start})"
+                )
+
+    def test_dom_dow_vixie_or_rule(self):
+        """Standard cron quirk: when BOTH day-of-month and day-of-week are
+        restricted, a time matching EITHER fires (vixie OR rule)."""
+        from datetime import datetime, timezone
+
+        sched = parse_standard("0 0 13 * 5")  # 13th OR Friday
+        t = datetime(2026, 2, 1, tzinfo=timezone.utc)
+        fired_days = set()
+        for _ in range(12):
+            t = sched.next(t)
+            fired_days.add((t.day, t.weekday()))
+        assert any(d == 13 for d, _ in fired_days)
+        assert any(w == 4 for _, w in fired_days)  # Friday
+        for d, w in fired_days:
+            assert d == 13 or w == 4
