@@ -33,7 +33,7 @@ class BertConfig:
     mlp_dim: int = 3072
     max_len: int = 512
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "auto"  # auto | flash | xla | ring
+    attention_impl: str = "auto"  # auto | flash | xla | ring | ulysses
     # Run the Pallas kernels under the interpreter — CPU tests of the flash
     # path (forward AND backward) through the full model; never set on TPU.
     attention_interpret: bool = False

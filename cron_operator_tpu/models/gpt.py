@@ -35,7 +35,7 @@ class GPTConfig:
     mlp_dim: int = 3072
     max_len: int = 1024
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "auto"  # auto | flash | xla | ring
+    attention_impl: str = "auto"  # auto | flash | xla | ring | ulysses
     attention_interpret: bool = False  # CPU tests of the Pallas path
     # MoE: 0 disables; k > 0 replaces every k-th block's FFN with a
     # Switch-MoE layer of ``num_experts`` experts.

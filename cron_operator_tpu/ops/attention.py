@@ -1,13 +1,16 @@
-"""Attention dispatch: one public op, three execution strategies.
+"""Attention dispatch: one public op, four execution strategies.
 
-- ``"flash"`` — Pallas TPU kernel (:mod:`ops.flash_attention`); picked
+- ``"flash"``   — Pallas TPU kernel (:mod:`ops.flash_attention`); picked
   automatically on TPU backends when shapes are tile-aligned.
-- ``"xla"``   — plain jnp attention (f32 accumulation); XLA fuses it well
-  enough for short sequences and is the CPU/GPU fallback.
-- ``"ring"``  — sequence-parallel ring attention over a mesh ``seq`` axis
-  (:mod:`parallel.ring`); picked when the caller passes a mesh whose
-  ``seq`` axis is >1 — long-context training where one device cannot hold
-  the sequence.
+- ``"xla"``     — plain jnp attention (f32 accumulation); XLA fuses it
+  well enough for short sequences and is the CPU/GPU fallback.
+- ``"ring"``    — sequence-parallel ring attention over a mesh ``seq``
+  axis (:mod:`parallel.ring`); the auto pick when the caller passes a
+  mesh whose ``seq`` axis is >1 — long-context training where one device
+  cannot hold the sequence. No head-count constraint.
+- ``"ulysses"`` — the all-to-all head-scatter sequence-parallel variant
+  (:mod:`parallel.ulysses`): two large collectives instead of P ppermute
+  hops; requires the head count to divide the ``seq`` axis size.
 
 Models call :func:`multi_head_attention` and stay strategy-agnostic; the
 choice is a deployment concern (slice shape + sequence length), exactly
@@ -33,6 +36,7 @@ from cron_operator_tpu.parallel.ring import (
     _single_device_attention,
     ring_attention,
 )
+from cron_operator_tpu.parallel.ulysses import ulysses_attention
 
 
 def reference_attention(
@@ -62,8 +66,12 @@ def multi_head_attention(
 ) -> jax.Array:
     """Dispatching multi-head attention on ``[batch, seq, heads, head_dim]``.
 
-    ``impl``: ``"auto" | "flash" | "xla" | "ring"``. ``interpret`` forces
-    the Pallas kernel's interpreter (CPU tests of the flash paths).
+    ``impl``: ``"auto" | "flash" | "xla" | "ring" | "ulysses"``.
+    ``interpret`` forces the Pallas kernel's interpreter (CPU tests of the
+    flash paths). Both sequence-parallel variants are exact; ring has no
+    head-count constraint, ulysses (all-to-all head scatter) needs the
+    head count to divide the ``seq`` axis size and does fewer, larger
+    collectives.
     """
     if impl == "auto":
         if mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1:
@@ -77,6 +85,10 @@ def multi_head_attention(
         if mesh is None:
             raise ValueError("impl='ring' needs a mesh with a seq axis")
         return ring_attention(q, k, v, mesh, causal=causal)
+    if impl == "ulysses":
+        if mesh is None:
+            raise ValueError("impl='ulysses' needs a mesh with a seq axis")
+        return ulysses_attention(q, k, v, mesh, causal=causal)
     if impl == "flash":
         return _sharded_flash(q, k, v, mesh, causal=causal,
                               interpret=interpret)

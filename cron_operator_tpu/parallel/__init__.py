@@ -30,6 +30,10 @@ from cron_operator_tpu.parallel.pipeline import (
     stack_pipeline_stages,
 )
 from cron_operator_tpu.parallel.ring import ring_attention, ring_attention_local
+from cron_operator_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_local,
+)
 
 __all__ = [
     "MeshPlan",
@@ -43,6 +47,8 @@ __all__ = [
     "sharding_for_tree",
     "ring_attention",
     "ring_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
     "spmd_pipeline",
     "stack_pipeline_stages",
     "init_moe_params",

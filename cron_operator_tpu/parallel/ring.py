@@ -102,6 +102,56 @@ def ring_attention_local(
     return out.astype(q.dtype)
 
 
+def seq_sharded_call(
+    local_fn,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str,
+    causal: bool,
+    op_name: str,
+):
+    """Shared scaffolding for sequence-parallel attention variants (ring,
+    ulysses): divisibility guards, init-trace fallbacks, batch-axis spec
+    derivation, and the ``shard_map`` call. One place to fix, not three.
+
+    ``local_fn(q, k, v)`` is the per-device body (already bound to the
+    axis name and causal flag). Returns the sharded result, or the plain
+    single-device attention on the fallback paths.
+    """
+    par = mesh.shape.get(seq_axis, 1)
+    if par <= 1:
+        return _single_device_attention(q, k, v, causal=causal)
+    if q.shape[1] % par != 0:
+        if q.shape[0] > 1:
+            # A real batch with an indivisible sequence would silently
+            # materialize full S×S attention — exactly the OOM/perf cliff
+            # these ops exist to avoid. Fail loudly; pad upstream.
+            raise ValueError(
+                f"{op_name}: seq len {q.shape[1]} does not divide the "
+                f"{par}-way {seq_axis!r} axis; pad the sequence or resize "
+                "the mesh (silent fallback is allowed only for batch-of-1 "
+                "init traces)"
+            )
+        # Batch-of-1 trace during model.init: plain local attention.
+        return _single_device_attention(q, k, v, causal=causal)
+
+    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+    batch_size = 1
+    for a in batch_axes:
+        batch_size *= mesh.shape[a]
+    # Keep the batch replicated when it doesn't divide (init-time traces).
+    lead = batch_axes if batch_axes and q.shape[0] % batch_size == 0 else None
+    spec = P(lead, seq_axis, None, None)
+
+    return jax.shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -118,36 +168,11 @@ def ring_attention(
     Falls back to a single-block ring (plain attention) when the mesh has no
     ``seq_axis`` — same code path either way.
     """
-    ring = mesh.shape.get(seq_axis, 1)
-    if ring > 1 and q.shape[1] % ring != 0:
-        if q.shape[0] > 1:
-            # A real batch with an indivisible sequence would silently
-            # materialize full S×S attention — exactly the OOM/perf cliff
-            # this op exists to avoid. Fail loudly; pad upstream.
-            raise ValueError(
-                f"ring_attention: seq len {q.shape[1]} does not divide the "
-                f"{ring}-way {seq_axis!r} axis; pad the sequence or resize "
-                "the mesh (silent fallback is allowed only for batch-of-1 "
-                "init traces)"
-            )
-        # Batch-of-1 trace during model.init: plain local attention.
-        return _single_device_attention(q, k, v, causal=causal)
-    if ring <= 1:
-        return _single_device_attention(q, k, v, causal=causal)
-
-    batch_axes = tuple(a for a in BATCH_AXES if a in mesh.axis_names)
-    batch_size = 1
-    for a in batch_axes:
-        batch_size *= mesh.shape[a]
-    # Keep the batch replicated when it doesn't divide (init-time traces).
-    lead = batch_axes if batch_axes and q.shape[0] % batch_size == 0 else None
-    spec = P(lead, seq_axis, None, None)
-
     fn = partial(ring_attention_local, axis_name=seq_axis, causal=causal)
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )(q, k, v)
+    return seq_sharded_call(
+        fn, q, k, v, mesh, seq_axis=seq_axis, causal=causal,
+        op_name="ring_attention",
+    )
 
 
 def _single_device_attention(
@@ -174,4 +199,4 @@ def _single_device_attention(
     return out.astype(q.dtype)
 
 
-__all__ = ["ring_attention", "ring_attention_local"]
+__all__ = ["ring_attention", "ring_attention_local", "seq_sharded_call"]

@@ -1,0 +1,97 @@
+"""Ulysses sequence parallelism: all-to-all head-scatter attention.
+
+The second exact long-context strategy next to :mod:`parallel.ring`
+(SURVEY.md §2.3 names "ring attention, Ulysses, blockwise" as the
+delegated-to-workloads menu; here both exact variants are framework
+primitives). Where ring attention keeps heads whole and rotates K/V
+blocks around the ICI ring, Ulysses redistributes ONCE each way:
+
+    [b, seq/P, heads, d]  --all_to_all-->  [b, seq, heads/P, d]
+        full attention over the complete sequence per local head subset
+    [b, seq, heads/P, d]  --all_to_all-->  [b, seq/P, heads, d]
+
+Two collectives total (vs ``P`` ppermute hops), at the cost of needing
+``heads % P == 0`` and moving Q as well as K/V. Rule of thumb on TPU:
+Ulysses wins when heads are plentiful and sequence blocks are small
+enough that the single large all-to-all beats P overlapped hops; ring
+wins at extreme sequence lengths (its per-hop traffic is K/V only and
+overlaps with compute). Both are exact — same math as full attention —
+so they are interchangeable per workload via ``param.attention``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+from cron_operator_tpu.parallel.mesh import SEQ_AXIS
+from cron_operator_tpu.parallel.ring import (
+    _single_device_attention,
+    seq_sharded_call,
+)
+
+
+def ulysses_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Per-device body (under ``shard_map``; q/k/v are seq-local blocks).
+
+    ``[b, seq_local, h, d]`` → all_to_all → full-sequence attention on
+    ``h/P`` local heads (causal masking needs no block offsets — the
+    sequence is complete here) → all_to_all back.
+    """
+    # Scatter heads (axis 2), gather sequence (axis 1).
+    def a2a_in(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def a2a_out(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qg, kg, vg = a2a_in(q), a2a_in(k), a2a_in(v)  # [b, S, h/P, d]
+    out = _single_device_attention(qg, kg, vg, causal=causal)
+    return a2a_out(out)  # [b, seq_local, h, d]
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    seq_axis: str = SEQ_AXIS,
+) -> jax.Array:
+    """Sequence-parallel attention on global ``[batch, seq, heads,
+    head_dim]`` arrays via head-scatter all-to-alls. Call inside ``jit``;
+    mirrors :func:`parallel.ring.ring_attention`'s guards and fallbacks.
+    """
+    par = mesh.shape.get(seq_axis, 1)
+    heads = q.shape[2]
+    if par > 1 and heads % par != 0:
+        # Ulysses-specific constraint (the shared scaffolding handles the
+        # seq-divisibility and fallback cases).
+        raise ValueError(
+            f"ulysses_attention: {heads} heads do not divide the {par}-way "
+            f"{seq_axis!r} axis — use ring attention (head-count-free) or "
+            "resize the mesh"
+        )
+    fn = partial(ulysses_attention_local, axis_name=seq_axis, causal=causal)
+    return seq_sharded_call(
+        fn, q, k, v, mesh, seq_axis=seq_axis, causal=causal,
+        op_name="ulysses_attention",
+    )
+
+
+__all__ = ["ulysses_attention", "ulysses_attention_local"]

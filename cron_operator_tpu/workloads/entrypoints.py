@@ -212,8 +212,9 @@ def bert(ctx: JobContext) -> None:
     """BERT MLM on synthetic tokens — the long-context workload.
 
     Params: steps(=10), batch_size(=8), seq_len(=512), size(=base|tiny),
-    attention(=auto|flash|xla|ring), seq/tensor/fsdp mesh axes, remat(=0).
-    With ``seq`` > 1 the sequence axis is ring-sharded over the mesh.
+    attention(=auto|flash|xla|ring|ulysses), seq/tensor/fsdp mesh axes,
+    remat(=0). With ``seq`` > 1 the sequence axis is sharded over the
+    mesh (ring rotates K/V, ulysses all-to-alls heads).
     """
     steps = int(ctx.params.get("steps", 10))
     batch_size = int(ctx.params.get("batch_size", 8))
@@ -250,7 +251,7 @@ def gpt(ctx: JobContext) -> None:
     """GPT causal LM on synthetic tokens — long-context + optional MoE.
 
     Params: steps(=10), batch_size(=8), seq_len(=1024), size(=base|tiny),
-    attention(=auto|flash|xla|ring), moe_every(=0: dense),
+    attention(=auto|flash|xla|ring|ulysses), moe_every(=0: dense),
     num_experts(=8), seq/tensor/fsdp/expert mesh axes, remat(=0).
     Targets are next-token shifted (causal_token_batches).
     """

@@ -201,3 +201,83 @@ class TestHybridMultiSliceMesh:
 
         with pytest.raises(ValueError, match="not divisible"):
             hybrid_mesh_for_slices(3)  # 8 devices / 3 slices
+
+
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism: exact parity with full attention,
+    gradients, constraint enforcement, model integration."""
+
+    def _qkv(self, b=2, s=64, h=4, d=16, key=0):
+        ks = jax.random.split(jax.random.PRNGKey(key), 3)
+        return tuple(
+            jax.random.normal(k, (b, s, h, d), jnp.float32) for k in ks
+        )
+
+    def test_matches_reference(self):
+        from cron_operator_tpu.ops.attention import reference_attention
+        from cron_operator_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = mesh_for_devices(seq=4)  # seq=4 × data=2
+        q, k, v = self._qkv()
+        for causal in (False, True):
+            out = jax.jit(
+                lambda q, k, v, c=causal: ulysses_attention(
+                    q, k, v, mesh, causal=c)
+            )(q, k, v)
+            ref = reference_attention(q, k, v, causal=causal)
+            import numpy as np
+
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4,
+            )
+
+    def test_grads_match_reference(self):
+        from cron_operator_tpu.ops.attention import reference_attention
+        from cron_operator_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = mesh_for_devices(seq=4)
+        q, k, v = self._qkv(key=1)
+
+        def loss_u(q, k, v):
+            return jnp.sum(
+                ulysses_attention(q, k, v, mesh, causal=True) ** 2
+            )
+
+        def loss_r(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+        gu = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        import numpy as np
+
+        for a, b in zip(gu, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4,
+            )
+
+    def test_head_divisibility_enforced(self):
+        from cron_operator_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = mesh_for_devices(seq=4)
+        q, k, v = self._qkv(h=6)  # 6 heads over a 4-way axis
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_attention(q, k, v, mesh)
+
+    def test_bert_trains_with_ulysses(self):
+        from cron_operator_tpu.models import Bert, BertConfig
+        from cron_operator_tpu.workloads import data as datasets
+        from cron_operator_tpu.workloads.train import TrainConfig, Trainer
+
+        mesh = mesh_for_devices(seq=2)
+        cfg = BertConfig.tiny(max_len=64, attention_impl="ulysses")
+        m = Bert(cfg, mesh=mesh)
+        params = m.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 64), jnp.int32)
+        )["params"]
+        tr = Trainer(
+            lambda p, x: m.apply({"params": p}, x), params, mesh,
+            TrainConfig(seq_dim_in_batch=1, labels_follow_seq=True),
+        )
+        it = datasets.token_batches(4, 64, cfg.vocab_size)
+        s1, s2 = tr.step(next(it)), tr.step(next(it))
+        assert jnp.isfinite(s1.loss) and jnp.isfinite(s2.loss)
