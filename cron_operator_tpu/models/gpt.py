@@ -45,6 +45,11 @@ class GPTConfig:
     # Weight of the router load-balancing loss, folded into the model's
     # scalar aux output (trainer adds it to the task loss).
     moe_aux_weight: float = 0.01
+    # Return final hidden states instead of logits, for trainers that
+    # compute the loss with ops.xent.chunked_cross_entropy against the
+    # tied embedding — skips materializing [b, s, vocab] logits entirely
+    # (the dominant HBM spike at long context).
+    return_hidden: bool = False
 
     @staticmethod
     def tiny(**overrides) -> "GPTConfig":
@@ -129,9 +134,15 @@ class DecoderLayer(nn.Module):
 
 
 class GPT(nn.Module):
-    """Token ids ``[batch, seq]`` → (next-token logits ``[b, s, vocab]``,
-    aux loss scalar). The aux scalar is the weighted MoE router balance
-    loss (0.0 for dense configs) — trainers add it to the task loss."""
+    """Token ids ``[batch, seq]`` → (output, aux loss scalar).
+
+    ``output`` is next-token logits ``[b, s, vocab]`` by default; with
+    ``cfg.return_hidden`` it is the pair ``(hidden [b, s, d] in
+    cfg.dtype, tied embedding table [vocab, d])`` for trainers that
+    compute the loss via :func:`ops.xent.chunked_cross_entropy` (the
+    table comes from the model so callers never hard-code param paths).
+    The aux scalar is the weighted MoE router balance loss (0.0 for
+    dense configs) — trainers add it to the task loss."""
 
     config: GPTConfig = field(default_factory=GPTConfig)
     mesh: Optional[jax.sharding.Mesh] = None
@@ -159,8 +170,16 @@ class GPT(nn.Module):
             )(x)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        aux_out = cfg.moe_aux_weight * aux_total
+        if cfg.return_hidden:
+            # Loss-fusion mode: hidden states stay in cfg.dtype (the
+            # chunked CE op upcasts per chunk — an f32 copy here would
+            # double the residual held across the backward pass at
+            # exactly the long-context scale this mode targets) and the
+            # tied table travels with them.
+            return (x, tok.embedding), aux_out
         logits = tok.attend(x)
-        return logits.astype(jnp.float32), cfg.moe_aux_weight * aux_total
+        return logits.astype(jnp.float32), aux_out
 
 
 __all__ = ["GPT", "GPTConfig", "DecoderLayer", "MoEBlock"]

@@ -1,0 +1,160 @@
+"""Memory-efficient (chunked) softmax cross-entropy over a large vocab.
+
+For a causal LM the loss path ``hidden @ table.T → [T, V] logits →
+softmax CE`` materializes the biggest tensor in the whole step: at
+seq 16k and vocab 50k the logits are ~3.2 GB (f32) per example — pure
+HBM pressure, gone a microsecond later. This op never builds ``[T, V]``:
+a ``lax.scan`` over vocab chunks keeps a running (online) logsumexp and
+picks out the label logit, so peak extra memory is ``[T, chunk]``. The
+backward pass recomputes each chunk's softmax slice and accumulates
+``dhidden``/``dtable`` chunk by chunk (flash-attention's trade — FLOPs
+for HBM — applied to the vocab matmul).
+
+Matmuls stay MXU-shaped ([T, d] @ [d, chunk]); everything is stock XLA,
+no Pallas needed. Exact: same math as
+``optax.softmax_cross_entropy_with_integer_labels`` up to f32 rounding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _num_chunks(v: int, chunk_size: int) -> int:
+    return -(-v // chunk_size)  # ceil
+
+
+def _pad_table(table, chunk_size):
+    """Zero-pad the vocab dim to a chunk multiple. ``dynamic_slice`` CLAMPS
+    an out-of-range start, so slicing an unpadded table would silently
+    re-read earlier rows on the final partial chunk."""
+    v = table.shape[0]
+    pad_v = _num_chunks(v, chunk_size) * chunk_size
+    if pad_v == v:
+        return table
+    return jnp.pad(table, ((0, pad_v - v), (0, 0)))
+
+
+def _chunk_logits(hidden_f32, table_pad, start, chunk_size, v):
+    """[T, chunk] logits for rows [start, start+chunk) of the PADDED
+    table; rows past the real vocab end masked to -inf."""
+    tbl = lax.dynamic_slice_in_dim(table_pad, start, chunk_size, axis=0)
+    logits = hidden_f32 @ tbl.astype(jnp.float32).T  # [T, chunk]
+    idx = start + lax.broadcasted_iota(jnp.int32, (1, chunk_size), 1)
+    return jnp.where(idx < v, logits, -jnp.inf)
+
+
+def _forward(hidden, table, labels, chunk_size):
+    d = hidden.shape[-1]
+    v = table.shape[0]
+    # A chunk larger than the vocab would PAD the table up to the chunk
+    # and do masked work on rows that don't exist — worse than the naive
+    # path it replaces. Clamp (static Python int; shapes stay static).
+    chunk_size = min(chunk_size, v)
+    h = hidden.reshape(-1, d).astype(jnp.float32)
+    y = labels.reshape(-1)
+    t = h.shape[0]
+    n = _num_chunks(v, chunk_size)
+    table_pad = _pad_table(table, chunk_size)
+
+    def step(carry, i):
+        m, l, label_logit = carry
+        start = i * chunk_size
+        s = _chunk_logits(h, table_pad, start, chunk_size, v)  # [T, chunk]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.where(jnp.isneginf(s), 0.0, jnp.exp(s - m_new[:, None])),
+            axis=-1,
+        )
+        # The label's logit, if it falls in this chunk.
+        in_chunk = (y >= start) & (y < start + chunk_size)
+        local = jnp.clip(y - start, 0, chunk_size - 1)
+        picked = jnp.take_along_axis(s, local[:, None], axis=-1)[:, 0]
+        label_logit = jnp.where(in_chunk, picked, label_logit)
+        return (m_new, l, label_logit), None
+
+    m0 = jnp.full((t,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((t,), jnp.float32)
+    ll0 = jnp.zeros((t,), jnp.float32)
+    (m, l, label_logit), _ = lax.scan(
+        step, (m0, l0, ll0), jnp.arange(n)
+    )
+    lse = m + jnp.log(l)
+    loss = jnp.mean(lse - label_logit)
+    return loss, (hidden, table, labels, lse)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_cross_entropy(
+    hidden: jax.Array,
+    table: jax.Array,
+    labels: jax.Array,
+    chunk_size: int = 8192,
+) -> jax.Array:
+    """Mean softmax cross-entropy of ``hidden @ table.T`` against integer
+    ``labels``, never materializing the full logits.
+
+    ``hidden``: [..., d] (any leading dims); ``table``: [V, d] (the tied
+    output embedding); ``labels``: [...] int. Returns a scalar.
+    """
+    loss, _ = _forward(hidden, table, labels, chunk_size)
+    return loss
+
+
+def _fwd(hidden, table, labels, chunk_size):
+    loss, res = _forward(hidden, table, labels, chunk_size)
+    return loss, res
+
+
+def _bwd(chunk_size, res, g):
+    hidden, table, labels, lse = res
+    d = hidden.shape[-1]
+    v = table.shape[0]
+    chunk_size = min(chunk_size, v)  # same clamp as _forward
+    h = hidden.reshape(-1, d).astype(jnp.float32)
+    y = labels.reshape(-1)
+    t = h.shape[0]
+    n = _num_chunks(v, chunk_size)
+    scale = g / t  # d(mean)/d(per-token)
+
+    pad_v = n * chunk_size
+    table_pad = _pad_table(table, chunk_size)
+
+    def step(dh, i):
+        start = i * chunk_size
+        s = _chunk_logits(h, table_pad, start, chunk_size, v)
+        p = jnp.where(
+            jnp.isneginf(s), 0.0, jnp.exp(s - lse[:, None])
+        )  # softmax slice [T, chunk]
+        in_chunk = (y >= start) & (y < start + chunk_size)
+        local = jnp.clip(y - start, 0, chunk_size - 1)
+        onehot = (
+            jax.nn.one_hot(local, chunk_size, dtype=jnp.float32)
+            * in_chunk[:, None]
+        )
+        dlogits = (p - onehot) * scale  # [T, chunk]
+        tbl = lax.dynamic_slice_in_dim(
+            table_pad, start, chunk_size, axis=0
+        ).astype(jnp.float32)
+        dh = dh + dlogits @ tbl  # [T, d]
+        dtbl = dlogits.T @ h  # [chunk, d]
+        return dh, dtbl
+
+    dh0 = jnp.zeros_like(h)
+    dh, dtbl_chunks = lax.scan(step, dh0, jnp.arange(n))
+    dtable = dtbl_chunks.reshape(pad_v, d)[:v]
+    return (
+        dh.reshape(hidden.shape).astype(hidden.dtype),
+        dtable.astype(table.dtype),
+        None,  # labels: int, no gradient
+    )
+
+
+chunked_cross_entropy.defvjp(_fwd, _bwd)
+
+
+__all__ = ["chunked_cross_entropy"]

@@ -252,7 +252,9 @@ def gpt(ctx: JobContext) -> None:
 
     Params: steps(=10), batch_size(=8), seq_len(=1024), size(=base|tiny),
     attention(=auto|flash|xla|ring|ulysses), moe_every(=0: dense),
-    num_experts(=8), seq/tensor/fsdp/expert mesh axes, remat(=0).
+    num_experts(=8), seq/tensor/fsdp/expert mesh axes, remat(=0),
+    fused_xent(=0: when 1 the loss is chunked_cross_entropy against the
+    tied embedding — [b, s, vocab] logits are never materialized).
     Targets are next-token shifted (causal_token_batches).
     """
     steps = int(ctx.params.get("steps", 10))
@@ -262,6 +264,7 @@ def gpt(ctx: JobContext) -> None:
     attention = ctx.params.get("attention", "auto")
     moe_every = int(ctx.params.get("moe_every", 0))
     num_experts = int(ctx.params.get("num_experts", 8))
+    fused_xent = ctx.params.get("fused_xent", "0") in ("1", "true")
     devs = _devices(ctx)
     with jax.default_device(devs[0]):
         mesh = _mesh(ctx, devs)
@@ -269,13 +272,28 @@ def gpt(ctx: JobContext) -> None:
         cfg = maker(
             max_len=seq_len, attention_impl=attention,
             moe_every=moe_every, num_experts=num_experts,
+            return_hidden=fused_xent,
         )
         model = GPT(cfg, mesh=mesh)
         params = _jit_init(
             model, jax.random.PRNGKey(0), _zeros((1, seq_len), dtype="int32")
         )
+        if fused_xent:
+            from cron_operator_tpu.ops.xent import chunked_cross_entropy
+
+            def loss_fn(out, y):
+                # return_hidden mode: the model hands back (hidden,
+                # tied table) itself — no param-path coupling here.
+                hidden, table = out
+                return chunked_cross_entropy(hidden, table, y)
+        else:
+            from cron_operator_tpu.workloads.train import cross_entropy_loss
+            loss_fn = cross_entropy_loss
+
+        def apply_fn(p, x):
+            return model.apply({"params": p}, x)
         trainer = Trainer(
-            lambda p, x: model.apply({"params": p}, x), params, mesh,
+            apply_fn, params, mesh,
             TrainConfig(
                 remat=ctx.params.get("remat", "0") in ("1", "true"),
                 seq_dim_in_batch=1,
@@ -283,6 +301,7 @@ def gpt(ctx: JobContext) -> None:
                 aux_loss_in_output=True,
                 save_every=_save_every(ctx),
             ),
+            loss_fn=loss_fn,
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
