@@ -64,5 +64,91 @@ def causal_token_batches(
         yield {"x": ids[:, :-1], "y": ids[:, 1:]}
 
 
+class Prefetcher:
+    """Background batch placement: overlap host→device transfer with
+    compute.
+
+    ``Trainer.step`` used to build + ``device_put`` each batch on the
+    critical path; with a prefetcher the NEXT batch is already placed
+    (sharded onto the mesh) while the current step runs — the standard
+    double-buffering that hides input latency behind the device. The
+    ``place`` callable is ``Trainer.put_batch`` (device placement happens
+    on this thread); ``depth`` bounds device memory spent on staged
+    batches.
+
+    Must be :meth:`close`'d (Trainer does, in ``run``'s finally) — the
+    producer thread of an infinite generator would otherwise park forever
+    per job in a long-lived executor process.
+    """
+
+    _DONE = object()
+
+    def __init__(self, batches, place, depth: int = 2):
+        import queue as _queue
+        import threading as _threading
+
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(1, depth))
+        self._stop = _threading.Event()
+        self._exc: Exception | None = None
+        self._finished = False  # terminal: next() keeps raising StopIteration
+        self._batches = batches
+        self._place = place
+        self._thread = _threading.Thread(
+            target=self._fill, name="batch-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def _fill(self) -> None:
+        import queue as _queue
+
+        def offer(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        try:
+            for batch in self._batches:
+                if not offer(self._place(batch)):
+                    return
+                if self._stop.is_set():
+                    return
+        except Exception as exc:  # noqa: BLE001 — re-raised on the consumer
+            self._exc = exc
+        offer(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            # Iterator protocol: repeated next() after exhaustion (or
+            # after close()) must keep raising, never park on q.get()
+            # waiting for a producer that already exited.
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._finished = True
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        self._finished = True
+        # Unblock a producer parked on a full queue.
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+        self._thread.join(timeout=5.0)
+
+
 __all__ = ["mnist_batches", "imagenet_batches", "token_batches",
-           "causal_token_batches"]
+           "causal_token_batches", "Prefetcher"]

@@ -83,6 +83,10 @@ def _save_every(ctx: JobContext) -> int:
     return int(ctx.params.get("save_every", 10))
 
 
+def _prefetch(ctx: JobContext) -> int:
+    return int(ctx.params.get("prefetch", 0))
+
+
 def _jit_init(model, rng, x):
     """``model.init`` under jit: eager init dispatches every conv/norm op
     separately (tens of seconds for ResNet-50 on a cold process); one
@@ -172,7 +176,8 @@ def mnist(ctx: JobContext) -> None:
         trainer = Trainer(
             lambda p, x: model.apply({"params": p}, x), params, mesh,
             TrainConfig(optimizer="sgd", learning_rate=0.01,
-                        save_every=_save_every(ctx)),
+                        save_every=_save_every(ctx),
+                        prefetch=_prefetch(ctx)),
             checkpoint=_checkpoint_store(ctx),
         )
         _run(ctx, trainer, datasets.mnist_batches(batch_size), steps)
@@ -198,7 +203,8 @@ def resnet50(ctx: JobContext) -> None:
         trainer = Trainer(
             lambda p, x: model.apply({"params": p}, x), params, mesh,
             TrainConfig(optimizer="sgd", learning_rate=0.1,
-                        save_every=_save_every(ctx)),
+                        save_every=_save_every(ctx),
+                        prefetch=_prefetch(ctx)),
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
@@ -237,6 +243,7 @@ def bert(ctx: JobContext) -> None:
                 seq_dim_in_batch=1,
                 labels_follow_seq=True,
                 save_every=_save_every(ctx),
+                prefetch=_prefetch(ctx),
             ),
             checkpoint=_checkpoint_store(ctx),
         )
@@ -300,6 +307,7 @@ def gpt(ctx: JobContext) -> None:
                 labels_follow_seq=True,
                 aux_loss_in_output=True,
                 save_every=_save_every(ctx),
+                prefetch=_prefetch(ctx),
             ),
             loss_fn=loss_fn,
             checkpoint=_checkpoint_store(ctx),

@@ -49,6 +49,9 @@ class TrainConfig:
     # aux (e.g. MoE router balance loss, already weighted by the model) is
     # added to the task loss.
     aux_loss_in_output: bool = False
+    # Batches ahead to place on device from a background thread (0 = off).
+    # Hides host→device transfer behind compute (workloads.data.Prefetcher).
+    prefetch: int = 0
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer == "adamw":
@@ -164,14 +167,29 @@ class Trainer:
         """Train until ``steps_done`` reaches ``steps`` (a TOTAL-step
         target, so a checkpoint-restored trainer only runs the remainder —
         preempted work is not repeated)."""
+        prefetcher = None
+        # Lazy: a no-op run (target already reached after checkpoint
+        # restore, or an immediate stop) must not consume + device-place
+        # depth+1 batches it will never use.
+        if self.config.prefetch > 0 and self.steps_done < steps:
+            from cron_operator_tpu.workloads.data import Prefetcher
+
+            prefetcher = Prefetcher(
+                batches, self.put_batch, self.config.prefetch
+            )
+            batches = prefetcher  # step's put_batch is a no-op re-place
         stats = []
-        while self.steps_done < steps:
-            if should_stop is not None and should_stop():
-                break
-            s = self.step(next(batches))
-            stats.append(s)
-            if on_step is not None:
-                on_step(s)
+        try:
+            while self.steps_done < steps:
+                if should_stop is not None and should_stop():
+                    break
+                s = self.step(next(batches))
+                stats.append(s)
+                if on_step is not None:
+                    on_step(s)
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
         if self.checkpoint is not None:
             self.checkpoint.wait()
         return stats

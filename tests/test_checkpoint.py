@@ -157,3 +157,58 @@ class TestPreemptionResume:
             # Cancel the (long) re-run and shut down.
             api.delete("kubeflow.org/v1", "JAXJob", "default", "mnist-pre")
             ex.stop()
+
+
+class TestElasticResume:
+    def test_restore_across_different_mesh_topology(self, cpus, tmp_path):
+        """Elastic resharding: a checkpoint saved under one sharding plan
+        (fsdp=2) restores into a trainer on a DIFFERENT plan (tensor=2) —
+        the restore targets the new mesh layout directly (Orbax
+        restore-into-`like`), so a rescheduled job can resume on whatever
+        slice shape it lands on."""
+        from cron_operator_tpu.models import MLP
+        from cron_operator_tpu.workloads.train import TrainConfig, Trainer
+
+        def build(mesh_kwargs, store):
+            import jax.numpy as jnp
+
+            mesh = mesh_for_devices(cpus, **mesh_kwargs)
+            model = MLP()
+            params = model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+            )["params"]
+            return Trainer(
+                lambda p, x: model.apply({"params": p}, x), params, mesh,
+                TrainConfig(optimizer="sgd", learning_rate=0.05,
+                            save_every=2),
+                checkpoint=store,
+            )
+
+        t1 = build(
+            dict(fsdp=2),
+            CheckpointStore("ns", "elastic-1785339000",
+                            root=str(tmp_path), lineage="family"),
+        )
+        t1.run(datasets.mnist_batches(16, seed=11), steps=2)
+        t1.checkpoint.wait()
+        saved = np.asarray(
+            jax.device_get(t1.state.params["Dense_0"]["kernel"])
+        )
+        t1.checkpoint.close()
+
+        t2 = build(
+            dict(tensor=2),
+            CheckpointStore("ns", "elastic-1785339060",
+                            root=str(tmp_path), lineage="family"),
+        )
+        assert t2.steps_done == 2
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(
+                t2.state.params["Dense_0"]["kernel"]
+            )),
+            saved,
+        )
+        # And it keeps training on the new topology.
+        stats = t2.run(datasets.mnist_batches(16, seed=11), steps=4)
+        assert [s.step for s in stats] == [3, 4]
+        t2.checkpoint.close()
