@@ -66,11 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
     start = sub.add_parser("start", help="start the operator manager")
     # Reference flag surface (start.go:215-247):
     start.add_argument("--max-concurrent-reconciles", type=int, default=10)
-    start.add_argument("--qps", type=int, default=30,
-                       help="client QPS (accepted for compatibility; the "
+    start.add_argument("--qps", type=float, default=30,
+                       help="kube client QPS (cluster mode: token-bucket "
+                            "flow control, reference default 30; the "
                             "embedded control plane is not rate-limited)")
     start.add_argument("--burst", type=int, default=50,
-                       help="client burst (compatibility)")
+                       help="kube client burst (cluster mode)")
     start.add_argument("--metrics-bind-address", default="0",
                        help="':8080' to enable, '0' to disable (default)")
     start.add_argument("--health-probe-bind-address", default=":8081")
@@ -178,6 +179,8 @@ def cmd_start(args: argparse.Namespace) -> int:
             cfg.ca_file = args.kube_ca_file
         if args.kube_insecure:
             cfg.insecure = True
+        cfg.qps = float(args.qps)
+        cfg.burst = int(args.burst)
         api = ClusterAPIServer(cfg, scheme=scheme)
         log.info("cluster mode: reconciling against %s", cfg.server)
     else:

@@ -29,6 +29,7 @@ import logging
 import os
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -59,7 +60,13 @@ Unstructured = Dict[str, Any]
 
 
 class ClusterConfig:
-    """Connection parameters for a kube-apiserver."""
+    """Connection parameters for a kube-apiserver.
+
+    ``qps``/``burst`` are the client-side flow-control knobs the reference
+    wires from ``--qps/--burst`` into its rest.Config
+    (``cmd/operator/start.go:152-154``); defaults match its 30/50.
+    ``qps=0`` disables limiting.
+    """
 
     def __init__(
         self,
@@ -67,11 +74,15 @@ class ClusterConfig:
         token: Optional[str] = None,
         ca_file: Optional[str] = None,
         insecure: bool = False,
+        qps: float = 30.0,
+        burst: int = 50,
     ):
         self.server = server.rstrip("/")
         self.token = token
         self.ca_file = ca_file
         self.insecure = insecure
+        self.qps = qps
+        self.burst = burst
 
     @classmethod
     def in_cluster(cls) -> "ClusterConfig":
@@ -91,6 +102,34 @@ class ClusterConfig:
             token=token,
             ca_file=ca_path if os.path.exists(ca_path) else None,
         )
+
+
+class TokenBucket:
+    """client-go ``flowcontrol.NewTokenBucketRateLimiter`` analog:
+    ``burst`` requests immediately, refilled at ``qps`` per second.
+    Thread-safe; ``acquire`` blocks until a token is available."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = float(qps)
+        self.burst = max(1, int(burst))
+        self._tokens = float(self.burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    float(self.burst),
+                    self._tokens + (now - self._last) * self.qps,
+                )
+                self._last = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
 
 
 def _status_error(code: int, body: str) -> ApiError:
@@ -128,6 +167,10 @@ class ClusterAPIServer:
         self._watch_threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self._ctx = self._ssl_context()
+        self._limiter = (
+            TokenBucket(self.config.qps, self.config.burst)
+            if self.config.qps > 0 else None
+        )
 
     # ---- transport --------------------------------------------------------
 
@@ -151,6 +194,8 @@ class ClusterAPIServer:
         content_type: str = "application/json",
         timeout: float = 30.0,
     ) -> Any:
+        if self._limiter is not None:
+            self._limiter.acquire()
         url = self.config.server + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
@@ -407,6 +452,10 @@ class ClusterAPIServer:
         req.add_header("Accept", "application/json")
         if self.config.token:
             req.add_header("Authorization", f"Bearer {self.config.token}")
+        # Each (re-)establishment costs a token — a crash-looping watch
+        # must not hammer the apiserver past the flow-control budget.
+        if self._limiter is not None:
+            self._limiter.acquire()
         last_rv = rv
         with urllib.request.urlopen(req, context=self._ctx, timeout=330) as r:
             for raw in r:
@@ -439,4 +488,4 @@ class ClusterAPIServer:
                 logger.error("watcher callback failed", exc_info=True)
 
 
-__all__ = ["ClusterAPIServer", "ClusterConfig"]
+__all__ = ["ClusterAPIServer", "ClusterConfig", "TokenBucket"]
