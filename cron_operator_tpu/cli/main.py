@@ -128,16 +128,42 @@ def build_parser() -> argparse.ArgumentParser:
     get.add_argument("resource", choices=["crons", "workloads"],
                      help="'crons' prints the reference printcolumns; "
                           "'workloads' lists scheduled jobs with status")
-    get.add_argument("-n", "--namespace", default="default")
-    get.add_argument("--server", default="http://127.0.0.1:8443",
-                     help="operator --serve-api address (or a real "
-                          "kube-apiserver URL)")
-    get.add_argument("--token", default=None, help="bearer token")
-    get.add_argument("--ca-file", default=None,
-                     help="CA bundle for an HTTPS --server")
-    get.add_argument("--insecure", action="store_true", default=False,
-                     help="skip TLS verification (dev only)")
+    _add_connection_flags(get)
+
+    desc = sub.add_parser(
+        "describe", help="show one Cron's spec, status and events"
+    )
+    desc.add_argument("resource", choices=["cron"])
+    desc.add_argument("name")
+    _add_connection_flags(desc)
     return parser
+
+
+def _add_connection_flags(p: argparse.ArgumentParser) -> None:
+    """Shared client-connection flags for the inspection subcommands."""
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--server", default="http://127.0.0.1:8443",
+                   help="operator --serve-api address (or a real "
+                        "kube-apiserver URL)")
+    p.add_argument("--token", default=None, help="bearer token")
+    p.add_argument("--ca-file", default=None,
+                   help="CA bundle for an HTTPS --server")
+    p.add_argument("--insecure", action="store_true", default=False,
+                   help="skip TLS verification (dev only)")
+
+
+def _client_from_args(args: argparse.Namespace):
+    from cron_operator_tpu.api.scheme import default_scheme
+    from cron_operator_tpu.runtime.cluster import (
+        ClusterAPIServer,
+        ClusterConfig,
+    )
+
+    return ClusterAPIServer(
+        ClusterConfig(args.server, token=args.token,
+                      ca_file=args.ca_file, insecure=args.insecure),
+        scheme=default_scheme(),
+    )
 
 
 def _configure_logging(level: str, encoder: str) -> None:
@@ -340,20 +366,11 @@ def _print_table(headers: List[str], rows: List[List[str]]) -> None:
 
 
 def cmd_get(args: argparse.Namespace) -> int:
-    from cron_operator_tpu.api.scheme import default_scheme
     from cron_operator_tpu.controller.workload import get_job_status
-    from cron_operator_tpu.runtime.cluster import (
-        ClusterAPIServer,
-        ClusterConfig,
-    )
     from cron_operator_tpu.runtime.kube import ApiError, NotFoundError
 
-    scheme = default_scheme()
-    api = ClusterAPIServer(
-        ClusterConfig(args.server, token=args.token,
-                      ca_file=args.ca_file, insecure=args.insecure),
-        scheme=scheme,
-    )
+    api = _client_from_args(args)
+    scheme = api.scheme
     try:
         if args.resource == "crons":
             crons = api.list("apps.kubedl.io/v1alpha1", "Cron",
@@ -410,6 +427,73 @@ def cmd_get(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_describe(args: argparse.Namespace) -> int:
+    """kubectl-describe analog for a Cron: spec, status, and its events
+    (the reference delegates this to kubectl; standalone mode has none)."""
+    from cron_operator_tpu.runtime.kube import ApiError, NotFoundError
+
+    api = _client_from_args(args)
+    try:
+        try:
+            cron = api.get("apps.kubedl.io/v1alpha1", "Cron",
+                           args.namespace, args.name)
+        except NotFoundError:
+            print(f"error: cron {args.namespace}/{args.name} not found",
+                  file=sys.stderr)
+            return 1
+        spec = cron.get("spec") or {}
+        st = cron.get("status") or {}
+        meta = cron.get("metadata") or {}
+        print(f"Name:               {meta.get('name')}")
+        print(f"Namespace:          {meta.get('namespace')}")
+        print(f"Schedule:           {spec.get('schedule')}")
+        print(f"Concurrency Policy: {spec.get('concurrencyPolicy', 'Allow')}")
+        print(f"Suspend:            "
+              f"{str(bool(spec.get('suspend', False))).lower()}")
+        if spec.get("deadline"):
+            print(f"Deadline:           {spec['deadline']}")
+        if spec.get("historyLimit") is not None:
+            print(f"History Limit:      {spec['historyLimit']}")
+        print(f"Last Schedule Time: {st.get('lastScheduleTime', '<none>')}")
+        active = st.get("active") or []
+        print(f"Active:             {len(active)}")
+        for ref in active:
+            print(f"  {ref.get('kind')}/{ref.get('name')}")
+        history = st.get("history") or []
+        if history:
+            print("History:")
+            for h in history:
+                obj = h.get("object") or {}
+                print(f"  {obj.get('kind')}/{obj.get('name')}   "
+                      f"{h.get('status', '')}   created "
+                      f"{h.get('created', '')}")
+        try:
+            events = api.list("v1", "Event", args.namespace)
+        except NotFoundError:
+            events = []
+        mine = sorted(
+            (
+                e for e in events
+                if (e.get("involvedObject") or {}).get("name") == args.name
+                and (e.get("involvedObject") or {}).get("kind") == "Cron"
+            ),
+            # Real apiservers LIST in name order (random uuid suffixes);
+            # chronological order is what a debugger needs.
+            key=lambda e: e.get("lastTimestamp") or "",
+        )
+        print("Events:" if mine else "Events:             <none>")
+        for e in mine[-20:]:
+            print(f"  {e.get('type', ''):8} {e.get('reason', ''):22} "
+                  f"{_age(e.get('lastTimestamp')):>6}   "
+                  f"{e.get('message', '')}")
+    except ApiError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    finally:
+        api.stop()
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -417,6 +501,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_start(args)
     if args.command == "get":
         return cmd_get(args)
+    if args.command == "describe":
+        return cmd_describe(args)
     parser.print_help()
     return 0
 

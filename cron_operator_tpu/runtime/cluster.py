@@ -33,7 +33,6 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
-import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from cron_operator_tpu.api.scheme import GVK, Scheme, default_scheme, parse_api_version
@@ -45,6 +44,7 @@ from cron_operator_tpu.runtime.kube import (
     InvalidError,
     NotFoundError,
     WatchEvent,
+    make_event_object,
 )
 from cron_operator_tpu.utils.clock import Clock, RealClock
 
@@ -341,36 +341,17 @@ class ClusterAPIServer:
     def record_event(
         self, involved: Unstructured, etype: str, reason: str, message: str
     ) -> None:
-        meta = involved.get("metadata") or {}
-        ns = meta.get("namespace") or "default"
-        now = rfc3339(self.clock.now())
-        event = {
-            "apiVersion": "v1",
-            "kind": "Event",
-            "metadata": {
-                "name": f"{meta.get('name', 'unknown')}.{uuid.uuid4().hex[:10]}",
-                "namespace": ns,
-            },
-            "involvedObject": {
-                "apiVersion": involved.get("apiVersion"),
-                "kind": involved.get("kind"),
-                "namespace": ns,
-                "name": meta.get("name"),
-                "uid": meta.get("uid"),
-            },
-            "type": etype,
-            "reason": reason,
-            "message": message,
-            "firstTimestamp": now,
-            "lastTimestamp": now,
-            "count": 1,
-            "source": {"component": self.field_manager},
-        }
+        event = make_event_object(
+            involved, etype, reason, message, rfc3339(self.clock.now()),
+            component=self.field_manager,
+        )
         try:
             self.create(event)
         except ApiError:
-            logger.warning("failed to record event %s/%s", reason, ns,
-                           exc_info=True)
+            logger.warning(
+                "failed to record event %s/%s", reason,
+                event["metadata"]["namespace"], exc_info=True,
+            )
 
     # ---- watches (informer analog) ----------------------------------------
 

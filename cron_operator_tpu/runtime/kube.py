@@ -96,6 +96,48 @@ def match_labels(obj: Unstructured, selector: Optional[Dict[str, str]]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def make_event_object(
+    involved: Unstructured,
+    etype: str,
+    reason: str,
+    message: str,
+    now: str,
+    component: str = "cron-operator-tpu",
+) -> Unstructured:
+    """corev1 Event payload — the ONE builder shared by the embedded
+    store and the cluster client (they must emit identical events)."""
+    meta = involved.get("metadata") or {}
+    ns = meta.get("namespace") or "default"
+    return {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": f"{meta.get('name', 'unknown')}.{uuid.uuid4().hex[:10]}",
+            "namespace": ns,
+        },
+        "involvedObject": {
+            "apiVersion": involved.get("apiVersion"),
+            "kind": involved.get("kind"),
+            "namespace": ns,
+            "name": meta.get("name"),
+            "uid": meta.get("uid"),
+        },
+        "type": etype,
+        "reason": reason,
+        "message": message,
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+        "source": {"component": component},
+    }
+
+
+# Retained Event objects per namespace; real apiservers TTL events (~1h),
+# an in-memory store must bound them or a long-lived operator with a
+# recurring-event cron grows without limit.
+EVENT_OBJECTS_PER_NAMESPACE = 1000
+
+
 def controller_owner(obj: Unstructured) -> Optional[Dict[str, Any]]:
     """The controller=true owner reference, if any."""
     for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
@@ -237,6 +279,33 @@ class APIServer:
                     timestamp=self.clock.now(),
                 )
             )
+        # Also persist as a corev1 Event OBJECT so the REST facade (and
+        # `describe`) can list events the way kubectl does — the side list
+        # above stays for in-process test assertions.
+        ns = meta.get("namespace") or "default"
+        try:
+            self.create(make_event_object(
+                involved, etype, reason, message, rfc3339(self.clock.now())
+            ))
+            self._prune_events(ns)
+        except ApiError:  # event bookkeeping must never fail the caller
+            pass
+
+    def _prune_events(self, namespace: str) -> None:
+        """Bound retained Event objects per namespace (TTL analog: real
+        apiservers expire events after ~1h; an in-memory store must cap
+        them). Oldest-first by store insertion order."""
+        with self._lock:
+            keys = [
+                k for k in self._objects
+                if k[1] == "Event" and k[2] == namespace
+            ]
+            excess = keys[: max(0, len(keys) - EVENT_OBJECTS_PER_NAMESPACE)]
+        for k in excess:
+            try:
+                self.delete(k[0], k[1], k[2], k[3], propagation="Orphan")
+            except NotFoundError:
+                pass
 
     def events(
         self, reason: Optional[str] = None, involved_name: Optional[str] = None

@@ -426,3 +426,45 @@ class TestGetSubcommand:
         assert rc == 1
         assert captured.err.startswith("error: ")
         assert "Traceback" not in captured.err
+
+
+class TestDescribeSubcommand:
+    def test_describe_cron_shows_status_and_events(self, server, client,
+                                                   capsys):
+        """kubectl-describe analog: spec summary, status, and the
+        object's events — including events recorded by the EMBEDDED
+        control plane (persisted as corev1 Event objects)."""
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        client.create(make_cron("desc", schedule="*/2 * * * *",
+                                policy="Forbid", history=4))
+        client.patch_status(
+            "apps.kubedl.io/v1alpha1", "Cron", "default", "desc",
+            {"lastScheduleTime": "2026-07-30T01:00:00Z",
+             "active": [{"kind": "JAXJob", "name": "desc-1"}]},
+        )
+        # Embedded-side event (what the reconciler records in-process).
+        server.api.record_event(
+            {"apiVersion": "apps.kubedl.io/v1alpha1", "kind": "Cron",
+             "metadata": {"name": "desc", "namespace": "default"}},
+            "Warning", "TooManyMissedTimes", "too many missed start times",
+        )
+
+        rc = cli_main(["describe", "cron", "desc", "--server", server.url,
+                       "--token", TOKEN])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "Schedule:           */2 * * * *" in out
+        assert "Concurrency Policy: Forbid" in out
+        assert "Last Schedule Time: 2026-07-30T01:00:00Z" in out
+        assert "JAXJob/desc-1" in out
+        assert "TooManyMissedTimes" in out
+
+    def test_describe_missing_cron_fails_cleanly(self, server, capsys):
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        rc = cli_main(["describe", "cron", "nope", "--server", server.url,
+                       "--token", TOKEN])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "not found" in captured.err

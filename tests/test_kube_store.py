@@ -203,3 +203,20 @@ class TestWatchAndEvents:
         assert len(evs) == 1
         assert evs[0].involved_name == "c"
         assert evs[0].type == "Warning"
+
+    def test_record_event_also_creates_v1_event_object(self, api):
+        """Events must be listable as corev1 Event objects (what the REST
+        facade and `describe` read), not only via the in-process side
+        list."""
+        cron = {"apiVersion": "apps.kubedl.io/v1alpha1", "kind": "Cron",
+                "metadata": {"name": "c", "namespace": "ns9"}}
+        api.record_event(cron, "Warning", "FailedTPUAdmission", "bad topo")
+        objs = api.list("v1", "Event", namespace="ns9")
+        assert len(objs) == 1
+        ev = objs[0]
+        assert ev["reason"] == "FailedTPUAdmission"
+        assert ev["involvedObject"]["kind"] == "Cron"
+        assert ev["involvedObject"]["name"] == "c"
+        assert ev["type"] == "Warning"
+        # the side list keeps working for test assertions
+        assert api.events(reason="FailedTPUAdmission")
