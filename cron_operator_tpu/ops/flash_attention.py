@@ -118,7 +118,7 @@ def _flash_kernel(
         l = jnp.where(masked, 1.0, l)  # fully-masked rows → zeros, not NaN
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
         lse = m_ref[...] + jnp.log(l)
-        lse_ref[0] = jnp.where(masked, LSE_MASKED, lse)[:, 0]
+        lse_ref[0] = jnp.where(masked, LSE_MASKED, lse)
 
 
 def _bwd_dq_kernel(
@@ -142,8 +142,8 @@ def _bwd_dq_kernel(
         k_blk = k_ref[0].astype(jnp.float32)            # [block_k, d]
         v_blk = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)              # [block_q, d]
-        lse = lse_ref[0][:, None]                       # [block_q, 1]
-        delta = delta_ref[0][:, None]                   # [block_q, 1]
+        lse = lse_ref[0]                                # [block_q, 1]
+        delta = delta_ref[0]                            # [block_q, 1]
 
         s = jnp.dot(q * scale, k_blk.T,
                     preferred_element_type=jnp.float32)
@@ -196,8 +196,8 @@ def _bwd_dkv_kernel(
         k_blk = k_ref[0].astype(jnp.float32)            # [block_k, d]
         v_blk = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)              # [block_q, d]
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                                # [block_q, 1]
+        delta = delta_ref[0]                            # [block_q, 1]
 
         s = jnp.dot(q * scale, k_blk.T,
                     preferred_element_type=jnp.float32)
@@ -252,7 +252,7 @@ def _from_bhsd(x: jax.Array, b: int, h: int) -> jax.Array:
 
 def _forward(q, k, v, causal, block_q, block_k, interpret):
     """Runs the forward kernel; returns (o, lse) with o in public
-    ``[b, s, h, d]`` layout and lse in internal ``[b*h, s]`` layout."""
+    ``[b, s, h, d]`` layout and lse in internal ``[b*h, s, 1]`` layout."""
     b, s, h, d = q.shape
     _check_shapes(s, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
@@ -289,14 +289,18 @@ def _forward(q, k, v, causal, block_q, block_k, interpret):
                 (1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
                 memory_space=pltpu.VMEM,
             ),
+            # LSE rides as [bh, s, 1] — a trailing unit dim keeps the
+            # block's last-two dims (block_q, 1) legal under Mosaic's
+            # (8, 128)-divisible-or-full tiling rule, which a [bh, s]
+            # layout with (1, block_q) blocks violates.
             pl.BlockSpec(
-                (1, block_q), lambda bh, qi, ki: (bh, qi),
+                (1, block_q, 1), lambda bh, qi, ki: (bh, qi, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),  # acc
@@ -330,8 +334,9 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
     # Δ_i = Σ_d dO_id · O_id — one fused elementwise-reduce; no kernel
     # needed (flash-2 precomputes this exactly the same way).
     delta = jnp.sum(
-        dor.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1
-    )  # [b*h, s]
+        dor.astype(jnp.float32) * orr.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # [b*h, s, 1] — same trailing-unit-dim layout as lse (tiling rule)
 
     n_qblocks = s // block_q
     n_kblocks = s // block_k
@@ -341,7 +346,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
                            memory_space=pltpu.VMEM)
     k_spec3 = pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0),
                            memory_space=pltpu.VMEM)
-    row_spec3 = pl.BlockSpec((1, block_q), lambda i, qi, ki: (i, qi),
+    row_spec3 = pl.BlockSpec((1, block_q, 1), lambda i, qi, ki: (i, qi, 0),
                              memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -363,7 +368,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
                            memory_space=pltpu.VMEM)
     k_specT = pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
                            memory_space=pltpu.VMEM)
-    row_specT = pl.BlockSpec((1, block_q), lambda i, ki, qi: (i, qi),
+    row_specT = pl.BlockSpec((1, block_q, 1), lambda i, ki, qi: (i, qi, 0),
                              memory_space=pltpu.VMEM)
 
     dk, dv = pl.pallas_call(
