@@ -58,14 +58,55 @@ def main(argv=None) -> int:
         for kk in jax.random.split(key, 3)
     )
 
-    def timed(fn, *args):
-        out = fn(*args)  # compile
+    from jax import lax
+
+    def timed_chain(chain_fn, carry):
+        """Per-iteration time of ``chain_fn`` (carry → same-shaped carry)
+        with constant overhead subtracted out, or ``None`` when the
+        measurement is invalid (noise made the difference non-positive).
+
+        ONE compiled program — a jitted ``lax.scan`` of the chain, length
+        ``iters`` — is fed its own output k times per span (k and 2k), and
+        the report is (t_2k − t_k)/(k·iters). The device sync + tunnel
+        round-trip (~80 ms here — milliseconds of per-iter noise for a
+        dispatch-per-iteration loop, which timed the same kernel at
+        0.023 ms and 0.209 ms across runs) happens once per span and
+        cancels in the difference; the k async re-dispatches cost ~µs
+        each. k is calibrated so a span is ~0.5 s, dwarfing round-trip
+        jitter. Feeding outputs back as inputs keeps XLA from folding
+        repeats; compiling a single length keeps Mosaic compile time (a
+        seq-2048 fwd+bwd program is expensive) out of the bench budget."""
+        run = jax.jit(lambda c: lax.scan(
+            lambda c, _: (chain_fn(c), None), c, None, length=iters
+        )[0])
+        out = run(carry)  # compile
         jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(*args)
-        jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
-        return (time.perf_counter() - t0) / iters, out
+
+        def spanned(k):
+            best = float("inf")
+            for _ in range(3):  # best-of-3: min is the least-interference
+                c = carry       # estimate on a shared/tunneled device,
+                t0 = time.perf_counter()  # and differencing mins keeps
+                for _ in range(k):        # t_2k − t_k positive
+                    c = run(c)
+                jax.tree_util.tree_map(
+                    lambda x: x.block_until_ready(), c)
+                best = min(best, time.perf_counter() - t0)
+            return best, c
+
+        # Calibration estimate must itself be overhead-free (a raw span/k
+        # estimate is RTT-inflated and sizes k smaller → coarser), so it
+        # is a two-span difference too.
+        t1, _ = spanned(1)
+        t2, _ = spanned(2)
+        per_block = max(t2 - t1, 1e-6)  # seconds per iters-length block
+        k = max(1, min(256, int(0.5 / per_block)))
+        t_k, out = spanned(k)
+        t_2k, _ = spanned(2 * k)
+        diff = t_2k - t_k
+        if diff <= 0:  # interference beat the differencing: no number is
+            return None, out  # better than a garbage 0.0/∞-speedup one
+        return diff / (k * iters), out
 
     # Both sides jitted: fused-program vs fused-program (ADVICE r2 — timing
     # jitted flash against eager op-by-op XLA overstated the kernel).
@@ -75,19 +116,34 @@ def main(argv=None) -> int:
     xla_fn = jax.jit(lambda q, k, v: multi_head_attention(
         q, k, v, causal=causal, impl="xla"
     ))
-    flash_t, flash_out = timed(flash_fn, q, k, v)
-    xla_t, xla_out = timed(xla_fn, q, k, v)
+    # The attention output has q's shape: chain it as the next q.
+    flash_t, _ = timed_chain(lambda c: flash_fn(c, k, v), q)
+    xla_t, _ = timed_chain(lambda c: xla_fn(c, k, v), q)
+    flash_out = flash_fn(q, k, v)  # single un-chained call for correctness
 
     # Training-path comparison: full value_and_grad through each impl
-    # (exercises the Pallas flash-2 backward kernels under Mosaic).
+    # (exercises the Pallas flash-2 backward kernels under Mosaic);
+    # dq has q's shape — chain it.
     def grad_of(fn):
-        return jax.jit(jax.grad(
+        return jax.grad(
             lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
             argnums=(0, 1, 2),
-        ))
+        )
 
-    flash_bwd_t, _ = timed(grad_of(flash_fn), q, k, v)
-    xla_bwd_t, _ = timed(grad_of(xla_fn), q, k, v)
+    flash_grad = grad_of(flash_fn)
+    xla_grad = grad_of(xla_fn)
+
+    def chain_all_grads(grad_fn):
+        # Fold dk/dv into the carry at ~1e-20 weight: a carry that uses
+        # only dq lets XLA dead-code-eliminate the entire dK/dV pass and
+        # the "backward" number measures half a backward.
+        def chain(c):
+            dq, dk, dv = grad_fn(c, k, v)
+            return dq + ((dk.sum() + dv.sum()) * 1e-20).astype(dq.dtype)
+        return chain
+
+    flash_bwd_t, _ = timed_chain(chain_all_grads(flash_grad), q)
+    xla_bwd_t, _ = timed_chain(chain_all_grads(xla_grad), q)
 
     ref = reference_attention(
         q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
@@ -119,35 +175,57 @@ def main(argv=None) -> int:
             y, aux = moe_ffn(p, x, compute_dtype=jnp.bfloat16)
             return jnp.sum(y.astype(jnp.float32) ** 2) + aux
 
-        moe_fwd_t, _ = timed(jax.jit(
-            lambda p, x: moe_ffn(p, x, compute_dtype=jnp.bfloat16)[0]
-        ), mp, x)
-        moe_step_t, _ = timed(jax.jit(jax.grad(moe_loss)), mp, x)
+        # y has x's shape: chain it. The grad chain carries dL/dx (same
+        # shape as x) while still computing the param grads each iteration
+        # (argnums covers both).
+        moe_fwd_t, _ = timed_chain(
+            lambda c: moe_ffn(mp, c, compute_dtype=jnp.bfloat16)[0], x
+        )
+        moe_grad = jax.grad(moe_loss, argnums=(0, 1))
+
+        def moe_chain(c):
+            gp, gx = moe_grad(mp, c)
+            live = jax.tree_util.tree_reduce(
+                lambda a, g: a + g.sum(), gp, 0.0
+            )
+            # Keep the param-grad branch live (see chain_all_grads).
+            return (gx + live * 1e-20).astype(x.dtype)
+
+        moe_step_t, _ = timed_chain(moe_chain, x)
         moe = {
             "tokens": tokens, "d_model": d_model, "experts": n_exp,
-            "fwd_ms": round(moe_fwd_t * 1e3, 3),
-            "grad_ms": round(moe_step_t * 1e3, 3),
+            "fwd_ms": _ms(moe_fwd_t),
+            "grad_ms": _ms(moe_step_t),
         }
 
     print(json.dumps({
         "backend": backend,
         "flash_mode": "mosaic" if on_tpu else "interpret",
+        "timing": (
+            "one compiled scan-of-iters chain fed back k times; "
+            "(t_2k - t_k)/(k*iters), best-of-3 spans, k sized for ~0.5s; "
+            "null = noise beat the differencing"
+        ),
         "shape": [b, s, h, d],
         "causal": causal,
-        "flash_ms": round(flash_t * 1e3, 3),
-        "xla_ms": round(xla_t * 1e3, 3),
-        "speedup_flash_over_xla": (
-            round(xla_t / flash_t, 3) if flash_t > 0 else None
-        ),
-        "flash_grad_ms": round(flash_bwd_t * 1e3, 3),
-        "xla_grad_ms": round(xla_bwd_t * 1e3, 3),
-        "speedup_flash_grad_over_xla": (
-            round(xla_bwd_t / flash_bwd_t, 3) if flash_bwd_t > 0 else None
-        ),
+        "flash_ms": _ms(flash_t),
+        "xla_ms": _ms(xla_t),
+        "speedup_flash_over_xla": _ratio(xla_t, flash_t),
+        "flash_grad_ms": _ms(flash_bwd_t),
+        "xla_grad_ms": _ms(xla_bwd_t),
+        "speedup_flash_grad_over_xla": _ratio(xla_bwd_t, flash_bwd_t),
         "flash_max_abs_err_vs_f32_ref": round(max_err, 5),
         "moe": moe,
     }))
     return 0
+
+
+def _ms(t):
+    return round(t * 1e3, 3) if t is not None else None
+
+
+def _ratio(num, den):
+    return round(num / den, 3) if num and den else None
 
 
 if __name__ == "__main__":

@@ -10,7 +10,8 @@ its metrics stop at reconcile counts, SURVEY.md §5).
 
 Common params (all optional, all strings): ``steps``, ``batch_size``,
 ``platform`` (force ``cpu`` for tests), ``tensor``/``seq``/``fsdp`` (mesh
-axis sizes). Model-specific params documented per entrypoint.
+axis sizes), ``data`` (``device`` default | ``host`` — see
+:func:`_batches`). Model-specific params documented per entrypoint.
 """
 
 from __future__ import annotations
@@ -87,6 +88,22 @@ def _prefetch(ctx: JobContext) -> int:
     return int(ctx.params.get("prefetch", 0))
 
 
+def _sync_every(ctx: JobContext) -> int:
+    return int(ctx.params.get("sync_every", 1))
+
+
+def _batches(ctx: JobContext, trainer: Trainer, host_factory, device_factory):
+    """``param.data`` selects where synthetic batches materialize:
+    ``device`` (default) generates them on-device via a jitted PRNG program
+    placed straight into the training sharding — per-step host traffic is
+    one folded key instead of the whole batch (decisive on remote/tunneled
+    devices); ``host`` keeps the numpy path (composes with
+    ``param.prefetch`` to overlap the host→device transfer)."""
+    if ctx.params.get("data", "device") == "host":
+        return host_factory()
+    return device_factory(shardings=trainer.batch_sharding)
+
+
 def _jit_init(model, rng, x):
     """``model.init`` under jit: eager init dispatches every conv/norm op
     separately (tens of seconds for ResNet-50 on a cold process); one
@@ -112,6 +129,7 @@ def _run(
     # step) — the TensorBoard/XProf artifact for TPU perf work.
     profile_dir = ctx.params.get("profile_dir")
     profiling = [False]
+    window = [0.0, 0]  # wall time and step count since the last synced step
 
     def on_step(s: StepStats) -> None:
         if s.step == first_local_step:
@@ -130,8 +148,18 @@ def _run(
                 except Exception as exc:  # noqa: BLE001
                     ctx.progress["profile_error"] = str(exc)
         ctx.progress["steps_done"] = s.step
-        ctx.progress["last_loss"] = s.loss
-        ctx.progress["last_step_time_s"] = round(s.step_time_s, 4)
+        # Under sync_every > 1, async steps record dispatch-only times and
+        # the next synced step absorbs the whole window's device work —
+        # neither is a per-step time by itself, so publish the window
+        # average at each synced step (loss is only known there too).
+        window[0] += s.step_time_s
+        window[1] += 1
+        if s.loss is not None:
+            ctx.progress["last_loss"] = s.loss
+            ctx.progress["last_step_time_s"] = round(
+                window[0] / window[1], 4
+            )
+            window[0], window[1] = 0.0, 0
         now = time.time()
         if ctx.publish is not None and (
             s.step == first_local_step or now - last_publish[0] > 1.0
@@ -177,10 +205,21 @@ def mnist(ctx: JobContext) -> None:
             lambda p, x: model.apply({"params": p}, x), params, mesh,
             TrainConfig(optimizer="sgd", learning_rate=0.01,
                         save_every=_save_every(ctx),
-                        prefetch=_prefetch(ctx)),
+                        prefetch=_prefetch(ctx),
+                        sync_every=_sync_every(ctx)),
             checkpoint=_checkpoint_store(ctx),
         )
-        _run(ctx, trainer, datasets.mnist_batches(batch_size), steps)
+        _run(
+            ctx, trainer,
+            _batches(
+                ctx, trainer,
+                lambda: datasets.mnist_batches(batch_size),
+                lambda shardings: datasets.device_mnist_batches(
+                    batch_size, shardings=shardings
+                ),
+            ),
+            steps,
+        )
 
 
 @register_entrypoint("resnet50")
@@ -204,11 +243,19 @@ def resnet50(ctx: JobContext) -> None:
             lambda p, x: model.apply({"params": p}, x), params, mesh,
             TrainConfig(optimizer="sgd", learning_rate=0.1,
                         save_every=_save_every(ctx),
-                        prefetch=_prefetch(ctx)),
+                        prefetch=_prefetch(ctx),
+                        sync_every=_sync_every(ctx)),
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
-            ctx, trainer, datasets.imagenet_batches(batch_size, image_size),
+            ctx, trainer,
+            _batches(
+                ctx, trainer,
+                lambda: datasets.imagenet_batches(batch_size, image_size),
+                lambda shardings: datasets.device_imagenet_batches(
+                    batch_size, image_size, shardings=shardings
+                ),
+            ),
             steps,
         )
 
@@ -244,12 +291,22 @@ def bert(ctx: JobContext) -> None:
                 labels_follow_seq=True,
                 save_every=_save_every(ctx),
                 prefetch=_prefetch(ctx),
+                sync_every=_sync_every(ctx),
             ),
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
             ctx, trainer,
-            datasets.token_batches(batch_size, seq_len, cfg.vocab_size), steps,
+            _batches(
+                ctx, trainer,
+                lambda: datasets.token_batches(
+                    batch_size, seq_len, cfg.vocab_size
+                ),
+                lambda shardings: datasets.device_token_batches(
+                    batch_size, seq_len, cfg.vocab_size, shardings=shardings
+                ),
+            ),
+            steps,
         )
 
 
@@ -308,14 +365,21 @@ def gpt(ctx: JobContext) -> None:
                 aux_loss_in_output=True,
                 save_every=_save_every(ctx),
                 prefetch=_prefetch(ctx),
+                sync_every=_sync_every(ctx),
             ),
             loss_fn=loss_fn,
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
             ctx, trainer,
-            datasets.causal_token_batches(
-                batch_size, seq_len, cfg.vocab_size
+            _batches(
+                ctx, trainer,
+                lambda: datasets.causal_token_batches(
+                    batch_size, seq_len, cfg.vocab_size
+                ),
+                lambda shardings: datasets.device_causal_token_batches(
+                    batch_size, seq_len, cfg.vocab_size, shardings=shardings
+                ),
             ),
             steps,
         )

@@ -52,6 +52,13 @@ class TrainConfig:
     # Batches ahead to place on device from a background thread (0 = off).
     # Hides host→device transfer behind compute (workloads.data.Prefetcher).
     prefetch: int = 0
+    # Block on the loss every N steps (1 = every step). Fetching a scalar
+    # is a full host↔device round trip — ~80 ms on a tunneled device,
+    # swamping a ~20 ms train step — so steady-state throughput needs the
+    # sync amortized: intermediate steps dispatch async (their StepStats
+    # carry loss=None), and the periodic synced step's wall time absorbs
+    # the queued device work, keeping the *average* step time honest.
+    sync_every: int = 1
 
     def make_optimizer(self) -> optax.GradientTransformation:
         if self.optimizer == "adamw":
@@ -65,7 +72,7 @@ class TrainConfig:
 @dataclass
 class StepStats:
     step: int
-    loss: float
+    loss: Optional[float]  # None on async (non-synced) steps
     step_time_s: float
 
 
@@ -144,10 +151,12 @@ class Trainer:
             for k, v in batch.items()
         }
 
-    def step(self, batch: Dict[str, Any]) -> StepStats:
+    def step(self, batch: Dict[str, Any], sync: bool = True) -> StepStats:
         t0 = time.perf_counter()
         self.state, loss = self._step(self.state, self.put_batch(batch))
-        loss = float(loss)  # blocks; keeps step-time numbers honest
+        # Blocking keeps the step-time numbers honest; sync=False lets the
+        # caller amortize the round trip (see TrainConfig.sync_every).
+        loss = float(loss) if sync else None
         self.steps_done += 1
         if (
             self.checkpoint is not None
@@ -178,16 +187,35 @@ class Trainer:
                 batches, self.put_batch, self.config.prefetch
             )
             batches = prefetcher  # step's put_batch is a no-op re-place
+        se = max(1, self.config.sync_every)
+        first = self.steps_done + 1
         stats = []
         try:
             while self.steps_done < steps:
                 if should_stop is not None and should_stop():
                     break
-                s = self.step(next(batches))
+                nxt = self.steps_done + 1
+                # Always sync the first step (the tick→first-step anchor
+                # must be device-completed, not merely dispatched) and the
+                # last (so run() returns with the device drained).
+                sync = (
+                    nxt == first or nxt >= steps
+                    or (nxt - first) % se == se - 1
+                )
+                s = self.step(next(batches), sync=sync)
                 stats.append(s)
                 if on_step is not None:
                     on_step(s)
         finally:
+            if stats and stats[-1].loss is None:
+                # Exited (should_stop / exception) behind async steps:
+                # drain the device before teardown — never leave programs
+                # in flight (chip hygiene) — and charge the drain to the
+                # last step so avg_step_time_s stays honest instead of
+                # averaging dispatch-only times.
+                t0 = time.perf_counter()
+                jax.block_until_ready(self.state)
+                stats[-1].step_time_s += time.perf_counter() - t0
             if prefetcher is not None:
                 prefetcher.close()
         if self.checkpoint is not None:
