@@ -136,6 +136,30 @@ def build_parser() -> argparse.ArgumentParser:
     desc.add_argument("resource", choices=["cron"])
     desc.add_argument("name")
     _add_connection_flags(desc)
+
+    # The reference's operational verbs are kubectl idioms: suspend is
+    # `kubectl patch cron ... spec.suspend=true` (the gate the reconciler
+    # honors at cron_controller.go:169-173); a manual run is `kubectl
+    # create job --from=cronjob/...`. Standalone mode has no kubectl, so
+    # the CLI carries them.
+    for verb, desc_text in (
+        ("suspend", "set spec.suspend=true (ticks stop firing)"),
+        ("resume", "clear spec.suspend (ticks fire again)"),
+    ):
+        v = sub.add_parser(verb, help=desc_text)
+        v.add_argument("resource", choices=["cron"])
+        v.add_argument("name")
+        _add_connection_flags(v)
+
+    trig = sub.add_parser(
+        "trigger",
+        help="instantiate a Cron's workload template once, immediately "
+             "(kubectl create job --from=cronjob analog); ignores "
+             "suspend/deadline/concurrency gates",
+    )
+    trig.add_argument("resource", choices=["cron"])
+    trig.add_argument("name")
+    _add_connection_flags(trig)
     return parser
 
 
@@ -494,6 +518,132 @@ def cmd_describe(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_suspend(args: argparse.Namespace, suspend: bool) -> int:
+    """Flip ``spec.suspend`` (the reference's ``kubectl patch`` idiom; the
+    reconciler stops/starts ticking on the watch event,
+    ``cron_controller.go:169-173``). Read-modify-update with a conflict
+    retry: the primary use case is suspending a cron the live operator is
+    actively reconciling, so a status patch landing between GET and PUT
+    (resourceVersion bump) must not fail the command."""
+    from cron_operator_tpu.runtime.kube import (
+        ApiError,
+        ConflictError,
+        NotFoundError,
+    )
+
+    api = _client_from_args(args)
+    try:
+        for attempt in range(5):
+            try:
+                cron = api.get("apps.kubedl.io/v1alpha1", "Cron",
+                               args.namespace, args.name)
+            except NotFoundError:
+                print(f"error: cron {args.namespace}/{args.name} not found",
+                      file=sys.stderr)
+                return 1
+            already = bool((cron.get("spec") or {}).get("suspend", False))
+            if already == suspend:
+                print(f"cron.apps.kubedl.io/{args.name} unchanged "
+                      f"(suspend={str(suspend).lower()})")
+                return 0
+            cron.setdefault("spec", {})["suspend"] = suspend
+            try:
+                api.update(cron)
+            except ConflictError:
+                continue  # re-read the bumped resourceVersion and retry
+            print(f"cron.apps.kubedl.io/{args.name} "
+                  f"{'suspended' if suspend else 'resumed'}")
+            return 0
+        print("error: persistent resourceVersion conflicts (5 attempts)",
+              file=sys.stderr)
+        return 1
+    except ApiError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    finally:
+        api.stop()
+
+
+def cmd_trigger(args: argparse.Namespace) -> int:
+    """Create one workload from the Cron's template right now — the
+    ``kubectl create job --from=cronjob/<name>`` analog. Deliberately
+    bypasses the reconciler's scheduling gates (suspend/deadline/
+    concurrency): a manual trigger is an operator saying "run it anyway".
+    Everything else matches a scheduled run — shared ownership stamping
+    (cron-name label + owner-ref via ``attach_cron_ownership``, so status
+    sync, history and cascade-GC pick it up) and the same TPU admission/
+    topology injection the tick path applies before POSTing."""
+    import copy as _copy
+    import time as _time
+
+    from cron_operator_tpu.backends.tpu import inject_tpu_topology
+    from cron_operator_tpu.controller.workload import attach_cron_ownership
+    from cron_operator_tpu.runtime.kube import (
+        AlreadyExistsError,
+        ApiError,
+        NotFoundError,
+    )
+
+    api = _client_from_args(args)
+    try:
+        try:
+            cron = api.get("apps.kubedl.io/v1alpha1", "Cron",
+                           args.namespace, args.name)
+        except NotFoundError:
+            print(f"error: cron {args.namespace}/{args.name} not found",
+                  file=sys.stderr)
+            return 1
+        template = ((cron.get("spec") or {}).get("template") or {}).get(
+            "workload")
+        if (
+            not template
+            or not template.get("kind")
+            or not template.get("apiVersion")
+        ):
+            print("error: cron has no workload template with "
+                  "apiVersion + kind", file=sys.stderr)
+            return 1
+
+        w = _copy.deepcopy(template)
+        meta = w.setdefault("metadata", {})
+        meta.pop("generateName", None)
+        # "-manual-" keeps manual runs visually distinct from scheduled
+        # ones (whose names encode the tick unix time) and out of the
+        # deterministic-name fail-over guard's namespace.
+        meta["name"] = f"{args.name}-manual-{int(_time.time())}"
+        attach_cron_ownership(
+            w, args.name, (cron.get("metadata") or {}).get("uid"),
+            args.namespace,
+        )
+        # Same TPU seam as the tick path (cron_controller reconcile):
+        # nodeSelectors / chip resources / replicas=hosts / coordinator
+        # env must be on the object we POST; invalid annotations fail the
+        # command the way FailedTPUAdmission fails the tick.
+        try:
+            inject_tpu_topology(w)
+        except ValueError as err:
+            print(f"error: TPU admission failed: {err}", file=sys.stderr)
+            return 1
+        try:
+            created = api.create(w)
+        except AlreadyExistsError:
+            print(f"error: {meta['name']} already exists (retry in 1s)",
+                  file=sys.stderr)
+            return 1
+        api.record_event(
+            cron, "Normal", "ManualTrigger",
+            f"manually triggered workload {meta['name']}",
+        )
+        kind = created.get("kind", "workload")
+        print(f"{kind.lower()}/{created['metadata']['name']} created")
+    except ApiError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    finally:
+        api.stop()
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -503,6 +653,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_get(args)
     if args.command == "describe":
         return cmd_describe(args)
+    if args.command == "suspend":
+        return cmd_suspend(args, suspend=True)
+    if args.command == "resume":
+        return cmd_suspend(args, suspend=False)
+    if args.command == "trigger":
+        return cmd_trigger(args)
     parser.print_help()
     return 0
 

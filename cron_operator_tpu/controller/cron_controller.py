@@ -50,6 +50,7 @@ from cron_operator_tpu.api.v1alpha1 import (
 )
 from cron_operator_tpu.controller.schedule import parse_standard
 from cron_operator_tpu.controller.workload import (
+    attach_cron_ownership,
     get_default_job_name,
     is_workload_finished,
     get_job_status,
@@ -472,21 +473,10 @@ class CronReconciler:
             # reference, which mutates its deepcopy at :369).
             cron.spec.concurrency_policy = ConcurrencyPolicy.FORBID
 
-        meta["namespace"] = cron.metadata.namespace
-        labels = meta.get("labels") or {}
-        labels[LABEL_CRON_NAME] = cron.metadata.name
-        meta["labels"] = labels
-        meta["ownerReferences"] = [
-            {
-                "apiVersion": API_VERSION,
-                "kind": KIND_CRON,
-                "name": cron.metadata.name,
-                "uid": cron.metadata.uid,
-                "controller": True,
-                "blockOwnerDeletion": True,
-            }
-        ]
-        return w
+        return attach_cron_ownership(
+            w, cron.metadata.name, cron.metadata.uid,
+            cron.metadata.namespace,
+        )
 
     def _get_next_schedule(
         self, cron: Cron, now: datetime

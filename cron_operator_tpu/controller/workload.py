@@ -15,6 +15,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from cron_operator_tpu.api.scheme import GVK, gvk_of
 from cron_operator_tpu.api.v1alpha1 import (
+    API_VERSION,
+    KIND_CRON,
+    LABEL_CRON_NAME,
     Cron,
     JobStatus,
     job_status_from_unstructured,
@@ -22,6 +25,35 @@ from cron_operator_tpu.api.v1alpha1 import (
 )
 
 Unstructured = Dict[str, Any]
+
+
+def attach_cron_ownership(
+    workload: Unstructured, cron_name: str, cron_uid: Optional[str],
+    namespace: str,
+) -> Unstructured:
+    """Stamp a template-instantiated workload with the Cron's ownership
+    contract (``cron_controller.go:371-384``): namespace, the
+    ``kubedl.io/cron-name`` tracking label (how ``listWorkloads`` finds
+    it), and the controller owner-ref (cascade GC + ``Owns`` watches).
+    Shared by the reconciler's tick path and the CLI's manual ``trigger``
+    so both produce workloads that status sync / history / concurrency
+    treat identically."""
+    meta = workload.setdefault("metadata", {})
+    meta["namespace"] = namespace
+    labels = meta.get("labels") or {}
+    labels[LABEL_CRON_NAME] = cron_name
+    meta["labels"] = labels
+    meta["ownerReferences"] = [
+        {
+            "apiVersion": API_VERSION,
+            "kind": KIND_CRON,
+            "name": cron_name,
+            "uid": cron_uid,
+            "controller": True,
+            "blockOwnerDeletion": True,
+        }
+    ]
+    return workload
 
 
 class WorkloadTemplateError(ValueError):

@@ -468,3 +468,78 @@ class TestDescribeSubcommand:
         captured = capsys.readouterr()
         assert rc == 1
         assert "not found" in captured.err
+
+
+class TestOperationalVerbs:
+    """`suspend` / `resume` / `trigger` — the reference's kubectl idioms
+    (`kubectl patch ... spec.suspend`, `kubectl create job --from=cronjob`)
+    carried by the CLI for standalone deployments."""
+
+    def test_suspend_and_resume_flip_spec(self, server, client, capsys):
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        client.create(make_cron("pausable", schedule="*/5 * * * *"))
+
+        rc = cli_main(["suspend", "cron", "pausable",
+                       "--server", server.url, "--token", TOKEN])
+        assert rc == 0
+        assert "suspended" in capsys.readouterr().out
+        cron = client.get("apps.kubedl.io/v1alpha1", "Cron",
+                          "default", "pausable")
+        assert cron["spec"]["suspend"] is True
+
+        # idempotent: suspending a suspended cron reports unchanged
+        rc = cli_main(["suspend", "cron", "pausable",
+                       "--server", server.url, "--token", TOKEN])
+        assert rc == 0
+        assert "unchanged" in capsys.readouterr().out
+
+        rc = cli_main(["resume", "cron", "pausable",
+                       "--server", server.url, "--token", TOKEN])
+        assert rc == 0
+        assert "resumed" in capsys.readouterr().out
+        cron = client.get("apps.kubedl.io/v1alpha1", "Cron",
+                          "default", "pausable")
+        assert cron["spec"]["suspend"] is False
+
+    def test_trigger_creates_labeled_owned_workload(self, server, client,
+                                                    capsys):
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        client.create(make_cron("manual", schedule="0 0 1 1 *"))
+
+        rc = cli_main(["trigger", "cron", "manual",
+                       "--server", server.url, "--token", TOKEN])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "jaxjob/manual-manual-" in out
+
+        jobs = client.list("kubeflow.org/v1", "JAXJob",
+                           namespace="default")
+        mine = [j for j in jobs
+                if j["metadata"]["name"].startswith("manual-manual-")]
+        assert len(mine) == 1
+        meta = mine[0]["metadata"]
+        # labeled + owner-ref'd like a scheduled run: status sync, history
+        # and cascade GC pick it up unmodified
+        assert meta["labels"]["kubedl.io/cron-name"] == "manual"
+        owner = meta["ownerReferences"][0]
+        assert owner["kind"] == "Cron" and owner["name"] == "manual"
+        # TPU admission ran, same as the tick path: scheduling metadata is
+        # on the POSTed object (make_cron's template is v5e 2x2)
+        pod = (mine[0]["spec"]["replicaSpecs"]["Worker"]["template"]
+               ["spec"])
+        assert "gke-tpu-topology" in str(pod.get("nodeSelector", {}))
+        # the manual run is visible as an event on the cron
+        events = client.list("v1", "Event", "default")
+        assert any(e.get("reason") == "ManualTrigger" for e in events)
+
+    def test_verbs_fail_cleanly_on_missing_cron(self, server, capsys):
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        for verb in ("suspend", "resume", "trigger"):
+            rc = cli_main([verb, "cron", "ghost",
+                           "--server", server.url, "--token", TOKEN])
+            captured = capsys.readouterr()
+            assert rc == 1
+            assert "not found" in captured.err
