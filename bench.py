@@ -243,6 +243,47 @@ def _attention_microbench(platform, timeout: float):
         return {"error": f"unparseable output: {out.stdout[-200:]}"}
 
 
+def _lm_bench(platform, timeout: float) -> dict:
+    """BERT-base seq-512 steady-state throughput via the runner subprocess
+    — the language-model leg of the BASELINE configs (the tick→first-step
+    headline uses ResNet-50; this evidences the transformer/attention
+    path end-to-end on the same device). Skipped on the CPU fallback."""
+    if platform == "cpu":
+        return {"skipped": "cpu fallback"}
+    args = [
+        sys.executable, "-m", "cron_operator_tpu.workloads.runner",
+        "bert", "steps=12", "batch_size=8", "seq_len=512", "sync_every=6",
+    ]
+    try:
+        out = subprocess.run(args, capture_output=True, text=True,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"error": f"exceeded {timeout:.0f}s"}
+    if out.returncode != 0:
+        return {"error": f"rc={out.returncode}: "
+                         f"{(out.stderr or '').strip()[-400:]}"}
+    from cron_operator_tpu.workloads.runner import PROGRESS_PREFIX
+
+    progress = {}
+    for line in out.stdout.splitlines():
+        if line.startswith(PROGRESS_PREFIX):
+            try:
+                msg = json.loads(line[len(PROGRESS_PREFIX):])
+            except ValueError:
+                continue
+            progress = msg.get("progress") or progress
+    if not progress.get("steps_per_s"):
+        return {"error": f"no steady-state progress parsed: "
+                         f"{out.stdout[-200:]}"}
+    return {
+        "model": "bert-base", "batch_size": 8, "seq_len": 512,
+        "steps_per_s": progress["steps_per_s"],
+        "avg_step_time_s": progress.get("avg_step_time_s"),
+        "tokens_per_s": round(8 * 512 * progress["steps_per_s"], 1),
+        "last_loss": progress.get("last_loss"),
+    }
+
+
 def _control_plane_bench(n_crons: int = 300) -> dict:
     """Scheduling-throughput microbench — no device involved.
 
@@ -367,6 +408,7 @@ def main() -> int:
         return _emit(None, extra, error=f"prewarm failed: {warm.get('error')}")
 
     extra["attention_bench"] = _attention_microbench(platform, timeout=300.0)
+    extra["lm_bench"] = _lm_bench(platform, timeout=240.0)
     try:
         extra["control_plane"] = _control_plane_bench()
     except Exception as exc:  # noqa: BLE001 — a microbench must not

@@ -160,6 +160,15 @@ def build_parser() -> argparse.ArgumentParser:
     trig.add_argument("resource", choices=["cron"])
     trig.add_argument("name")
     _add_connection_flags(trig)
+
+    dele = sub.add_parser(
+        "delete",
+        help="delete a Cron (kubectl delete analog); owned workloads are "
+             "cascade-collected via their owner references",
+    )
+    dele.add_argument("resource", choices=["cron"])
+    dele.add_argument("name")
+    _add_connection_flags(dele)
     return parser
 
 
@@ -644,6 +653,30 @@ def cmd_trigger(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_delete(args: argparse.Namespace) -> int:
+    """kubectl-delete analog. Background propagation — owned workloads go
+    via their owner references (the store's cascade GC; a real apiserver's
+    garbage collector)."""
+    from cron_operator_tpu.runtime.kube import ApiError, NotFoundError
+
+    api = _client_from_args(args)
+    try:
+        try:
+            api.delete("apps.kubedl.io/v1alpha1", "Cron",
+                       args.namespace, args.name, propagation="Background")
+        except NotFoundError:
+            print(f"error: cron {args.namespace}/{args.name} not found",
+                  file=sys.stderr)
+            return 1
+        print(f"cron.apps.kubedl.io/{args.name} deleted")
+    except ApiError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    finally:
+        api.stop()
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -659,6 +692,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_suspend(args, suspend=False)
     if args.command == "trigger":
         return cmd_trigger(args)
+    if args.command == "delete":
+        return cmd_delete(args)
     parser.print_help()
     return 0
 

@@ -543,3 +543,43 @@ class TestOperationalVerbs:
             captured = capsys.readouterr()
             assert rc == 1
             assert "not found" in captured.err
+
+
+class TestDeleteSubcommand:
+    def test_delete_cascades_to_owned_workloads(self, server, client,
+                                                capsys):
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        client.create(make_cron("doomed", schedule="0 0 1 1 *"))
+        rc = cli_main(["trigger", "cron", "doomed",
+                       "--server", server.url, "--token", TOKEN])
+        assert rc == 0
+        capsys.readouterr()
+
+        rc = cli_main(["delete", "cron", "doomed",
+                       "--server", server.url, "--token", TOKEN])
+        out = capsys.readouterr().out
+        assert rc == 0 and "deleted" in out
+        assert client.try_get("apps.kubedl.io/v1alpha1", "Cron",
+                              "default", "doomed") is None
+        # owner-ref cascade: the manually triggered workload goes too
+        import time as _t
+        deadline = _t.time() + 5
+        while _t.time() < deadline:
+            left = [
+                j for j in client.list("kubeflow.org/v1", "JAXJob",
+                                       namespace="default")
+                if j["metadata"]["name"].startswith("doomed-manual-")
+            ]
+            if not left:
+                break
+            _t.sleep(0.1)
+        assert not left
+
+    def test_delete_missing_fails_cleanly(self, server, capsys):
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        rc = cli_main(["delete", "cron", "ghost",
+                       "--server", server.url, "--token", TOKEN])
+        captured = capsys.readouterr()
+        assert rc == 1 and "not found" in captured.err
