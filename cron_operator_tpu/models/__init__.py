@@ -3,8 +3,10 @@
 These are the JAX analogs of the training containers the reference
 operator's example Crons launch (``/root/reference/examples/v1alpha1/cron/``
 runs PyTorch/TF MNIST-style images): an MLP for the MNIST acceptance
-configs, ResNet-50 for the v5e-16 north-star benchmark, and BERT for the
-long-context / v5e-64 config (BASELINE.md acceptance configs 1-5).
+configs, ResNet-50 for the v5e-16 north-star benchmark, BERT for the
+long-context / v5e-64 config (BASELINE.md acceptance configs 1-5), GPT
+(causal LM, optional MoE blocks) and ViT (attention on images, sharing
+BERT's encoder stack).
 
 All models are flax.linen modules with bf16 compute / f32 params by
 default (MXU-native), static shapes, and no Python control flow in the
@@ -15,8 +17,9 @@ from cron_operator_tpu.models.mlp import MLP
 from cron_operator_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from cron_operator_tpu.models.bert import Bert, BertConfig
 from cron_operator_tpu.models.gpt import GPT, GPTConfig
+from cron_operator_tpu.models.vit import ViT, ViTConfig
 
 __all__ = [
     "MLP", "ResNet", "ResNet18", "ResNet50", "Bert", "BertConfig",
-    "GPT", "GPTConfig",
+    "GPT", "GPTConfig", "ViT", "ViTConfig",
 ]

@@ -1,0 +1,109 @@
+"""Vision Transformer — attention on images, completing the zoo's coverage
+of the two data modalities × two architectures the acceptance workloads
+span (conv/image: ResNet; attention/text: BERT, GPT; attention/image: this).
+
+Reuses BERT's :class:`~cron_operator_tpu.models.bert.EncoderLayer`
+unchanged (the config is duck-typed — same field names), inheriting the
+bf16-compute/f32-param convention and the attention dispatcher. Note the
+token count is ``(size/patch)² + 1`` (CLS) — e.g. 197 for base/224 —
+which is never 128-aligned, so the dispatcher's ``auto`` resolves to XLA
+dense attention here (the right call regardless: at ~200 tokens dense
+wins; see ``ops/attention.py``'s crossover) and ``flash``/``ring``/
+``ulysses`` cannot be forced. The patch stem is one strided conv —
+MXU-native, exactly how the TPU wants patchification (no gather/reshape
+gymnastics).
+
+Reference parity note: the reference operator schedules arbitrary
+workload containers (examples are PyTorch/TF MNIST-style scripts,
+``/root/reference/examples/v1alpha1/cron/``); the model zoo is this
+build's in-tree analog of those containers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cron_operator_tpu.models.bert import EncoderLayer
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "auto"  # auto | flash | xla | ring | ulysses
+    attention_interpret: bool = False
+
+    @staticmethod
+    def base(**overrides) -> "ViTConfig":
+        return ViTConfig(**overrides)
+
+    @staticmethod
+    def tiny(**overrides) -> "ViTConfig":
+        defaults = dict(
+            image_size=32, patch_size=8, num_classes=10, hidden_size=64,
+            num_layers=2, num_heads=4, mlp_dim=256,
+        )
+        defaults.update(overrides)
+        return ViTConfig(**defaults)
+
+
+class ViT(nn.Module):
+    """NHWC images ``[batch, size, size, 3]`` → logits ``[batch, classes]``."""
+
+    config: ViTConfig = field(default_factory=ViTConfig)
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        if images.shape[1] % cfg.patch_size or images.shape[2] % cfg.patch_size:
+            raise ValueError(
+                f"image {images.shape[1]}x{images.shape[2]} not divisible "
+                f"by patch size {cfg.patch_size}"
+            )
+        # Patchify = one strided conv onto the hidden dim.
+        x = nn.Conv(
+            cfg.hidden_size, (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            dtype=cfg.dtype, name="patch_embed",
+        )(images.astype(cfg.dtype))
+        b = x.shape[0]
+        n = x.shape[1] * x.shape[2]
+        x = x.reshape(b, n, cfg.hidden_size)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, cfg.hidden_size)
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, cfg.hidden_size)).astype(cfg.dtype),
+             x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_emb", nn.initializers.normal(0.02),
+            (n + 1, cfg.hidden_size),
+        )
+        x = x + pos[None].astype(cfg.dtype)
+
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, mesh=self.mesh, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype)(x)
+        # Classification head on the CLS token; f32 logits for a stable
+        # softmax-cross-entropy.
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(
+            x[:, 0].astype(jnp.float32)
+        )
+
+
+__all__ = ["ViT", "ViTConfig"]

@@ -22,7 +22,16 @@ from typing import Any, Dict, Iterator, Optional
 import jax
 
 from cron_operator_tpu.backends.registry import JobContext, register_entrypoint
-from cron_operator_tpu.models import GPT, GPTConfig, MLP, Bert, BertConfig, ResNet50
+from cron_operator_tpu.models import (
+    GPT,
+    GPTConfig,
+    MLP,
+    Bert,
+    BertConfig,
+    ResNet50,
+    ViT,
+    ViTConfig,
+)
 from cron_operator_tpu.parallel.mesh import mesh_for_devices
 from cron_operator_tpu.workloads import data as datasets
 from cron_operator_tpu.workloads.train import StepStats, TrainConfig, Trainer
@@ -385,10 +394,64 @@ def gpt(ctx: JobContext) -> None:
         )
 
 
+@register_entrypoint("vit")
+def vit(ctx: JobContext) -> None:
+    """ViT classification on synthetic ImageNet — attention on images.
+
+    Params: steps(=10), batch_size(=64), image_size(=224), size(=base|tiny),
+    remat(=0). Attention is XLA dense — the (size/patch)²+1 token count is
+    never 128-aligned, so the flash/sequence-parallel paths don't apply
+    (see models/vit.py).
+    """
+    steps = int(ctx.params.get("steps", 10))
+    batch_size = int(ctx.params.get("batch_size", 64))
+    size = ctx.params.get("size", "base")
+    maker = ViTConfig.tiny if size == "tiny" else ViTConfig.base
+    cfg = maker()  # attention stays "auto"→xla; see docstring
+    image_size = int(ctx.params.get("image_size", cfg.image_size))
+    if image_size != cfg.image_size:
+        from dataclasses import replace
+
+        cfg = replace(cfg, image_size=image_size)
+    devs = _devices(ctx)
+    with jax.default_device(devs[0]):
+        mesh = _mesh(ctx, devs)
+        model = ViT(cfg, mesh=mesh)
+        params = _jit_init(
+            model, jax.random.PRNGKey(0),
+            _zeros((1, cfg.image_size, cfg.image_size, 3)),
+        )
+        trainer = Trainer(
+            lambda p, x: model.apply({"params": p}, x), params, mesh,
+            TrainConfig(
+                remat=ctx.params.get("remat", "0") in ("1", "true"),
+                save_every=_save_every(ctx),
+                prefetch=_prefetch(ctx),
+                sync_every=_sync_every(ctx),
+            ),
+            checkpoint=_checkpoint_store(ctx),
+        )
+        _run(
+            ctx, trainer,
+            _batches(
+                ctx, trainer,
+                lambda: datasets.imagenet_batches(
+                    batch_size, cfg.image_size,
+                    num_classes=cfg.num_classes,
+                ),
+                lambda shardings: datasets.device_imagenet_batches(
+                    batch_size, cfg.image_size,
+                    num_classes=cfg.num_classes, shardings=shardings,
+                ),
+            ),
+            steps,
+        )
+
+
 def _zeros(shape, dtype: Optional[str] = None):
     import jax.numpy as jnp
 
     return jnp.zeros(shape, dtype or jnp.float32)
 
 
-__all__ = ["mnist", "resnet50", "bert", "gpt"]
+__all__ = ["mnist", "resnet50", "bert", "gpt", "vit"]
