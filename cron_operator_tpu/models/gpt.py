@@ -72,6 +72,12 @@ class MoEBlock(nn.Module):
     """
 
     config: GPTConfig
+    # Decode steps route a batch-sized token pool; the training capacity
+    # factor over so few tokens drops colliding rows (capacity 1). Decode
+    # raises the factor to num_experts — capacity = batch, no drops ever —
+    # which is cheap at serving batch sizes and keeps cached decode
+    # numerically aligned with a no-drop forward.
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> tuple:
@@ -90,8 +96,12 @@ class MoEBlock(nn.Module):
         }
         b, s, _ = x.shape
         flat = x.reshape(b * s, d)
+        cf = (
+            max(cfg.moe_capacity_factor, float(e)) if self.decode
+            else cfg.moe_capacity_factor
+        )
         y, aux = moe_ffn(
-            params, flat, capacity_factor=cfg.moe_capacity_factor,
+            params, flat, capacity_factor=cf,
             compute_dtype=cfg.dtype,
         )
         return y.reshape(b, s, d).astype(cfg.dtype), aux
@@ -101,9 +111,19 @@ class DecoderLayer(nn.Module):
     config: GPTConfig
     mesh: Optional[jax.sharding.Mesh] = None
     use_moe: bool = False
+    # Serving modes (training uses neither): ``prefill`` runs the normal
+    # batched causal forward AND writes the whole prompt's K/V into the
+    # layer's cache in one pass; ``decode`` processes ONE token [b, 1, d]
+    # against that cache. The position index comes from the caller (one
+    # counter at the GPT level — per-layer counters kept in implicit
+    # lockstep would desynchronize silently if a layer were ever skipped).
+    decode: bool = False
+    prefill: bool = False
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> tuple:
+    def __call__(
+        self, x: jnp.ndarray, pos_idx: Optional[jnp.ndarray] = None
+    ) -> tuple:
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_heads
 
@@ -113,10 +133,15 @@ class DecoderLayer(nn.Module):
             name="qkv",
         )(y)
         q, k, v = (qkv[:, :, i] for i in range(3))
-        attn = multi_head_attention(
-            q, k, v, causal=True, impl=cfg.attention_impl, mesh=self.mesh,
-            interpret=cfg.attention_interpret,
-        )
+        if self.decode:
+            attn = self._decode_attention(q, k, v, pos_idx)
+        else:
+            attn = multi_head_attention(
+                q, k, v, causal=True, impl=cfg.attention_impl,
+                mesh=self.mesh, interpret=cfg.attention_interpret,
+            )
+            if self.prefill:
+                self._write_prefill_cache(k, v)
         attn = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out"
         )(attn)
@@ -125,12 +150,67 @@ class DecoderLayer(nn.Module):
         y = nn.LayerNorm(dtype=cfg.dtype)(x)
         aux = jnp.zeros((), jnp.float32)
         if self.use_moe:
-            y, aux = MoEBlock(cfg, name="moe")(y)
+            y, aux = MoEBlock(cfg, decode=self.decode, name="moe")(y)
         else:
             y = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype)(y)
             y = nn.gelu(y)
             y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(y)
         return x + y, aux
+
+    def _cache_vars(self, b, h, d):
+        cfg = self.config
+        zeros = lambda: jnp.zeros((b, cfg.max_len, h, d), cfg.dtype)  # noqa: E731
+        return (
+            self.variable("cache", "k", zeros),
+            self.variable("cache", "v", zeros),
+        )
+
+    def _write_prefill_cache(self, k, v):
+        """Batched cache fill: the whole prompt's K/V in ONE pass (a
+        per-token prefill would stream the full parameter set p times)."""
+        cfg = self.config
+        b, p, h, d = k.shape
+        cache_k, cache_v = self._cache_vars(b, h, d)
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(cfg.dtype), (0, 0, 0, 0)
+        )
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(cfg.dtype), (0, 0, 0, 0)
+        )
+
+    def _decode_attention(self, q, k, v, pos_idx):
+        """One-token attention against the layer's KV cache.
+
+        Cache layout ``[b, max_len, heads, head_dim]`` in ``cfg.dtype``
+        — the decode state is one pytree the driver carries through
+        ``lax.scan``. Static shapes throughout: the new K/V land via
+        dynamic_update_slice at ``pos_idx`` and masking (not slicing)
+        excludes the unwritten tail — the XLA-friendly decode shape (no
+        data-dependent dims; one [1, max_len] row per head,
+        bandwidth-bound as decode always is).
+        """
+        cfg = self.config
+        b, one, h, d = q.shape
+        assert one == 1, "decode processes one token per call"
+        assert pos_idx is not None, "decode needs the position index"
+        cache_k, cache_v = self._cache_vars(b, h, d)
+        cache_k.value = jax.lax.dynamic_update_slice(
+            cache_k.value, k.astype(cfg.dtype), (0, pos_idx, 0, 0)
+        )
+        cache_v.value = jax.lax.dynamic_update_slice(
+            cache_v.value, v.astype(cfg.dtype), (0, pos_idx, 0, 0)
+        )
+
+        scale = 1.0 / (d ** 0.5)
+        scores = jnp.einsum(
+            "bohd,bshd->bhs", q, cache_k.value,
+            preferred_element_type=jnp.float32,
+        ) * scale  # [b, h, max_len]
+        mask = jnp.arange(cfg.max_len) <= pos_idx  # written positions
+        scores = jnp.where(mask[None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhs,bshd->bhd", probs, cache_v.value)
+        return out.reshape(b, 1, h, d)
 
 
 class GPT(nn.Module):
@@ -146,6 +226,14 @@ class GPT(nn.Module):
 
     config: GPTConfig = field(default_factory=GPTConfig)
     mesh: Optional[jax.sharding.Mesh] = None
+    # Serving modes: ``prefill`` consumes the whole prompt [b, p] in one
+    # batched pass while populating the per-layer KV caches (flax "cache"
+    # collection, created on the first mutable apply); ``decode`` takes
+    # ONE token [b, 1] per step against those caches. A single position
+    # counter ("cache"/"step") lives here and is passed down to every
+    # layer. See workloads/generate.py for the scan driver.
+    decode: bool = False
+    prefill: bool = False
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray) -> tuple:
@@ -159,15 +247,31 @@ class GPT(nn.Module):
             (cfg.max_len, cfg.hidden_size),
         )
         s = input_ids.shape[1]
-        x = tok(input_ids) + pos[None, :s].astype(cfg.dtype)
+        pos_idx = None
+        if self.decode or self.prefill:
+            step = self.variable(
+                "cache", "step", lambda: jnp.zeros((), jnp.int32)
+            )
+        if self.decode:
+            pos_idx = step.value  # tokens consumed so far
+            p = jax.lax.dynamic_slice(
+                pos, (pos_idx, 0), (1, cfg.hidden_size)
+            )
+            step.value = pos_idx + 1
+            x = tok(input_ids) + p[None].astype(cfg.dtype)
+        else:
+            x = tok(input_ids) + pos[None, :s].astype(cfg.dtype)
+            if self.prefill:
+                step.value = jnp.asarray(s, jnp.int32)
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(cfg.num_layers):
             use_moe = (
                 cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
             )
             x, aux = DecoderLayer(
-                cfg, mesh=self.mesh, use_moe=use_moe, name=f"layer_{i}"
-            )(x)
+                cfg, mesh=self.mesh, use_moe=use_moe, decode=self.decode,
+                prefill=self.prefill, name=f"layer_{i}",
+            )(x, pos_idx)
             aux_total = aux_total + aux
         x = nn.LayerNorm(dtype=cfg.dtype)(x)
         aux_out = cfg.moe_aux_weight * aux_total

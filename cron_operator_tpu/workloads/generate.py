@@ -1,0 +1,124 @@
+"""Autoregressive generation — the serving path for the GPT family.
+
+One compiled program per (shapes, steps): the prompt is consumed by a
+single batched causal pass that also populates the KV caches (prefill),
+then a ``lax.scan`` over a single-token decode step samples the
+continuation — the whole generation is one XLA computation with static
+shapes (the TPU-idiomatic decode: no Python loop per token, no
+recompilation per step, KV cache carried as scan state).
+
+The KV cache is the model's flax ``"cache"`` collection
+(:class:`models.gpt.GPT` with ``decode=True``): ``[b, max_len, h, d]``
+per layer plus write indices, created on the first mutable apply and
+threaded through the scans as a plain pytree.
+
+Decode is bandwidth-bound (one [1, max_len] attention row per head per
+step); batch is the throughput lever, exactly as on any accelerator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cron_operator_tpu.models.gpt import GPT, GPTConfig
+
+_COMPILED = {}  # (cfg, max_new, greedy) → jitted fn (shapes handled by jit)
+
+
+def generate(
+    config: GPTConfig,
+    params,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (``temperature=0``) or sampled continuation of each prompt.
+
+    ``prompt_ids`` is ``[batch, prompt_len]`` int32; returns
+    ``[batch, prompt_len + max_new_tokens]``. Compiled once per
+    (config, shapes, steps) and cached.
+    """
+    b, p = prompt_ids.shape
+    if p < 1:
+        raise ValueError("empty prompt")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if p + max_new_tokens > config.max_len:
+        raise ValueError(
+            f"prompt {p} + {max_new_tokens} new tokens exceeds "
+            f"max_len {config.max_len}"
+        )
+    if temperature < 0:
+        raise ValueError("temperature must be >= 0")
+    greedy = temperature == 0.0
+    if not greedy and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused in greedy mode
+
+    # jit specializes per input shape on its own; keying the wrapper by
+    # shapes too would just grow an unbounded duplicate cache.
+    key = (config, max_new_tokens, greedy)
+    fn = _COMPILED.get(key)
+    if fn is None:
+        fn = _build(config, max_new_tokens, greedy)
+        _COMPILED[key] = fn
+    return fn(params, prompt_ids, jnp.float32(max(temperature, 1e-6)), rng)
+
+
+def _build(config: GPTConfig, max_new: int, greedy: bool):
+    # Serving always wants logits (return_hidden is a training-loss
+    # fusion); MoE/aux outputs are ignored at decode time.
+    cfg = replace(config, return_hidden=False)
+    prefill_model = GPT(cfg, prefill=True)
+    decode_model = GPT(cfg, decode=True)
+
+    def step(params, cache, token):
+        """One decode step: [b, 1] token → ([b, vocab] logits, cache')."""
+        (logits, _), mut = decode_model.apply(
+            {"params": params, "cache": cache}, token, mutable=["cache"]
+        )
+        return logits[:, -1], mut["cache"]
+
+    def run(params, prompt, temperature, rng):
+        # Prefill: ONE batched causal pass consumes the whole prompt,
+        # creating and filling every layer's KV cache (a token-at-a-time
+        # prefill would stream the full parameter set p times).
+        (logits, _), mut = prefill_model.apply(
+            {"params": params}, prompt, mutable=["cache"]
+        )
+        cache = mut["cache"]
+
+        def sample(logits, key):
+            if greedy:
+                return jnp.argmax(logits, axis=-1)
+            return jax.random.categorical(key, logits / temperature)
+
+        keys = jax.random.split(rng, max_new)
+        first = sample(logits[:, -1], keys[0])
+
+        # Step-then-sample: each iteration feeds the previous token and
+        # samples from the fresh logits — exactly max_new − 1 decode
+        # forwards after the prefill (the final sampled token never needs
+        # a forward of its own).
+        def gen_body(carry, key):
+            prev, cache = carry
+            logits, cache = step(params, cache, prev[:, None])
+            nxt = sample(logits, key)
+            return (nxt, cache), nxt
+
+        _, rest = lax.scan(gen_body, (first, cache), keys[1:])
+        toks = jnp.concatenate([first[None], rest], axis=0)  # [max_new, b]
+        return jnp.concatenate([prompt, toks.T.astype(prompt.dtype)], axis=1)
+
+    return jax.jit(run)
+
+
+__all__ = ["generate"]
