@@ -11,7 +11,9 @@ its metrics stop at reconcile counts, SURVEY.md §5).
 Common params (all optional, all strings): ``steps``, ``batch_size``,
 ``platform`` (force ``cpu`` for tests), ``tensor``/``seq``/``fsdp`` (mesh
 axis sizes), ``data`` (``device`` default | ``host`` — see
-:func:`_batches`). Model-specific params documented per entrypoint.
+:func:`_batches`), ``lr``/``lr_schedule``/``warmup_steps``/
+``schedule_steps``/``sync_every`` (see :func:`_train_kwargs`).
+Model-specific params documented per entrypoint.
 """
 
 from __future__ import annotations
@@ -99,6 +101,26 @@ def _prefetch(ctx: JobContext) -> int:
 
 def _sync_every(ctx: JobContext) -> int:
     return int(ctx.params.get("sync_every", 1))
+
+
+def _train_kwargs(ctx: JobContext, steps: int, **defaults) -> dict:
+    """TrainConfig kwargs shared by every entrypoint: per-entrypoint
+    defaults overridden by the common ``param.*`` surface — ``lr``,
+    ``lr_schedule`` (constant|cosine|warmup_cosine), ``warmup_steps``,
+    ``schedule_steps`` (defaults to the run's total-step target),
+    ``save_every``, ``prefetch``, ``sync_every``."""
+    kw = dict(defaults)
+    kw.update(
+        save_every=_save_every(ctx),
+        prefetch=_prefetch(ctx),
+        sync_every=_sync_every(ctx),
+        lr_schedule=ctx.params.get("lr_schedule", "constant"),
+        warmup_steps=int(ctx.params.get("warmup_steps", 0)),
+        schedule_steps=int(ctx.params.get("schedule_steps", steps)),
+    )
+    if "lr" in ctx.params:
+        kw["learning_rate"] = float(ctx.params["lr"])
+    return kw
 
 
 def _batches(ctx: JobContext, trainer: Trainer, host_factory, device_factory):
@@ -212,10 +234,9 @@ def mnist(ctx: JobContext) -> None:
         params = _jit_init(model, jax.random.PRNGKey(0), _zeros((1, 28, 28, 1)))
         trainer = Trainer(
             lambda p, x: model.apply({"params": p}, x), params, mesh,
-            TrainConfig(optimizer="sgd", learning_rate=0.01,
-                        save_every=_save_every(ctx),
-                        prefetch=_prefetch(ctx),
-                        sync_every=_sync_every(ctx)),
+            TrainConfig(**_train_kwargs(
+                ctx, steps, optimizer="sgd", learning_rate=0.01,
+            )),
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
@@ -250,10 +271,9 @@ def resnet50(ctx: JobContext) -> None:
         )
         trainer = Trainer(
             lambda p, x: model.apply({"params": p}, x), params, mesh,
-            TrainConfig(optimizer="sgd", learning_rate=0.1,
-                        save_every=_save_every(ctx),
-                        prefetch=_prefetch(ctx),
-                        sync_every=_sync_every(ctx)),
+            TrainConfig(**_train_kwargs(
+                ctx, steps, optimizer="sgd", learning_rate=0.1,
+            )),
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
@@ -294,14 +314,12 @@ def bert(ctx: JobContext) -> None:
         )
         trainer = Trainer(
             lambda p, x: model.apply({"params": p}, x), params, mesh,
-            TrainConfig(
+            TrainConfig(**_train_kwargs(
+                ctx, steps,
                 remat=ctx.params.get("remat", "0") in ("1", "true"),
                 seq_dim_in_batch=1,
                 labels_follow_seq=True,
-                save_every=_save_every(ctx),
-                prefetch=_prefetch(ctx),
-                sync_every=_sync_every(ctx),
-            ),
+            )),
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
@@ -367,15 +385,13 @@ def gpt(ctx: JobContext) -> None:
             return model.apply({"params": p}, x)
         trainer = Trainer(
             apply_fn, params, mesh,
-            TrainConfig(
+            TrainConfig(**_train_kwargs(
+                ctx, steps,
                 remat=ctx.params.get("remat", "0") in ("1", "true"),
                 seq_dim_in_batch=1,
                 labels_follow_seq=True,
                 aux_loss_in_output=True,
-                save_every=_save_every(ctx),
-                prefetch=_prefetch(ctx),
-                sync_every=_sync_every(ctx),
-            ),
+            )),
             loss_fn=loss_fn,
             checkpoint=_checkpoint_store(ctx),
         )
@@ -423,12 +439,10 @@ def vit(ctx: JobContext) -> None:
         )
         trainer = Trainer(
             lambda p, x: model.apply({"params": p}, x), params, mesh,
-            TrainConfig(
+            TrainConfig(**_train_kwargs(
+                ctx, steps,
                 remat=ctx.params.get("remat", "0") in ("1", "true"),
-                save_every=_save_every(ctx),
-                prefetch=_prefetch(ctx),
-                sync_every=_sync_every(ctx),
-            ),
+            )),
             checkpoint=_checkpoint_store(ctx),
         )
         _run(
@@ -448,10 +462,77 @@ def vit(ctx: JobContext) -> None:
         )
 
 
+@register_entrypoint("generate")
+def generate_job(ctx: JobContext) -> None:
+    """Scheduled batch inference: GPT KV-cache generation as a Cron
+    workload (nightly eval/sampling jobs — the serving-side counterpart
+    of the training entrypoints). Each round generates a batch of
+    continuations from synthetic prompts; progress reports rounds and
+    sustained tokens/s.
+
+    Params: rounds(=1), batch_size(=8), prompt_len(=32), max_new(=128),
+    temperature(=0 → greedy), size(=base|tiny).
+    """
+    from cron_operator_tpu.workloads.generate import generate
+
+    rounds = int(ctx.params.get("rounds", 1))
+    batch_size = int(ctx.params.get("batch_size", 8))
+    prompt_len = int(ctx.params.get("prompt_len", 32))
+    max_new = int(ctx.params.get("max_new", 128))
+    temperature = float(ctx.params.get("temperature", 0))
+    size = ctx.params.get("size", "base")
+    devs = _devices(ctx)
+    with jax.default_device(devs[0]):
+        maker = GPTConfig.tiny if size == "tiny" else GPTConfig
+        cfg = maker(max_len=prompt_len + max_new)
+        model = GPT(cfg)
+        params = _jit_init(
+            model, jax.random.PRNGKey(0),
+            _zeros((1, prompt_len), dtype="int32"),
+        )
+        key = jax.random.PRNGKey(int(ctx.params.get("seed", 0)))
+        ctx.progress["started_at"] = time.time()
+        total_tokens = 0
+        steady_t0 = None
+        for r in range(rounds):
+            if ctx.should_stop is not None and ctx.should_stop():
+                break
+            kp, ks = jax.random.split(jax.random.fold_in(key, r))
+            prompt = jax.random.randint(
+                kp, (batch_size, prompt_len), 0, cfg.vocab_size,
+                dtype=jax.numpy.int32,
+            )
+            out = generate(
+                cfg, params, prompt, max_new,
+                temperature=temperature,
+                rng=ks if temperature > 0 else None,
+            )
+            int(out[0, -1])  # value fetch = true device sync
+            now = time.time()
+            if r == 0:
+                # Round 0 carries the compile; steady throughput starts
+                # after it (mirrors the trainers' first-step convention).
+                ctx.progress["first_step_at"] = now
+                steady_t0 = now
+            else:
+                total_tokens += batch_size * max_new
+                elapsed = now - steady_t0
+                if elapsed > 0:
+                    ctx.progress["tokens_per_s"] = round(
+                        total_tokens / elapsed, 1
+                    )
+            ctx.progress["steps_done"] = r + 1
+            ctx.progress["tokens_generated"] = (
+                (r + 1) * batch_size * max_new
+            )
+            if ctx.publish is not None:
+                ctx.publish()
+
+
 def _zeros(shape, dtype: Optional[str] = None):
     import jax.numpy as jnp
 
     return jnp.zeros(shape, dtype or jnp.float32)
 
 
-__all__ = ["mnist", "resnet50", "bert", "gpt", "vit"]
+__all__ = ["mnist", "resnet50", "bert", "gpt", "vit", "generate_job"]

@@ -41,6 +41,15 @@ class TrainConfig:
     learning_rate: float = 1e-3
     weight_decay: float = 1e-4
     optimizer: str = "adamw"  # adamw | sgd
+    # Learning-rate schedule: "constant", "cosine" (decay to 0 over
+    # schedule_steps), or "warmup_cosine" (linear 0→lr over warmup_steps,
+    # then cosine to 0 at schedule_steps). Schedules are optax functions
+    # evaluated on the optimizer step count, so checkpoint resume lands at
+    # the right point of the curve for free (step travels in TrainState).
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    schedule_steps: int = 0  # decay horizon; entrypoints default it to
+    # the run's total-step target
     remat: bool = False  # jax.checkpoint the forward (HBM ↔ FLOPs trade)
     seq_dim_in_batch: Optional[int] = None  # dim of x sharded over `seq`
     labels_follow_seq: bool = False  # labels carry the seq dim too (MLM)
@@ -60,12 +69,45 @@ class TrainConfig:
     # the queued device work, keeping the *average* step time honest.
     sync_every: int = 1
 
+    def lr_at(self):
+        """The learning rate as an optax schedule (callable on the step
+        count) — what make_optimizer feeds the optimizer for decaying
+        schedules, and directly evaluable for tests/logging."""
+        if self.lr_schedule == "constant":
+            return optax.constant_schedule(self.learning_rate)
+        if self.lr_schedule not in ("cosine", "warmup_cosine"):
+            raise ValueError(f"unknown lr_schedule {self.lr_schedule!r}")
+        if self.schedule_steps <= 0:
+            # The registered entrypoints default this to the run's step
+            # target; a direct Trainer user who forgets it would silently
+            # train at ~0 LR from step 1 (cosine fully decayed).
+            raise ValueError(
+                f"lr_schedule={self.lr_schedule!r} needs schedule_steps > 0"
+            )
+        if self.lr_schedule == "cosine":
+            return optax.cosine_decay_schedule(
+                self.learning_rate, self.schedule_steps
+            )
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=self.learning_rate,
+            warmup_steps=max(1, self.warmup_steps),
+            decay_steps=max(self.warmup_steps + 1, self.schedule_steps),
+        )
+
     def make_optimizer(self) -> optax.GradientTransformation:
+        # A constant LR stays a plain float: wrapping it in a schedule
+        # would add ScaleByScheduleState to the optimizer-state pytree and
+        # break Orbax restore of every checkpoint saved before schedules
+        # existed (structure mismatch), for zero behavioral gain.
+        lr = (
+            self.learning_rate if self.lr_schedule == "constant"
+            else self.lr_at()
+        )
         if self.optimizer == "adamw":
-            return optax.adamw(self.learning_rate,
-                               weight_decay=self.weight_decay)
+            return optax.adamw(lr, weight_decay=self.weight_decay)
         if self.optimizer == "sgd":
-            return optax.sgd(self.learning_rate, momentum=0.9)
+            return optax.sgd(lr, momentum=0.9)
         raise ValueError(f"unknown optimizer {self.optimizer!r}")
 
 

@@ -407,3 +407,56 @@ class TestSyncEvery:
         assert len(stats) == 4
         assert stats[-1].loss is None  # stopped between syncs
         assert drained, "finally-drain must block on the state"
+
+
+class TestLRSchedules:
+    def test_warmup_cosine_shape(self):
+        cfg = TrainConfig(
+            learning_rate=0.1, lr_schedule="warmup_cosine",
+            warmup_steps=10, schedule_steps=100,
+        )
+        lr = cfg.lr_at()
+        assert lr(0) == 0.0                     # warmup starts at zero
+        assert abs(lr(10) - 0.1) < 1e-6        # peak at warmup end
+        assert lr(50) < 0.1                     # decaying
+        assert lr(100) < lr(50)                 # monotone decay
+        # make_optimizer accepts the schedule (optax injects it)
+        cfg.make_optimizer()
+
+    def test_cosine_decays_to_zero(self):
+        cfg = TrainConfig(learning_rate=0.2, lr_schedule="cosine",
+                          schedule_steps=40)
+        lr = cfg.lr_at()
+        assert abs(lr(0) - 0.2) < 1e-6
+        assert lr(40) < 1e-6
+
+    def test_constant_and_unknown(self):
+        assert TrainConfig(learning_rate=0.3).lr_at()(999) == 0.3
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="unknown lr_schedule"):
+            TrainConfig(lr_schedule="nope").lr_at()
+
+    def test_schedule_trains(self, cpus):
+        """A scheduled optimizer steps the sharded trainer end to end
+        (the schedule's step count lives in TrainState, so checkpoint
+        resume lands on the right point of the curve for free)."""
+        mesh = mesh_for_devices(cpus)
+        with jax.default_device(cpus[0]):
+            m = MLP(features=(32,))
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(
+                    optimizer="sgd", learning_rate=0.05,
+                    lr_schedule="warmup_cosine", warmup_steps=2,
+                    schedule_steps=6,
+                ),
+            )
+            stats = tr.run(datasets.mnist_batches(8, seed=3), steps=4)
+        assert len(stats) == 4
+        assert all(
+            s.loss is None or jnp.isfinite(s.loss) for s in stats
+        )
