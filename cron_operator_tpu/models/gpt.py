@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from cron_operator_tpu.ops.attention import multi_head_attention
+from cron_operator_tpu.ops.rope import apply_rope
 from cron_operator_tpu.parallel.moe import moe_ffn
 
 
@@ -37,6 +38,15 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     attention_impl: str = "auto"  # auto | flash | xla | ring | ulysses
     attention_interpret: bool = False  # CPU tests of the Pallas path
+    # Grouped-query attention: 0 (default) means MHA (= num_heads, and
+    # the fused qkv projection layout stays byte-compatible with earlier
+    # checkpoints). A divisor of num_heads shares each K/V head across
+    # num_heads/num_kv_heads query heads — the KV cache (the serving
+    # memory bill) shrinks by that factor.
+    num_kv_heads: int = 0
+    # Rotary position embeddings on Q/K (relative positions); the learned
+    # absolute pos_emb table is skipped when on.
+    rope: bool = False
     # MoE: 0 disables; k > 0 replaces every k-th block's FFN with a
     # Switch-MoE layer of ``num_experts`` experts.
     moe_every: int = 0
@@ -126,18 +136,56 @@ class DecoderLayer(nn.Module):
     ) -> tuple:
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_heads
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        if kv_heads < 1 or cfg.num_heads % kv_heads:
+            raise ValueError(
+                f"num_kv_heads {kv_heads} must be a positive divisor of "
+                f"num_heads {cfg.num_heads}"
+            )
 
         y = nn.LayerNorm(dtype=cfg.dtype)(x)
-        qkv = nn.DenseGeneral(
-            (3, cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
-            name="qkv",
-        )(y)
-        q, k, v = (qkv[:, :, i] for i in range(3))
+        if kv_heads == cfg.num_heads:
+            # MHA keeps the fused projection (checkpoint-compatible with
+            # configs that predate GQA).
+            qkv = nn.DenseGeneral(
+                (3, cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
+                name="qkv",
+            )(y)
+            q, k, v = (qkv[:, :, i] for i in range(3))
+        else:
+            q = nn.DenseGeneral(
+                (cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
+                name="q",
+            )(y)
+            kv = nn.DenseGeneral(
+                (2, kv_heads, head_dim), axis=-1, dtype=cfg.dtype,
+                name="kv",
+            )(y)
+            k, v = kv[:, :, 0], kv[:, :, 1]
+
+        if cfg.rope:
+            if self.decode:
+                positions = pos_idx[None]  # the one current position
+            else:
+                positions = jnp.arange(x.shape[1])
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
+
         if self.decode:
             attn = self._decode_attention(q, k, v, pos_idx)
         else:
+            if kv_heads != cfg.num_heads:
+                # Training/prefill compute path: broadcast K/V up to the
+                # query head count (XLA fuses the repeat into the matmuls;
+                # the cache below still stores only kv_heads — GQA's
+                # memory win is the cache, not the prefill FLOPs).
+                group = cfg.num_heads // kv_heads
+                k_full = jnp.repeat(k, group, axis=2)
+                v_full = jnp.repeat(v, group, axis=2)
+            else:
+                k_full, v_full = k, v
             attn = multi_head_attention(
-                q, k, v, causal=True, impl=cfg.attention_impl,
+                q, k_full, v_full, causal=True, impl=cfg.attention_impl,
                 mesh=self.mesh, interpret=cfg.attention_interpret,
             )
             if self.prefill:
@@ -157,9 +205,11 @@ class DecoderLayer(nn.Module):
             y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(y)
         return x + y, aux
 
-    def _cache_vars(self, b, h, d):
+    def _cache_vars(self, b, kv_heads, d):
+        # GQA caches only kv_heads — the serving memory saving.
         cfg = self.config
-        zeros = lambda: jnp.zeros((b, cfg.max_len, h, d), cfg.dtype)  # noqa: E731
+        def zeros():
+            return jnp.zeros((b, cfg.max_len, kv_heads, d), cfg.dtype)
         return (
             self.variable("cache", "k", zeros),
             self.variable("cache", "v", zeros),
@@ -191,9 +241,10 @@ class DecoderLayer(nn.Module):
         """
         cfg = self.config
         b, one, h, d = q.shape
+        kv_h = k.shape[2]
         assert one == 1, "decode processes one token per call"
         assert pos_idx is not None, "decode needs the position index"
-        cache_k, cache_v = self._cache_vars(b, h, d)
+        cache_k, cache_v = self._cache_vars(b, kv_h, d)
         cache_k.value = jax.lax.dynamic_update_slice(
             cache_k.value, k.astype(cfg.dtype), (0, pos_idx, 0, 0)
         )
@@ -202,14 +253,18 @@ class DecoderLayer(nn.Module):
         )
 
         scale = 1.0 / (d ** 0.5)
+        group = h // kv_h
+        # Grouped einsum: each KV head serves `group` query heads without
+        # materializing a repeated cache (GQA reads kv_h×, not h×).
+        qg = q.reshape(b, kv_h, group, d)
         scores = jnp.einsum(
-            "bohd,bshd->bhs", q, cache_k.value,
+            "bkgd,bskd->bkgs", qg, cache_k.value,
             preferred_element_type=jnp.float32,
-        ) * scale  # [b, h, max_len]
+        ) * scale  # [b, kv_h, group, max_len]
         mask = jnp.arange(cfg.max_len) <= pos_idx  # written positions
-        scores = jnp.where(mask[None, None, :], scores, -1e30)
+        scores = jnp.where(mask[None, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhs,bshd->bhd", probs, cache_v.value)
+        out = jnp.einsum("bkgs,bskd->bkgd", probs, cache_v.value)
         return out.reshape(b, 1, h, d)
 
 
@@ -241,7 +296,9 @@ class GPT(nn.Module):
         tok = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
         )
-        pos = self.param(
+        # RoPE replaces the learned absolute table entirely (positions
+        # rotate Q/K inside each layer instead).
+        pos = None if cfg.rope else self.param(
             "pos_emb",
             nn.initializers.normal(0.02),
             (cfg.max_len, cfg.hidden_size),
@@ -254,13 +311,17 @@ class GPT(nn.Module):
             )
         if self.decode:
             pos_idx = step.value  # tokens consumed so far
-            p = jax.lax.dynamic_slice(
-                pos, (pos_idx, 0), (1, cfg.hidden_size)
-            )
             step.value = pos_idx + 1
-            x = tok(input_ids) + p[None].astype(cfg.dtype)
+            x = tok(input_ids)
+            if pos is not None:
+                p = jax.lax.dynamic_slice(
+                    pos, (pos_idx, 0), (1, cfg.hidden_size)
+                )
+                x = x + p[None].astype(cfg.dtype)
         else:
-            x = tok(input_ids) + pos[None, :s].astype(cfg.dtype)
+            x = tok(input_ids)
+            if pos is not None:
+                x = x + pos[None, :s].astype(cfg.dtype)
             if self.prefill:
                 step.value = jnp.asarray(s, jnp.int32)
         aux_total = jnp.zeros((), jnp.float32)

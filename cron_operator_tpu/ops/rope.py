@@ -1,0 +1,51 @@
+"""Rotary position embeddings (RoPE) — the modern LLM position encoding.
+
+Rotates each (even, odd) feature pair of Q and K by a position- and
+frequency-dependent angle, so attention scores depend on relative
+position only (Su et al., RoFormer). Pure elementwise math on
+``[..., seq, heads, head_dim]`` — XLA fuses it into the surrounding
+projections; no parameters, no kernel needed.
+
+The same function serves training (``positions = arange(seq)``) and
+KV-cache decode (``positions = [current_index]``) — getting decode
+positions right is exactly what the generation oracle test pins.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(
+    positions: jax.Array, head_dim: int, theta: float = 10000.0
+) -> tuple:
+    """(cos, sin) tables ``[len(positions), head_dim//2]`` in f32."""
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float = 10000.0
+) -> jax.Array:
+    """Rotate ``x [batch, seq, heads, head_dim]`` at ``positions [seq]``.
+
+    head_dim must be even. Returns x's dtype (rotation in f32).
+    """
+    b, s, h, d = x.shape
+    if d % 2:
+        raise ValueError(f"head_dim {d} must be even for RoPE")
+    cos, sin = rope_angles(positions, d, theta)  # [s, d//2]
+    cos = cos[None, :, None, :]  # broadcast over batch, heads
+    sin = sin[None, :, None, :]
+    xf = x.astype(jnp.float32).reshape(b, s, h, d // 2, 2)
+    x1, x2 = xf[..., 0], xf[..., 1]
+    rot = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return rot.reshape(b, s, h, d).astype(x.dtype)
+
+
+__all__ = ["apply_rope", "rope_angles"]

@@ -345,7 +345,9 @@ def gpt(ctx: JobContext) -> None:
     attention(=auto|flash|xla|ring|ulysses), moe_every(=0: dense),
     num_experts(=8), seq/tensor/fsdp/expert mesh axes, remat(=0),
     fused_xent(=0: when 1 the loss is chunked_cross_entropy against the
-    tied embedding — [b, s, vocab] logits are never materialized).
+    tied embedding — [b, s, vocab] logits are never materialized),
+    kv_heads(=0: MHA; a divisor of num_heads enables grouped-query
+    attention), rope(=0: learned absolute positions; 1 = rotary).
     Targets are next-token shifted (causal_token_batches).
     """
     steps = int(ctx.params.get("steps", 10))
@@ -364,6 +366,8 @@ def gpt(ctx: JobContext) -> None:
             max_len=seq_len, attention_impl=attention,
             moe_every=moe_every, num_experts=num_experts,
             return_hidden=fused_xent,
+            num_kv_heads=int(ctx.params.get("kv_heads", 0)),
+            rope=ctx.params.get("rope", "0") in ("1", "true"),
         )
         model = GPT(cfg, mesh=mesh)
         params = _jit_init(
@@ -471,7 +475,8 @@ def generate_job(ctx: JobContext) -> None:
     sustained tokens/s.
 
     Params: rounds(=1), batch_size(=8), prompt_len(=32), max_new(=128),
-    temperature(=0 → greedy), size(=base|tiny).
+    temperature(=0 → greedy), size(=base|tiny), kv_heads(=0: MHA;
+    grouped-query shrinks the KV cache), rope(=0|1).
     """
     from cron_operator_tpu.workloads.generate import generate
 
@@ -484,7 +489,11 @@ def generate_job(ctx: JobContext) -> None:
     devs = _devices(ctx)
     with jax.default_device(devs[0]):
         maker = GPTConfig.tiny if size == "tiny" else GPTConfig
-        cfg = maker(max_len=prompt_len + max_new)
+        cfg = maker(
+            max_len=prompt_len + max_new,
+            num_kv_heads=int(ctx.params.get("kv_heads", 0)),
+            rope=ctx.params.get("rope", "0") in ("1", "true"),
+        )
         model = GPT(cfg)
         params = _jit_init(
             model, jax.random.PRNGKey(0),

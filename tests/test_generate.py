@@ -117,3 +117,51 @@ class TestValidation:
                 generate(cfg, params, prompt, 1, temperature=-1.0)
             with pytest.raises(ValueError, match="must be >= 1"):
                 generate(cfg, params, prompt, 0)
+
+
+class TestModernAttentionDecode:
+    """GQA + RoPE through the same greedy oracle: the cached decode path
+    (grouped einsum over a kv_heads-sized cache, per-position rotations)
+    must reproduce the full training forward exactly."""
+
+    def test_gqa_rope_greedy_matches_full_forward(self, cpu0):
+        with jax.default_device(cpu0):
+            cfg = _tiny(num_heads=4, num_kv_heads=2, rope=True)
+            model, params, prompt = _init(cfg)
+            assert "pos_emb" not in params  # RoPE replaces the table
+            out = generate(cfg, params, prompt, max_new_tokens=5)
+            seq = prompt
+            for _ in range(5):
+                logits, _ = model.apply({"params": params}, seq)
+                nxt = jnp.argmax(logits[:, -1], axis=-1)[:, None]
+                seq = jnp.concatenate([seq, nxt.astype(seq.dtype)], axis=1)
+            assert (out == seq).all(), (
+                "GQA/RoPE cached decode diverged from the full forward"
+            )
+
+    def test_gqa_cache_is_kv_heads_sized(self, cpu0):
+        """The whole point of GQA at serving time: the cache stores
+        kv_heads, not num_heads."""
+        from cron_operator_tpu.models.gpt import GPT as _GPT
+
+        with jax.default_device(cpu0):
+            from dataclasses import replace as _replace
+
+            cfg = _tiny(num_heads=4, num_kv_heads=2)
+            _, params, prompt = _init(cfg)
+            decode = _GPT(_replace(cfg, return_hidden=False), decode=True)
+            _, mut = decode.apply(
+                {"params": params}, prompt[:, :1], mutable=["cache"]
+            )
+            k = mut["cache"]["layer_0"]["k"]
+            assert k.shape == (2, cfg.max_len, 2, cfg.hidden_size // 4)
+
+    def test_invalid_kv_heads_rejected(self, cpu0):
+        with jax.default_device(cpu0):
+            cfg = _tiny(num_heads=4, num_kv_heads=3)
+            model = GPT(cfg)
+            with pytest.raises(ValueError, match="positive divisor"):
+                model.init(
+                    jax.random.PRNGKey(0),
+                    jnp.zeros((1, 4), jnp.int32),
+                )
