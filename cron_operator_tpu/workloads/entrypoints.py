@@ -108,7 +108,9 @@ def _train_kwargs(ctx: JobContext, steps: int, **defaults) -> dict:
     defaults overridden by the common ``param.*`` surface — ``lr``,
     ``lr_schedule`` (constant|cosine|warmup_cosine), ``warmup_steps``,
     ``schedule_steps`` (defaults to the run's total-step target),
-    ``save_every``, ``prefetch``, ``sync_every``."""
+    ``grad_clip`` (global-norm clip, 0=off), ``decay_mask`` (AdamW decay
+    only on rank≥2 params), ``save_every``, ``prefetch``,
+    ``sync_every``."""
     kw = dict(defaults)
     kw.update(
         save_every=_save_every(ctx),
@@ -117,6 +119,8 @@ def _train_kwargs(ctx: JobContext, steps: int, **defaults) -> dict:
         lr_schedule=ctx.params.get("lr_schedule", "constant"),
         warmup_steps=int(ctx.params.get("warmup_steps", 0)),
         schedule_steps=int(ctx.params.get("schedule_steps", steps)),
+        grad_clip_norm=float(ctx.params.get("grad_clip", 0)),
+        decay_mask=ctx.params.get("decay_mask", "0") in ("1", "true"),
     )
     if "lr" in ctx.params:
         kw["learning_rate"] = float(ctx.params["lr"])

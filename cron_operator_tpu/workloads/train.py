@@ -50,6 +50,14 @@ class TrainConfig:
     warmup_steps: int = 0
     schedule_steps: int = 0  # decay horizon; entrypoints default it to
     # the run's total-step target
+    # Clip gradients to this global norm before the optimizer (0 = off).
+    # Both this and decay_mask alter the optimizer-state pytree when
+    # enabled, so flipping them breaks checkpoint-resume into runs that
+    # started without them (same rule as switching optimizers).
+    grad_clip_norm: float = 0.0
+    # AdamW weight decay only on rank>=2 params (kernels/embeddings) —
+    # decaying biases and norm scales is the classic silent regression.
+    decay_mask: bool = False
     remat: bool = False  # jax.checkpoint the forward (HBM ↔ FLOPs trade)
     seq_dim_in_batch: Optional[int] = None  # dim of x sharded over `seq`
     labels_follow_seq: bool = False  # labels carry the seq dim too (MLM)
@@ -104,11 +112,30 @@ class TrainConfig:
             self.learning_rate if self.lr_schedule == "constant"
             else self.lr_at()
         )
+        if self.decay_mask and self.optimizer != "adamw":
+            # SGD has no weight decay to mask — accepting the flag would
+            # leave an operator believing masked decay is active.
+            raise ValueError(
+                "decay_mask requires the adamw optimizer "
+                f"(got {self.optimizer!r})"
+            )
+        mask = (
+            (lambda params: jax.tree_util.tree_map(
+                lambda p: p.ndim >= 2, params
+            ))
+            if self.decay_mask else None
+        )
         if self.optimizer == "adamw":
-            return optax.adamw(lr, weight_decay=self.weight_decay)
-        if self.optimizer == "sgd":
-            return optax.sgd(lr, momentum=0.9)
-        raise ValueError(f"unknown optimizer {self.optimizer!r}")
+            tx = optax.adamw(lr, weight_decay=self.weight_decay, mask=mask)
+        elif self.optimizer == "sgd":
+            tx = optax.sgd(lr, momentum=0.9)
+        else:
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if self.grad_clip_norm > 0:
+            tx = optax.chain(
+                optax.clip_by_global_norm(self.grad_clip_norm), tx
+            )
+        return tx
 
 
 @dataclass

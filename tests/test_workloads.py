@@ -460,3 +460,51 @@ class TestLRSchedules:
         assert all(
             s.loss is None or jnp.isfinite(s.loss) for s in stats
         )
+
+
+class TestOptimizerHygiene:
+    def test_grad_clip_bounds_update_norm(self):
+        """clip_by_global_norm chained before SGD: a huge gradient must
+        produce an update whose global norm is lr * clip."""
+        import optax
+
+        cfg = TrainConfig(optimizer="sgd", learning_rate=1.0,
+                          grad_clip_norm=1.0)
+        tx = cfg.make_optimizer()
+        p = {"w": jnp.zeros((4,))}
+        g = {"w": jnp.full((4,), 100.0)}  # norm 200
+        updates, _ = tx.update(g, tx.init(p), p)
+        norm = float(optax.global_norm(updates))
+        assert abs(norm - 1.0) < 1e-4  # momentum=0.9 SGD: first step = g
+
+    def test_decay_mask_spares_rank1_params(self):
+        """With decay_mask, zero-gradient biases/norm scales must not
+        shrink, while kernels still decay."""
+        cfg = TrainConfig(optimizer="adamw", learning_rate=0.1,
+                          weight_decay=0.5, decay_mask=True)
+        tx = cfg.make_optimizer()
+        p = {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))}
+        g = {"kernel": jnp.zeros((2, 2)), "bias": jnp.zeros((2,))}
+        updates, _ = tx.update(g, tx.init(p), p)
+        assert float(jnp.abs(updates["bias"]).max()) == 0.0
+        assert float(jnp.abs(updates["kernel"]).max()) > 0.0
+
+    def test_defaults_keep_checkpoint_structure(self):
+        """Defaults-off must produce the identical optimizer-state pytree
+        as before these knobs existed (resume compatibility)."""
+        import optax
+
+        p = {"w": jnp.ones((2,))}
+        old = optax.adamw(1e-3, weight_decay=1e-4).init(p)
+        new = TrainConfig().make_optimizer().init(p)
+        assert (
+            jax.tree_util.tree_structure(old)
+            == jax.tree_util.tree_structure(new)
+        )
+
+    def test_decay_mask_rejects_sgd(self):
+        import pytest as _pytest
+
+        cfg = TrainConfig(optimizer="sgd", decay_mask=True)
+        with _pytest.raises(ValueError, match="requires the adamw"):
+            cfg.make_optimizer()
