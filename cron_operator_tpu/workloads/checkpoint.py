@@ -84,6 +84,23 @@ class CheckpointStore:
 
         return self._mgr.restore(step, args=ocp.args.StandardRestore(like))
 
+    def restore_params(self, step: Optional[int] = None) -> Any:
+        """Params-only restore for SERVING — no optimizer-state template
+        needed (the training job's optimizer config is unknown to a
+        serving job). Template-free restore yields the checkpoint as
+        plain nested dicts, from which the ``params`` subtree is
+        returned (host arrays; the consumer device_puts into its own
+        layout). For sharded multi-host serving a proper template
+        restore would be required; this is the single-host path the
+        ``generate`` entrypoint uses."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}"
+            )
+        raw = self._mgr.restore(step)
+        return raw["params"]
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 

@@ -479,8 +479,14 @@ def generate_job(ctx: JobContext) -> None:
     sustained tokens/s.
 
     Params: rounds(=1), batch_size(=8), prompt_len(=32), max_new(=128),
-    temperature(=0 → greedy), size(=base|tiny), kv_heads(=0: MHA;
-    grouped-query shrinks the KV cache), rope(=0|1).
+    temperature(=0 → greedy), size(=base|tiny),
+    seq_len(=prompt_len+max_new: the model max_len — set it to the
+    TRAINING job's seq_len when serving a checkpoint), kv_heads(=0: MHA;
+    grouped-query shrinks the KV cache), rope(=0|1),
+    checkpoint_from(=unset: random weights; a job/family name loads the
+    latest params that training lineage checkpointed — the train-nightly
+    → serve-nightly pairing; the GPTConfig params must match the
+    training job's), checkpoint_dir(=the store root).
     """
     from cron_operator_tpu.workloads.generate import generate
 
@@ -493,16 +499,42 @@ def generate_job(ctx: JobContext) -> None:
     devs = _devices(ctx)
     with jax.default_device(devs[0]):
         maker = GPTConfig.tiny if size == "tiny" else GPTConfig
+        # seq_len (the same param the gpt TRAINING entrypoint uses) pins
+        # max_len — it must match the training config when serving a
+        # checkpoint, or the pos_emb table shapes disagree at restore.
         cfg = maker(
-            max_len=prompt_len + max_new,
+            max_len=int(ctx.params.get("seq_len", prompt_len + max_new)),
             num_kv_heads=int(ctx.params.get("kv_heads", 0)),
             rope=ctx.params.get("rope", "0") in ("1", "true"),
         )
         model = GPT(cfg)
-        params = _jit_init(
-            model, jax.random.PRNGKey(0),
-            _zeros((1, prompt_len), dtype="int32"),
-        )
+        ckpt_from = ctx.params.get("checkpoint_from")
+        if ckpt_from:
+            # Restored weights replace init entirely — compiling and
+            # materializing a random init just to discard it would waste
+            # the serve tick's startup budget.
+            from cron_operator_tpu.workloads.checkpoint import (
+                CheckpointStore,
+            )
+
+            store = CheckpointStore(
+                ctx.namespace or "default", ckpt_from,
+                root=ctx.params.get("checkpoint_dir"),
+            )
+            try:
+                # Pin the step BEFORE restoring: a concurrent training
+                # tick can save a newer step mid-restore, and reporting
+                # that one would misattribute the served weights.
+                step = store.latest_step()
+                params = store.restore_params(step)
+                ctx.progress["restored_from_step"] = step
+            finally:
+                store.close()
+        else:
+            params = _jit_init(
+                model, jax.random.PRNGKey(0),
+                _zeros((1, prompt_len), dtype="int32"),
+            )
         key = jax.random.PRNGKey(int(ctx.params.get("seed", 0)))
         ctx.progress["started_at"] = time.time()
         total_tokens = 0

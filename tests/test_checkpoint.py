@@ -212,3 +212,83 @@ class TestElasticResume:
         stats = t2.run(datasets.mnist_batches(16, seed=11), steps=4)
         assert [s.step for s in stats] == [3, 4]
         t2.checkpoint.close()
+
+
+class TestTrainThenServe:
+    """The nightly pairing: a cron-scheduled training job checkpoints a
+    lineage; a cron-scheduled generate job serves the latest params from
+    it (params-only restore — the serving job never needs the training
+    job's optimizer config)."""
+
+    def test_generate_restores_trained_params(self, cpus, tmp_path,
+                                              monkeypatch):
+        import numpy as np
+
+        from cron_operator_tpu.backends.registry import (
+            JobContext,
+            resolve_entrypoint,
+        )
+        from cron_operator_tpu.workloads import generate as gen_mod
+        from cron_operator_tpu.workloads.checkpoint import CheckpointStore
+
+        common_model = {
+            "size": "tiny", "seq_len": "16", "platform": "cpu",
+        }
+        train_ctx = JobContext(
+            name="lm-train-1700000000", namespace="default", job={},
+            params={
+                **common_model, "steps": "3", "batch_size": "8",
+                "checkpoint": "1", "save_every": "3",
+                "checkpoint_lineage": "family",
+                "checkpoint_dir": str(tmp_path),
+            },
+        )
+        resolve_entrypoint("gpt")(train_ctx)
+        assert train_ctx.progress["steps_done"] == 3
+
+        # The family lineage dir is the tick-suffix-stripped name.
+        store = CheckpointStore("default", "lm-train", root=str(tmp_path))
+        trained = store.restore_params()
+        store.close()
+
+        # Spy on the serve path's actual weights: the entrypoint must
+        # hand generate() the TRAINED params, not a fresh init.
+        served = {}
+        real_generate = gen_mod.generate
+
+        def spy(cfg, params, prompt, max_new, **kw):
+            served["params"] = params
+            return real_generate(cfg, params, prompt, max_new, **kw)
+
+        monkeypatch.setattr(gen_mod, "generate", spy)
+
+        serve_ctx = JobContext(
+            name="lm-serve", namespace="default", job={},
+            params={
+                **common_model, "rounds": "1", "batch_size": "2",
+                "prompt_len": "4", "max_new": "4",
+                "checkpoint_from": "lm-train",
+                "checkpoint_dir": str(tmp_path),
+            },
+        )
+        resolve_entrypoint("generate")(serve_ctx)
+        assert serve_ctx.progress["restored_from_step"] == 3
+        assert serve_ctx.progress["steps_done"] == 1
+
+        for a, b in zip(
+            jax.tree_util.tree_leaves(served["params"]),
+            jax.tree_util.tree_leaves(trained),
+        ):
+            assert np.allclose(np.asarray(a), np.asarray(b)), (
+                "serve job did not use the trained checkpoint"
+            )
+
+    def test_restore_params_missing_lineage_raises(self, tmp_path):
+        from cron_operator_tpu.workloads.checkpoint import CheckpointStore
+
+        store = CheckpointStore("default", "ghost", root=str(tmp_path))
+        try:
+            with pytest.raises(FileNotFoundError, match="no checkpoint"):
+                store.restore_params()
+        finally:
+            store.close()

@@ -58,12 +58,16 @@ def test_examples_parse_and_validate():
     examples = sorted((REPO / "examples" / "v1alpha1" / "cron").glob("*.yaml"))
     assert len(examples) >= 6
     for path in examples:
-        doc = yaml.safe_load(path.read_text())
-        assert doc["kind"] == "Cron", path.name
-        cron = Cron.from_dict(doc)
-        parse_standard(cron.spec.schedule)  # raises on bad schedule
-        workload = new_empty_workload(cron)  # raises on bad template
-        assert workload.get("kind"), path.name
+        # Multi-document files (e.g. the train+serve pairing) are
+        # ordinary kubectl practice; validate every document.
+        docs = [d for d in yaml.safe_load_all(path.read_text()) if d]
+        assert docs, path.name
+        for doc in docs:
+            assert doc["kind"] == "Cron", path.name
+            cron = Cron.from_dict(doc)
+            parse_standard(cron.spec.schedule)  # raises on bad schedule
+            workload = new_empty_workload(cron)  # raises on bad template
+            assert workload.get("kind"), path.name
 
 
 class TestKustomizeTree:
