@@ -54,6 +54,9 @@ class CheckpointStore:
         root: Optional[str] = None,
         max_to_keep: int = 3,
         lineage: str = "job",  # "job" | "family" — see module docstring
+        create: bool = True,  # False = read-only open (serving): a
+        # mistyped lineage must raise, not litter the shared checkpoint
+        # root with empty directories
     ):
         import orbax.checkpoint as ocp
 
@@ -61,11 +64,16 @@ class CheckpointStore:
             raise ValueError(f"unknown checkpoint lineage {lineage!r}")
         key = job_family(job_name) if lineage == "family" else job_name
         self.directory = os.path.join(root or DEFAULT_ROOT, namespace, key)
-        os.makedirs(self.directory, exist_ok=True)
+        if create:
+            os.makedirs(self.directory, exist_ok=True)
+        elif not os.path.isdir(self.directory):
+            raise FileNotFoundError(
+                f"no checkpoint lineage at {self.directory}"
+            )
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+                max_to_keep=max_to_keep, create=create
             ),
         )
 

@@ -506,6 +506,10 @@ def generate_job(ctx: JobContext) -> None:
             max_len=int(ctx.params.get("seq_len", prompt_len + max_new)),
             num_kv_heads=int(ctx.params.get("kv_heads", 0)),
             rope=ctx.params.get("rope", "0") in ("1", "true"),
+            # Must mirror the training config when serving an MoE
+            # checkpoint — a dense serve model can't hold 'moe' subtrees.
+            moe_every=int(ctx.params.get("moe_every", 0)),
+            num_experts=int(ctx.params.get("num_experts", 8)),
         )
         model = GPT(cfg)
         ckpt_from = ctx.params.get("checkpoint_from")
@@ -520,12 +524,21 @@ def generate_job(ctx: JobContext) -> None:
             store = CheckpointStore(
                 ctx.namespace or "default", ckpt_from,
                 root=ctx.params.get("checkpoint_dir"),
+                create=False,  # read-only: a typo'd name must raise
             )
             try:
                 # Pin the step BEFORE restoring: a concurrent training
                 # tick can save a newer step mid-restore, and reporting
-                # that one would misattribute the served weights.
+                # that one would misattribute the served weights. A None
+                # pin must raise here — restore_params(None) would
+                # re-query and could succeed against a just-landed save
+                # while we report restored_from_step=None.
                 step = store.latest_step()
+                if step is None:
+                    raise FileNotFoundError(
+                        f"lineage {ckpt_from!r} has no completed "
+                        "checkpoint yet"
+                    )
                 params = store.restore_params(step)
                 ctx.progress["restored_from_step"] = step
             finally:

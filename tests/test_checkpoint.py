@@ -292,3 +292,26 @@ class TestTrainThenServe:
                 store.restore_params()
         finally:
             store.close()
+
+    def test_serve_with_typoed_lineage_raises_without_littering(
+        self, tmp_path
+    ):
+        """Read-only open: a mistyped checkpoint_from must raise and must
+        NOT create an empty lineage dir in the shared root."""
+        from cron_operator_tpu.backends.registry import (
+            JobContext,
+            resolve_entrypoint,
+        )
+
+        ctx = JobContext(
+            name="serve-typo", namespace="default", job={},
+            params={
+                "size": "tiny", "seq_len": "16", "platform": "cpu",
+                "rounds": "1", "batch_size": "2", "prompt_len": "4",
+                "max_new": "4", "checkpoint_from": "gpt-nightly-tarin",
+                "checkpoint_dir": str(tmp_path),
+            },
+        )
+        with pytest.raises(FileNotFoundError, match="no checkpoint"):
+            resolve_entrypoint("generate")(ctx)
+        assert not (tmp_path / "default" / "gpt-nightly-tarin").exists()

@@ -89,6 +89,31 @@ class TestTrainer:
             s1, s2 = tr.step(next(it)), tr.step(next(it))
         assert jnp.isfinite(s1.loss) and jnp.isfinite(s2.loss)
 
+    def test_gpt_gqa_rope_under_ring_sp(self, cpus):
+        """GQA + RoPE must compose with ring sequence parallelism: RoPE
+        rotates at global positions before the seq shard_map, and the
+        broadcast K/V heads ride the ring like MHA ones."""
+        from cron_operator_tpu.models import GPT, GPTConfig
+
+        mesh = mesh_for_devices(cpus, seq=2)
+        with jax.default_device(cpus[0]):
+            cfg = GPTConfig.tiny(
+                max_len=64, attention_impl="ring",
+                num_kv_heads=2, rope=True,
+            )
+            m = GPT(cfg, mesh=mesh)
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 64), jnp.int32)
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(seq_dim_in_batch=1, labels_follow_seq=True,
+                            aux_loss_in_output=True),
+            )
+            it = datasets.token_batches(8, 64, cfg.vocab_size)
+            s1, s2 = tr.step(next(it)), tr.step(next(it))
+        assert jnp.isfinite(s1.loss) and jnp.isfinite(s2.loss)
+
     def test_profile_trace_written(self, tmp_path):
         """param.profile_dir captures a jax.profiler trace of the
         steady-state steps (SURVEY.md §5: the reference has no
