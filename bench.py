@@ -243,25 +243,20 @@ def _attention_microbench(platform, timeout: float):
         return {"error": f"unparseable output: {out.stdout[-200:]}"}
 
 
-def _lm_bench(platform, timeout: float) -> dict:
-    """BERT-base seq-512 steady-state throughput via the runner subprocess
-    — the language-model leg of the BASELINE configs (the tick→first-step
-    headline uses ResNet-50; this evidences the transformer/attention
-    path end-to-end on the same device). Skipped on the CPU fallback."""
-    if platform == "cpu":
-        return {"skipped": "cpu fallback"}
-    args = [
-        sys.executable, "-m", "cron_operator_tpu.workloads.runner",
-        "bert", "steps=12", "batch_size=8", "seq_len=512", "sync_every=6",
-    ]
+def _runner_progress(runner_args, timeout: float):
+    """Run a workloads.runner subprocess → ``(progress, error)`` tuple:
+    exactly one side is non-None. Never raises — bench legs must not
+    poison the headline metric."""
+    args = [sys.executable, "-m", "cron_operator_tpu.workloads.runner",
+            *runner_args]
     try:
         out = subprocess.run(args, capture_output=True, text=True,
                              timeout=timeout)
     except subprocess.TimeoutExpired:
-        return {"error": f"exceeded {timeout:.0f}s"}
+        return None, {"error": f"exceeded {timeout:.0f}s"}
     if out.returncode != 0:
-        return {"error": f"rc={out.returncode}: "
-                         f"{(out.stderr or '').strip()[-400:]}"}
+        return None, {"error": f"rc={out.returncode}: "
+                               f"{(out.stderr or '').strip()[-400:]}"}
     from cron_operator_tpu.workloads.runner import PROGRESS_PREFIX
 
     progress = {}
@@ -272,15 +267,56 @@ def _lm_bench(platform, timeout: float) -> dict:
             except ValueError:
                 continue
             progress = msg.get("progress") or progress
+    if not progress:
+        return None, {"error": f"no progress parsed: {out.stdout[-200:]}"}
+    return progress, None
+
+
+def _lm_bench(platform, timeout: float) -> dict:
+    """BERT-base seq-512 steady-state throughput via the runner subprocess
+    — the language-model leg of the BASELINE configs (the tick→first-step
+    headline uses ResNet-50; this evidences the transformer/attention
+    path end-to-end on the same device). Skipped on the CPU fallback."""
+    if platform == "cpu":
+        return {"skipped": "cpu fallback"}
+    progress, err = _runner_progress(
+        ["bert", "steps=12", "batch_size=8", "seq_len=512",
+         "sync_every=6"],
+        timeout,
+    )
+    if err:
+        return err
     if not progress.get("steps_per_s"):
-        return {"error": f"no steady-state progress parsed: "
-                         f"{out.stdout[-200:]}"}
+        return {"error": f"no steady-state progress: {progress}"}
     return {
         "model": "bert-base", "batch_size": 8, "seq_len": 512,
         "steps_per_s": progress["steps_per_s"],
         "avg_step_time_s": progress.get("avg_step_time_s"),
         "tokens_per_s": round(8 * 512 * progress["steps_per_s"], 1),
         "last_loss": progress.get("last_loss"),
+    }
+
+
+def _decode_bench(platform, timeout: float) -> dict:
+    """GPT-base KV-cache decode throughput via the `generate` entrypoint
+    (serving path: batched prefill + lax.scan sampling). Round 0 carries
+    the compile; tokens_per_s is the steady rounds after it."""
+    if platform == "cpu":
+        return {"skipped": "cpu fallback"}
+    progress, err = _runner_progress(
+        ["generate", "rounds=3", "batch_size=8", "prompt_len=64",
+         "max_new=128"],
+        timeout,
+    )
+    if err:
+        return err
+    if not progress.get("tokens_per_s"):
+        return {"error": f"no steady throughput: {progress}"}
+    return {
+        "model": "gpt-base", "batch_size": 8, "prompt_len": 64,
+        "max_new": 128,
+        "decode_tokens_per_s": progress["tokens_per_s"],
+        "tokens_generated": progress.get("tokens_generated"),
     }
 
 
@@ -409,6 +445,7 @@ def main() -> int:
 
     extra["attention_bench"] = _attention_microbench(platform, timeout=300.0)
     extra["lm_bench"] = _lm_bench(platform, timeout=240.0)
+    extra["decode_bench"] = _decode_bench(platform, timeout=300.0)
     try:
         extra["control_plane"] = _control_plane_bench()
     except Exception as exc:  # noqa: BLE001 — a microbench must not
