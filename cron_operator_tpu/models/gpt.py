@@ -174,18 +174,11 @@ class DecoderLayer(nn.Module):
         if self.decode:
             attn = self._decode_attention(q, k, v, pos_idx)
         else:
-            if kv_heads != cfg.num_heads:
-                # Training/prefill compute path: broadcast K/V up to the
-                # query head count (XLA fuses the repeat into the matmuls;
-                # the cache below still stores only kv_heads — GQA's
-                # memory win is the cache, not the prefill FLOPs).
-                group = cfg.num_heads // kv_heads
-                k_full = jnp.repeat(k, group, axis=2)
-                v_full = jnp.repeat(v, group, axis=2)
-            else:
-                k_full, v_full = k, v
+            # Grouped K/V go to the dispatcher as-is: the flash kernel
+            # consumes the layout natively; the other impls broadcast
+            # internally. The prefill cache always stores kv_heads.
             attn = multi_head_attention(
-                q, k_full, v_full, causal=True, impl=cfg.attention_impl,
+                q, k, v, causal=True, impl=cfg.attention_impl,
                 mesh=self.mesh, interpret=cfg.attention_interpret,
             )
             if self.prefill:

@@ -24,6 +24,7 @@ from functools import partial
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from cron_operator_tpu.ops.flash_attention import flash_attention
@@ -72,6 +73,11 @@ def multi_head_attention(
     head-count constraint, ulysses (all-to-all head scatter) needs the
     head count to divide the ``seq`` axis size and does fewer, larger
     collectives.
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
+    (a divisor). The flash kernel consumes the grouped layout natively
+    (its grid index-maps each query head to its KV head — no repeated
+    K/V is ever materialized); the other impls broadcast K/V up here.
     """
     if impl == "auto":
         if mesh is not None and mesh.shape.get(SEQ_AXIS, 1) > 1:
@@ -88,6 +94,22 @@ def multi_head_attention(
             impl = "flash"
         else:
             impl = "xla"
+
+    h, kv_h = q.shape[2], k.shape[2]
+    if kv_h != h and impl != "flash":
+        # Dense/ring/ulysses paths take full-head K/V; XLA fuses the
+        # broadcast into the surrounding matmuls. (The flash path
+        # validates and consumes the grouped layout itself —
+        # flash_attention._gqa_layout — so the ratio check lives in one
+        # place per consumer.)
+        if kv_h < 1 or h % kv_h:
+            raise ValueError(
+                f"k/v heads {kv_h} must be a positive divisor of "
+                f"q heads {h}"
+            )
+        group = h // kv_h
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
 
     if impl == "ring":
         if mesh is None:
@@ -125,7 +147,12 @@ def _sharded_flash(q, k, v, mesh, *, causal: bool, interpret: bool = False):
         n_batch *= mesh.shape[a]
     lead = batch_axes if q.shape[0] % n_batch == 0 else None
     t = mesh.shape.get(TENSOR_AXIS, 1)
-    heads = TENSOR_AXIS if (t > 1 and q.shape[2] % t == 0) else None
+    # GQA: BOTH head counts must divide the tensor axis for a head split.
+    heads = (
+        TENSOR_AXIS
+        if (t > 1 and q.shape[2] % t == 0 and k.shape[2] % t == 0)
+        else None
+    )
     if lead is None and heads is None:  # init-time trace shapes: local run
         return flash_attention(q, k, v, causal=causal, interpret=interpret)
     spec = P(lead, None, heads, None)

@@ -267,17 +267,44 @@ def _from_bhsd(x: jax.Array, b: int, h: int) -> jax.Array:
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
+def _gqa_layout(q, k):
+    """(h, kv_h, kv_index) for grouped-query attention: the kernels' grid
+    runs over ``b*h`` query heads while K/V stay at ``b*kv_h`` — the
+    index map routes each query-head grid row to its KV head, so the
+    grouped layout is consumed natively and a repeated K/V tensor is
+    never materialized (the whole point of GQA at long context: the
+    custom call can't be fused into, so a pre-repeat would be resident
+    in HBM and doubled again in the VJP residuals)."""
+    h, kv_h = q.shape[2], k.shape[2]
+    if kv_h < 1 or h % kv_h:
+        raise ValueError(
+            f"k/v heads {kv_h} must be a positive divisor of q heads {h}"
+        )
+    group = h // kv_h
+
+    def kv_index(bh):
+        # bh = b_idx * h + h_idx; h_idx = kvh_idx * group + g
+        return (bh // h) * kv_h + (bh % h) // group
+
+    return h, kv_h, kv_index
+
+
 def _forward(q, k, v, causal, block_q, block_k, interpret):
     """Runs the forward kernel; returns (o, lse) with o in public
     ``[b, s, h, d]`` layout and lse in internal ``[b*h, s, 1]`` layout."""
     b, s, h, d = q.shape
     _check_shapes(s, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
+    _, _, kv_index = _gqa_layout(q, k)
 
     qr, kr, vr = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
 
     n_kblocks = s // block_k
     grid = (b * h, s // block_q, n_kblocks)
+    kv_spec = pl.BlockSpec(
+        (1, block_k, d), lambda bh, qi, ki: (kv_index(bh), ki, 0),
+        memory_space=pltpu.VMEM,
+    )
     o, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel,
@@ -292,14 +319,8 @@ def _forward(q, k, v, causal, block_q, block_k, interpret):
                 (1, block_q, d), lambda bh, qi, ki: (bh, qi, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(
-                (1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_k, d), lambda bh, qi, ki: (bh, ki, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
             pl.BlockSpec(
@@ -345,6 +366,8 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
     b, s, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
+    _, kv_h, kv_index = _gqa_layout(q, k)
+    group = h // kv_h
 
     qr, kr, vr = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
     dor, orr = _to_bhsd(do), _to_bhsd(o)
@@ -361,8 +384,10 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
 
     q_spec3 = pl.BlockSpec((1, block_q, d), lambda i, qi, ki: (i, qi, 0),
                            memory_space=pltpu.VMEM)
-    k_spec3 = pl.BlockSpec((1, block_k, d), lambda i, qi, ki: (i, ki, 0),
-                           memory_space=pltpu.VMEM)
+    k_spec3 = pl.BlockSpec(
+        (1, block_k, d), lambda i, qi, ki: (kv_index(i), ki, 0),
+        memory_space=pltpu.VMEM,
+    )
     row_spec3 = pl.BlockSpec((1, block_q, 1), lambda i, qi, ki: (i, qi, 0),
                              memory_space=pltpu.VMEM)
 
@@ -380,11 +405,19 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
         interpret=interpret,
     )(qr, kr, vr, dor, lse, delta)
 
-    # dK/dV pass iterates queries innermost: index maps swap roles.
+    # dK/dV pass iterates queries innermost: index maps swap roles. Under
+    # GQA the kernel still runs per QUERY head (grid bh) reading the
+    # grouped K/V via kv_index; it emits per-query-head dK/dV partials,
+    # which one XLA reduction folds back to the kv_h heads below —
+    # transient [b*h] outputs, but no pre-repeated K/V input anywhere.
     q_specT = pl.BlockSpec((1, block_q, d), lambda i, ki, qi: (i, qi, 0),
                            memory_space=pltpu.VMEM)
-    k_specT = pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
-                           memory_space=pltpu.VMEM)
+    k_specT = pl.BlockSpec(
+        (1, block_k, d), lambda i, ki, qi: (kv_index(i), ki, 0),
+        memory_space=pltpu.VMEM,
+    )
+    dk_specT = pl.BlockSpec((1, block_k, d), lambda i, ki, qi: (i, ki, 0),
+                            memory_space=pltpu.VMEM)
     row_specT = pl.BlockSpec((1, block_q, 1), lambda i, ki, qi: (i, qi, 0),
                              memory_space=pltpu.VMEM)
 
@@ -396,7 +429,7 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
         ),
         grid=(bh, n_kblocks, n_qblocks),
         in_specs=[q_specT, k_specT, k_specT, q_specT, row_specT, row_specT],
-        out_specs=[k_specT, k_specT],
+        out_specs=[dk_specT, dk_specT],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), k.dtype),
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
@@ -408,7 +441,19 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, do):
         interpret=interpret,
     )(qr, kr, vr, dor, lse, delta)
 
-    return (_from_bhsd(dq, b, h), _from_bhsd(dk, b, h), _from_bhsd(dv, b, h))
+    if group > 1:
+        # Sum the per-query-head partials within each KV group (f32
+        # accumulate — bf16 partial sums would lose grad precision).
+        dk = dk.reshape(b, kv_h, group, s, d).astype(jnp.float32)
+        dv = dv.reshape(b, kv_h, group, s, d).astype(jnp.float32)
+        dk = dk.sum(axis=2).reshape(b * kv_h, s, d).astype(k.dtype)
+        dv = dv.sum(axis=2).reshape(b * kv_h, s, d).astype(v.dtype)
+
+    return (
+        _from_bhsd(dq, b, h),
+        _from_bhsd(dk, b, kv_h),
+        _from_bhsd(dv, b, kv_h),
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -437,6 +482,10 @@ def flash_attention(
     Block sizes default to ``min(512, seq)`` (see ``_MAX_DEFAULT_BLOCK``);
     sequence length must divide by them (the BERT workload pads to 128
     multiples; the dispatcher enforces this before choosing the kernel).
+
+    Grouped-query attention: ``k``/``v`` may carry fewer heads than ``q``
+    (a positive divisor) — consumed natively via index-mapped K/V specs
+    (see ``_gqa_layout``); grads come back at the grouped head counts.
     """
     s = q.shape[1]
     block_q = block_q or _default_block(s)
