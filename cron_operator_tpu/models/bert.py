@@ -21,6 +21,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from cron_operator_tpu.models.layers import grouped_qkv_projection
 from cron_operator_tpu.ops.attention import multi_head_attention
 
 
@@ -37,6 +38,11 @@ class BertConfig:
     # Run the Pallas kernels under the interpreter — CPU tests of the flash
     # path (forward AND backward) through the full model; never set on TPU.
     attention_interpret: bool = False
+    # Grouped-query attention (0 = MHA, fused qkv projection preserved
+    # for checkpoint compat) and rotary positions — same semantics as
+    # GPTConfig; the dispatcher/flash kernel consume the grouped layout.
+    num_kv_heads: int = 0
+    rope: bool = False
 
     @staticmethod
     def base(**overrides) -> "BertConfig":
@@ -59,16 +65,14 @@ class EncoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
-        head_dim = cfg.hidden_size // cfg.num_heads
-        b, s, _ = x.shape
 
         # Pre-LN (trains stably without warmup — fine for benchmarks).
         y = nn.LayerNorm(dtype=cfg.dtype)(x)
-        qkv = nn.DenseGeneral(
-            (3, cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
-            name="qkv",
-        )(y)
-        q, k, v = (qkv[:, :, i] for i in range(3))  # each [b, s, h, d]
+        # Shared GQA/RoPE projection contract (models/layers.py) — also
+        # what ViT uses through this layer; rotary positions work for
+        # bidirectional encoders too (1-D over the flattened patch index
+        # in ViT's case).
+        q, k, v = grouped_qkv_projection(cfg, y)
         attn = multi_head_attention(
             q, k, v, impl=cfg.attention_impl, mesh=self.mesh,
             interpret=cfg.attention_interpret,
@@ -97,13 +101,17 @@ class Bert(nn.Module):
         tok = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="tok_emb"
         )
-        pos = self.param(
+        # RoPE replaces the learned absolute table (same semantics as
+        # GPT) — keeping both would double-encode positions.
+        pos = None if cfg.rope else self.param(
             "pos_emb",
             nn.initializers.normal(0.02),
             (cfg.max_len, cfg.hidden_size),
         )
         s = input_ids.shape[1]
-        x = tok(input_ids) + pos[None, :s].astype(cfg.dtype)
+        x = tok(input_ids)
+        if pos is not None:
+            x = x + pos[None, :s].astype(cfg.dtype)
         for i in range(cfg.num_layers):
             x = EncoderLayer(cfg, mesh=self.mesh, name=f"layer_{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype)(x)

@@ -22,8 +22,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from cron_operator_tpu.models.layers import grouped_qkv_projection
 from cron_operator_tpu.ops.attention import multi_head_attention
-from cron_operator_tpu.ops.rope import apply_rope
 from cron_operator_tpu.parallel.moe import moe_ffn
 
 
@@ -135,41 +135,16 @@ class DecoderLayer(nn.Module):
         self, x: jnp.ndarray, pos_idx: Optional[jnp.ndarray] = None
     ) -> tuple:
         cfg = self.config
-        head_dim = cfg.hidden_size // cfg.num_heads
-        kv_heads = cfg.num_kv_heads or cfg.num_heads
-        if kv_heads < 1 or cfg.num_heads % kv_heads:
-            raise ValueError(
-                f"num_kv_heads {kv_heads} must be a positive divisor of "
-                f"num_heads {cfg.num_heads}"
-            )
 
         y = nn.LayerNorm(dtype=cfg.dtype)(x)
-        if kv_heads == cfg.num_heads:
-            # MHA keeps the fused projection (checkpoint-compatible with
-            # configs that predate GQA).
-            qkv = nn.DenseGeneral(
-                (3, cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
-                name="qkv",
-            )(y)
-            q, k, v = (qkv[:, :, i] for i in range(3))
-        else:
-            q = nn.DenseGeneral(
-                (cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
-                name="q",
-            )(y)
-            kv = nn.DenseGeneral(
-                (2, kv_heads, head_dim), axis=-1, dtype=cfg.dtype,
-                name="kv",
-            )(y)
-            k, v = kv[:, :, 0], kv[:, :, 1]
-
-        if cfg.rope:
-            if self.decode:
-                positions = pos_idx[None]  # the one current position
-            else:
-                positions = jnp.arange(x.shape[1])
-            q = apply_rope(q, positions)
-            k = apply_rope(k, positions)
+        # Shared GQA/RoPE projection contract (models/layers.py); decode
+        # rotates at the single cache position instead of arange.
+        q, k, v = grouped_qkv_projection(
+            cfg, y,
+            rope_positions=(
+                pos_idx[None] if (self.decode and cfg.rope) else None
+            ),
+        )
 
         if self.decode:
             attn = self._decode_attention(q, k, v, pos_idx)

@@ -1,0 +1,64 @@
+"""Shared attention-projection building block for the model zoo.
+
+One implementation of the Q/K/V projection contract all transformer
+families use (BERT/ViT encoder, GPT decoder): fused ``qkv`` for MHA
+(keeps param trees byte-compatible with checkpoints that predate GQA),
+split ``q`` + ``kv`` projections for grouped-query configs, and rotary
+position application when the config asks for it. Factored here so the
+GQA/RoPE semantics cannot drift between the families.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from cron_operator_tpu.ops.rope import apply_rope
+
+
+def grouped_qkv_projection(
+    cfg, y: jnp.ndarray, rope_positions: Optional[jax.Array] = None
+):
+    """Project ``y [b, s, hidden]`` → (q, k, v) per ``cfg``.
+
+    ``cfg`` needs ``hidden_size``, ``num_heads``, ``num_kv_heads``
+    (0 = MHA), ``dtype`` and ``rope``. Must be called inside a flax
+    compact context (creates the projection submodules). When
+    ``cfg.rope``, Q/K are rotated at ``rope_positions`` (defaults to
+    ``arange(s)``; decode passes its single cache position).
+    """
+    head_dim = cfg.hidden_size // cfg.num_heads
+    kv_heads = cfg.num_kv_heads or cfg.num_heads
+    if kv_heads < 1 or cfg.num_heads % kv_heads:
+        raise ValueError(
+            f"num_kv_heads {kv_heads} must be a positive divisor of "
+            f"num_heads {cfg.num_heads}"
+        )
+    if kv_heads == cfg.num_heads:
+        qkv = nn.DenseGeneral(
+            (3, cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype,
+            name="qkv",
+        )(y)
+        q, k, v = (qkv[:, :, i] for i in range(3))  # each [b, s, h, d]
+    else:
+        q = nn.DenseGeneral(
+            (cfg.num_heads, head_dim), axis=-1, dtype=cfg.dtype, name="q"
+        )(y)
+        kv = nn.DenseGeneral(
+            (2, kv_heads, head_dim), axis=-1, dtype=cfg.dtype, name="kv"
+        )(y)
+        k, v = kv[:, :, 0], kv[:, :, 1]
+    if cfg.rope:
+        positions = (
+            jnp.arange(y.shape[1]) if rope_positions is None
+            else rope_positions
+        )
+        q = apply_rope(q, positions)
+        k = apply_rope(k, positions)
+    return q, k, v
+
+
+__all__ = ["grouped_qkv_projection"]

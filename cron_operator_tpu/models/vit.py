@@ -43,6 +43,10 @@ class ViTConfig:
     dtype: Any = jnp.bfloat16
     attention_impl: str = "auto"  # auto | flash | xla | ring | ulysses
     attention_interpret: bool = False
+    # Same semantics as BertConfig (the encoder layer is shared): GQA
+    # head grouping and rotary positions over the flattened patch index.
+    num_kv_heads: int = 0
+    rope: bool = False
 
     @staticmethod
     def base(**overrides) -> "ViTConfig":
@@ -90,11 +94,12 @@ class ViT(nn.Module):
              x],
             axis=1,
         )
-        pos = self.param(
-            "pos_emb", nn.initializers.normal(0.02),
-            (n + 1, cfg.hidden_size),
-        )
-        x = x + pos[None].astype(cfg.dtype)
+        if not cfg.rope:  # rotary (in the shared encoder layer) replaces
+            pos = self.param(  # the learned absolute table
+                "pos_emb", nn.initializers.normal(0.02),
+                (n + 1, cfg.hidden_size),
+            )
+            x = x + pos[None].astype(cfg.dtype)
 
         for i in range(cfg.num_layers):
             x = EncoderLayer(cfg, mesh=self.mesh, name=f"layer_{i}")(x)

@@ -103,6 +103,15 @@ def _sync_every(ctx: JobContext) -> int:
     return int(ctx.params.get("sync_every", 1))
 
 
+def _gqa_rope_kwargs(ctx: JobContext) -> dict:
+    """param.kv_heads / param.rope — shared by every attention family
+    (bert/gpt/vit training and the generate serving job), parsed once."""
+    return {
+        "num_kv_heads": int(ctx.params.get("kv_heads", 0)),
+        "rope": ctx.params.get("rope", "0") in ("1", "true"),
+    }
+
+
 def _train_kwargs(ctx: JobContext, steps: int, **defaults) -> dict:
     """TrainConfig kwargs shared by every entrypoint: per-entrypoint
     defaults overridden by the common ``param.*`` surface — ``lr``,
@@ -299,8 +308,9 @@ def bert(ctx: JobContext) -> None:
 
     Params: steps(=10), batch_size(=8), seq_len(=512), size(=base|tiny),
     attention(=auto|flash|xla|ring|ulysses), seq/tensor/fsdp mesh axes,
-    remat(=0). With ``seq`` > 1 the sequence axis is sharded over the
-    mesh (ring rotates K/V, ulysses all-to-alls heads).
+    remat(=0), kv_heads(=0: MHA), rope(=0|1). With ``seq`` > 1 the
+    sequence axis is sharded over the mesh (ring rotates K/V, ulysses
+    all-to-alls heads).
     """
     steps = int(ctx.params.get("steps", 10))
     batch_size = int(ctx.params.get("batch_size", 8))
@@ -311,7 +321,10 @@ def bert(ctx: JobContext) -> None:
     with jax.default_device(devs[0]):
         mesh = _mesh(ctx, devs)
         maker = BertConfig.tiny if size == "tiny" else BertConfig.base
-        cfg = maker(max_len=seq_len, attention_impl=attention)
+        cfg = maker(
+            max_len=seq_len, attention_impl=attention,
+            **_gqa_rope_kwargs(ctx),
+        )
         model = Bert(cfg, mesh=mesh)
         params = _jit_init(
             model, jax.random.PRNGKey(0), _zeros((1, seq_len), dtype="int32")
@@ -370,8 +383,7 @@ def gpt(ctx: JobContext) -> None:
             max_len=seq_len, attention_impl=attention,
             moe_every=moe_every, num_experts=num_experts,
             return_hidden=fused_xent,
-            num_kv_heads=int(ctx.params.get("kv_heads", 0)),
-            rope=ctx.params.get("rope", "0") in ("1", "true"),
+            **_gqa_rope_kwargs(ctx),
         )
         model = GPT(cfg, mesh=mesh)
         params = _jit_init(
@@ -423,15 +435,20 @@ def vit(ctx: JobContext) -> None:
     """ViT classification on synthetic ImageNet — attention on images.
 
     Params: steps(=10), batch_size(=64), image_size(=224), size(=base|tiny),
-    remat(=0). Attention is XLA dense — the (size/patch)²+1 token count is
-    never 128-aligned, so the flash/sequence-parallel paths don't apply
-    (see models/vit.py).
+    remat(=0), kv_heads(=0: MHA), rope(=0|1: rotary over the flattened
+    patch index, replacing the learned table). Attention is XLA dense —
+    the (size/patch)²+1 token count is never 128-aligned, so the
+    flash/sequence-parallel paths don't apply (see models/vit.py).
     """
     steps = int(ctx.params.get("steps", 10))
     batch_size = int(ctx.params.get("batch_size", 64))
     size = ctx.params.get("size", "base")
     maker = ViTConfig.tiny if size == "tiny" else ViTConfig.base
-    cfg = maker()  # attention stays "auto"→xla; see docstring
+    # attention stays "auto"→xla (see docstring); GQA/RoPE ride the
+    # shared encoder projection.
+    cfg = maker(
+        **_gqa_rope_kwargs(ctx),
+    )
     image_size = int(ctx.params.get("image_size", cfg.image_size))
     if image_size != cfg.image_size:
         from dataclasses import replace
@@ -504,8 +521,7 @@ def generate_job(ctx: JobContext) -> None:
         # checkpoint, or the pos_emb table shapes disagree at restore.
         cfg = maker(
             max_len=int(ctx.params.get("seq_len", prompt_len + max_new)),
-            num_kv_heads=int(ctx.params.get("kv_heads", 0)),
-            rope=ctx.params.get("rope", "0") in ("1", "true"),
+            **_gqa_rope_kwargs(ctx),
             # Must mirror the training config when serving an MoE
             # checkpoint — a dense serve model can't hold 'moe' subtrees.
             moe_every=int(ctx.params.get("moe_every", 0)),
