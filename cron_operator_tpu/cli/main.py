@@ -613,30 +613,46 @@ def cmd_trigger(args: argparse.Namespace) -> int:
                   "apiVersion + kind", file=sys.stderr)
             return 1
 
-        w = _copy.deepcopy(template)
-        meta = w.setdefault("metadata", {})
-        meta.pop("generateName", None)
-        # "-manual-" keeps manual runs visually distinct from scheduled
-        # ones (whose names encode the tick unix time) and out of the
-        # deterministic-name fail-over guard's namespace.
-        meta["name"] = f"{args.name}-manual-{int(_time.time())}"
-        attach_cron_ownership(
-            w, args.name, (cron.get("metadata") or {}).get("uid"),
-            args.namespace,
-        )
-        # Same TPU seam as the tick path (cron_controller reconcile):
-        # nodeSelectors / chip resources / replicas=hosts / coordinator
-        # env must be on the object we POST; invalid annotations fail the
-        # command the way FailedTPUAdmission fails the tick.
-        try:
-            inject_tpu_topology(w)
-        except ValueError as err:
-            print(f"error: TPU admission failed: {err}", file=sys.stderr)
-            return 1
-        try:
-            created = api.create(w)
-        except AlreadyExistsError:
-            print(f"error: {meta['name']} already exists (retry in 1s)",
+        # The timestamp is second-granular, so two triggers in the same
+        # second would collide; disambiguate with a short suffix instead
+        # of telling the user to retry (ADVICE r4). Each attempt builds
+        # the workload from scratch AFTER the name is final: the TPU seam
+        # below bakes the name into the coordinator env
+        # (JAX_COORDINATOR_ADDRESS = "{name}-worker-0..."), so renaming a
+        # previously injected object would ship a dangling DNS name.
+        created = name = None
+        for attempt in range(5):
+            suffix = f"-{attempt}" if attempt else ""
+            name = f"{args.name}-manual-{int(_time.time())}{suffix}"
+            w = _copy.deepcopy(template)
+            meta = w.setdefault("metadata", {})
+            meta.pop("generateName", None)
+            # "-manual-" keeps manual runs visually distinct from
+            # scheduled ones (whose names encode the tick unix time) and
+            # out of the deterministic-name fail-over guard's namespace.
+            meta["name"] = name
+            attach_cron_ownership(
+                w, args.name, (cron.get("metadata") or {}).get("uid"),
+                args.namespace,
+            )
+            # Same TPU seam as the tick path (cron_controller reconcile):
+            # nodeSelectors / chip resources / replicas=hosts /
+            # coordinator env must be on the object we POST; invalid
+            # annotations fail the command the way FailedTPUAdmission
+            # fails the tick.
+            try:
+                inject_tpu_topology(w)
+            except ValueError as err:
+                print(f"error: TPU admission failed: {err}",
+                      file=sys.stderr)
+                return 1
+            try:
+                created = api.create(w)
+                break
+            except AlreadyExistsError:
+                continue
+        if created is None:
+            print(f"error: {name} already exists (retry in 1s)",
                   file=sys.stderr)
             return 1
         api.record_event(

@@ -232,7 +232,13 @@ class DecoderLayer(nn.Module):
         mask = jnp.arange(cfg.max_len) <= pos_idx  # written positions
         scores = jnp.where(mask[None, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bkgs,bskd->bkgd", probs, cache_v.value)
+        # f32 accumulation: the reduction runs over max_len positions, so
+        # bf16 partial sums would lose precision on long contexts (ADVICE
+        # r4) — accumulate f32, store back in the compute dtype.
+        out = jnp.einsum(
+            "bkgs,bskd->bkgd", probs, cache_v.value,
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
         return out.reshape(b, 1, h, d)
 
 

@@ -34,8 +34,12 @@ from typing import Any, Callable, List
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# jax.shard_map is the stable spelling (ring.py/attention.py use it too);
+# the jax.experimental alias warned on every import and is slated for
+# removal.
+shard_map = jax.shard_map
 
 from cron_operator_tpu.parallel.mesh import BATCH_AXES, PIPE_AXIS
 
@@ -164,7 +168,7 @@ def spmd_pipeline(
         mesh=mesh,
         in_specs=(P(PIPE_AXIS), x_spec),
         out_specs=x_spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn(stacked_params, x)
 

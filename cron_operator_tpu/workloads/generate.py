@@ -14,10 +14,20 @@ threaded through the scans as a plain pytree.
 
 Decode is bandwidth-bound (one [1, max_len] attention row per head per
 step); batch is the throughput lever, exactly as on any accelerator.
+
+MoE caveat: cached decode raises expert capacity to no-drop (a single
+token must never be dropped by its own router), while prefill/training
+keep the configured ``moe_capacity_factor``. The two paths are therefore
+only bitwise-identical when ``moe_capacity_factor >= num_experts``; with
+a drop-capable capacity a token dropped during prefill but routed during
+decode (or vice versa) can legitimately diverge. Operators comparing
+decode output against a full forward should pin capacity accordingly.
 """
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from dataclasses import replace
 from typing import Optional
 
@@ -27,7 +37,19 @@ from jax import lax
 
 from cron_operator_tpu.models.gpt import GPT, GPTConfig
 
-_COMPILED = {}  # (cfg, max_new, greedy) → jitted fn (shapes handled by jit)
+# (cfg, max_new, greedy) → jitted fn. LRU-bounded: a long-lived serving
+# operator fed varying max_new/configs must not accumulate compiled
+# executables forever (ADVICE r4). Evicting a jitted fn drops its
+# compiled programs with it; a re-encountered key recompiles (or hits the
+# persistent XLA cache). Each entry can still hold multiple shape
+# specializations — that is jit's own per-fn cache, bounded by the entry
+# count here.
+_COMPILED_CAP = 8
+_COMPILED: "OrderedDict" = OrderedDict()
+# The local backend runs workloads on threads; get/insert/evict/
+# move_to_end must be atomic or a concurrent eviction between a hit and
+# its move_to_end raises KeyError.
+_COMPILED_LOCK = threading.Lock()
 
 
 def generate(
@@ -66,10 +88,19 @@ def generate(
     # jit specializes per input shape on its own; keying the wrapper by
     # shapes too would just grow an unbounded duplicate cache.
     key = (config, max_new_tokens, greedy)
-    fn = _COMPILED.get(key)
+    with _COMPILED_LOCK:
+        fn = _COMPILED.get(key)
+        if fn is not None:
+            _COMPILED.move_to_end(key)
     if fn is None:
+        # Build outside the lock (tracing is slow); worst case two
+        # threads build the same fn and one insert wins — harmless.
         fn = _build(config, max_new_tokens, greedy)
-        _COMPILED[key] = fn
+        with _COMPILED_LOCK:
+            fn = _COMPILED.setdefault(key, fn)
+            _COMPILED.move_to_end(key)
+            while len(_COMPILED) > _COMPILED_CAP:
+                _COMPILED.popitem(last=False)
     return fn(params, prompt_ids, jnp.float32(max(temperature, 1e-6)), rng)
 
 

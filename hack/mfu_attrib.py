@@ -1,0 +1,234 @@
+"""ResNet-50 step-time attribution (VERDICT r4 #1, stage 2).
+
+The sweep (hack/mfu_probe.py) showed chain ≈ dispatch (no tunnel/host
+overhead) and best MFU ~15% at batch 128 — so the compute itself is the
+ceiling. This probe times the step's components separately:
+
+- ``rng``        — just the synthetic-batch generation (jax.random.normal
+                   of [b, 224, 224, 3] + randint labels). Threefry on TPU
+                   is ALU-heavy; if this is a big slice, the "training"
+                   number is paying for the data generator.
+- ``rng_rbg``    — same under the rbg PRNG (hardware RNG, much cheaper).
+- ``fwd``        — forward pass only, fixed batch.
+- ``fwdbwd``     — value_and_grad + SGD update, fixed batch (the train
+                   step minus data generation).
+- ``fwdbwd_nonorm`` — same but with GroupNorm replaced by identity:
+                   the delta is the norm layers' cost (53 of them; a
+                   two-pass reduction each ⇒ prime HBM-traffic suspect).
+- ``step``       — the full step as benched (rng + fwd + bwd + opt).
+
+Also prints XLA's own flop count for the fwd (cost_analysis), checking
+the 12.3 GFLOP/img MFU denominator.
+
+Run: ``python hack/mfu_attrib.py [batch=128] [image=224] [chain=5]``.
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def _parse(argv):
+    out = {}
+    for a in argv:
+        if "=" in a:
+            k, v = a.split("=", 1)
+            out[k] = v
+    return out
+
+
+def main() -> int:
+    cli = _parse(sys.argv[1:])
+    batch = int(cli.get("batch", "128"))
+    image = int(cli.get("image", "224"))
+    chain = int(cli.get("chain", "5"))
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from cron_operator_tpu.models import ResNet50
+
+    class _Identity(nn.Module):
+        """GroupNorm stand-in: same call signature, no reduction."""
+        dtype: jnp.dtype = jnp.bfloat16
+
+        @nn.compact
+        def __call__(self, x):
+            # A learnable scale keeps parameter structure non-empty so
+            # value_and_grad still has something per layer; cost ~0.
+            s = self.param("scale", nn.initializers.ones, (1,))
+            return x * s.astype(x.dtype)
+
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    def fetch(c):
+        float(jax.tree_util.tree_leaves(c)[0].ravel()[0])
+
+    def timed(run, carry):
+        """(t_2k - t_k)/(k*chain) span differencing, best-of-3."""
+        c = run(carry)
+        fetch(c)
+
+        def span(k):
+            nonlocal c
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    c = run(c)
+                fetch(c)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t1, t2 = span(1), span(2)
+        per = max(t2 - t1, 1e-6)
+        k = max(1, min(64, int(1.0 / per)))
+        tk, t2k = span(k), span(2 * k)
+        diff = t2k - tk
+        return (diff / (k * chain)) if diff > 0 else None
+
+    def scan_of(body):
+        return jax.jit(
+            lambda c: jax.lax.scan(body, c, None, length=chain)[0],
+            donate_argnums=0,
+        )
+
+    out = {"batch": batch, "image": image, "chain": chain}
+
+    # --- rng-only --------------------------------------------------------
+    def rng_body(carry, _):
+        key, acc = carry
+        key, k1, k2 = jax.random.split(key, 3)
+        x = jax.random.normal(k1, (batch, image, image, 3), jnp.bfloat16)
+        y = jax.random.randint(k2, (batch,), 0, 1000)
+        # Touch the outputs so XLA cannot DCE the generation.
+        return (key, acc + x.mean().astype(jnp.float32) + y.sum()), None
+
+    t = timed(scan_of(rng_body), (jax.random.PRNGKey(0), jnp.float32(0)))
+    out["rng_ms"] = round(t * 1e3, 2) if t else None
+
+    # --- rng under rbg ---------------------------------------------------
+    def rbg_body(carry, _):
+        key, acc = carry
+        key, k1, k2 = jax.random.split(key, 3)
+        x = jax.random.normal(k1, (batch, image, image, 3), jnp.bfloat16)
+        y = jax.random.randint(k2, (batch,), 0, 1000)
+        return (key, acc + x.mean().astype(jnp.float32) + y.sum()), None
+
+    rbg_key = jax.random.key(0, impl="rbg")
+    try:
+        t = timed(scan_of(rbg_body), (rbg_key, jnp.float32(0)))
+        out["rng_rbg_ms"] = round(t * 1e3, 2) if t else None
+    except Exception as exc:  # noqa: BLE001
+        out["rng_rbg_ms"] = f"error: {str(exc)[-200:]}"
+
+    # --- model variants --------------------------------------------------
+    def build(norm=None):
+        kw = {}
+        if norm is not None:
+            from cron_operator_tpu.models.resnet import BottleneckBlock
+            from functools import partial as _p
+
+            kw["block"] = _p(BottleneckBlock, norm=norm)
+        model = ResNet50(**kw)
+        params = jax.jit(model.init)(
+            jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3))
+        )["params"]
+        return model, params
+
+    def loss_of(model, p, x, y):
+        logits = model.apply({"params": p}, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
+
+    def fresh(tree):
+        """Deep-copy a param tree so a donated carry never deletes the
+        original's buffers (each timed() run donates its carry)."""
+        return jax.tree_util.tree_map(jnp.copy, tree)
+
+    x_fix = jax.random.normal(
+        jax.random.PRNGKey(3), (batch, image, image, 3), jnp.bfloat16
+    )
+    y_fix = jax.random.randint(jax.random.PRNGKey(4), (batch,), 0, 1000)
+
+    model, params = build()
+
+    # XLA's own flop count for the fwd — sanity on the MFU denominator.
+    try:
+        lowered = jax.jit(
+            lambda p, x: model.apply({"params": p}, x)
+        ).lower(params, x_fix)
+        ca = lowered.compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if ca and "flops" in ca:
+            out["xla_fwd_flops_per_image"] = round(ca["flops"] / batch / 1e9,
+                                                   2)
+    except Exception as exc:  # noqa: BLE001
+        out["xla_fwd_flops_per_image"] = f"error: {str(exc)[-200:]}"
+
+    # fwd only
+    def fwd_body(carry, _):
+        acc = carry
+        l = loss_of(model, params, x_fix, y_fix)
+        return acc + l, None
+
+    t = timed(scan_of(fwd_body), jnp.float32(0))
+    out["fwd_ms"] = round(t * 1e3, 2) if t else None
+
+    # fwd+bwd+opt, fixed data
+    def make_step(model, params):
+        p0 = fresh(params)
+
+        def body(carry, _):
+            p, o = carry
+            _, g = jax.value_and_grad(
+                lambda pp: loss_of(model, pp, x_fix, y_fix)
+            )(p)
+            u, o = tx.update(g, o, p)
+            return (optax.apply_updates(p, u), o), None
+        return body, (p0, tx.init(p0))
+
+    body, carry = make_step(model, params)
+    t = timed(scan_of(body), carry)
+    out["fwdbwd_ms"] = round(t * 1e3, 2) if t else None
+
+    # fwd+bwd+opt with identity norm
+    model_nn, params_nn = build(norm=_Identity)
+    body, carry = make_step(model_nn, params_nn)
+    t = timed(scan_of(body), carry)
+    out["fwdbwd_nonorm_ms"] = round(t * 1e3, 2) if t else None
+
+    # full step (rng + train), the benched configuration
+    def full_body(carry, _):
+        p, o, key = carry
+        key, k1, k2 = jax.random.split(key, 3)
+        x = jax.random.normal(k1, (batch, image, image, 3), jnp.bfloat16)
+        y = jax.random.randint(k2, (batch,), 0, 1000)
+        _, g = jax.value_and_grad(lambda pp: loss_of(model, pp, x, y))(p)
+        u, o = tx.update(g, o, p)
+        return (optax.apply_updates(p, u), o, key), None
+
+    p0 = fresh(params)
+    t = timed(scan_of(full_body),
+              (p0, tx.init(p0), jax.random.PRNGKey(1)))
+    out["step_ms"] = round(t * 1e3, 2) if t else None
+
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

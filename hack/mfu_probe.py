@@ -1,0 +1,223 @@
+"""ResNet-50 MFU attribution probe (VERDICT r4 #1).
+
+The r4 artifact reported mfu=0.1247 at batch 64 with no attribution. This
+probe separates the three candidate causes:
+
+- **batch too small** — sweep batch sizes; MFU should climb if the MXU is
+  under-fed at 64.
+- **dispatch/tunnel overhead** — time the SAME train step two ways:
+  ``chain`` (one jitted ``lax.scan`` of CHAIN steps, span-differenced —
+  pure device compute, zero per-step host involvement) vs ``dispatch``
+  (one jitted call per step, value fetch at the end — the Trainer's
+  shape). The difference is host dispatch + tunnel cost, not the model.
+- **conv efficiency** — if the chain MFU is still low at the best batch,
+  the convs themselves are the ceiling; optionally dump a profiler trace
+  (``profile_dir=...``) for the best config.
+
+Timing methodology is ops/microbench.timed_chain's: one compiled program
+fed its own output across two spans of k and 2k repeats; report
+(t_2k - t_k) / (k * CHAIN). A value fetch (not block_until_ready — the
+axon client's block returns optimistically) bounds each span, and its
+constant cost cancels in the difference.
+
+Run: ``python hack/mfu_probe.py [batch=64,128,256] [image=224]
+[chain=5] [profile_dir=/tmp/trace]``. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+# One source of truth for the FLOPs model and the ordered peak table —
+# bench.py's PEAK_FLOPS already encodes the "v5 lite before v5" ordering
+# lesson (its r3 dict produced mfu:null on the real chip).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import PEAK_FLOPS, _flops_per_image  # noqa: E402
+
+
+def _parse(argv):
+    out = {}
+    for a in argv:
+        if "=" in a:
+            k, v = a.split("=", 1)
+            out[k] = v
+    return out
+
+
+def main() -> int:
+    params_cli = _parse(sys.argv[1:])
+    batches = [int(b) for b in params_cli.get("batch", "64,128,256").split(",")]
+    image = int(params_cli.get("image", "224"))
+    chain = int(params_cli.get("chain", "5"))
+    profile_dir = params_cli.get("profile_dir")
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from cron_operator_tpu.models import ResNet50
+
+    dev = jax.devices()[0]
+    kind = dev.device_kind
+    peak = next((v for k, v in PEAK_FLOPS if k in kind.lower()), None)
+    flops_per_image = _flops_per_image(image)
+
+    model = ResNet50()
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    def loss_of(p, x, y):
+        logits = model.apply({"params": p}, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
+
+    def fetch(c):
+        # True sync: pull one scalar (axon block_until_ready is optimistic).
+        float(jax.tree_util.tree_leaves(c)[0].ravel()[0])
+
+    def make_step(batch):
+        """The train-step body — ONE definition shared by the sweep and
+        the profiler block, so the profiled trace is the same program the
+        sweep timed."""
+        def step(carry, _):
+            p, o, key = carry
+            key, k1, k2 = jax.random.split(key, 3)
+            x = jax.random.normal(k1, (batch, image, image, 3),
+                                  jnp.bfloat16)
+            y = jax.random.randint(k2, (batch,), 0, 1000)
+            _, g = jax.value_and_grad(loss_of)(p, x, y)
+            u, o = tx.update(g, o, p)
+            return (optax.apply_updates(p, u), o, key), None
+        return step
+
+    def make_chain_run(batch):
+        step = make_step(batch)
+        return jax.jit(
+            lambda c: jax.lax.scan(step, c, None, length=chain)[0],
+            donate_argnums=0,
+        )
+
+    def init_carry():
+        params = jax.jit(model.init)(
+            jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3))
+        )["params"]
+        return (params, tx.init(params), jax.random.PRNGKey(1))
+
+    results = []
+    for batch in batches:
+        rec = {"batch": batch, "image": image}
+        try:
+            # --- chain mode: pure device compute --------------------------
+            run = make_chain_run(batch)
+            t0 = time.perf_counter()
+            c = run(init_carry())
+            fetch(c)
+            rec["compile_plus_first_s"] = round(time.perf_counter() - t0, 1)
+
+            def span(k):
+                nonlocal c
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    for _ in range(k):
+                        c = run(c)
+                    fetch(c)
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            t1 = span(1)
+            t2 = span(2)
+            per_block = max(t2 - t1, 1e-6)
+            k = max(1, min(64, int(1.0 / per_block)))
+            tk = span(k)
+            t2k = span(2 * k)
+            diff = t2k - tk
+            if diff > 0:
+                chain_step = diff / (k * chain)
+                rec["chain_step_ms"] = round(chain_step * 1e3, 2)
+                rec["chain_images_per_s"] = round(batch / chain_step, 1)
+                if peak:
+                    rec["chain_mfu"] = round(
+                        batch * flops_per_image / chain_step / peak, 4
+                    )
+            else:
+                rec["chain_step_ms"] = None
+
+            # --- dispatch mode: one call per step, fetch at the end -------
+            # (the Trainer's shape: value_and_grad + apply per dispatch)
+            step = make_step(batch)
+            one = jax.jit(
+                lambda c: step(c, None)[0], donate_argnums=0
+            )
+            c1 = one(c)
+            fetch(c1)
+            n = max(10, int(0.5 / max(chain_step, 1e-3)) if diff > 0 else 10)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    c1 = one(c1)
+                fetch(c1)
+                best = min(best, time.perf_counter() - t0)
+            disp_step = best / n
+            rec["dispatch_step_ms"] = round(disp_step * 1e3, 2)
+            rec["dispatch_n"] = n
+            if peak:
+                rec["dispatch_mfu"] = round(
+                    batch * flops_per_image / disp_step / peak, 4
+                )
+            del c, c1
+        except Exception as exc:  # noqa: BLE001 — one OOM batch must not
+            rec["error"] = str(exc)[-400:]  # kill the sweep
+        results.append(rec)
+
+    # Keyed on images/s, not MFU: MFU needs a PEAK entry for the device
+    # kind, and an unknown kind must not silently skip a requested trace.
+    best = max(
+        (r for r in results if r.get("chain_images_per_s")),
+        key=lambda r: r["chain_images_per_s"],
+        default=None,
+    )
+    profile_error = None
+    if profile_dir and best is not None:
+        # Re-run the best config briefly under the profiler for op-level
+        # attribution (TensorBoard/XProf artifact). Same program as the
+        # sweep: make_chain_run is the single step-builder. Guarded: an
+        # optional trace must never discard the sweep's measurements.
+        try:
+            run = make_chain_run(best["batch"])
+            c = run(init_carry())
+            fetch(c)
+            jax.profiler.start_trace(profile_dir)
+            for _ in range(3):
+                c = run(c)
+            fetch(c)
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001
+            profile_error = str(exc)[-400:]
+
+    print(json.dumps({
+        "device_kind": kind,
+        "backend": jax.default_backend(),
+        "peak_flops": peak,
+        "flops_per_image": flops_per_image,
+        "chain_len": chain,
+        "sweep": results,
+        "best": best,
+        "profile_dir": profile_dir if best else None,
+        "profile_error": profile_error,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

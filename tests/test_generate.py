@@ -165,3 +165,34 @@ class TestModernAttentionDecode:
                     jax.random.PRNGKey(0),
                     jnp.zeros((1, 4), jnp.int32),
                 )
+
+
+class TestCompiledCacheBound:
+    def test_lru_cap_bounds_distinct_keys(self, cpu0, monkeypatch):
+        """Many distinct (config, max_new) keys must not grow _COMPILED
+        without bound (ADVICE r4: a long-lived serving operator fed
+        varying max_new retains every jitted fn forever)."""
+        import cron_operator_tpu.workloads.generate as gen
+
+        monkeypatch.setattr(gen, "_COMPILED", type(gen._COMPILED)())
+        built = []
+
+        def fake_build(config, max_new, greedy):
+            built.append(max_new)
+            return lambda *a: jnp.zeros((1, 1), jnp.int32)
+
+        monkeypatch.setattr(gen, "_build", fake_build)
+        cfg = _tiny()
+        prompt = jnp.zeros((1, 2), jnp.int32)
+        for max_new in range(1, gen._COMPILED_CAP + 9):
+            gen.generate(cfg, {}, prompt, max_new)
+        assert len(gen._COMPILED) == gen._COMPILED_CAP
+
+        # LRU, not FIFO: re-touching a resident key keeps it resident.
+        survivor = max(built) - 1
+        gen.generate(cfg, {}, prompt, survivor)  # touch → most-recent
+        n_built = len(built)
+        gen.generate(cfg, {}, prompt, 1)  # evicts the true LRU entry
+        gen.generate(cfg, {}, prompt, survivor)  # still cached: no build
+        assert len(built) == n_built + 1
+        assert len(gen._COMPILED) == gen._COMPILED_CAP
