@@ -13,8 +13,10 @@ how JAXJob workloads execute.
 from __future__ import annotations
 
 import argparse
+import hmac
 import json
 import logging
+import os as _os
 import signal
 import sys
 import threading
@@ -31,9 +33,45 @@ def _parse_bind(addr: str) -> Optional[int]:
     return int(addr.rsplit(":", 1)[-1])
 
 
-def _serve(port: int, routes, name: str) -> ThreadingHTTPServer:
+def _bool_arg(v: str) -> bool:
+    """Go-style bool flag value ('--metrics-secure=false')."""
+    if v.lower() in ("1", "true", "t", "yes"):
+        return True
+    if v.lower() in ("0", "false", "f", "no"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected true/false, got {v!r}")
+
+
+def _serve(
+    port: int,
+    routes,
+    name: str,
+    tls_ctx=None,
+    token: Optional[str] = None,
+) -> ThreadingHTTPServer:
+    """Serve ``routes`` on ``port`` (0 = ephemeral; read
+    ``server.server_address``). ``tls_ctx`` wraps the listener in TLS;
+    ``token`` requires ``Authorization: Bearer <token>`` (401 otherwise)
+    — the embedded-mode analog of the reference's authn/z FilterProvider
+    (start.go:121-133), which delegates to TokenReview/
+    SubjectAccessReview in a real cluster."""
+
     class Handler(BaseHTTPRequestHandler):
+        # A stalled peer must not hold a handler thread forever (the TLS
+        # handshake also runs under this deadline — see wrap below).
+        timeout = 30
+
         def do_GET(self):  # noqa: N802
+            if token is not None and not hmac.compare_digest(
+                self.headers.get("Authorization") or "", f"Bearer {token}"
+            ):
+                body = b"Unauthorized"
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", "Bearer")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             fn = routes.get(self.path)
             if fn is None:
                 self.send_response(404)
@@ -51,6 +89,15 @@ def _serve(port: int, routes, name: str) -> ThreadingHTTPServer:
             pass
 
     server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    if tls_ctx is not None:
+        # Lazy handshake: with do_handshake_on_connect the handshake
+        # would run inside accept() on the single serve_forever thread,
+        # so one peer that connects and never sends a ClientHello wedges
+        # every later scrape. Deferring it moves the handshake into the
+        # per-connection handler thread, where Handler.timeout bounds it.
+        server.socket = tls_ctx.wrap_socket(
+            server.socket, server_side=True, do_handshake_on_connect=False
+        )
     threading.Thread(target=server.serve_forever, name=name, daemon=True).start()
     return server
 
@@ -74,6 +121,29 @@ def build_parser() -> argparse.ArgumentParser:
                        help="kube client burst (cluster mode)")
     start.add_argument("--metrics-bind-address", default="0",
                        help="':8080' to enable, '0' to disable (default)")
+    # Secure-metrics trio (reference start.go:226-242; default-secure,
+    # default-no-h2 per the Rapid-Reset CVE guidance it cites):
+    start.add_argument("--metrics-secure", type=_bool_arg, default=True,
+                       metavar="BOOL",
+                       help="serve /metrics over HTTPS (default true; "
+                            "--metrics-secure=false for plain HTTP). With "
+                            "no --metrics-cert-path a self-signed cert is "
+                            "generated (dev/testing convenience, as in the "
+                            "reference)")
+    start.add_argument("--metrics-cert-path", default="",
+                       help="directory containing the metrics server "
+                            "certificate (watched for rotation)")
+    start.add_argument("--metrics-cert-name", default="tls.crt")
+    start.add_argument("--metrics-cert-key", default="tls.key")
+    start.add_argument("--metrics-token", default=None,
+                       help="bearer token required to scrape /metrics "
+                            "(defaults to --serve-api-token when that is "
+                            "set; unauthenticated otherwise)")
+    start.add_argument("--enable-http2", action="store_true", default=False,
+                       help="allow HTTP/2 ALPN on the TLS endpoints "
+                            "(default off, mirroring the reference's CVE "
+                            "mitigation; the embedded servers speak "
+                            "HTTP/1.1 either way)")
     start.add_argument("--health-probe-bind-address", default=":8081")
     start.add_argument("--leader-elect", action="store_true", default=False)
     start.add_argument("--zap-log-level", default="info",
@@ -306,16 +376,59 @@ def cmd_start(args: argparse.Namespace) -> int:
         )
         log.info("health probes serving on :%d", health_port)
     metrics_port = _parse_bind(args.metrics_bind_address)
+    cert_watcher = None
     if metrics_port is not None:
+        tls_ctx = None
+        if args.metrics_secure:
+            from cron_operator_tpu.utils.tlsutil import (
+                CertWatcher,
+                self_signed_cert,
+                server_context,
+            )
+
+            if args.metrics_cert_path:
+                cert = _os.path.join(args.metrics_cert_path,
+                                     args.metrics_cert_name)
+                key = _os.path.join(args.metrics_cert_path,
+                                    args.metrics_cert_key)
+                tls_ctx = server_context(
+                    cert, key, enable_http2=args.enable_http2
+                )
+                # Rotation: reload the pair into the live context when
+                # the files change (reference certwatcher parity).
+                cert_watcher = CertWatcher(tls_ctx, cert, key).start()
+                log.info("metrics TLS from %s (watched)",
+                         args.metrics_cert_path)
+            else:
+                cert, key = self_signed_cert()
+                tls_ctx = server_context(
+                    cert, key, enable_http2=args.enable_http2
+                )
+                log.info(
+                    "metrics TLS with a generated self-signed cert (%s) — "
+                    "pass --metrics-cert-path for production", cert,
+                )
+            if not args.enable_http2:
+                log.info("disabling http/2")
+        metrics_token = args.metrics_token or args.serve_api_token
+        if args.metrics_secure and not metrics_token:
+            log.warning(
+                "metrics served over TLS without authentication — set "
+                "--metrics-token (or --serve-api-token) to require a "
+                "bearer token"
+            )
         servers.append(
             _serve(
                 metrics_port,
                 {"/metrics": lambda: (manager.metrics.render_prometheus(),
                                       "text/plain")},
                 "metrics",
+                tls_ctx=tls_ctx,
+                token=metrics_token,
             )
         )
-        log.info("metrics serving on :%d", metrics_port)
+        log.info("metrics serving on :%d (%s)", metrics_port,
+                 "https" if tls_ctx is not None else "http")
 
     for manifest in args.load:
         import yaml
@@ -355,6 +468,8 @@ def cmd_start(args: argparse.Namespace) -> int:
     stop.wait(timeout=args.run_for)
 
     log.info("shutting down")
+    if cert_watcher is not None:
+        cert_watcher.stop()
     manager.stop()
     if api_http is not None:
         api_http.stop()

@@ -141,3 +141,109 @@ class TestNorthStarObservation:
         assert "# TYPE cron_tick_to_first_step_seconds histogram" in body
         assert 'cron_tick_to_first_step_seconds_bucket{le="30"} 1' in body
         assert "cron_tick_to_first_step_seconds_count 1" in body
+
+
+class TestSecureMetrics:
+    """VERDICT r4 #2: /metrics over TLS with bearer authn — the embedded
+    analog of the reference's secure-metrics stack
+    (cmd/operator/start.go:87-150)."""
+
+    def _serve_tls(self, token=None, enable_http2=False):
+        from cron_operator_tpu.cli.main import _serve
+        from cron_operator_tpu.utils.tlsutil import (
+            self_signed_cert,
+            server_context,
+        )
+
+        cert, key = self_signed_cert()
+        ctx = server_context(cert, key, enable_http2=enable_http2)
+        server = _serve(
+            0,
+            {"/metrics": lambda: ("# TYPE up gauge\nup 1\n", "text/plain")},
+            "test-secure-metrics",
+            tls_ctx=ctx,
+            token=token,
+        )
+        return server, cert
+
+    def _client_ctx(self, cert):
+        import ssl
+
+        # Verify against the self-signed cert itself: proves the
+        # generated cert is valid for 127.0.0.1, not just that TLS
+        # happens to be on.
+        ctx = ssl.create_default_context(cafile=cert)
+        ctx.check_hostname = False
+        return ctx
+
+    def test_scrape_with_token_ok_without_token_rejected(self):
+        import urllib.error
+
+        server, cert = self._serve_tls(token="s3cret")
+        try:
+            port = server.server_address[1]
+            url = f"https://127.0.0.1:{port}/metrics"
+            ctx = self._client_ctx(cert)
+
+            req = urllib.request.Request(
+                url, headers={"Authorization": "Bearer s3cret"}
+            )
+            with urllib.request.urlopen(req, timeout=5, context=ctx) as r:
+                assert r.status == 200
+                assert "up 1" in r.read().decode()
+
+            for headers in ({}, {"Authorization": "Bearer wrong"}):
+                req = urllib.request.Request(url, headers=headers)
+                try:
+                    urllib.request.urlopen(req, timeout=5, context=ctx)
+                    raise AssertionError("unauthenticated scrape passed")
+                except urllib.error.HTTPError as err:
+                    assert err.code == 401
+        finally:
+            server.shutdown()
+
+    def test_http2_refused_at_alpn_by_default(self):
+        import socket
+        import ssl
+
+        server, cert = self._serve_tls()
+        try:
+            port = server.server_address[1]
+            ctx = self._client_ctx(cert)
+            ctx.set_alpn_protocols(["h2", "http/1.1"])
+            with socket.create_connection(("127.0.0.1", port), 5) as raw:
+                with ctx.wrap_socket(raw) as tls:
+                    # The CVE-mitigation default: the server never
+                    # selects h2 even when the client prefers it.
+                    assert tls.selected_alpn_protocol() == "http/1.1"
+        finally:
+            server.shutdown()
+
+    def test_cert_watcher_reloads_rotated_pair(self, tmp_path):
+        import shutil
+
+        from cron_operator_tpu.utils.tlsutil import (
+            CertWatcher,
+            self_signed_cert,
+            server_context,
+        )
+
+        cert, key = self_signed_cert(dir=str(tmp_path / "a"))
+        ctx = server_context(cert, key)
+        watcher = CertWatcher(ctx, cert, key)  # not started: poll by hand
+        assert watcher.poll_once() is False  # unchanged → no reload
+
+        cert2, key2 = self_signed_cert(
+            common_name="rotated", dir=str(tmp_path / "b")
+        )
+        shutil.copy(cert2, cert)
+        shutil.copy(key2, key)
+        assert watcher.poll_once() is True
+        assert watcher.reloads == 1
+        assert watcher.poll_once() is False  # stable again
+
+        # Half-written rotation (key truncated): keep the old pair.
+        with open(key, "w"):
+            pass
+        assert watcher.poll_once() is False
+        assert watcher.reloads == 1
