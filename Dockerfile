@@ -18,7 +18,11 @@ RUN pip wheel --no-cache-dir --no-deps --wheel-dir /wheels .
 FROM python:3.12-slim
 
 COPY --from=builder /wheels /wheels
-RUN pip install --no-cache-dir /wheels/*.whl pyyaml && rm -rf /wheels
+# pyyaml: manifest loading; cryptography: the default-secure /metrics
+# self-signed certificate path (cmd_start fails fast with a clear error
+# if it is missing and no cert path is provided).
+RUN pip install --no-cache-dir /wheels/*.whl pyyaml cryptography \
+    && rm -rf /wheels
 
 USER 65534:65534
 

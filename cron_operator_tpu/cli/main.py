@@ -400,7 +400,20 @@ def cmd_start(args: argparse.Namespace) -> int:
                 log.info("metrics TLS from %s (watched)",
                          args.metrics_cert_path)
             else:
-                cert, key = self_signed_cert()
+                try:
+                    cert, key = self_signed_cert()
+                except ImportError as err:
+                    # Only the self-signed fallback needs `cryptography`;
+                    # provided certs (server_context) use stdlib ssl. Fail
+                    # fast with the actionable choices instead of a
+                    # crash-looping ModuleNotFoundError mid-startup.
+                    log.error(
+                        "metrics TLS needs the 'cryptography' package to "
+                        "generate a self-signed cert (%s); install it, "
+                        "provide --metrics-cert-path, or pass "
+                        "--metrics-secure=false", err,
+                    )
+                    return 2
                 tls_ctx = server_context(
                     cert, key, enable_http2=args.enable_http2
                 )

@@ -49,7 +49,13 @@ class ItemExponentialBackoff:
 
 class WorkQueue(Generic[T]):
     def __init__(self) -> None:
-        self._cond = threading.Condition()
+        lock = threading.RLock()
+        self._cond = threading.Condition(lock)
+        # The delay loop waits on its OWN condition (same lock): add()'s
+        # single notify() must only ever wake a worker blocked in get() —
+        # waking the delay loop instead would strand the added item until
+        # the next notify.
+        self._delay_cond = threading.Condition(lock)
         self._queue: List[T] = []
         self._dirty: set = set()
         self._processing: set = set()
@@ -116,7 +122,7 @@ class WorkQueue(Generic[T]):
             heapq.heappush(
                 self._delayed, (time.monotonic() + delay_s, next(self._seq), item)
             )
-            self._cond.notify()
+            self._delay_cond.notify()
 
     def add_rate_limited(self, item: T) -> None:
         self.add_after(item, self.rate_limiter.when(item))
@@ -125,6 +131,13 @@ class WorkQueue(Generic[T]):
         self.rate_limiter.forget(item)
 
     def _delay_loop(self) -> None:
+        # Deadline-aware, not fixed-cadence: sleep until the earliest
+        # pending deadline (add_after notifies the condition when a new
+        # earlier item lands). A fixed 5 ms poll burned ~200 wakeups/s
+        # per controller even while completely idle — measurable CPU
+        # stolen from co-located training dispatch on small hosts, for
+        # zero latency benefit. Capped at 100 ms so pathological clock
+        # weirdness can't wedge the loop.
         while True:
             due: List[T] = []
             with self._cond:
@@ -134,9 +147,15 @@ class WorkQueue(Generic[T]):
                 while self._delayed and self._delayed[0][0] <= now:
                     _, _, item = heapq.heappop(self._delayed)
                     due.append(item)
+                if not due:
+                    wait = (
+                        min(0.1, self._delayed[0][0] - now)
+                        if self._delayed else 0.1
+                    )
+                    self._delay_cond.wait(wait)
+                    continue
             for item in due:
                 self.add(item)
-            time.sleep(0.005)
 
     # ---- shutdown ---------------------------------------------------------
 
@@ -144,6 +163,7 @@ class WorkQueue(Generic[T]):
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
+            self._delay_cond.notify_all()
 
     @property
     def is_shut_down(self) -> bool:

@@ -66,6 +66,75 @@ def causal_token_batches(
         yield {"x": ids[:, :-1], "y": ids[:, 1:]}
 
 
+def mnist_sample(batch_size: int):
+    """``key → batch`` for MNIST shapes — the jitted-PRNG sample fn shared
+    by :func:`device_batches` (own-program-per-batch) and the Trainer's
+    FUSED mode (generation inlined into the train step: zero per-step
+    host→device traffic, see ``train.Trainer(sample_fn=...)``)."""
+    import jax
+    import jax.numpy as jnp
+
+    def sample(key):
+        kx, ky = jax.random.split(key)
+        return {
+            "x": jax.random.normal(kx, (batch_size, 28, 28, 1), jnp.float32),
+            "y": jax.random.randint(ky, (batch_size,), 0, 10,
+                                    dtype=jnp.int32),
+        }
+
+    return sample
+
+
+def imagenet_sample(batch_size: int, image_size: int = 224,
+                    num_classes: int = 1000):
+    """``key → batch`` for ImageNet shapes (see :func:`mnist_sample`)."""
+    import jax
+    import jax.numpy as jnp
+
+    def sample(key):
+        kx, ky = jax.random.split(key)
+        return {
+            "x": jax.random.normal(
+                kx, (batch_size, image_size, image_size, 3), jnp.float32
+            ),
+            "y": jax.random.randint(
+                ky, (batch_size,), 0, num_classes, dtype=jnp.int32
+            ),
+        }
+
+    return sample
+
+
+def token_sample(batch_size: int, seq_len: int, vocab_size: int):
+    """``key → batch`` of MLM-style token batches (see
+    :func:`mnist_sample`)."""
+    import jax
+    import jax.numpy as jnp
+
+    def sample(key):
+        ids = jax.random.randint(
+            key, (batch_size, seq_len), 0, vocab_size, dtype=jnp.int32
+        )
+        return {"x": ids, "y": ids}
+
+    return sample
+
+
+def causal_token_sample(batch_size: int, seq_len: int, vocab_size: int):
+    """``key → batch`` of shifted next-token pairs (see
+    :func:`mnist_sample`)."""
+    import jax
+    import jax.numpy as jnp
+
+    def sample(key):
+        ids = jax.random.randint(
+            key, (batch_size, seq_len + 1), 0, vocab_size, dtype=jnp.int32
+        )
+        return {"x": ids[:, :-1], "y": ids[:, 1:]}
+
+    return sample
+
+
 def device_batches(sample_fn, shardings=None, seed: int = 0):
     """Synthetic batches generated ON the device by a jitted PRNG program.
 
@@ -95,70 +164,34 @@ def device_batches(sample_fn, shardings=None, seed: int = 0):
 
 
 def device_mnist_batches(batch_size: int, seed: int = 0, shardings=None):
-    import jax
-    import jax.numpy as jnp
-
-    def sample(key):
-        kx, ky = jax.random.split(key)
-        return {
-            "x": jax.random.normal(kx, (batch_size, 28, 28, 1), jnp.float32),
-            "y": jax.random.randint(ky, (batch_size,), 0, 10, dtype=jnp.int32),
-        }
-
-    return device_batches(sample, shardings, seed)
+    return device_batches(mnist_sample(batch_size), shardings, seed)
 
 
 def device_imagenet_batches(
     batch_size: int, image_size: int = 224, num_classes: int = 1000,
     seed: int = 0, shardings=None,
 ):
-    import jax
-    import jax.numpy as jnp
-
-    def sample(key):
-        kx, ky = jax.random.split(key)
-        return {
-            "x": jax.random.normal(
-                kx, (batch_size, image_size, image_size, 3), jnp.float32
-            ),
-            "y": jax.random.randint(
-                ky, (batch_size,), 0, num_classes, dtype=jnp.int32
-            ),
-        }
-
-    return device_batches(sample, shardings, seed)
+    return device_batches(
+        imagenet_sample(batch_size, image_size, num_classes), shardings, seed
+    )
 
 
 def device_token_batches(
     batch_size: int, seq_len: int, vocab_size: int, seed: int = 0,
     shardings=None,
 ):
-    import jax
-    import jax.numpy as jnp
-
-    def sample(key):
-        ids = jax.random.randint(
-            key, (batch_size, seq_len), 0, vocab_size, dtype=jnp.int32
-        )
-        return {"x": ids, "y": ids}
-
-    return device_batches(sample, shardings, seed)
+    return device_batches(
+        token_sample(batch_size, seq_len, vocab_size), shardings, seed
+    )
 
 
 def device_causal_token_batches(
     batch_size: int, seq_len: int, vocab_size: int, seed: int = 0,
     shardings=None,
 ):
-    import jax
-    import jax.numpy as jnp
-
-    def sample(key):
-        ids = jax.random.randint(
-            key, (batch_size, seq_len + 1), 0, vocab_size, dtype=jnp.int32
-        )
-        return {"x": ids[:, :-1], "y": ids[:, 1:]}
-
-    return device_batches(sample, shardings, seed)
+    return device_batches(
+        causal_token_sample(batch_size, seq_len, vocab_size), shardings, seed
+    )
 
 
 class Prefetcher:
@@ -248,6 +281,8 @@ class Prefetcher:
 
 
 __all__ = ["mnist_batches", "imagenet_batches", "token_batches",
-           "causal_token_batches", "device_batches", "device_mnist_batches",
-           "device_imagenet_batches", "device_token_batches",
-           "device_causal_token_batches", "Prefetcher"]
+           "causal_token_batches", "mnist_sample", "imagenet_sample",
+           "token_sample", "causal_token_sample", "device_batches",
+           "device_mnist_batches", "device_imagenet_batches",
+           "device_token_batches", "device_causal_token_batches",
+           "Prefetcher"]

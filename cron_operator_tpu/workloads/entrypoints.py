@@ -10,7 +10,7 @@ its metrics stop at reconcile counts, SURVEY.md §5).
 
 Common params (all optional, all strings): ``steps``, ``batch_size``,
 ``platform`` (force ``cpu`` for tests), ``tensor``/``seq``/``fsdp`` (mesh
-axis sizes), ``data`` (``device`` default | ``host`` — see
+axis sizes), ``data`` (``device`` default | ``host`` | ``fused`` — see
 :func:`_batches`), ``lr``/``lr_schedule``/``warmup_steps``/
 ``schedule_steps``/``sync_every`` (see :func:`_train_kwargs`).
 Model-specific params documented per entrypoint.
@@ -136,15 +136,27 @@ def _train_kwargs(ctx: JobContext, steps: int, **defaults) -> dict:
     return kw
 
 
+def _fused(ctx: JobContext) -> bool:
+    return ctx.params.get("data", "device") == "fused"
+
+
 def _batches(ctx: JobContext, trainer: Trainer, host_factory, device_factory):
     """``param.data`` selects where synthetic batches materialize:
     ``device`` (default) generates them on-device via a jitted PRNG program
     placed straight into the training sharding — per-step host traffic is
     one folded key instead of the whole batch (decisive on remote/tunneled
     devices); ``host`` keeps the numpy path (composes with
-    ``param.prefetch`` to overlap the host→device transfer)."""
-    if ctx.params.get("data", "device") == "host":
+    ``param.prefetch`` to overlap the host→device transfer); ``fused``
+    moves generation INSIDE the jitted train step (Trainer ``sample_fn``
+    — one dispatch per step, zero per-step host traffic; the
+    hermetic-benchmark mode, see PERF.md finding 3)."""
+    mode = ctx.params.get("data", "device")
+    if mode == "host":
         return host_factory()
+    if mode == "fused":
+        from itertools import repeat
+
+        return repeat({})
     return device_factory(shardings=trainer.batch_sharding)
 
 
@@ -231,6 +243,17 @@ def _run(
         avg = sum(s.step_time_s for s in tail) / len(tail)
         ctx.progress["avg_step_time_s"] = round(avg, 4)
         ctx.progress["steps_per_s"] = round(1.0 / avg, 4) if avg > 0 else None
+    # Opt-in (param.flops_accounting=1) because Trainer.flops_per_step
+    # re-lowers + re-compiles the step for its cost analysis — a cache
+    # hit under bench.py's persistent compile cache, but a duplicate
+    # multi-ten-second XLA compile for an arbitrary scheduled job — and
+    # runs AFTER training so the steps themselves never pay for it.
+    if ctx.params.get("flops_accounting", "0") in ("1", "true"):
+        flops = trainer.flops_per_step()
+        if flops:
+            # Per-device post-partitioning count: the honest MFU
+            # numerator against a per-chip peak (bench.py).
+            ctx.progress["xla_flops_per_step"] = flops
 
 
 @register_entrypoint("mnist")
@@ -251,6 +274,8 @@ def mnist(ctx: JobContext) -> None:
                 ctx, steps, optimizer="sgd", learning_rate=0.01,
             )),
             checkpoint=_checkpoint_store(ctx),
+            sample_fn=(datasets.mnist_sample(batch_size)
+                       if _fused(ctx) else None),
         )
         _run(
             ctx, trainer,
@@ -288,6 +313,8 @@ def resnet50(ctx: JobContext) -> None:
                 ctx, steps, optimizer="sgd", learning_rate=0.1,
             )),
             checkpoint=_checkpoint_store(ctx),
+            sample_fn=(datasets.imagenet_sample(batch_size, image_size)
+                       if _fused(ctx) else None),
         )
         _run(
             ctx, trainer,
@@ -338,6 +365,10 @@ def bert(ctx: JobContext) -> None:
                 labels_follow_seq=True,
             )),
             checkpoint=_checkpoint_store(ctx),
+            sample_fn=(
+                datasets.token_sample(batch_size, seq_len, cfg.vocab_size)
+                if _fused(ctx) else None
+            ),
         )
         _run(
             ctx, trainer,
@@ -414,6 +445,12 @@ def gpt(ctx: JobContext) -> None:
             )),
             loss_fn=loss_fn,
             checkpoint=_checkpoint_store(ctx),
+            sample_fn=(
+                datasets.causal_token_sample(
+                    batch_size, seq_len, cfg.vocab_size
+                )
+                if _fused(ctx) else None
+            ),
         )
         _run(
             ctx, trainer,
@@ -469,6 +506,12 @@ def vit(ctx: JobContext) -> None:
                 remat=ctx.params.get("remat", "0") in ("1", "true"),
             )),
             checkpoint=_checkpoint_store(ctx),
+            sample_fn=(
+                datasets.imagenet_sample(
+                    batch_size, cfg.image_size, cfg.num_classes
+                )
+                if _fused(ctx) else None
+            ),
         )
         _run(
             ctx, trainer,
@@ -564,6 +607,26 @@ def generate_job(ctx: JobContext) -> None:
                 model, jax.random.PRNGKey(0),
                 _zeros((1, prompt_len), dtype="int32"),
             )
+        # Decode is HBM-bandwidth-bound: each decode step re-reads the
+        # (bf16-cast, scan-hoisted) parameters once for the whole batch
+        # plus every item's full static KV cache ([b, max_len, kv_h, d]
+        # K and V per layer — masked, not length-truncated). Publish the
+        # read-bytes model so consumers (bench.py) can place measured
+        # tokens/s against the chip's HBM roofline.
+        import jax.numpy as jnp
+
+        n_params = sum(
+            int(a.size) for a in jax.tree_util.tree_leaves(params)
+        )
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dsize = jnp.dtype(cfg.dtype).itemsize
+        ctx.progress["n_params"] = n_params
+        ctx.progress["decode_read_bytes_per_step"] = (
+            n_params * dsize
+            + 2 * cfg.num_layers * batch_size * cfg.max_len
+            * kv_heads * head_dim * dsize
+        )
         key = jax.random.PRNGKey(int(ctx.params.get("seed", 0)))
         ctx.progress["started_at"] = time.time()
         total_tokens = 0

@@ -69,6 +69,10 @@ class TrainConfig:
     # Batches ahead to place on device from a background thread (0 = off).
     # Hides host→device transfer behind compute (workloads.data.Prefetcher).
     prefetch: int = 0
+    # Seed for FUSED in-step data generation (Trainer sample_fn): the
+    # batch key is fold_in(PRNGKey(data_seed), state.step), so resume
+    # continues the data stream instead of replaying it.
+    data_seed: int = 0
     # Block on the loss every N steps (1 = every step). Fetching a scalar
     # is a full host↔device round trip — ~80 ms on a tunneled device,
     # swamping a ~20 ms train step — so steady-state throughput needs the
@@ -159,10 +163,21 @@ class Trainer:
         config: Optional[TrainConfig] = None,
         loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = cross_entropy_loss,
         checkpoint: Optional[Any] = None,  # workloads.checkpoint.CheckpointStore
+        sample_fn: Optional[Callable[[jax.Array], Dict[str, jax.Array]]] = None,
     ):
+        """``sample_fn`` (``key → batch dict``, e.g. ``data.imagenet_sample``)
+        switches the trainer to FUSED data mode: the batch is generated
+        INSIDE the jitted step from ``fold_in(PRNGKey(data_seed),
+        state.step)`` — one dispatch per step and zero per-step
+        host→device traffic. On a tunneled/remote device this is the
+        difference between the chain-timed device step and the measured
+        one (r5: 53 ms device vs 76-98 ms with a separate per-step
+        batch-generation dispatch; PERF.md). Callers then feed ``run``
+        empty-dict batches (``itertools.repeat({})``)."""
         self.mesh = mesh
         self.config = config or TrainConfig()
         self.checkpoint = checkpoint
+        self.sample_fn = sample_fn
         tx = self.config.make_optimizer()
 
         fwd = apply_fn
@@ -170,8 +185,23 @@ class Trainer:
             fwd = jax.checkpoint(apply_fn)
 
         aux_in_output = self.config.aux_loss_in_output
+        data_seed = self.config.data_seed
 
         def step_fn(state: train_state.TrainState, batch: Dict[str, jax.Array]):
+            if sample_fn is not None:
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(data_seed), state.step
+                )
+                # Pin the generated batch to the training layout so GSPMD
+                # shards generation the same way an external batch would
+                # arrive (self.batch_sharding exists by first trace).
+                batch = {
+                    k: jax.lax.with_sharding_constraint(
+                        v, self.batch_sharding[k]
+                    )
+                    for k, v in sample_fn(key).items()
+                }
+
             def loss_of(p):
                 out = fwd(p, batch["x"])
                 if aux_in_output:
@@ -206,19 +236,61 @@ class Trainer:
             "x": NamedSharding(mesh, x_spec),
             "y": NamedSharding(mesh, y_spec),
         }
+        # Fused mode takes an EMPTY batch dict (the data comes from the
+        # in-step PRNG); the in_shardings pytree must match it.
+        in_batch_sharding = {} if sample_fn is not None else self.batch_sharding
         self._step = jax.jit(
             step_fn,
-            in_shardings=(self.state_sharding, self.batch_sharding),
+            in_shardings=(self.state_sharding, in_batch_sharding),
             out_shardings=(self.state_sharding,
                            NamedSharding(mesh, jax.sharding.PartitionSpec())),
             donate_argnums=(0,),
         )
+        self._batch_struct = None  # set on first put_batch (flops_per_step)
+        self._flops_per_step: Optional[float] = None
 
     def put_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
-        return {
+        placed = {
             k: jax.device_put(jnp.asarray(v), self.batch_sharding[k])
             for k, v in batch.items()
         }
+        if self._batch_struct is None:
+            self._batch_struct = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), placed
+            )
+        return placed
+
+    def flops_per_step(self) -> Optional[float]:
+        """XLA's own flop count for ONE compiled train step (fwd + bwd +
+        optimizer + any in-step data generation) via cost analysis of the
+        jitted step at the shapes actually trained.
+
+        This is the honest MFU numerator: analytic per-model tables
+        undercount (the classic "ResNet-50 = 4.1 GFLOPs" figure counts
+        multiply-ADDS; XLA counts a MAC as 2 flops — measured 8.03 vs
+        4.1 GFLOP fwd at 224², a 2× MFU error, hack/mfu_attrib.py).
+        Returns None before the first step or when the backend offers no
+        cost analysis.
+        """
+        if self._batch_struct is None:
+            return None
+        if self._flops_per_step is None:
+            try:
+                struct = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    self.state,
+                )
+                ca = (
+                    self._step.lower(struct, self._batch_struct)
+                    .compile()
+                    .cost_analysis()
+                )
+                ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+                flops = (ca or {}).get("flops")
+                self._flops_per_step = float(flops) if flops else None
+            except Exception:  # noqa: BLE001 — diagnostics must not
+                self._flops_per_step = None  # fail training
+        return self._flops_per_step
 
     def step(self, batch: Dict[str, Any], sync: bool = True) -> StepStats:
         t0 = time.perf_counter()

@@ -45,6 +45,19 @@ echo "==> chart README in sync (helm-docs analog)"
 python hack/chart_docs.py --check
 
 echo "==> unit + integration tests"
-python -m pytest tests/ -q
+# With pytest-cov installed (CI always; optional locally) the suite runs
+# under coverage and hack/ci_gate enforces the pyproject fail_under
+# threshold — untested seams become visible per PR (VERDICT r4 #7: the
+# pre-round-4 runner gap would have been flagged).
+if python -c "import pytest_cov" 2>/dev/null; then
+    # --cov-fail-under passed explicitly: older pytest-cov releases do
+    # not pick fail_under up from [tool.coverage.report]. Keep the two
+    # values in sync.
+    python -m pytest tests/ -q --cov \
+        --cov-report=term-missing:skip-covered --cov-fail-under=70
+else
+    echo "    (pytest-cov not installed; running without coverage)"
+    python -m pytest tests/ -q
+fi
 
 echo "GATE: all checks passed"

@@ -109,28 +109,23 @@ def main() -> int:
     out = {"batch": batch, "image": image, "chain": chain}
 
     # --- rng-only --------------------------------------------------------
+    # acc leads the carry: timed()'s fetch pulls the FIRST leaf, which
+    # must be a plain scalar, not a (typed) PRNG key.
     def rng_body(carry, _):
-        key, acc = carry
+        acc, key = carry
         key, k1, k2 = jax.random.split(key, 3)
         x = jax.random.normal(k1, (batch, image, image, 3), jnp.bfloat16)
         y = jax.random.randint(k2, (batch,), 0, 1000)
         # Touch the outputs so XLA cannot DCE the generation.
-        return (key, acc + x.mean().astype(jnp.float32) + y.sum()), None
+        return (acc + x.mean().astype(jnp.float32) + y.sum(), key), None
 
-    t = timed(scan_of(rng_body), (jax.random.PRNGKey(0), jnp.float32(0)))
+    t = timed(scan_of(rng_body), (jnp.float32(0), jax.random.PRNGKey(0)))
     out["rng_ms"] = round(t * 1e3, 2) if t else None
 
     # --- rng under rbg ---------------------------------------------------
-    def rbg_body(carry, _):
-        key, acc = carry
-        key, k1, k2 = jax.random.split(key, 3)
-        x = jax.random.normal(k1, (batch, image, image, 3), jnp.bfloat16)
-        y = jax.random.randint(k2, (batch,), 0, 1000)
-        return (key, acc + x.mean().astype(jnp.float32) + y.sum()), None
-
-    rbg_key = jax.random.key(0, impl="rbg")
     try:
-        t = timed(scan_of(rbg_body), (rbg_key, jnp.float32(0)))
+        t = timed(scan_of(rng_body),
+                  (jnp.float32(0), jax.random.key(0, impl="rbg")))
         out["rng_rbg_ms"] = round(t * 1e3, 2) if t else None
     except Exception as exc:  # noqa: BLE001
         out["rng_rbg_ms"] = f"error: {str(exc)[-200:]}"

@@ -533,3 +533,60 @@ class TestOptimizerHygiene:
         cfg = TrainConfig(optimizer="sgd", decay_mask=True)
         with _pytest.raises(ValueError, match="requires the adamw"):
             cfg.make_optimizer()
+
+
+class TestFusedData:
+    """param.data=fused — batch generation inlined into the jitted train
+    step (Trainer sample_fn): one dispatch per step, zero per-step host
+    traffic. The hermetic-benchmark mode (PERF.md findings 3-4)."""
+
+    def _train(self, cpus, sample_fn=None, batches=None, steps=3):
+        from itertools import repeat
+
+        with jax.default_device(cpus[0]):
+            mesh = mesh_for_devices(cpus)
+            m = MLP(features=(32,))
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(optimizer="sgd"),
+                sample_fn=sample_fn,
+            )
+            stats = tr.run(
+                batches if batches is not None else repeat({}), steps
+            )
+            return [s.loss for s in stats]
+
+    def test_fused_stream_equals_device_stream(self, cpus):
+        """fold_in(key, state.step) must reproduce device_batches'
+        fold_in(key, i) stream exactly — fused is a dispatch-count
+        optimization, not a different data distribution."""
+        fused = self._train(cpus, sample_fn=datasets.mnist_sample(8))
+        dev = self._train(
+            cpus, batches=datasets.device_mnist_batches(8)
+        )
+        assert fused == dev
+
+    def test_fused_entrypoint_runs(self, cpus):
+        """The param.data=fused surface end to end through the runner
+        context (mnist entrypoint)."""
+        from cron_operator_tpu.backends.registry import resolve_entrypoint
+
+        ctx_progress = {}
+
+        class Ctx:
+            params = {"steps": "2", "batch_size": "8", "platform": "cpu",
+                      "data": "fused", "save_every": "0",
+                      "flops_accounting": "1"}
+            progress = ctx_progress
+            publish = None
+            should_stop = None
+            namespace = "default"
+            name = "fused-test"
+
+        resolve_entrypoint("mnist")(Ctx())
+        assert ctx_progress["steps_done"] == 2
+        assert ctx_progress["last_loss"] is not None
+        assert ctx_progress.get("xla_flops_per_step")
