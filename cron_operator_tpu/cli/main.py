@@ -42,6 +42,32 @@ def _bool_arg(v: str) -> bool:
     raise argparse.ArgumentTypeError(f"expected true/false, got {v!r}")
 
 
+def _watched_tls(cert_path, cert_name, cert_key, enable_http2, log, what):
+    """Provided-cert TLS for an inbound surface (metrics / served API):
+    build the context and start the rotation watcher. Returns
+    ``(ctx, watcher)`` or ``(None, None)`` after logging an actionable
+    error (a typo'd cert dir must exit 2, not crash-loop on a raw
+    OSError traceback)."""
+    import ssl
+
+    from cron_operator_tpu.utils.tlsutil import CertWatcher, server_context
+
+    cert = _os.path.join(cert_path, cert_name)
+    key = _os.path.join(cert_path, cert_key)
+    try:
+        ctx = server_context(cert, key, enable_http2=enable_http2)
+    except (OSError, ssl.SSLError) as err:
+        log.error(
+            "%s TLS could not load the certificate pair %s / %s: %s — "
+            "check the --%s-cert-path/-name/-key flags", what, cert, key,
+            err, what,
+        )
+        return None, None
+    watcher = CertWatcher(ctx, cert, key).start()
+    log.info("%s TLS from %s (watched)", what, cert_path)
+    return ctx, watcher
+
+
 def _serve(
     port: int,
     routes,
@@ -184,6 +210,17 @@ def build_parser() -> argparse.ArgumentParser:
     start.add_argument("--serve-api-token", default=None,
                        help="bearer token required by --serve-api "
                             "(default: unauthenticated on localhost)")
+    # The reference's webhook server is cert-watched TLS
+    # (start.go:100-119); the served API is this framework's equivalent
+    # inbound surface, so it carries the same cert plumbing. Opt-in
+    # (certs provided, never self-signed): webhook-style serving always
+    # has operator-provisioned certs.
+    start.add_argument("--serve-api-cert-path", default="",
+                       help="directory with the API server certificate — "
+                            "enables HTTPS on --serve-api (watched for "
+                            "rotation, like --metrics-cert-path)")
+    start.add_argument("--serve-api-cert-name", default="tls.crt")
+    start.add_argument("--serve-api-cert-key", default="tls.key")
     start.add_argument("--run-for", type=float, default=None,
                        metavar="SECONDS",
                        help="exit after N seconds (default: run until signal)")
@@ -333,6 +370,7 @@ def cmd_start(args: argparse.Namespace) -> int:
     )
 
     api_http = None
+    api_cert_watcher = None
     if args.serve_api:
         if args.api_server == "cluster":
             log.error("--serve-api applies to the embedded control plane "
@@ -345,9 +383,19 @@ def cmd_start(args: argparse.Namespace) -> int:
             log.error("--serve-api expects [HOST]:PORT, got %r",
                       args.serve_api)
             return 2
+        api_tls_ctx = None
+        if args.serve_api_cert_path:
+            api_tls_ctx, api_cert_watcher = _watched_tls(
+                args.serve_api_cert_path, args.serve_api_cert_name,
+                args.serve_api_cert_key, args.enable_http2, log,
+                "serve-api",
+            )
+            if api_tls_ctx is None:
+                return 2
         api_http = HTTPAPIServer(
             api=api, scheme=scheme, host=host or "127.0.0.1",
             port=int(port), token=args.serve_api_token,
+            tls_ctx=api_tls_ctx,
         )
         api_http.start()
         log.info("embedded API serving on %s", api_http.url)
@@ -381,24 +429,18 @@ def cmd_start(args: argparse.Namespace) -> int:
         tls_ctx = None
         if args.metrics_secure:
             from cron_operator_tpu.utils.tlsutil import (
-                CertWatcher,
                 self_signed_cert,
                 server_context,
             )
 
             if args.metrics_cert_path:
-                cert = _os.path.join(args.metrics_cert_path,
-                                     args.metrics_cert_name)
-                key = _os.path.join(args.metrics_cert_path,
-                                    args.metrics_cert_key)
-                tls_ctx = server_context(
-                    cert, key, enable_http2=args.enable_http2
+                tls_ctx, cert_watcher = _watched_tls(
+                    args.metrics_cert_path, args.metrics_cert_name,
+                    args.metrics_cert_key, args.enable_http2, log,
+                    "metrics",
                 )
-                # Rotation: reload the pair into the live context when
-                # the files change (reference certwatcher parity).
-                cert_watcher = CertWatcher(tls_ctx, cert, key).start()
-                log.info("metrics TLS from %s (watched)",
-                         args.metrics_cert_path)
+                if tls_ctx is None:
+                    return 2
             else:
                 try:
                     cert, key = self_signed_cert()
@@ -483,6 +525,8 @@ def cmd_start(args: argparse.Namespace) -> int:
     log.info("shutting down")
     if cert_watcher is not None:
         cert_watcher.stop()
+    if api_cert_watcher is not None:
+        api_cert_watcher.stop()
     manager.stop()
     if api_http is not None:
         api_http.stop()

@@ -119,10 +119,19 @@ class HTTPAPIServer:
         host: str = "127.0.0.1",
         port: int = 0,
         token: Optional[str] = None,
+        tls_ctx=None,
     ):
+        """``tls_ctx`` (an ``ssl.SSLContext``, e.g. from
+        ``utils.tlsutil.server_context``) serves the API over HTTPS — the
+        embedded analog of the reference's cert-watched webhook server
+        (start.go:100-119: same TLS options stack as metrics, cert dir
+        watched for rotation via utils.tlsutil.CertWatcher). The
+        handshake is deferred to the per-connection handler thread so a
+        stalled peer cannot wedge the accept loop."""
         self.api = api or APIServer()
         self.scheme = scheme or default_scheme()
         self.token = token
+        self.tls = tls_ctx is not None
         self._kinds: Dict[Tuple[str, str, str], str] = {}
         for gvk, plural in list(self.scheme.items()) + _CORE_KINDS:
             self._kinds[(gvk.group, gvk.version, plural)] = gvk.kind
@@ -131,6 +140,11 @@ class HTTPAPIServer:
         self._server = ThreadingHTTPServer(
             (host, port), self._make_handler()
         )
+        if tls_ctx is not None:
+            self._server.socket = tls_ctx.wrap_socket(
+                self._server.socket, server_side=True,
+                do_handshake_on_connect=False,
+            )
         self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
@@ -143,7 +157,8 @@ class HTTPAPIServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self._server.server_address[0]}:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self._server.server_address[0]}:{self.port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -208,6 +223,11 @@ class HTTPAPIServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Under TLS the handshake runs lazily in this handler's
+            # thread (see __init__); the socket timeout bounds it — and
+            # every read — so a stalled peer's thread is reclaimed. Watch
+            # streams are unaffected: they write at least every 0.5 s.
+            timeout = 60 if outer.tls else None
 
             def log_message(self, *a):  # noqa: D102
                 pass

@@ -243,6 +243,20 @@ def _run(
         avg = sum(s.step_time_s for s in tail) / len(tail)
         ctx.progress["avg_step_time_s"] = round(avg, 4)
         ctx.progress["steps_per_s"] = round(1.0 / avg, 4) if avg > 0 else None
+    # Dispatch-health diagnostic: async (non-synced) steps record pure
+    # dispatch time — their median should be single-digit ms. A high p50
+    # in an artifact attributes a slow run to host/link dispatch overhead
+    # (tunnel congestion, CPU starvation) rather than device compute
+    # (PERF.md finding 3). The final step is excluded either way: on an
+    # early exit Trainer.run charges the whole device drain to it, which
+    # would masquerade as a giant "dispatch" sample.
+    async_ms = sorted(
+        s.step_time_s * 1e3 for s in tail[:-1] if s.loss is None
+    )
+    if async_ms:
+        ctx.progress["async_dispatch_ms_p50"] = round(
+            async_ms[len(async_ms) // 2], 2
+        )
     # Opt-in (param.flops_accounting=1) because Trainer.flops_per_step
     # re-lowers + re-compiles the step for its cost analysis — a cache
     # hit under bench.py's persistent compile cache, but a duplicate

@@ -97,6 +97,12 @@ def main(argv: List[str] | None = None) -> int:
         level=logging.INFO,
         format="%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s",
     )
+    # Runner messages stay INFO; the ML stack's own loggers are capped at
+    # WARNING — with basicConfig(INFO) a chatty backend (the experimental
+    # tunneled-TPU plugin in particular) can log on the per-dispatch hot
+    # path, and stderr formatting there is pure overhead per train step.
+    for noisy in ("jax", "jaxlib", "axon", "flax", "orbax"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv:
         print(

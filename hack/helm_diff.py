@@ -28,6 +28,10 @@ import yaml
 
 
 def _key(doc) -> tuple:
+    if not isinstance(doc, dict):
+        # A renderer emitting a bare string/list is itself a divergence
+        # worth surfacing, not a crash: key it by its repr.
+        return ("<non-mapping>", repr(doc), "", "")
     meta = doc.get("metadata") or {}
     return (
         doc.get("apiVersion", ""),
@@ -38,9 +42,18 @@ def _key(doc) -> tuple:
 
 
 def load_docs(path: str):
+    """{identity key: (count, doc)} — the count catches a renderer
+    emitting the same document twice (a plain dict would silently
+    collapse duplicates and pass the diff, the exact breakage this
+    script exists to catch)."""
     with open(path) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
-    return {_key(d): d for d in docs}
+    out = {}
+    for d in docs:
+        k = _key(d)
+        count, _ = out.get(k, (0, None))
+        out[k] = (count + 1, d)
+    return out
 
 
 def canonical(doc) -> str:
@@ -63,15 +76,22 @@ def main(argv=None) -> int:
             print(f"DIVERGENT: {ident} only in {args.label_b}",
                   file=sys.stderr)
             rc = 1
-        elif key not in b:
+            continue
+        if key not in b:
             print(f"DIVERGENT: {ident} only in {args.label_a}",
                   file=sys.stderr)
             rc = 1
-        elif a[key] != b[key]:
+            continue
+        (na, da), (nb, db) = a[key], b[key]
+        if na != nb:
+            print(f"DIVERGENT: {ident} emitted {na}x by {args.label_a} "
+                  f"but {nb}x by {args.label_b}", file=sys.stderr)
+            rc = 1
+        if da != db:
             print(f"DIVERGENT: {ident}", file=sys.stderr)
             sys.stderr.writelines(difflib.unified_diff(
-                canonical(a[key]).splitlines(keepends=True),
-                canonical(b[key]).splitlines(keepends=True),
+                canonical(da).splitlines(keepends=True),
+                canonical(db).splitlines(keepends=True),
                 fromfile=f"{args.label_a}:{ident}",
                 tofile=f"{args.label_b}:{ident}",
             ))
