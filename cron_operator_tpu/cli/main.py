@@ -74,13 +74,25 @@ def _serve(
     name: str,
     tls_ctx=None,
     token: Optional[str] = None,
+    authn=None,
 ) -> ThreadingHTTPServer:
     """Serve ``routes`` on ``port`` (0 = ephemeral; read
-    ``server.server_address``). ``tls_ctx`` wraps the listener in TLS;
-    ``token`` requires ``Authorization: Bearer <token>`` (401 otherwise)
-    — the embedded-mode analog of the reference's authn/z FilterProvider
-    (start.go:121-133), which delegates to TokenReview/
-    SubjectAccessReview in a real cluster."""
+    ``server.server_address``). ``tls_ctx`` wraps the listener in TLS.
+    Auth is the reference FilterProvider analog (start.go:121-133),
+    picked per deployment mode: ``token`` requires a static
+    ``Authorization: Bearer <token>`` (embedded mode); ``authn`` is a
+    callable(authorization_header) -> bool for kube-delegated
+    TokenReview/SubjectAccessReview (cluster mode,
+    runtime.authfilter.ScrapeAuthenticator). 401 otherwise."""
+
+    def _denied(headers) -> bool:
+        if token is not None:
+            return not hmac.compare_digest(
+                headers.get("Authorization") or "", f"Bearer {token}"
+            )
+        if authn is not None:
+            return not authn(headers.get("Authorization"))
+        return False
 
     class Handler(BaseHTTPRequestHandler):
         # A stalled peer must not hold a handler thread forever (the TLS
@@ -88,9 +100,7 @@ def _serve(
         timeout = 30
 
         def do_GET(self):  # noqa: N802
-            if token is not None and not hmac.compare_digest(
-                self.headers.get("Authorization") or "", f"Bearer {token}"
-            ):
+            if _denied(self.headers):
                 body = b"Unauthorized"
                 self.send_response(401)
                 self.send_header("WWW-Authenticate", "Bearer")
@@ -466,12 +476,31 @@ def cmd_start(args: argparse.Namespace) -> int:
             if not args.enable_http2:
                 log.info("disabling http/2")
         metrics_token = args.metrics_token or args.serve_api_token
+        metrics_authn = None
         if args.metrics_secure and not metrics_token:
-            log.warning(
-                "metrics served over TLS without authentication — set "
-                "--metrics-token (or --serve-api-token) to require a "
-                "bearer token"
-            )
+            if args.api_server == "cluster":
+                # The reference's exact gate: every scrape's bearer token
+                # goes through TokenReview + SubjectAccessReview for GET
+                # /metrics (start.go:121-133 FilterProvider). The RBAC
+                # for the review calls ships in
+                # config/rbac/metrics_auth_role.yaml; scrapers bind
+                # metrics_reader_role.yaml. Prometheus sends its SA token
+                # via the ServiceMonitor's bearerTokenFile.
+                from cron_operator_tpu.runtime.authfilter import (
+                    ScrapeAuthenticator,
+                )
+
+                metrics_authn = ScrapeAuthenticator(api).allow
+                log.info(
+                    "metrics scrapes authenticated via kube "
+                    "TokenReview/SubjectAccessReview"
+                )
+            else:
+                log.warning(
+                    "metrics served over TLS without authentication — "
+                    "set --metrics-token (or --serve-api-token) to "
+                    "require a bearer token"
+                )
         servers.append(
             _serve(
                 metrics_port,
@@ -480,6 +509,7 @@ def cmd_start(args: argparse.Namespace) -> int:
                 "metrics",
                 tls_ctx=tls_ctx,
                 token=metrics_token,
+                authn=metrics_authn,
             )
         )
         log.info("metrics serving on :%d (%s)", metrics_port,

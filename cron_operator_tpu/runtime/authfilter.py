@@ -1,0 +1,92 @@
+"""Kube-delegated authn/z for scrape endpoints — the FilterProvider analog.
+
+The reference wraps its secure metrics endpoint in controller-runtime's
+``filters.WithAuthenticationAndAuthorization``
+(``/root/reference/cmd/operator/start.go:121-133``): every scrape's
+bearer token goes through a TokenReview (who is this?) and a
+SubjectAccessReview for ``get`` on the ``/metrics`` non-resource URL (may
+they?). :class:`ScrapeAuthenticator` is that filter for the cluster-mode
+operator, built on :meth:`runtime.cluster.ClusterAPIServer.token_review`
+/ ``subject_access_review`` — the RBAC to CALL the review APIs ships in
+``config/rbac/metrics_auth_role.yaml``, and scrapers are authorized by
+binding ``config/rbac/metrics_reader_role.yaml``.
+
+Results are TTL-cached per token: Prometheus re-scrapes every 15-30 s
+with the same ServiceAccount token, and two apiserver round trips per
+scrape would put the kube API on the metrics hot path. Failures are
+closed (deny): an unreachable apiserver means no anonymous metrics, not
+an open endpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+logger = logging.getLogger("runtime.authfilter")
+
+
+class ScrapeAuthenticator:
+    """``allow(authorization_header) -> bool`` via kube reviews.
+
+    ``client`` is a :class:`ClusterAPIServer` (or anything with
+    ``token_review`` / ``subject_access_review``).
+    """
+
+    def __init__(self, client, path: str = "/metrics", verb: str = "get",
+                 ttl_s: float = 60.0, clock=time.monotonic):
+        self._client = client
+        self._path = path
+        self._verb = verb
+        self._ttl = ttl_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        # token -> (expires_at, allowed). STRICTLY bounded LRU: an
+        # attacker spraying unique forged tokens must not grow memory —
+        # expiry-only sweeping would evict nothing inside the TTL window.
+        # (The per-unique-token apiserver round trip itself is inherent
+        # to delegated auth and throttled by the client's QPS limiter.)
+        self._cache: "OrderedDict" = OrderedDict()
+        self._cache_cap = 1024
+
+    def allow(self, authorization: Optional[str]) -> bool:
+        if not authorization or not authorization.startswith("Bearer "):
+            return False
+        token = authorization[len("Bearer "):].strip()
+        if not token:
+            return False
+        now = self._clock()
+        with self._lock:
+            hit = self._cache.get(token)
+            if hit is not None and hit[0] > now:
+                self._cache.move_to_end(token)
+                return hit[1]
+        allowed = self._review(token)
+        with self._lock:
+            self._cache[token] = (now + self._ttl, allowed)
+            self._cache.move_to_end(token)
+            while len(self._cache) > self._cache_cap:
+                self._cache.popitem(last=False)
+        return allowed
+
+    def _review(self, token: str) -> bool:
+        try:
+            status = self._client.token_review(token)
+            if not status.get("authenticated"):
+                return False
+            user = (status.get("user") or {}).get("username") or ""
+            groups = (status.get("user") or {}).get("groups") or []
+            return self._client.subject_access_review(
+                user, groups, self._verb, self._path
+            )
+        except Exception as exc:  # noqa: BLE001 — fail CLOSED
+            logger.warning(
+                "scrape authn/z review failed (denying): %s", exc
+            )
+            return False
+
+
+__all__ = ["ScrapeAuthenticator"]

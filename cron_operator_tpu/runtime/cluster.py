@@ -336,6 +336,51 @@ class ClusterAPIServer:
             },
         )
 
+    # ---- authn/z reviews --------------------------------------------------
+
+    def token_review(self, token: str) -> Dict[str, Any]:
+        """POST a ``TokenReview`` — "who is this bearer token?" Returns
+        the review ``status`` (``authenticated``, ``user.username``,
+        ``user.groups``). The authn half of the secure-metrics gate
+        (reference: controller-runtime filters.WithAuthenticationAndAuthorization,
+        cmd/operator/start.go:121-133); the verbs are granted by
+        config/rbac/metrics_auth_role.yaml."""
+        out = self._request(
+            "POST", "/apis/authentication.k8s.io/v1/tokenreviews",
+            body={
+                "apiVersion": "authentication.k8s.io/v1",
+                "kind": "TokenReview",
+                "spec": {"token": token},
+            },
+        )
+        return (out or {}).get("status") or {}
+
+    def subject_access_review(
+        self,
+        user: str,
+        groups: Optional[List[str]],
+        verb: str,
+        non_resource_path: str,
+    ) -> bool:
+        """POST a ``SubjectAccessReview`` for a non-resource URL — "may
+        this user GET /metrics?" The authz half of the gate; authorized
+        scrapers hold config/rbac/metrics_reader_role.yaml."""
+        out = self._request(
+            "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews",
+            body={
+                "apiVersion": "authorization.k8s.io/v1",
+                "kind": "SubjectAccessReview",
+                "spec": {
+                    "user": user,
+                    "groups": groups or [],
+                    "nonResourceAttributes": {
+                        "verb": verb, "path": non_resource_path,
+                    },
+                },
+            },
+        )
+        return bool(((out or {}).get("status") or {}).get("allowed"))
+
     # ---- events -----------------------------------------------------------
 
     def record_event(

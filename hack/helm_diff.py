@@ -42,17 +42,15 @@ def _key(doc) -> tuple:
 
 
 def load_docs(path: str):
-    """{identity key: (count, doc)} — the count catches a renderer
-    emitting the same document twice (a plain dict would silently
-    collapse duplicates and pass the diff, the exact breakage this
-    script exists to catch)."""
+    """{identity key: [docs]} — ALL documents per identity are kept and
+    compared element-wise (keeping only a count, or only the last doc,
+    would pass a [corrupted, good] vs [good, good] divergence — the
+    exact breakage this script exists to catch)."""
     with open(path) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
     out = {}
     for d in docs:
-        k = _key(d)
-        count, _ = out.get(k, (0, None))
-        out[k] = (count + 1, d)
+        out.setdefault(_key(d), []).append(d)
     return out
 
 
@@ -82,22 +80,27 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             rc = 1
             continue
-        (na, da), (nb, db) = a[key], b[key]
-        if na != nb:
-            print(f"DIVERGENT: {ident} emitted {na}x by {args.label_a} "
-                  f"but {nb}x by {args.label_b}", file=sys.stderr)
+        la, lb = a[key], b[key]
+        if len(la) != len(lb):
+            print(f"DIVERGENT: {ident} emitted {len(la)}x by "
+                  f"{args.label_a} but {len(lb)}x by {args.label_b}",
+                  file=sys.stderr)
             rc = 1
-        if da != db:
-            print(f"DIVERGENT: {ident}", file=sys.stderr)
+        for i, (da, db) in enumerate(zip(la, lb)):
+            if da == db:
+                continue
+            n = f"#{i}" if max(len(la), len(lb)) > 1 else ""
+            print(f"DIVERGENT: {ident}{n}", file=sys.stderr)
             sys.stderr.writelines(difflib.unified_diff(
                 canonical(da).splitlines(keepends=True),
                 canonical(db).splitlines(keepends=True),
-                fromfile=f"{args.label_a}:{ident}",
-                tofile=f"{args.label_b}:{ident}",
+                fromfile=f"{args.label_a}:{ident}{n}",
+                tofile=f"{args.label_b}:{ident}{n}",
             ))
             rc = 1
     if rc == 0:
-        print(f"EQUIVALENT: {len(a)} documents match "
+        n_docs = sum(len(v) for v in a.values())
+        print(f"EQUIVALENT: {n_docs} documents match "
               f"({args.label_a} == {args.label_b})")
     return rc
 

@@ -60,6 +60,11 @@ class TestDefaultRender:
             "--qps=30",
             "--burst=50",
             "--metrics-bind-address=:8080",
+            # Explicit false (the CLI defaults secure) — the reference
+            # chart makes the same choice (its deployment.yaml:62-63);
+            # values.metrics.secure=true opts into HTTPS + the https
+            # ServiceMonitor.
+            "--metrics-secure=false",
             "--health-probe-bind-address=:8081",
         ]
 
@@ -191,3 +196,55 @@ class TestHostTimezone:
         vm = spec["containers"][0]["volumeMounts"][0]
         assert vm["mountPath"] == "/etc/localtime"
         assert vm["readOnly"] is True
+
+
+class TestSecureMetricsRender:
+    """values.metrics.secure=true — the chart's opt-in to the CLI's
+    default-secure /metrics (the reference chart pins secure=false; ours
+    additionally renders the HTTPS scrape config when opted in)."""
+
+    def test_secure_flag_and_https_servicemonitor(self):
+        objs = render({
+            "metrics": {"secure": True,
+                        "serviceMonitor": {"enable": True}},
+        })
+        args = container(find(objs, "Deployment"))["args"]
+        # Go-style bool formatting (helmtmpl._fmt): must render exactly
+        # what real helm renders, or the helm-validate CI job diverges.
+        assert "--metrics-secure=true" in args
+        sm = find(objs, "ServiceMonitor")
+        ep = sm["spec"]["endpoints"][0]
+        assert ep["scheme"] == "https"
+        assert ep["tlsConfig"]["insecureSkipVerify"] is True
+        assert "serviceaccount/token" in ep["bearerTokenFile"]
+
+    def test_default_stays_plain_http(self):
+        objs = render({"metrics": {"serviceMonitor": {"enable": True}}})
+        args = container(find(objs, "Deployment"))["args"]
+        assert "--metrics-secure=false" in args
+        ep = find(objs, "ServiceMonitor")["spec"]["endpoints"][0]
+        assert "scheme" not in ep
+
+    def test_secure_true_ships_review_rbac(self):
+        """metrics.secure=true wires kube-delegated scrape auth, which
+        needs the TokenReview/SubjectAccessReview verbs — without this
+        RBAC every scrape fails closed with 401."""
+        objs = render({"metrics": {"secure": True}})
+        auth = find(objs, "ClusterRole", name_contains="metrics-auth")
+        flat = [(r.get("apiGroups"), r.get("resources"), r.get("verbs"))
+                for r in auth["rules"]]
+        assert (["authentication.k8s.io"], ["tokenreviews"],
+                ["create"]) in flat
+        assert (["authorization.k8s.io"], ["subjectaccessreviews"],
+                ["create"]) in flat
+        binding = find(objs, "ClusterRoleBinding",
+                       name_contains="metrics-auth")
+        sa = find(objs, "ServiceAccount")
+        assert binding["subjects"][0]["name"] == sa["metadata"]["name"]
+        reader = find(objs, "ClusterRole", name_contains="metrics-reader")
+        assert reader["rules"][0]["nonResourceURLs"] == ["/metrics"]
+
+    def test_default_ships_no_review_rbac(self):
+        objs = render()
+        assert not [o for o in objs
+                    if "metrics-auth" in o["metadata"]["name"]]

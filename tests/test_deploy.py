@@ -142,4 +142,17 @@ class TestKustomizeTree:
             if d and d.get("kind") == "Deployment":
                 deploy = d
         dargs = deploy["spec"]["template"]["spec"]["containers"][0]["args"]
-        assert args == dargs
+        # The two install paths intentionally diverge on the metrics
+        # posture (reference parity: its kustomize manager serves secure
+        # :8443, its chart/plain path pins --metrics-secure=false on
+        # :8080); everything else must stay in lockstep.
+        metrics = ("--metrics-bind-address", "--metrics-secure")
+
+        def non_metrics(a):
+            return [x for x in a if not x.startswith(metrics)]
+
+        assert non_metrics(args) == non_metrics(dargs)
+        assert "--metrics-bind-address=:8443" in args  # secure kustomize
+        assert "--metrics-secure=false" not in args
+        assert "--metrics-bind-address=:8080" in dargs  # plain manifest
+        assert "--metrics-secure=false" in dargs
