@@ -65,6 +65,11 @@ class ScrapeAuthenticator:
                 self._cache.move_to_end(token)
                 return hit[1]
         allowed = self._review(token)
+        if allowed is None:
+            # Transient review failure: deny THIS request (fail closed)
+            # but don't poison the cache — a one-scrape apiserver blip
+            # must not lock a legitimate scraper out for a full TTL.
+            return False
         with self._lock:
             self._cache[token] = (now + self._ttl, allowed)
             self._cache.move_to_end(token)
@@ -72,7 +77,9 @@ class ScrapeAuthenticator:
                 self._cache.popitem(last=False)
         return allowed
 
-    def _review(self, token: str) -> bool:
+    def _review(self, token: str) -> Optional[bool]:
+        """True/False = authoritative review outcome (cacheable); None =
+        transient failure (deny, never cache)."""
         try:
             status = self._client.token_review(token)
             if not status.get("authenticated"):
@@ -86,7 +93,7 @@ class ScrapeAuthenticator:
             logger.warning(
                 "scrape authn/z review failed (denying): %s", exc
             )
-            return False
+            return None
 
 
 __all__ = ["ScrapeAuthenticator"]

@@ -248,3 +248,12 @@ class TestSecureMetricsRender:
         objs = render()
         assert not [o for o in objs
                     if "metrics-auth" in o["metadata"]["name"]]
+
+    def test_secure_rbac_not_gated_on_rbac_create(self):
+        """rbac.create=false (pre-existing workload RBAC) must NOT
+        silently drop the review RBAC the secure-metrics opt-in needs —
+        that combination would 401 every scrape with no install-time
+        signal."""
+        objs = render({"metrics": {"secure": True},
+                       "rbac": {"create": False}})
+        find(objs, "ClusterRole", name_contains="metrics-auth")

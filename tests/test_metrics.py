@@ -384,3 +384,14 @@ class TestScrapeAuthenticator:
                 kube.stop()
         finally:
             stub.shutdown()
+
+    def test_transient_failure_denies_but_is_not_cached(self):
+        """An apiserver blip must deny the in-flight scrape (fail
+        closed) without locking the token out for the TTL."""
+        client, auth = self._auth(
+            users={"tok": ("prom", [])}, allowed={"prom"},
+        )
+        client.fail = True
+        assert auth.allow("Bearer tok") is False
+        client.fail = False  # apiserver recovers
+        assert auth.allow("Bearer tok") is True  # immediately, no TTL wait
