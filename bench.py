@@ -66,6 +66,12 @@ CPU_IMAGE = int(os.environ.get("BENCH_CPU_IMAGE", "128"))
 # measure window (r5 rehearsal: 40 CPU steps overran the 240 s grace and
 # the artifact lost steps_per_s/avg_step_time).
 CPU_STEPS = int(os.environ.get("BENCH_CPU_STEPS", "6"))
+# Optimizer steps per dispatched program (TrainConfig.steps_per_call):
+# amortizes the tunnel's per-dispatch cost, whose drift was the residual
+# variable in full-stack runs (PERF.md finding 5). 5 ≈ 265 ms/dispatch
+# at the flagship shape — long enough to amortize, short enough that the
+# first-call (= tick→first-step anchor) stays sub-second.
+STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL", "5"))
 # Round-4 probe strategy (VERDICT r3 #1): ONE long attempt instead of
 # r3's 2x150 s that both failed — a tunnel init that hasn't come up in
 # 150 s was observed (r4, faulthandler) still inside PJRT client
@@ -218,32 +224,52 @@ def _probe_devices(timeout: float, attempts: int = PROBE_ATTEMPTS):
     }
 
 
-def _prewarm(platform, batch: int, image: int, timeout: float):
+def _prewarm(platform, batch: int, image: int, steps: int, timeout: float):
     """Compile-warm the exact bench computation via the runner subprocess
-    (persistent cache makes the measured run a cache hit)."""
-    args = [
-        sys.executable, "-m", "cron_operator_tpu.workloads.runner",
-        "resnet50", "steps=1", f"batch_size={batch}", f"image_size={image}",
-        "data=fused",  # must match the measured run's program exactly
-        # Prewarm ALSO populates the persistent cache for the measured
-        # run's post-run flops cost-analysis (a re-lower + re-compile).
-        "flops_accounting=1",
-    ]
-    if platform:
-        args.append(f"platform={platform}")
+    (persistent cache makes the measured run a cache hit).
+
+    One prewarm run per distinct program the measured run will dispatch:
+    the full steps_per_call scan, plus the remainder-length scan when
+    ``steps`` is not a multiple (otherwise that partial-chunk program
+    compiles mid-measure and pollutes the steady state)."""
+    # CPU fallback keeps one step per dispatch: there is no link to
+    # amortize, and a multi-step first call would inflate its
+    # tick->first-step anchor by whole CPU-step durations.
+    spc = STEPS_PER_CALL if platform is None else 1
+    lengths = [spc]
+    if steps % spc:
+        lengths.append(steps % spc)
     t0 = time.time()
-    try:
-        out = subprocess.run(args, capture_output=True, text=True,
-                             timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return {"ok": False, "error": f"prewarm exceeded {timeout:.0f}s"}
-    if out.returncode != 0:
-        return {
-            "ok": False,
-            "error": f"prewarm rc={out.returncode}: "
-                     f"{(out.stderr or '').strip()[-800:]}",
-        }
-    return {"ok": True, "seconds": round(time.time() - t0, 1)}
+    for length in lengths:
+        args = [
+            sys.executable, "-m", "cron_operator_tpu.workloads.runner",
+            "resnet50", f"steps={length}",
+            f"batch_size={batch}", f"image_size={image}",
+            # Must match the measured run's programs exactly: fused data
+            # AND the scan-of-length program.
+            "data=fused", f"steps_per_call={length}",
+            # Prewarm ALSO populates the persistent cache for the
+            # measured run's post-run flops cost-analysis (a re-lower +
+            # re-compile of the single-step program).
+            "flops_accounting=1",
+        ]
+        if platform:
+            args.append(f"platform={platform}")
+        remaining = timeout - (time.time() - t0)
+        try:
+            out = subprocess.run(args, capture_output=True, text=True,
+                                 timeout=max(1.0, remaining))
+        except subprocess.TimeoutExpired:
+            return {"ok": False,
+                    "error": f"prewarm exceeded {timeout:.0f}s"}
+        if out.returncode != 0:
+            return {
+                "ok": False,
+                "error": f"prewarm rc={out.returncode}: "
+                         f"{(out.stderr or '').strip()[-800:]}",
+            }
+    return {"ok": True, "seconds": round(time.time() - t0, 1),
+            "programs": lengths}
 
 
 def _attention_microbench(platform, timeout: float):
@@ -310,8 +336,10 @@ def _lm_bench(platform, timeout: float) -> dict:
     progress, err = _runner_progress(
         ["bert", "steps=24", "batch_size=8", "seq_len=512",
          # first+last sync only (see SYNC_EVERY above) + in-step data
-         # generation: the steady state is one dispatch per step.
-         "sync_every=24", "data=fused", "flops_accounting=1"],
+         # generation + 6 steps per dispatch: the steady state is four
+         # dispatches total.
+         "sync_every=24", "data=fused", "steps_per_call=6",
+         "flops_accounting=1"],
         timeout,
     )
     if err:
@@ -532,7 +560,7 @@ def main() -> int:
             "throughput."
         )
 
-    warm = _prewarm(platform, batch, image, PREWARM_TIMEOUT_S)
+    warm = _prewarm(platform, batch, image, steps, PREWARM_TIMEOUT_S)
     if not warm.get("ok") and platform is None:
         # TPU path compiled/ran sick — retry the whole bench on CPU rather
         # than returning nothing.
@@ -541,7 +569,7 @@ def main() -> int:
         batch, image, steps = shape_for(platform)
         extra.update(platform="cpu", batch_size=batch, image_size=image,
                      steps=steps)
-        warm = _prewarm(platform, batch, image, PREWARM_TIMEOUT_S)
+        warm = _prewarm(platform, batch, image, steps, PREWARM_TIMEOUT_S)
     extra["prewarm"] = warm
     if not warm.get("ok"):
         return _emit(None, extra, error=f"prewarm failed: {warm.get('error')}")
@@ -587,6 +615,9 @@ def main() -> int:
         # Fused in-step data generation: the steady state is one dispatch
         # per step, nothing per-step on the host (PERF.md finding 3-4).
         "tpu.kubedl.io/param.data": "fused",
+        "tpu.kubedl.io/param.steps_per_call": str(
+            STEPS_PER_CALL if platform is None else 1
+        ),
         "tpu.kubedl.io/param.flops_accounting": "1",
         # Belt & braces: never let one tick run unbounded.
         "tpu.kubedl.io/job-timeout": f"{int(MEASURE_TIMEOUT_S)}s",

@@ -125,6 +125,9 @@ def _train_kwargs(ctx: JobContext, steps: int, **defaults) -> dict:
         save_every=_save_every(ctx),
         prefetch=_prefetch(ctx),
         sync_every=_sync_every(ctx),
+        # K optimizer steps per dispatched program (fused data only) —
+        # the host-roundtrip amortizer for remote/tunneled devices.
+        steps_per_call=int(ctx.params.get("steps_per_call", 1)),
         lr_schedule=ctx.params.get("lr_schedule", "constant"),
         warmup_steps=int(ctx.params.get("warmup_steps", 0)),
         schedule_steps=int(ctx.params.get("schedule_steps", steps)),
@@ -188,7 +191,10 @@ def _run(
     window = [0.0, 0]  # wall time and step count since the last synced step
 
     def on_step(s: StepStats) -> None:
-        if s.step == first_local_step:
+        # Key-presence, not step equality: with steps_per_call > 1 the
+        # first CALL completes several steps at once.
+        first_call = "first_step_at" not in ctx.progress
+        if first_call:
             # The north-star timestamp: first optimizer step finished
             # (device-synced — Trainer.step blocks on the loss).
             ctx.progress["first_step_at"] = time.time()
@@ -208,8 +214,10 @@ def _run(
         # the next synced step absorbs the whole window's device work —
         # neither is a per-step time by itself, so publish the window
         # average at each synced step (loss is only known there too).
-        window[0] += s.step_time_s
-        window[1] += 1
+        # Weighted by chunk: step_time_s is per-step, so a partial final
+        # chunk must not count like a full one.
+        window[0] += s.step_time_s * s.chunk
+        window[1] += s.chunk
         if s.loss is not None:
             ctx.progress["last_loss"] = s.loss
             ctx.progress["last_step_time_s"] = round(
@@ -218,7 +226,7 @@ def _run(
             window[0], window[1] = 0.0, 0
         now = time.time()
         if ctx.publish is not None and (
-            s.step == first_local_step or now - last_publish[0] > 1.0
+            first_call or now - last_publish[0] > 1.0
         ):
             last_publish[0] = now
             ctx.publish()
@@ -237,21 +245,25 @@ def _run(
             # Orbax managers own background threads; a long-lived executor
             # runs many ticks, so every store must be released.
             trainer.checkpoint.close()
-    # Steady-state throughput: drop the compile-laden first step.
+    # Steady-state throughput: drop the compile-laden first call.
+    # Chunk-weighted: step_time_s is per-step, chunks can be non-uniform.
     tail = stats[1:] if len(stats) > 1 else stats
-    if tail:
-        avg = sum(s.step_time_s for s in tail) / len(tail)
+    n_steps = sum(s.chunk for s in tail)
+    if tail and n_steps:
+        avg = sum(s.step_time_s * s.chunk for s in tail) / n_steps
         ctx.progress["avg_step_time_s"] = round(avg, 4)
         ctx.progress["steps_per_s"] = round(1.0 / avg, 4) if avg > 0 else None
-    # Dispatch-health diagnostic: async (non-synced) steps record pure
-    # dispatch time — their median should be single-digit ms. A high p50
-    # in an artifact attributes a slow run to host/link dispatch overhead
+    # Dispatch-health diagnostic: async (non-synced) calls record pure
+    # dispatch wall time (× chunk to undo the per-step normalization —
+    # the DISPATCH is what the link taxes, however many steps it
+    # carries); the median should be single-digit ms. A high p50 in an
+    # artifact attributes a slow run to host/link dispatch overhead
     # (tunnel congestion, CPU starvation) rather than device compute
-    # (PERF.md finding 3). The final step is excluded either way: on an
-    # early exit Trainer.run charges the whole device drain to it, which
-    # would masquerade as a giant "dispatch" sample.
+    # (PERF.md finding 3). The final call is excluded either way: on an
+    # early exit Trainer.run charges the device drain to it, which would
+    # masquerade as a giant "dispatch" sample.
     async_ms = sorted(
-        s.step_time_s * 1e3 for s in tail[:-1] if s.loss is None
+        s.step_time_s * s.chunk * 1e3 for s in tail[:-1] if s.loss is None
     )
     if async_ms:
         ctx.progress["async_dispatch_ms_p50"] = round(

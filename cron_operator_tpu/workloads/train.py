@@ -73,6 +73,14 @@ class TrainConfig:
     # batch key is fold_in(PRNGKey(data_seed), state.step), so resume
     # continues the data stream instead of replaying it.
     data_seed: int = 0
+    # Optimizer steps per dispatched program (lax.scan of the step body;
+    # requires fused data — external batches can't be replayed inside
+    # the scan). >1 amortizes the per-dispatch host/link cost K× — on a
+    # tunneled device whose dispatch latency drifts (PERF.md finding 5)
+    # this pins the measured rate to the chip. The data stream is
+    # IDENTICAL to steps_per_call=1: each in-scan step derives its batch
+    # from the live state.step.
+    steps_per_call: int = 1
     # Block on the loss every N steps (1 = every step). Fetching a scalar
     # is a full host↔device round trip — ~80 ms on a tunneled device,
     # swamping a ~20 ms train step — so steady-state throughput needs the
@@ -146,7 +154,8 @@ class TrainConfig:
 class StepStats:
     step: int
     loss: Optional[float]  # None on async (non-synced) steps
-    step_time_s: float
+    step_time_s: float  # PER-STEP (dispatch wall / chunk)
+    chunk: int = 1  # optimizer steps this dispatch carried
 
 
 class Trainer:
@@ -239,15 +248,46 @@ class Trainer:
         # Fused mode takes an EMPTY batch dict (the data comes from the
         # in-step PRNG); the in_shardings pytree must match it.
         in_batch_sharding = {} if sample_fn is not None else self.batch_sharding
-        self._step = jax.jit(
-            step_fn,
+        self._jit_kwargs = dict(
             in_shardings=(self.state_sharding, in_batch_sharding),
             out_shardings=(self.state_sharding,
                            NamedSharding(mesh, jax.sharding.PartitionSpec())),
             donate_argnums=(0,),
         )
+        self._step_fn = step_fn
+        self._step = jax.jit(step_fn, **self._jit_kwargs)
+        if self.config.steps_per_call > 1 and sample_fn is None:
+            raise ValueError(
+                "steps_per_call > 1 requires fused data (sample_fn): "
+                "external batches cannot be replayed inside the scan"
+            )
+        self._multi: Dict[int, Any] = {}  # chunk length → jitted scan
         self._batch_struct = None  # set on first put_batch (flops_per_step)
         self._flops_per_step: Optional[float] = None
+
+    def _stepper(self, chunk: int):
+        """The jitted program for ``chunk`` optimizer steps per dispatch
+        (1 → the plain step). Cached per length — a partial final chunk
+        compiles its own (second, at most) program."""
+        if chunk <= 1:
+            return self._step
+        fn = self._multi.get(chunk)
+        if fn is None:
+            step_fn = self._step_fn
+
+            def multi(state, batch):
+                def body(s, _):
+                    s2, loss = step_fn(s, batch)
+                    return s2, loss
+
+                state, losses = jax.lax.scan(
+                    body, state, None, length=chunk
+                )
+                return state, losses[-1]
+
+            fn = jax.jit(multi, **self._jit_kwargs)
+            self._multi[chunk] = fn
+        return fn
 
     def put_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
         placed = {
@@ -292,20 +332,35 @@ class Trainer:
                 self._flops_per_step = None  # fail training
         return self._flops_per_step
 
-    def step(self, batch: Dict[str, Any], sync: bool = True) -> StepStats:
+    def step(
+        self, batch: Dict[str, Any], sync: bool = True, chunk: int = 1
+    ) -> StepStats:
+        """One dispatch of ``chunk`` optimizer steps (see
+        TrainConfig.steps_per_call). ``step_time_s`` is normalized PER
+        STEP (dispatch wall / chunk) so throughput math is
+        chunk-agnostic; ``loss`` is the chunk's last step's."""
         t0 = time.perf_counter()
-        self.state, loss = self._step(self.state, self.put_batch(batch))
+        self.state, loss = self._stepper(chunk)(
+            self.state, self.put_batch(batch)
+        )
         # Blocking keeps the step-time numbers honest; sync=False lets the
         # caller amortize the round trip (see TrainConfig.sync_every).
         loss = float(loss) if sync else None
-        self.steps_done += 1
+        before = self.steps_done
+        self.steps_done += chunk
         if (
             self.checkpoint is not None
             and self.config.save_every > 0
-            and self.steps_done % self.config.save_every == 0
+            # Crossing a save_every boundary anywhere inside the chunk.
+            and self.steps_done // self.config.save_every
+            > before // self.config.save_every
         ):
             self.checkpoint.save(self.steps_done, self.state)
-        return StepStats(self.steps_done, loss, time.perf_counter() - t0)
+        return StepStats(
+            self.steps_done, loss,
+            (time.perf_counter() - t0) / max(1, chunk),
+            chunk=max(1, chunk),
+        )
 
     def run(
         self,
@@ -329,6 +384,7 @@ class Trainer:
             )
             batches = prefetcher  # step's put_batch is a no-op re-place
         se = max(1, self.config.sync_every)
+        spc = max(1, self.config.steps_per_call)
         first = self.steps_done + 1
         stats = []
         try:
@@ -336,14 +392,20 @@ class Trainer:
                 if should_stop is not None and should_stop():
                     break
                 nxt = self.steps_done + 1
-                # Always sync the first step (the tick→first-step anchor
+                chunk = min(spc, steps - self.steps_done)
+                last_of_call = self.steps_done + chunk
+                # Always sync the first call (the tick→first-step anchor
                 # must be device-completed, not merely dispatched) and the
-                # last (so run() returns with the device drained).
+                # last (so run() returns with the device drained); between
+                # them, sync whenever the call crosses a sync_every
+                # boundary (counted in steps from `first`, so the cadence
+                # is chunk-agnostic).
                 sync = (
-                    nxt == first or nxt >= steps
-                    or (nxt - first) % se == se - 1
+                    nxt == first or last_of_call >= steps
+                    or (last_of_call - first + 1) // se
+                    > (nxt - first) // se
                 )
-                s = self.step(next(batches), sync=sync)
+                s = self.step(next(batches), sync=sync, chunk=chunk)
                 stats.append(s)
                 if on_step is not None:
                     on_step(s)
@@ -356,7 +418,11 @@ class Trainer:
                 # averaging dispatch-only times.
                 t0 = time.perf_counter()
                 jax.block_until_ready(self.state)
-                stats[-1].step_time_s += time.perf_counter() - t0
+                # step_time_s is per-step: normalize the drain by the
+                # final call's chunk too.
+                stats[-1].step_time_s += (
+                    (time.perf_counter() - t0) / stats[-1].chunk
+                )
             if prefetcher is not None:
                 prefetcher.close()
         if self.checkpoint is not None:

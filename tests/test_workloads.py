@@ -590,3 +590,60 @@ class TestFusedData:
         assert ctx_progress["steps_done"] == 2
         assert ctx_progress["last_loss"] is not None
         assert ctx_progress.get("xla_flops_per_step")
+
+
+class TestStepsPerCall:
+    """steps_per_call: K optimizer steps per dispatched program (a
+    lax.scan of the step body over fused data) — the host-roundtrip
+    amortizer. Must be a pure dispatch-count change: same data stream,
+    same final parameters."""
+
+    def _final_checksum(self, cpus, steps, spc):
+        from itertools import repeat
+
+        with jax.default_device(cpus[0]):
+            mesh = mesh_for_devices(cpus)
+            m = MLP(features=(32,))
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(optimizer="sgd", steps_per_call=spc),
+                sample_fn=datasets.mnist_sample(8),
+            )
+            stats = tr.run(repeat({}), steps)
+            assert tr.steps_done == steps
+            leaves = jax.tree_util.tree_leaves(tr.state.params)
+            return (
+                [s.step for s in stats],
+                float(sum(jnp.sum(jnp.abs(l)) for l in leaves)),
+            )
+
+    def test_chunked_matches_unchunked(self, cpus):
+        steps1, c1 = self._final_checksum(cpus, steps=6, spc=1)
+        steps3, c3 = self._final_checksum(cpus, steps=6, spc=3)
+        assert steps1 == [1, 2, 3, 4, 5, 6]
+        assert steps3 == [3, 6]
+        assert c1 == c3  # bit-identical params: same stream, fewer calls
+
+    def test_partial_final_chunk(self, cpus):
+        steps, c = self._final_checksum(cpus, steps=7, spc=3)
+        assert steps == [3, 6, 7]  # 3 + 3 + partial 1
+        _, c1 = self._final_checksum(cpus, steps=7, spc=1)
+        assert c == c1
+
+    def test_requires_fused_data(self, cpus):
+        import pytest
+
+        with jax.default_device(cpus[0]):
+            mesh = mesh_for_devices(cpus)
+            m = MLP(features=(32,))
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+            )["params"]
+            with pytest.raises(ValueError, match="fused data"):
+                Trainer(
+                    lambda p, x: m.apply({"params": p}, x), params, mesh,
+                    TrainConfig(optimizer="sgd", steps_per_call=4),
+                )
