@@ -234,10 +234,13 @@ def _prewarm(platform, batch: int, image: int, steps: int, timeout: float):
     compiles mid-measure and pollutes the steady state)."""
     # CPU fallback keeps one step per dispatch: there is no link to
     # amortize, and a multi-step first call would inflate its
-    # tick->first-step anchor by whole CPU-step durations.
-    spc = STEPS_PER_CALL if platform is None else 1
-    lengths = [spc]
-    if steps % spc:
+    # tick->first-step anchor by whole CPU-step durations. max(1, ...):
+    # BENCH_STEPS_PER_CALL=0 means "disable", not ZeroDivisionError.
+    spc = max(1, STEPS_PER_CALL) if platform is None else 1
+    # min(spc, steps): when steps < spc the measured run's only chunk IS
+    # the remainder — don't burn prewarm budget on an unused program.
+    lengths = [min(spc, steps)]
+    if steps % spc and steps > spc:
         lengths.append(steps % spc)
     t0 = time.time()
     for length in lengths:
@@ -616,7 +619,7 @@ def main() -> int:
         # per step, nothing per-step on the host (PERF.md finding 3-4).
         "tpu.kubedl.io/param.data": "fused",
         "tpu.kubedl.io/param.steps_per_call": str(
-            STEPS_PER_CALL if platform is None else 1
+            max(1, STEPS_PER_CALL) if platform is None else 1
         ),
         "tpu.kubedl.io/param.flops_accounting": "1",
         # Belt & braces: never let one tick run unbounded.

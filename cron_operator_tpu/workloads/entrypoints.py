@@ -180,7 +180,6 @@ def _run(
     ctx.progress["started_at"] = time.time()
     if trainer.steps_done:
         ctx.progress["resumed_from_step"] = trainer.steps_done
-    first_local_step = trainer.steps_done + 1
     last_publish = [0.0]
     # Optional profiling (SURVEY.md §5 "tracing/profiling: none in the
     # reference"): param.profile_dir=<path> captures a jax.profiler trace

@@ -271,6 +271,14 @@ class Trainer:
         compiles its own (second, at most) program."""
         if chunk <= 1:
             return self._step
+        if self.sample_fn is None:
+            # Same guard as __init__ for config.steps_per_call — the
+            # public step(chunk=) path must not silently replay one
+            # external batch for every step of the scan.
+            raise ValueError(
+                "chunk > 1 requires fused data (sample_fn): external "
+                "batches cannot be replayed inside the scan"
+            )
         fn = self._multi.get(chunk)
         if fn is None:
             step_fn = self._step_fn

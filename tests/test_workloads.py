@@ -647,3 +647,23 @@ class TestStepsPerCall:
                     lambda p, x: m.apply({"params": p}, x), params, mesh,
                     TrainConfig(optimizer="sgd", steps_per_call=4),
                 )
+
+    def test_step_chunk_requires_fused_data_too(self, cpus):
+        """The public step(chunk=) path must hit the same guard as
+        config.steps_per_call — otherwise one external batch silently
+        replays through the whole scan."""
+        import pytest
+
+        with jax.default_device(cpus[0]):
+            mesh = mesh_for_devices(cpus)
+            m = MLP(features=(32,))
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(optimizer="sgd"),
+            )
+            batch = next(datasets.mnist_batches(8))
+            with pytest.raises(ValueError, match="fused data"):
+                tr.step(batch, chunk=4)
