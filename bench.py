@@ -214,6 +214,12 @@ def _probe_devices(timeout: float, attempts: int = PROBE_ATTEMPTS):
         info["attempts"] = history + [
             {"attempt": attempt, "elapsed_s": info["init_s"], "ok": True}
         ]
+        if info.get("cleared_jax_platforms"):
+            # The probe self-healed a stale JAX_PLATFORMS pin (it named a
+            # platform no installed plugin registers). Every later child
+            # (prewarm, runners, microbench, sweep) inherits our env and
+            # would fail identically — clear the pin here too.
+            os.environ.pop("JAX_PLATFORMS", None)
         return ("cpu" if info["backend"] == "cpu" else None), info
     return "cpu", {
         "ok": False,

@@ -42,6 +42,25 @@ def main() -> int:
 
         devices = jax.devices()
     except Exception as exc:  # deterministic failure, not a hang
+        # Env/plugin mismatch self-heal: JAX_PLATFORMS pins a platform
+        # name the installed plugin set doesn't register under (observed
+        # r5: env said "axon" while the plugin registered as plain "tpu"
+        # when the sitecustomize path was missing — and vice versa). One
+        # re-exec with the pin cleared lets JAX auto-pick whatever
+        # accelerator actually registered; the re-exec'd run's JSON
+        # carries ``cleared_jax_platforms`` so the parent (bench.py) can
+        # strip the pin from every LATER child too — healing only the
+        # probe would leave prewarm/runner children failing identically.
+        if (
+            "not in the list of known backends" in str(exc)
+            and os.environ.get("JAX_PLATFORMS")
+            and os.environ.get("TPU_PROBE_REEXEC") != "1"
+        ):
+            faulthandler.cancel_dump_traceback_later()
+            env = dict(os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            env["TPU_PROBE_REEXEC"] = "1"
+            os.execve(sys.executable, [sys.executable, __file__], env)
         print(
             json.dumps(
                 {
@@ -66,6 +85,12 @@ def main() -> int:
                     devices[0].client, "platform_version", ""
                 ),
                 "init_s": round(time.time() - t0, 1),
+                # True when this is the self-healed re-exec (the original
+                # JAX_PLATFORMS pin named an unregistered platform) — the
+                # parent must clear the pin for its other children.
+                "cleared_jax_platforms": (
+                    os.environ.get("TPU_PROBE_REEXEC") == "1"
+                ),
             }
         )
     )
