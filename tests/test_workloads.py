@@ -667,3 +667,36 @@ class TestStepsPerCall:
             batch = next(datasets.mnist_batches(8))
             with pytest.raises(ValueError, match="fused data"):
                 tr.step(batch, chunk=4)
+
+
+class TestTpuProbeSelfHeal:
+    def test_stale_platform_pin_heals_to_registered_backend(self):
+        """JAX_PLATFORMS naming an unregistered platform must re-exec
+        with the pin cleared and report cleared_jax_platforms (bench.py
+        strips the pin for all later children on that signal) — not fail
+        rc=2 and silently downgrade the artifact to CPU."""
+        import json
+        import os
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        probe = (Path(__file__).resolve().parent.parent
+                 / "hack" / "tpu_probe.py")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "no-such-platform"
+        env.pop("TPU_PROBE_REEXEC", None)
+        env.pop("TPU_PROBE_HOLD", None)  # would block on stdin after OK
+        # Strip any plugin site-paths so only built-in backends register
+        # (deterministic regardless of the host's tunnel plugins).
+        env["PYTHONPATH"] = str(probe.parent.parent)
+        out = subprocess.run(
+            [sys.executable, str(probe)], env=env,
+            capture_output=True, text=True, timeout=180,
+            stdin=subprocess.DEVNULL,
+        )
+        assert out.returncode == 0, out.stderr[-500:]
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["ok"] is True
+        assert rec["cleared_jax_platforms"] is True
+        assert rec["backend"]  # whatever actually registered (cpu here)
