@@ -637,3 +637,34 @@ class TestServedAPITLS:
                 strict.stop()
         finally:
             srv.stop()
+
+    def test_watch_stream_over_https(self, tmp_path):
+        """The chunked long-lived watch must survive the TLS wrap + the
+        60 s handler socket timeout (writes land every <=0.5 s)."""
+        from cron_operator_tpu.utils.tlsutil import (
+            self_signed_cert,
+            server_context,
+        )
+
+        cert, key = self_signed_cert(dir=str(tmp_path))
+        srv = HTTPAPIServer(token=TOKEN, tls_ctx=server_context(cert, key))
+        srv.start()
+        capi = None
+        try:
+            capi = ClusterAPIServer(
+                ClusterConfig(srv.url, token=TOKEN, ca_file=cert),
+                scheme=default_scheme(),
+            )
+            seen = []
+            capi.add_watcher(
+                lambda ev: seen.append(ev.object["metadata"]["name"])
+            )
+            capi.start_watches([GVK_CRON])
+            time.sleep(0.3)
+            capi.create(make_cron("tls-watched", tpu=False))
+            wait_for(lambda: "tls-watched" in seen,
+                     message="watch event over TLS")
+        finally:
+            if capi is not None:
+                capi.stop()
+            srv.stop()
