@@ -382,12 +382,18 @@ def _decode_bench(platform, device_kind: str, timeout: float) -> dict:
     hbm = next(
         (v for k, v in PEAK_HBM if k in (device_kind or "").lower()), None
     )
+    deadline = time.time() + timeout  # TOTAL for the whole sweep
     sweep = []
     for batch in (8, 16, 32):
+        remaining = deadline - time.time()
+        if remaining < 30.0:
+            sweep.append({"batch_size": batch,
+                          "skipped": "decode budget exhausted"})
+            continue
         progress, err = _runner_progress(
             ["generate", "rounds=3", f"batch_size={batch}",
              "prompt_len=64", "max_new=128"],
-            timeout,
+            min(300.0, remaining),
         )
         if err:
             sweep.append({"batch_size": batch, **err})
@@ -541,6 +547,21 @@ def main() -> int:
     )
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
+    # Global wall-clock budget: with a SICK-but-up tunnel every leg can
+    # run to its own timeout and the worst case reaches hours — and an
+    # external kill loses the whole artifact, since the JSON only prints
+    # at the end. Optional legs get min(their timeout, what is left
+    # after reserving room for the measured run); when nothing is left
+    # they are skipped with a label instead of silently starving the
+    # headline.
+    t_begin = time.time()
+    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET", "2700"))
+    reserve = MEASURE_TIMEOUT_S * 2 + 60.0  # measured run + grace + emit
+
+    def leg_timeout(want: float) -> float:
+        remaining = total_budget - (time.time() - t_begin) - reserve
+        return min(want, max(0.0, remaining))
+
     platform, probe = _probe_devices(PROBE_TIMEOUT_S)
 
     def shape_for(platform):
@@ -583,10 +604,22 @@ def main() -> int:
     if not warm.get("ok"):
         return _emit(None, extra, error=f"prewarm failed: {warm.get('error')}")
 
-    extra["attention_bench"] = _attention_microbench(platform, timeout=300.0)
-    extra["lm_bench"] = _lm_bench(platform, timeout=240.0)
-    extra["decode_bench"] = _decode_bench(
-        platform, probe.get("kind") or "", timeout=300.0
+    def run_leg(name, fn, want):
+        t = leg_timeout(want)
+        if t < 30.0:
+            extra[name] = {"skipped": "global budget exhausted "
+                                      "(BENCH_TOTAL_BUDGET)"}
+            return
+        extra[name] = fn(t)
+
+    run_leg("attention_bench",
+            lambda t: _attention_microbench(platform, timeout=t), 300.0)
+    run_leg("lm_bench", lambda t: _lm_bench(platform, timeout=t), 240.0)
+    run_leg(
+        "decode_bench",
+        lambda t: _decode_bench(platform, probe.get("kind") or "",
+                                timeout=t),
+        600.0,  # split across the three batch legs inside
     )
     try:
         extra["control_plane"] = _control_plane_bench()
@@ -783,7 +816,13 @@ def main() -> int:
     # After the headline is computed (a sweep failure or timeout can no
     # longer cost the metric): the batch sweep + attribution record.
     if os.environ.get("BENCH_SWEEP", "1") != "0":
-        extra["mfu_sweep"] = _mfu_sweep(platform, timeout=450.0)
+        # The measured run is already done — only the final emit needs
+        # reserving (60 s), not the full measure reserve.
+        t = min(450.0, total_budget - (time.time() - t_begin) - 60.0)
+        if t < 60.0:
+            extra["mfu_sweep"] = {"skipped": "global budget exhausted"}
+        else:
+            extra["mfu_sweep"] = _mfu_sweep(platform, timeout=t)
     return _emit(round(latency, 3), extra)
 
 
