@@ -26,10 +26,10 @@ import logging
 import queue
 import threading
 import traceback
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Set, Tuple
 
 from cron_operator_tpu.api.scheme import default_scheme
-from cron_operator_tpu.api.v1alpha1 import rfc3339
+from cron_operator_tpu.api.v1alpha1 import parse_time, rfc3339
 from cron_operator_tpu.backends.registry import (
     ANNOTATION_ENTRYPOINT,
     JobContext,
@@ -38,6 +38,8 @@ from cron_operator_tpu.backends.registry import (
 from cron_operator_tpu.backends.tpu import inject_tpu_topology
 from cron_operator_tpu.controller.schedule import parse_go_duration
 from cron_operator_tpu.runtime.kube import APIServer, NotFoundError, WatchEvent
+from cron_operator_tpu.runtime.manager import PHASE_BUCKETS
+from cron_operator_tpu.telemetry import ANNOTATION_TRACE_ID
 
 logger = logging.getLogger("backends.local")
 
@@ -68,11 +70,21 @@ class LocalExecutor:
       what bench.py uses so a timed-out job can't poison later runs.
     """
 
-    def __init__(self, api: APIServer, scheme=None, isolation: str = "thread"):
+    def __init__(self, api: APIServer, scheme=None, isolation: str = "thread",
+                 metrics: Optional[Any] = None,
+                 tracer: Optional[Any] = None):
         if isolation not in ("thread", "subprocess"):
             raise ValueError(f"unknown isolation mode {isolation!r}")
         self.isolation = isolation
         self.api = api
+        # Optional telemetry sinks: `metrics` (runtime.manager.Metrics) gets
+        # the tick-phase histograms + step/throughput gauges derived from
+        # workload progress; `tracer` (telemetry.Tracer) gets the
+        # compile/first-step spans of the trace id the creating tick minted.
+        self.metrics = metrics
+        self.tracer = tracer
+        # Job keys whose one-shot first-step telemetry already fired.
+        self._telemetry_done: Set[JobKey] = set()
         self.scheme = scheme or default_scheme()
         self._handled_kinds = {
             (g.api_version, g.kind) for g in self.scheme.workload_kinds()
@@ -238,6 +250,7 @@ class LocalExecutor:
             namespace=meta.get("namespace", ""),
             job=obj,
             params=params,
+            trace_id=ann.get(ANNOTATION_TRACE_ID),
         )
 
     def _run_job(self, key: JobKey, ctx: JobContext) -> None:
@@ -308,8 +321,9 @@ class LocalExecutor:
             # tick→first-step latency histogram exactly like real ones.
             import time as _time
 
-            ctx.progress.setdefault("first_step_at", _time.time())
-            ctx.progress.setdefault("started_at", _time.time())
+            now_s = _time.time()
+            ctx.progress.setdefault("started_at", now_s)
+            ctx.progress.setdefault("first_step_at", now_s)
             if ctx.publish:
                 ctx.publish()
             # sleep in small increments so cancellation is prompt
@@ -523,6 +537,7 @@ class LocalExecutor:
         (observability for the tick→first-step north-star metric)."""
         if not ctx.progress:
             return
+        self._emit_telemetry(key, ctx)
         av, kind, ns, name = key
         try:
             obj = self.api.get(av, kind, ns, name)
@@ -531,6 +546,72 @@ class LocalExecutor:
             self.api.patch_status(av, kind, ns, name, status)
         except NotFoundError:
             pass
+
+    def _emit_telemetry(self, key: JobKey, ctx: JobContext) -> None:
+        """Forward training progress into the operator telemetry sinks.
+
+        Throughput gauges refresh on every publish. The one-shot pieces —
+        the ``cron_tick_phase_seconds`` histograms decomposing
+        tick→first-step into queue/compile/first_step, the
+        ``workload_compile_seconds`` histogram, and the ``device_compile``
+        / ``first_step`` spans of the tick's trace — fire once per job,
+        when ``first_step_at`` first appears in progress.
+        """
+        if self.metrics is None and self.tracer is None:
+            return
+        p = ctx.progress
+        if self.metrics is not None:
+            if p.get("last_step_time_s") is not None:
+                self.metrics.set(
+                    "workload_last_step_seconds", float(p["last_step_time_s"])
+                )
+            if p.get("tokens_per_s") is not None:
+                self.metrics.set(
+                    "workload_tokens_per_s", float(p["tokens_per_s"])
+                )
+        first = p.get("first_step_at")
+        if not first or key in self._telemetry_done:
+            return
+        self._telemetry_done.add(key)
+        if len(self._telemetry_done) > 4096:
+            with self._lock:
+                self._telemetry_done &= set(self._jobs)
+        started = float(p.get("started_at") or first)
+        compile_s = p.get("compile_time_s")
+        created = parse_time(
+            (ctx.job.get("metadata") or {}).get("creationTimestamp")
+        )
+
+        phases: Dict[str, float] = {}
+        if created is not None and started >= created.timestamp():
+            phases["queue"] = started - created.timestamp()
+        if compile_s is not None and float(compile_s) >= 0:
+            phases["compile"] = float(compile_s)
+        if float(first) >= started:
+            phases["first_step"] = float(first) - started
+
+        if self.metrics is not None:
+            for phase, seconds in phases.items():
+                self.metrics.observe(
+                    f'cron_tick_phase_seconds{{phase="{phase}"}}',
+                    seconds, buckets=PHASE_BUCKETS,
+                )
+            if "compile" in phases:
+                self.metrics.observe(
+                    "workload_compile_seconds", phases["compile"],
+                    buckets=PHASE_BUCKETS,
+                )
+        if self.tracer is not None and ctx.trace_id:
+            attrs = {"workload": ctx.name, "namespace": ctx.namespace}
+            if "compile" in phases:
+                self.tracer.record(
+                    "device_compile", ctx.trace_id, start_s=started,
+                    end_s=started + phases["compile"], attrs=attrs,
+                )
+            self.tracer.record(
+                "first_step", ctx.trace_id, start_s=started,
+                end_s=float(first), attrs=attrs,
+            )
 
     # ---- status helpers ---------------------------------------------------
 

@@ -36,6 +36,10 @@ class JobContext:
     # set by the executor: flushes `progress` into the workload's status
     # mid-run (entrypoints call it throttled; also called once at job end)
     publish: Optional[Callable[[], None]] = None
+    # trace id of the cron tick that created this workload (the
+    # tpu.kubedl.io/trace-id annotation / TPU_TRACE_ID env); telemetry the
+    # entrypoint emits is tagged with it so spans across layers correlate
+    trace_id: Optional[str] = None
 
     def should_stop(self) -> bool:
         return self.cancel.is_set()

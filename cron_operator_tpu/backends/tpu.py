@@ -25,6 +25,8 @@ from dataclasses import dataclass
 from math import prod
 from typing import Any, Dict, List, Optional
 
+from cron_operator_tpu.telemetry import ANNOTATION_TRACE_ID, ENV_TRACE_ID
+
 
 def normalize_param_key(key: str) -> str:
     """Canonical param-key form shared by every producer/consumer:
@@ -229,6 +231,9 @@ def render_job_env(job: Dict[str, Any]) -> List[Dict[str, Any]]:
         {"name": "TPU_JOB_NAME", "value": meta.get("name", "")},
         {"name": "TPU_JOB_NAMESPACE", "value": meta.get("namespace", "default")},
     ]
+    trace_id = ann.get(ANNOTATION_TRACE_ID)
+    if trace_id:
+        env.append({"name": ENV_TRACE_ID, "value": trace_id})
     for name, value in params_from_annotations(ann).items():
         env.append({"name": f"TPU_PARAM_{name.upper()}", "value": value})
     return env

@@ -334,6 +334,7 @@ def cmd_start(args: argparse.Namespace) -> int:
     from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
     from cron_operator_tpu.controller import CronReconciler
     from cron_operator_tpu.runtime import APIServer, Manager
+    from cron_operator_tpu.runtime.manager import PROMETHEUS_CONTENT_TYPE
     from cron_operator_tpu.runtime.kube import AlreadyExistsError
 
     scheme = default_scheme()
@@ -371,7 +372,13 @@ def cmd_start(args: argparse.Namespace) -> int:
         max_concurrent_reconciles=args.max_concurrent_reconciles,
         leader_elect=args.leader_elect,
     )
-    reconciler = CronReconciler(api, metrics=manager.metrics)
+    # One tracer per process: the cron tick's trace id links reconcile/
+    # submit spans (controller) to compile/first-step spans (backend) on
+    # /debug/traces.
+    from cron_operator_tpu.telemetry import Tracer
+
+    tracer = Tracer()
+    reconciler = CronReconciler(api, metrics=manager.metrics, tracer=tracer)
     manager.add_controller(
         "cron",
         reconciler.reconcile,
@@ -414,7 +421,7 @@ def cmd_start(args: argparse.Namespace) -> int:
     if args.backend == "local":
         from cron_operator_tpu.backends.local import LocalExecutor
 
-        executor = LocalExecutor(api)
+        executor = LocalExecutor(api, metrics=manager.metrics, tracer=tracer)
         executor.start()
 
     servers: List[ThreadingHTTPServer] = []
@@ -504,8 +511,18 @@ def cmd_start(args: argparse.Namespace) -> int:
         servers.append(
             _serve(
                 metrics_port,
-                {"/metrics": lambda: (manager.metrics.render_prometheus(),
-                                      "text/plain")},
+                {
+                    "/metrics": lambda: (
+                        manager.metrics.render_prometheus(),
+                        PROMETHEUS_CONTENT_TYPE,
+                    ),
+                    # Finished spans of recent ticks, grouped by trace id —
+                    # the qualitative debug view behind the /metrics
+                    # quantities (same TLS/token gate as /metrics).
+                    "/debug/traces": lambda: (
+                        tracer.render_json(), "application/json"
+                    ),
+                },
                 "metrics",
                 tls_ctx=tls_ctx,
                 token=metrics_token,

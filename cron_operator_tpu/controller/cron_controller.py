@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import time
 from dataclasses import dataclass
 from datetime import datetime, timedelta, timezone
 from typing import Any, Dict, List, Optional, Tuple
@@ -63,6 +64,7 @@ from cron_operator_tpu.runtime.kube import (
     APIServer,
     NotFoundError,
 )
+from cron_operator_tpu.telemetry import ANNOTATION_TRACE_ID, new_trace_id
 from cron_operator_tpu.utils.clock import Clock
 from cron_operator_tpu.utils.logctx import request_logger
 
@@ -92,13 +94,18 @@ class CronReconciler:
     """Reconciles Cron objects against the embedded control plane."""
 
     def __init__(self, api: APIServer, clock: Optional[Clock] = None,
-                 metrics: Optional[Any] = None):
+                 metrics: Optional[Any] = None,
+                 tracer: Optional[Any] = None):
         self.api = api
         self.clock = clock or api.clock
         # Domain metrics (runtime.manager.Metrics-compatible). The reference
         # exposes only controller-runtime built-ins (SURVEY.md §5 "No custom
         # metrics are registered — build should add domain metrics").
         self.metrics = metrics
+        # Span tracer (telemetry.Tracer-compatible). When set, each fired
+        # tick records "reconcile" and "submit" spans under the trace id
+        # stamped on the created workload.
+        self.tracer = tracer
         # De-dup state for per-tick (not per-reconcile) metric counting: the
         # same missed tick is re-observed by every reconcile until it fires
         # or is superseded.
@@ -121,6 +128,9 @@ class CronReconciler:
         # Per-request context carried as structured fields, not interpolated
         # into every format string (reference util.go:28-41).
         log = request_logger("cron", namespace, name)
+        # Wall-clock anchor for the "reconcile" span (tracer spans use the
+        # time.time domain so spans from other processes line up).
+        t_start = time.time()
         raw = self.api.try_get(API_VERSION, KIND_CRON, namespace, name)
         if raw is None:
             log.debug("not found; skipping")
@@ -134,7 +144,7 @@ class CronReconciler:
         cron = old_cron.deepcopy()
 
         try:
-            return self._reconcile(cron)
+            return self._reconcile(cron, t_start)
         finally:
             # Deferred status patch iff semantically changed.
             if cron.status.to_dict() != old_cron.status.to_dict():
@@ -151,7 +161,9 @@ class CronReconciler:
 
     # -- core ---------------------------------------------------------------
 
-    def _reconcile(self, cron: Cron) -> ReconcileResult:
+    def _reconcile(
+        self, cron: Cron, t_start: Optional[float] = None
+    ) -> ReconcileResult:
         ns, name = cron.metadata.namespace, cron.metadata.name
         log = request_logger("cron", ns, name)
 
@@ -275,6 +287,16 @@ class CronReconciler:
 
         workload = self._new_workload_from_template(cron, workload_tpl, next_run)
 
+        # The tick is firing: mint its trace id and stamp it on the workload
+        # so every downstream layer (executor thread, runner subprocess via
+        # TPU_TRACE_ID, training loop) tags telemetry with it. Stamped before
+        # inject_tpu_topology so the rendered runner env carries it too.
+        trace_id = new_trace_id()
+        workload.setdefault("metadata", {}).setdefault("annotations", {})[
+            ANNOTATION_TRACE_ID
+        ] = trace_id
+        log = request_logger("cron", ns, name, trace=trace_id)
+
         # TPU admission (SURVEY.md §7 step 4b). The reference hands its
         # template to the external training-operator verbatim
         # (``cron_controller.go:349-387``); our build owns the TPU seam, so
@@ -292,6 +314,7 @@ class CronReconciler:
                 tpu_spec.hosts, tpu_spec.chips_per_host,
             )
 
+        submit_start = time.time()
         try:
             self.api.create(workload)
             self._count("cron_ticks_fired_total")
@@ -316,11 +339,43 @@ class CronReconciler:
                 f"Error creating {gvk.kind}: {err}",
             )
             raise
+        self._record_tick_spans(
+            trace_id, cron, workload, t_start, submit_start
+        )
 
         cron.status.last_schedule_time = now
         return scheduled
 
     # -- helpers ------------------------------------------------------------
+
+    def _record_tick_spans(
+        self,
+        trace_id: str,
+        cron: Cron,
+        workload: Unstructured,
+        t_start: Optional[float],
+        submit_start: float,
+    ) -> None:
+        """Record the controller-side spans of a fired tick: "reconcile"
+        (request entry → workload accepted) and its child "submit" (the
+        create call). Backend/runner spans of the same trace follow as the
+        workload progresses."""
+        if self.tracer is None:
+            return
+        end = time.time()
+        attrs = {
+            "cron": f"{cron.metadata.namespace}/{cron.metadata.name}",
+            "workload": (workload.get("metadata") or {}).get("name", ""),
+        }
+        reconcile_span = self.tracer.record(
+            "reconcile", trace_id,
+            start_s=t_start if t_start is not None else submit_start,
+            end_s=end, attrs=attrs,
+        )
+        self.tracer.record(
+            "submit", trace_id, start_s=submit_start, end_s=end,
+            parent_id=reconcile_span.span_id, attrs=attrs,
+        )
 
     def _observe_first_step_latency(
         self, cron_key: Tuple[str, str], workloads: List[Unstructured]

@@ -47,6 +47,22 @@ class _Controller:
 LATENCY_BUCKETS = (1.0, 2.5, 5.0, 10.0, 15.0, 30.0, 45.0, 60.0, 90.0,
                    120.0, 180.0, 300.0, 600.0)
 
+# Reconcile / workqueue latencies live at millisecond scale — the
+# controller-runtime default bucket ladder, trimmed at 10 s.
+RECONCILE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+QUEUE_BUCKETS = RECONCILE_BUCKETS
+
+# Phase decomposition of tick→first-step (queue / submit / compile /
+# first_step): spans both the ms-scale queue phases and the multi-minute
+# compile tail.
+PHASE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 15.0, 30.0,
+                 45.0, 60.0, 90.0, 120.0, 180.0, 300.0)
+
+# Prometheus text exposition format 0.0.4 — what a scraper expects in the
+# Content-Type header of /metrics.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
 # Family metadata for everything this process emits, so the exposition
 # carries # HELP/# TYPE like a real client library (VERDICT r3 #6:
 # bare `name value` lines are a non-standard exposition).
@@ -55,8 +71,16 @@ _FAMILY_META: Dict[str, tuple] = {
         "counter", "Total number of reconciliations per controller"),
     "controller_runtime_reconcile_errors_total": (
         "counter", "Total number of reconciliation errors per controller"),
-    "controller_runtime_reconcile_time_seconds_sum": (
-        "counter", "Cumulative reconcile wall-clock seconds per controller"),
+    "controller_runtime_reconcile_time_seconds": (
+        "histogram", "Reconcile wall-clock seconds per controller "
+                     "(controller-runtime parity family)"),
+    "workqueue_depth": (
+        "gauge", "Current depth of the controller workqueue"),
+    "workqueue_adds_total": (
+        "counter", "Total items added to the controller workqueue"),
+    "workqueue_queue_duration_seconds": (
+        "histogram", "Seconds an item waits in the workqueue before a "
+                     "worker picks it up"),
     "cron_ticks_fired_total": (
         "counter", "Cron ticks that created a workload"),
     "cron_ticks_skipped_total": (
@@ -72,6 +96,18 @@ _FAMILY_META: Dict[str, tuple] = {
         "histogram", "Latency from workload creation (the cron tick) to "
                      "its first completed train step — the BASELINE.md "
                      "north-star quantity"),
+    "cron_tick_phase_seconds": (
+        "histogram", "Phase decomposition of tick->first-step latency "
+                     "(label phase: queue, compile, first_step)"),
+    "workload_compile_seconds": (
+        "histogram", "First-dispatch wall-clock seconds (XLA compile "
+                     "included) reported by the training loop"),
+    "workload_last_step_seconds": (
+        "gauge", "Most recently reported per-step wall-clock seconds "
+                 "across running workloads"),
+    "workload_tokens_per_s": (
+        "gauge", "Most recently reported training throughput in tokens "
+                 "per second across running workloads"),
 }
 
 
@@ -83,27 +119,63 @@ class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.counters: Dict[str, float] = {}
-        # family → {"buckets": tuple, "counts": list, "sum": float,
-        #           "count": int}
+        self.gauges: Dict[str, float] = {}
+        # series → {"buckets": tuple, "counts": list, "sum": float,
+        #           "count": int}; a series may carry a label block, e.g.
+        # 'cron_tick_phase_seconds{phase="queue"}' — all series of one
+        # family must share a bucket ladder.
         self._hists: Dict[str, Dict] = {}
+        self._hist_buckets: Dict[str, tuple] = {}  # family → buckets
+
+    @staticmethod
+    def labels(family: str, **kv: object) -> str:
+        """Build a labeled series name: ``labels("f", a="x") == 'f{a="x"}'``.
+
+        Label order is sorted so the same label set always yields the
+        same series key regardless of call-site kwarg order.
+        """
+        if not kv:
+            return family
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(kv.items()))
+        return f"{family}{{{inner}}}"
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0.0) + value
 
+    def set(self, name: str, value: float) -> None:
+        """Set a gauge series to an absolute value (last write wins)."""
+        with self._lock:
+            self.gauges[name] = float(value)
+
     def observe(
-        self, family: str, value: float,
+        self, series: str, value: float,
         buckets: tuple = LATENCY_BUCKETS,
     ) -> None:
         """Record one histogram observation (prometheus cumulative-bucket
-        semantics are applied at render time)."""
+        semantics are applied at render time). ``series`` may carry a
+        label block; every series of a family must use the same buckets —
+        a mismatched ladder raises ``ValueError`` instead of silently
+        corrupting the family.
+        """
+        buckets = tuple(buckets)
+        family = self._family(series)
         with self._lock:
-            h = self._hists.get(family)
+            declared = self._hist_buckets.get(family)
+            if declared is None:
+                self._hist_buckets[family] = buckets
+            elif declared != buckets:
+                raise ValueError(
+                    f"histogram family {family!r} already registered with "
+                    f"buckets {declared}; refusing conflicting buckets "
+                    f"{buckets}"
+                )
+            h = self._hists.get(series)
             if h is None:
-                h = {"buckets": tuple(buckets),
+                h = {"buckets": buckets,
                      "counts": [0] * (len(buckets) + 1),
                      "sum": 0.0, "count": 0}
-                self._hists[family] = h
+                self._hists[series] = h
             for i, le in enumerate(h["buckets"]):
                 if value <= le:
                     h["counts"][i] += 1
@@ -116,6 +188,10 @@ class Metrics:
     def get(self, name: str) -> float:
         with self._lock:
             return self.counters.get(name, 0.0)
+
+    def gauge(self, name: str) -> Optional[float]:
+        with self._lock:
+            return self.gauges.get(name)
 
     def histogram(self, family: str) -> Optional[Dict]:
         with self._lock:
@@ -138,6 +214,7 @@ class Metrics:
         series grouped by family, histograms with cumulative le buckets."""
         with self._lock:
             counters = dict(self.counters)
+            gauges = dict(self.gauges)
             hists = {
                 k: {"buckets": h["buckets"], "counts": list(h["counts"]),
                     "sum": h["sum"], "count": h["count"]}
@@ -156,26 +233,42 @@ class Metrics:
                 lines.append(f"# HELP {family} {mhelp}")
             lines.append(f"# TYPE {family} {mtype}")
 
-        by_family: Dict[str, List[str]] = {}
-        for series in counters:
-            by_family.setdefault(self._family(series), []).append(series)
-        for family in sorted(by_family):
-            meta(family, "counter")
-            for series in sorted(by_family[family]):
-                lines.append(f"{series} {counters[series]}")
-        for family in sorted(hists):
-            h = hists[family]
+        def flat(samples: Dict[str, float], default_type: str) -> None:
+            by_family: Dict[str, List[str]] = {}
+            for series in samples:
+                by_family.setdefault(self._family(series), []).append(series)
+            for family in sorted(by_family):
+                meta(family, default_type)
+                for series in sorted(by_family[family]):
+                    lines.append(f"{series} {samples[series]}")
+
+        flat(counters, "counter")
+        flat(gauges, "gauge")
+        # Sorting series lexicographically keeps all label sets of one
+        # family adjacent, so # HELP/# TYPE precede the first of them.
+        for series in sorted(hists):
+            h = hists[series]
+            family = self._family(series)
+            label_block = series[len(family):]  # "" or '{k="v",...}'
+            inner = label_block[1:-1] if label_block else ""
             meta(family, "histogram")
+
+            def bucket_labels(le: str) -> str:
+                return f'{inner},le="{le}"' if inner else f'le="{le}"'
+
             cumulative = 0
             for le, n in zip(h["buckets"], h["counts"]):
                 cumulative += n
                 lines.append(
-                    f'{family}_bucket{{le="{le:g}"}} {cumulative}'
+                    f'{family}_bucket{{{bucket_labels(f"{le:g}")}}} '
+                    f"{cumulative}"
                 )
             cumulative += h["counts"][-1]
-            lines.append(f'{family}_bucket{{le="+Inf"}} {cumulative}')
-            lines.append(f"{family}_sum {h['sum']}")
-            lines.append(f"{family}_count {h['count']}")
+            lines.append(
+                f'{family}_bucket{{{bucket_labels("+Inf")}}} {cumulative}'
+            )
+            lines.append(f"{family}_sum{label_block} {h['sum']}")
+            lines.append(f"{family}_count{label_block} {h['count']}")
         return "\n".join(lines) + "\n"
 
 
@@ -212,10 +305,13 @@ class Manager:
     ) -> None:
         """``For(for_gvk).Owns(each of owns)`` watch wiring
         (``cron_controller.go:70-77``)."""
-        self._controllers.append(
-            _Controller(name=name, reconcile=reconcile, for_gvk=for_gvk,
+        c = _Controller(name=name, reconcile=reconcile, for_gvk=for_gvk,
                         owns=list(owns or []))
-        )
+        # Wire workqueue parity metrics (depth gauge, add counter, queue
+        # latency histogram), labeled by controller name like client-go.
+        c.queue.instrument(name=name, metrics=self.metrics,
+                           buckets=QUEUE_BUCKETS)
+        self._controllers.append(c)
 
     def _on_watch_event(self, ev: WatchEvent) -> None:
         obj = ev.object
@@ -376,12 +472,15 @@ class Manager:
                 )
                 c.queue.add_rate_limited(req)
             finally:
-                self.metrics.inc(
-                    'controller_runtime_reconcile_time_seconds_sum'
+                self.metrics.observe(
+                    'controller_runtime_reconcile_time_seconds'
                     f'{{controller="{c.name}"}}',
                     time.monotonic() - start,
+                    buckets=RECONCILE_BUCKETS,
                 )
                 c.queue.done(req)
 
 
-__all__ = ["Manager", "Request", "Metrics"]
+__all__ = ["Manager", "Request", "Metrics", "PROMETHEUS_CONTENT_TYPE",
+           "LATENCY_BUCKETS", "RECONCILE_BUCKETS", "QUEUE_BUCKETS",
+           "PHASE_BUCKETS"]

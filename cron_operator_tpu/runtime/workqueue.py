@@ -21,6 +21,12 @@ from typing import Any, Dict, Generic, Hashable, List, Optional, Tuple, TypeVar
 
 T = TypeVar("T", bound=Hashable)
 
+# Default ladder for workqueue_queue_duration_seconds: queue wait is
+# millisecond-scale when healthy; the manager overrides this with its
+# canonical QUEUE_BUCKETS at instrument() time.
+_QUEUE_DURATION_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                           0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
 
 class ItemExponentialBackoff:
     def __init__(self, base_s: float = 0.005, cap_s: float = 1000.0):
@@ -68,6 +74,43 @@ class WorkQueue(Generic[T]):
         )
         self._delay_thread.start()
         self.rate_limiter = ItemExponentialBackoff()
+        # Optional metrics wiring (see instrument()).
+        self._metrics = None
+        self._metrics_name = ""
+        self._queue_buckets: tuple = _QUEUE_DURATION_BUCKETS
+        self._added_at: Dict[T, float] = {}
+
+    # ---- metrics ----------------------------------------------------------
+
+    def instrument(self, name: str, metrics, buckets=None) -> None:
+        """Attach a ``Metrics`` registry. The queue then maintains the
+        client-go parity families ``workqueue_depth{name=...}`` (gauge),
+        ``workqueue_adds_total{name=...}`` and
+        ``workqueue_queue_duration_seconds{name=...}`` (enqueue→get wait).
+        """
+        with self._cond:
+            self._metrics = metrics
+            self._metrics_name = name
+            if buckets is not None:
+                self._queue_buckets = tuple(buckets)
+            self._record_depth()
+
+    def _record_depth(self) -> None:
+        # Called with self._cond held; Metrics has its own lock and never
+        # calls back into the queue, so the ordering is deadlock-free.
+        if self._metrics is not None:
+            self._metrics.set(
+                f'workqueue_depth{{name="{self._metrics_name}"}}',
+                float(len(self._queue)),
+            )
+
+    def _record_enqueue(self, item: T) -> None:
+        self._added_at.setdefault(item, time.monotonic())
+        if self._metrics is not None:
+            self._metrics.inc(
+                f'workqueue_adds_total{{name="{self._metrics_name}"}}'
+            )
+            self._record_depth()
 
     # ---- core add/get/done ------------------------------------------------
 
@@ -79,6 +122,7 @@ class WorkQueue(Generic[T]):
             if item in self._processing:
                 return  # will be re-queued on done()
             self._queue.append(item)
+            self._record_enqueue(item)
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[T]:
@@ -97,6 +141,16 @@ class WorkQueue(Generic[T]):
             item = self._queue.pop(0)
             self._processing.add(item)
             self._dirty.discard(item)
+            enqueued = self._added_at.pop(item, None)
+            if self._metrics is not None:
+                if enqueued is not None:
+                    self._metrics.observe(
+                        'workqueue_queue_duration_seconds'
+                        f'{{name="{self._metrics_name}"}}',
+                        time.monotonic() - enqueued,
+                        buckets=self._queue_buckets,
+                    )
+                self._record_depth()
             return item
 
     def done(self, item: T) -> None:
@@ -104,6 +158,7 @@ class WorkQueue(Generic[T]):
             self._processing.discard(item)
             if item in self._dirty:
                 self._queue.append(item)
+                self._record_enqueue(item)
                 self._cond.notify()
 
     def __len__(self) -> int:

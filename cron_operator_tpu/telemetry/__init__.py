@@ -1,0 +1,26 @@
+"""End-to-end tracing for the tick→first-step path.
+
+One trace id is minted when the cron controller fires a tick and rides
+the workload object (annotation) and the runner env (``TPU_TRACE_ID``)
+through every layer, so the operator can decompose the BASELINE north
+star — ``cron_tick_to_first_step_seconds`` — into reconcile / submit /
+queue / compile / first-step spans on ``/debug/traces``.
+"""
+
+from cron_operator_tpu.telemetry.trace import (
+    ANNOTATION_TRACE_ID,
+    ENV_TRACE_ID,
+    Span,
+    Tracer,
+    new_span_id,
+    new_trace_id,
+)
+
+__all__ = [
+    "ANNOTATION_TRACE_ID",
+    "ENV_TRACE_ID",
+    "Span",
+    "Tracer",
+    "new_span_id",
+    "new_trace_id",
+]

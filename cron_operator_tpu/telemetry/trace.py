@@ -1,0 +1,173 @@
+"""Span-based tracer for the cron tick → first train step path.
+
+The design mirrors the shape (not the wire format) of OpenTelemetry:
+a *trace* is a set of spans sharing one ``trace_id``; each span has a
+name, wall-clock start/end, an optional parent, and free-form string
+attributes. Spans are tiny dicts-on-export, stored in a bounded
+in-process deque and served as JSON from ``/debug/traces`` — enough to
+answer "where did the 90 seconds go?" without any external collector.
+
+Propagation uses the two channels the operator already has:
+
+- ``tpu.kubedl.io/trace-id`` annotation on the workload object, stamped
+  by the cron controller when the tick fires and read back by backends.
+- ``TPU_TRACE_ID`` env var, rendered into the runner environment by
+  ``backends.tpu.render_job_env`` so subprocess / pod runners inherit it.
+
+Everything here is stdlib-only and thread-safe; recording is a few dict
+ops under a lock, cheap enough for the reconcile hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+# Annotation on workload objects carrying the tick's trace id.
+ANNOTATION_TRACE_ID = "tpu.kubedl.io/trace-id"
+# Env var carrying the trace id into runner subprocesses / pods.
+ENV_TRACE_ID = "TPU_TRACE_ID"
+
+# Default bound on the finished-span store. 512 spans ≈ 100+ ticks of
+# history at ~4 spans per tick; old spans are evicted FIFO.
+DEFAULT_MAX_SPANS = 512
+
+
+def new_trace_id() -> str:
+    """Mint a 16-hex-char trace id (half a uuid4, plenty of entropy)."""
+    return uuid.uuid4().hex[:16]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:8]
+
+
+@dataclass
+class Span:
+    """One timed operation inside a trace.
+
+    ``start_s`` / ``end_s`` are wall-clock epoch seconds (``time.time``
+    domain) so spans recorded in different processes line up.
+    """
+
+    name: str
+    trace_id: str
+    span_id: str = field(default_factory=new_span_id)
+    parent_id: Optional[str] = None
+    start_s: float = 0.0
+    end_s: Optional[float] = None
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        if self.end_s is None:
+            return None
+        return max(0.0, self.end_s - self.start_s)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_s": self.start_s,
+            "end_s": self.end_s,
+            "duration_s": self.duration_s,
+            "attrs": dict(self.attrs),
+        }
+
+
+class Tracer:
+    """Thread-safe bounded store of finished spans.
+
+    Spans only become visible (and evictable) once finished — either via
+    :meth:`finish`, the :meth:`span` context manager, or :meth:`record`
+    for after-the-fact spans reconstructed from timestamps the workload
+    progress stream already carries (``started_at``, ``first_step_at``).
+    """
+
+    def __init__(self, max_spans: int = DEFAULT_MAX_SPANS):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=max_spans)
+
+    def start_span(
+        self,
+        name: str,
+        trace_id: str,
+        start_s: float,
+        parent_id: Optional[str] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Span:
+        return Span(
+            name=name,
+            trace_id=trace_id,
+            parent_id=parent_id,
+            start_s=start_s,
+            attrs=dict(attrs or {}),
+        )
+
+    def finish(self, span: Span, end_s: float) -> Span:
+        span.end_s = end_s
+        with self._lock:
+            self._spans.append(span)
+        return span
+
+    def record(
+        self,
+        name: str,
+        trace_id: str,
+        start_s: float,
+        end_s: float,
+        parent_id: Optional[str] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Span:
+        """Record a completed span directly from two timestamps."""
+        span = self.start_span(name, trace_id, start_s, parent_id=parent_id, attrs=attrs)
+        return self.finish(span, end_s)
+
+    @contextmanager
+    def span(
+        self,
+        name: str,
+        trace_id: str,
+        start_s: float,
+        end_s_fn,
+        parent_id: Optional[str] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ) -> Iterator[Span]:
+        """Context manager recording ``name`` around the block.
+
+        ``end_s_fn`` is called on exit to stamp the end time, keeping the
+        tracer agnostic of the caller's clock.
+        """
+        s = self.start_span(name, trace_id, start_s, parent_id=parent_id, attrs=attrs)
+        try:
+            yield s
+        finally:
+            self.finish(s, end_s_fn())
+
+    def spans(self, trace_id: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = [s.to_dict() for s in self._spans]
+        if trace_id is not None:
+            out = [s for s in out if s["trace_id"] == trace_id]
+        return out
+
+    def traces(self) -> List[Dict[str, Any]]:
+        """Finished spans grouped by trace id, oldest trace first."""
+        grouped: Dict[str, List[Dict[str, Any]]] = {}
+        for s in self.spans():
+            grouped.setdefault(s["trace_id"], []).append(s)
+        return [
+            {"trace_id": tid, "spans": sorted(spans, key=lambda s: s["start_s"])}
+            for tid, spans in grouped.items()
+        ]
+
+    def render_json(self) -> str:
+        """JSON body for the ``/debug/traces`` route."""
+        return json.dumps({"traces": self.traces()}, indent=2, sort_keys=False)

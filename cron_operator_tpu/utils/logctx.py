@@ -12,6 +12,10 @@ Usage::
     log = request_logger("cron", namespace=ns, name=name)
     log.info("created %s %s", kind, wname)
     # → [controller=cron cron=ns/name] created JAXJob x-123
+
+    log = request_logger("cron", namespace=ns, name=name, trace=trace_id)
+    log.info("created %s %s", kind, wname)
+    # → [controller=cron cron=ns/name trace=ab12…] created JAXJob x-123
 """
 
 from __future__ import annotations
@@ -34,6 +38,7 @@ def request_logger(
     controller: str,
     namespace: Optional[str] = None,
     name: Optional[str] = None,
+    trace: Optional[str] = None,
     **fields: Any,
 ) -> logging.LoggerAdapter:
     """Logger for one reconcile request.
@@ -42,13 +47,18 @@ def request_logger(
     reference lowercases for the same reason, ``util.go:33-36``); the
     namespaced name is recorded under the controller name as key, matching
     the reference's ``WithValues(strings.ToLower(kind), req.NamespacedName)``.
-    Extra ``fields`` append verbatim (e.g. ``job="ns/x"``).
+    ``trace`` is the tick's trace id (telemetry.new_trace_id); it renders
+    as a ``trace=`` field so log lines correlate with ``/debug/traces``
+    spans. Field order is fixed: ``controller``, the namespaced name,
+    ``trace``, then extra ``fields`` in keyword order (e.g. ``job="ns/x"``).
     """
     controller = controller.lower()
     base = logging.getLogger(f"controller.{controller}")
     extra: "dict[str, Any]" = {"controller": controller}
     if name is not None:
         extra[controller] = f"{namespace}/{name}" if namespace else name
+    if trace is not None:
+        extra["trace"] = trace
     extra.update(fields)
     return _ContextAdapter(base, extra)
 

@@ -176,7 +176,15 @@ def _run(
     trainer: Trainer,
     batches: Iterator[Dict[str, Any]],
     steps: int,
+    tokens_per_step: Optional[int] = None,
 ) -> None:
+    """Drive ``trainer`` and stream progress telemetry through the ctx.
+
+    ``tokens_per_step`` (token workloads: batch_size × seq_len) turns the
+    step-time window into a live ``tokens_per_s`` throughput record; the
+    executor forwards it into the operator registry as the
+    ``workload_tokens_per_s`` gauge.
+    """
     ctx.progress["started_at"] = time.time()
     if trainer.steps_done:
         ctx.progress["resumed_from_step"] = trainer.steps_done
@@ -197,6 +205,12 @@ def _run(
             # The north-star timestamp: first optimizer step finished
             # (device-synced — Trainer.step blocks on the loss).
             ctx.progress["first_step_at"] = time.time()
+            if trainer.first_dispatch_time_s is not None:
+                # The compile component of tick→first-step (the first
+                # dispatch traces + XLA-compiles before executing).
+                ctx.progress["compile_time_s"] = round(
+                    trainer.first_dispatch_time_s, 4
+                )
             if profile_dir:
                 # The jax profiler is process-global; under thread
                 # isolation a concurrent profiled job would raise
@@ -218,10 +232,13 @@ def _run(
         window[0] += s.step_time_s * s.chunk
         window[1] += s.chunk
         if s.loss is not None:
+            win_avg = window[0] / window[1]
             ctx.progress["last_loss"] = s.loss
-            ctx.progress["last_step_time_s"] = round(
-                window[0] / window[1], 4
-            )
+            ctx.progress["last_step_time_s"] = round(win_avg, 4)
+            if tokens_per_step and win_avg > 0:
+                ctx.progress["tokens_per_s"] = round(
+                    tokens_per_step / win_avg, 1
+                )
             window[0], window[1] = 0.0, 0
         now = time.time()
         if ctx.publish is not None and (
@@ -252,6 +269,9 @@ def _run(
         avg = sum(s.step_time_s * s.chunk for s in tail) / n_steps
         ctx.progress["avg_step_time_s"] = round(avg, 4)
         ctx.progress["steps_per_s"] = round(1.0 / avg, 4) if avg > 0 else None
+        if tokens_per_step and avg > 0:
+            # Steady-state throughput (compile-laden first call excluded).
+            ctx.progress["tokens_per_s"] = round(tokens_per_step / avg, 1)
     # Dispatch-health diagnostic: async (non-synced) calls record pure
     # dispatch wall time (× chunk to undo the per-step normalization —
     # the DISPATCH is what the link taxes, however many steps it
@@ -407,6 +427,7 @@ def bert(ctx: JobContext) -> None:
                 ),
             ),
             steps,
+            tokens_per_step=batch_size * seq_len,
         )
 
 
@@ -489,6 +510,7 @@ def gpt(ctx: JobContext) -> None:
                 ),
             ),
             steps,
+            tokens_per_step=batch_size * seq_len,
         )
 
 

@@ -118,6 +118,8 @@ def main(argv: List[str] | None = None) -> int:
         resolve_entrypoint,
     )
 
+    from cron_operator_tpu.telemetry import ENV_TRACE_ID
+
     params = _gather_params(rest)
     _maybe_pin_platform(params)
     _maybe_init_distributed()
@@ -127,6 +129,10 @@ def main(argv: List[str] | None = None) -> int:
         namespace=os.environ.get("TPU_JOB_NAMESPACE", "default"),
         job={"metadata": {"name": os.environ.get("TPU_JOB_NAME", entry_name)}},
         params=params,
+        # Trace id the creating tick minted (rendered into the pod env by
+        # backends.tpu.render_job_env) — telemetry this process emits is
+        # attributable to its tick even across the process boundary.
+        trace_id=os.environ.get(ENV_TRACE_ID) or None,
     )
     # Stream progress to the parent (executor folds it into
     # status.trainingProgress; a k8s sidecar could do the same).

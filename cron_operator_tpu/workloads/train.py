@@ -264,6 +264,11 @@ class Trainer:
         self._multi: Dict[int, Any] = {}  # chunk length → jitted scan
         self._batch_struct = None  # set on first put_batch (flops_per_step)
         self._flops_per_step: Optional[float] = None
+        # Wall-clock of this process's first dispatch (XLA compile + first
+        # step execution). The compile-time telemetry record: entrypoints
+        # forward it as progress["compile_time_s"], decomposing the
+        # tick→first-step latency into its compile component on /metrics.
+        self.first_dispatch_time_s: Optional[float] = None
 
     def _stepper(self, chunk: int):
         """The jitted program for ``chunk`` optimizer steps per dispatch
@@ -354,6 +359,14 @@ class Trainer:
         # Blocking keeps the step-time numbers honest; sync=False lets the
         # caller amortize the round trip (see TrainConfig.sync_every).
         loss = float(loss) if sync else None
+        wall = time.perf_counter() - t0
+        if self.first_dispatch_time_s is None:
+            # Compile-laden by construction: a fresh process always traces
+            # + compiles on its first dispatch (even after checkpoint
+            # resume), so this wall time IS the compile measurement —
+            # meaningful only when the caller synced the call (run()
+            # always syncs the first).
+            self.first_dispatch_time_s = wall
         before = self.steps_done
         self.steps_done += chunk
         if (
@@ -366,7 +379,7 @@ class Trainer:
             self.checkpoint.save(self.steps_done, self.state)
         return StepStats(
             self.steps_done, loss,
-            (time.perf_counter() - t0) / max(1, chunk),
+            wall / max(1, chunk),
             chunk=max(1, chunk),
         )
 

@@ -1,0 +1,53 @@
+"""Per-request log context (utils/logctx.py): structured ``key=value``
+fields ahead of the message, in fixed order — controller, namespaced
+name, trace id, extras — so log lines correlate with the prometheus
+``controller`` label and ``/debug/traces`` span trace ids."""
+
+from __future__ import annotations
+
+from cron_operator_tpu.utils.logctx import request_logger
+
+
+def _render(log, msg="hello"):
+    rendered, _ = log.process(msg, {})
+    return rendered
+
+
+class TestRequestLogger:
+    def test_controller_and_namespaced_name(self):
+        log = request_logger("cron", namespace="default", name="demo")
+        assert _render(log) == "[controller=cron cron=default/demo] hello"
+
+    def test_trace_field_renders_after_name(self):
+        log = request_logger(
+            "cron", namespace="default", name="demo", trace="cafe0123"
+        )
+        assert _render(log) == (
+            "[controller=cron cron=default/demo trace=cafe0123] hello"
+        )
+
+    def test_extra_fields_follow_trace(self):
+        log = request_logger(
+            "cron", namespace="ns", name="x", trace="ab12", job="ns/j-1"
+        )
+        assert _render(log) == (
+            "[controller=cron cron=ns/x trace=ab12 job=ns/j-1] hello"
+        )
+
+    def test_field_order_is_fixed_regardless_of_kwargs(self):
+        # trace is a named parameter, not an **fields entry — it always
+        # lands between the namespaced name and the extras.
+        log = request_logger("cron", name="x", job="j", trace="t1")
+        assert _render(log) == "[controller=cron cron=x trace=t1 job=j] hello"
+
+    def test_no_trace_no_field(self):
+        log = request_logger("cron", namespace="ns", name="x")
+        assert "trace=" not in _render(log)
+
+    def test_controller_lowercased_and_cluster_scoped_name(self):
+        log = request_logger("Cron", name="x")
+        assert _render(log) == "[controller=cron cron=x] hello"
+
+    def test_logger_name_is_controller_scoped(self):
+        log = request_logger("cron", namespace="ns", name="x", trace="t")
+        assert log.logger.name == "controller.cron"

@@ -12,8 +12,13 @@ from __future__ import annotations
 
 import urllib.request
 
+import pytest
+
 from cron_operator_tpu.controller import CronReconciler
-from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.runtime.manager import (
+    PROMETHEUS_CONTENT_TYPE,
+    Metrics,
+)
 
 
 def _cron(name="c", schedule="*/5 * * * *"):
@@ -66,6 +71,49 @@ class TestMetricsRegistry:
         assert 'cron_tick_to_first_step_seconds_bucket{le="+Inf"} 3' in text
         assert "cron_tick_to_first_step_seconds_sum 109.0" in text
         assert "cron_tick_to_first_step_seconds_count 3" in text
+
+    def test_gauges_render_with_type_and_last_write_wins(self):
+        m = Metrics()
+        m.set("workload_tokens_per_s", 1000.0)
+        m.set("workload_tokens_per_s", 2500.5)
+        m.set('workqueue_depth{name="cron"}', 3)
+        text = m.render_prometheus()
+        assert "# TYPE workload_tokens_per_s gauge" in text
+        assert "workload_tokens_per_s 2500.5" in text
+        assert "# TYPE workqueue_depth gauge" in text
+        assert 'workqueue_depth{name="cron"} 3.0' in text
+        assert m.gauge("workload_tokens_per_s") == 2500.5
+
+    def test_labeled_histogram_series_share_family_headers(self):
+        m = Metrics()
+        m.observe('cron_tick_phase_seconds{phase="queue"}', 0.2,
+                  buckets=(1.0, 5.0))
+        m.observe('cron_tick_phase_seconds{phase="compile"}', 3.0,
+                  buckets=(1.0, 5.0))
+        text = m.render_prometheus()
+        assert text.count("# TYPE cron_tick_phase_seconds histogram") == 1
+        # `le` renders last inside the label block, after the series labels
+        assert ('cron_tick_phase_seconds_bucket{phase="compile",le="5"} 1'
+                in text)
+        assert ('cron_tick_phase_seconds_bucket{phase="queue",le="1"} 1'
+                in text)
+        assert 'cron_tick_phase_seconds_sum{phase="queue"} 0.2' in text
+        assert 'cron_tick_phase_seconds_count{phase="compile"} 1' in text
+
+    def test_conflicting_buckets_raise_value_error(self):
+        m = Metrics()
+        m.observe('cron_tick_phase_seconds{phase="queue"}', 0.2,
+                  buckets=(1.0, 5.0))
+        with pytest.raises(ValueError, match="cron_tick_phase_seconds"):
+            m.observe('cron_tick_phase_seconds{phase="compile"}', 3.0,
+                      buckets=(2.0, 4.0))
+        # same ladder (any series of the family) stays accepted
+        m.observe('cron_tick_phase_seconds{phase="compile"}', 3.0,
+                  buckets=(1.0, 5.0))
+
+    def test_exposition_content_type_is_prometheus_004(self):
+        assert (PROMETHEUS_CONTENT_TYPE
+                == "text/plain; version=0.0.4; charset=utf-8")
 
 
 class TestNorthStarObservation:

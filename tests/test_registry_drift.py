@@ -1,0 +1,66 @@
+"""Registry drift guard: every metric family the package emits must be
+declared in ``_FAMILY_META`` (runtime/manager.py), so the exposition
+always carries ``# HELP``/``# TYPE`` for it. A new ``inc``/``observe``/
+``set`` call site with an undeclared family fails here instead of
+shipping a bare, header-less series."""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+import cron_operator_tpu
+from cron_operator_tpu.runtime.manager import _FAMILY_META
+
+PKG_ROOT = pathlib.Path(cron_operator_tpu.__file__).parent
+
+# Family = the leading identifier of the first string literal passed to a
+# metrics sink call. Receiver-restricted (`metrics.` / the reconciler's
+# `self._count` shim) so unrelated `.set()` calls (threading.Event etc.)
+# never match; `\s*` spans newlines, catching the multi-line
+# 'family' f'{{labels}}' concatenation idiom.
+_CALL_RE = re.compile(
+    r"(?:metrics\.(?:inc|observe|set)|self\._count)\(\s*"
+    r"f?['\"]([A-Za-z_][A-Za-z0-9_]*)"
+)
+
+
+def _emitted_families():
+    found = {}
+    for path in sorted(PKG_ROOT.rglob("*.py")):
+        text = path.read_text()
+        for m in _CALL_RE.finditer(text):
+            found.setdefault(m.group(1), []).append(
+                f"{path.relative_to(PKG_ROOT.parent)}:"
+                f"{text.count(chr(10), 0, m.start()) + 1}"
+            )
+    return found
+
+
+class TestRegistryDrift:
+    def test_call_sites_are_found(self):
+        """The scan itself must keep working: if a refactor changes the
+        call idiom so nothing matches, this fails before the drift check
+        silently passes on an empty set."""
+        found = _emitted_families()
+        assert len(found) >= 10, f"suspiciously few call sites: {found}"
+        # spot-check the three sink kinds all get captured
+        assert "controller_runtime_reconcile_total" in found      # inc
+        assert "controller_runtime_reconcile_time_seconds" in found  # observe
+        assert "workqueue_depth" in found                          # set
+
+    def test_every_emitted_family_is_declared(self):
+        undeclared = {
+            family: sites
+            for family, sites in _emitted_families().items()
+            if family not in _FAMILY_META
+        }
+        assert not undeclared, (
+            "metric families emitted but missing from _FAMILY_META "
+            f"(runtime/manager.py): {undeclared}"
+        )
+
+    def test_declared_types_are_valid(self):
+        for family, (mtype, mhelp) in _FAMILY_META.items():
+            assert mtype in ("counter", "gauge", "histogram"), family
+            assert mhelp, f"{family} has no HELP text"
