@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane
 
 all: gate
 
@@ -32,3 +32,8 @@ dryrun:
 
 bench:
 	python bench.py
+
+# Control-plane throughput/latency at 1k/5k Crons (no device involved).
+# BASELINE=<git-ref> additionally measures that ref and reports speedups.
+bench-controlplane:
+	python hack/controlplane_bench.py $(if $(BASELINE),--baseline-ref $(BASELINE))

@@ -516,9 +516,10 @@ class LocalExecutor:
             "v1", "Pod", namespace=ns,
             label_selector={"tpu.kubedl.io/job-name": name},
         ):
-            pod["status"] = {"phase": "Succeeded"}
+            # list() hands out shared immutable snapshots — rebuild the
+            # top level instead of mutating in place.
             try:
-                self.api.update(pod)
+                self.api.update({**pod, "status": {"phase": "Succeeded"}})
             except Exception:
                 pass
 

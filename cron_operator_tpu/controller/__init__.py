@@ -9,6 +9,7 @@ from cron_operator_tpu.controller.schedule import (
     CronSchedule,
     EverySchedule,
     parse_standard,
+    parse_standard_cached,
 )
 from cron_operator_tpu.controller.cron_controller import (
     CronReconciler,
@@ -19,6 +20,7 @@ __all__ = [
     "CronSchedule",
     "EverySchedule",
     "parse_standard",
+    "parse_standard_cached",
     "CronReconciler",
     "ReconcileResult",
 ]

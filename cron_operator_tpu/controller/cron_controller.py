@@ -49,7 +49,7 @@ from cron_operator_tpu.api.v1alpha1 import (
     TypedLocalObjectReference,
     parse_time,
 )
-from cron_operator_tpu.controller.schedule import parse_standard
+from cron_operator_tpu.controller.schedule import parse_standard_cached
 from cron_operator_tpu.controller.workload import (
     attach_cron_ownership,
     get_default_job_name,
@@ -418,13 +418,35 @@ class CronReconciler:
 
     def _list_workloads(self, cron: Cron, gvk: GVK) -> List[Unstructured]:
         """List workloads of the template's GVK carrying this cron's label
-        in the cron's namespace (``cron_controller.go:242-266``)."""
-        return self.api.list(
+        in the cron's namespace (``cron_controller.go:242-266``).
+
+        Owned children are resolved through the store's ownerReference-UID
+        reverse index (O(children), not O(namespace)); the label-selector
+        list is unioned in so label-adopted workloads that lack an owner
+        reference are still observed."""
+        ns = cron.metadata.namespace
+        owned: List[Unstructured] = []
+        dependents = getattr(self.api, "dependents", None)
+        if dependents is not None and cron.metadata.uid:
+            owned = [
+                w for w in dependents(cron.metadata.uid, namespace=ns)
+                if w.get("apiVersion") == gvk.api_version
+                and w.get("kind") == gvk.kind
+            ]
+        labeled = self.api.list(
             gvk.api_version,
             gvk.kind,
-            namespace=cron.metadata.namespace,
+            namespace=ns,
             label_selector={LABEL_CRON_NAME: cron.metadata.name},
         )
+        seen = {
+            ((w.get("metadata") or {}).get("uid") or id(w)) for w in owned
+        }
+        owned.extend(
+            w for w in labeled
+            if ((w.get("metadata") or {}).get("uid") or id(w)) not in seen
+        )
+        return owned
 
     def _sync_status(
         self,
@@ -541,7 +563,7 @@ class CronReconciler:
         set (TPU-native extension; the reference only inherits the container
         timezone)."""
         try:
-            sched = parse_standard(cron.spec.schedule)
+            sched = parse_standard_cached(cron.spec.schedule)
         except ValueError as err:
             raise ValueError(
                 f"unparsable cron {cron.spec.schedule!r}: {err}"

@@ -24,6 +24,7 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass
 from datetime import datetime, timedelta
+from functools import lru_cache
 from typing import Optional
 
 MONTH_NAMES = {
@@ -191,6 +192,11 @@ class CronSchedule:
 
     def next(self, after: datetime) -> datetime:
         # First candidate: the next whole minute strictly after `after`.
+        # Within a matching day, the hour and minute are found by
+        # bit-scanning the field masks (lowest set bit at/above the
+        # current value) instead of stepping one minute at a time — a
+        # sparse schedule like "0 0 * * *" jumps straight to its
+        # activation rather than walking up to 1439 candidate minutes.
         t = after.replace(second=0, microsecond=0) + timedelta(minutes=1)
         limit = after + _MAX_SEARCH
         while t <= limit:
@@ -205,13 +211,22 @@ class CronSchedule:
             if not self._day_matches(t):
                 t = (t.replace(hour=0, minute=0)) + timedelta(days=1)
                 continue
-            if not (self.hour & (1 << t.hour)):
+            hours_left = self.hour >> t.hour
+            if not hours_left:
+                # no matching hour remains today
+                t = (t.replace(hour=0, minute=0)) + timedelta(days=1)
+                continue
+            skip_h = ((hours_left & -hours_left).bit_length()) - 1
+            if skip_h:
+                # jumping hours resets the minute search to :00
+                t = t.replace(minute=0) + timedelta(hours=skip_h)
+            minutes_left = self.minute >> t.minute
+            if not minutes_left:
+                # current hour exhausted; try from the next hour's :00
                 t = t.replace(minute=0) + timedelta(hours=1)
                 continue
-            if not (self.minute & (1 << t.minute)):
-                t = t + timedelta(minutes=1)
-                continue
-            return t
+            skip_m = ((minutes_left & -minutes_left).bit_length()) - 1
+            return t + timedelta(minutes=skip_m) if skip_m else t
         raise ValueError(
             f"schedule {self.source!r} has no activation within 5 years"
         )
@@ -237,9 +252,20 @@ def parse_standard(expr: str):
     return CronSchedule(expr)
 
 
+# Compiled-schedule cache, keyed by the spec string. Compiled schedules
+# are immutable after construction and hold no per-Cron state, so every
+# Cron with the same spec shares ONE compiled object, and re-reconciling
+# a Cron skips the parse entirely. An edited spec.schedule is a new key
+# (instant recompile, no stale schedule can fire); unparseable specs are
+# NOT cached (lru_cache does not memoize exceptions), so a bad edit
+# keeps surfacing its terminal error on every reconcile.
+parse_standard_cached = lru_cache(maxsize=4096)(parse_standard)
+
+
 __all__ = [
     "CronSchedule",
     "EverySchedule",
     "parse_standard",
+    "parse_standard_cached",
     "parse_go_duration",
 ]

@@ -128,7 +128,9 @@ class HTTPAPIServer:
         watched for rotation via utils.tlsutil.CertWatcher). The
         handshake is deferred to the per-connection handler thread so a
         stalled peer cannot wedge the accept loop."""
-        self.api = api or APIServer()
+        # Identity check, not truthiness: APIServer defines __len__, and
+        # an empty-but-live store must not be swapped for a fresh one.
+        self.api = api if api is not None else APIServer()
         self.scheme = scheme or default_scheme()
         self.token = token
         self.tls = tls_ctx is not None

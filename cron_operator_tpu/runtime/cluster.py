@@ -270,6 +270,7 @@ class ClusterAPIServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        owner_uid: Optional[str] = None,
     ) -> List[Unstructured]:
         query: Dict[str, str] = {}
         if label_selector:
@@ -287,6 +288,18 @@ class ClusterAPIServer:
         for item in items:
             item.setdefault("apiVersion", api_version)
             item.setdefault("kind", kind)
+        if owner_uid is not None:
+            # No owner-uid selector exists on the wire (real apiservers
+            # index this only in the GC controller); the label selector
+            # narrows server-side, the ownership check applies here.
+            items = [
+                i for i in items
+                if any(
+                    ref.get("uid") == owner_uid
+                    for ref in (i.get("metadata") or {}).get(
+                        "ownerReferences") or []
+                )
+            ]
         return items
 
     def update(self, obj: Unstructured) -> Unstructured:

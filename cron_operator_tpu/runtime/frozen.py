@@ -1,0 +1,123 @@
+"""Deeply-immutable snapshots of unstructured objects.
+
+The copy-on-write substrate of the embedded control plane: committed
+objects are stored as frozen dict/list trees and handed out *shared* on
+the read path (``list``, watch events) instead of deep-copied per
+caller. Writers never mutate a committed tree — every write commits a
+new version (possibly sharing unchanged subtrees with the old one), so
+a snapshot a reader holds is stable forever.
+
+``FrozenDict``/``FrozenList`` subclass the builtins, so JSON
+serialization, equality, iteration and ``isinstance(x, dict)`` checks
+all behave exactly like the plain types; only mutation raises. A caller
+that genuinely needs a private mutable copy uses :func:`thaw` (or
+``copy.deepcopy``, which is wired to do the same).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def _blocked(name: str):
+    def _raise(self, *args, **kwargs):  # noqa: ARG001
+        raise TypeError(
+            f"cannot {name}() a frozen control-plane snapshot; "
+            "deepcopy()/thaw() it first"
+        )
+
+    _raise.__name__ = name
+    return _raise
+
+
+class FrozenDict(dict):
+    """A dict that refuses mutation. ``deepcopy`` yields a plain dict."""
+
+    __slots__ = ()
+
+    __setitem__ = _blocked("__setitem__")
+    __delitem__ = _blocked("__delitem__")
+    __ior__ = _blocked("__ior__")
+    clear = _blocked("clear")
+    pop = _blocked("pop")
+    popitem = _blocked("popitem")
+    setdefault = _blocked("setdefault")
+    update = _blocked("update")
+
+    def __copy__(self) -> dict:
+        return dict(self)
+
+    def __deepcopy__(self, memo) -> dict:  # noqa: ARG002
+        return thaw(self)
+
+    def __reduce__(self):
+        # Pickle as the frozen type, item by item (dict.__reduce_ex__
+        # would replay items through the blocked __setitem__).
+        return (_rebuild_dict, (list(dict.items(self)),))
+
+
+class FrozenList(list):
+    """A list that refuses mutation. ``deepcopy`` yields a plain list."""
+
+    __slots__ = ()
+
+    __setitem__ = _blocked("__setitem__")
+    __delitem__ = _blocked("__delitem__")
+    __iadd__ = _blocked("__iadd__")
+    __imul__ = _blocked("__imul__")
+    append = _blocked("append")
+    clear = _blocked("clear")
+    extend = _blocked("extend")
+    insert = _blocked("insert")
+    pop = _blocked("pop")
+    remove = _blocked("remove")
+    reverse = _blocked("reverse")
+    sort = _blocked("sort")
+
+    def __copy__(self) -> list:
+        return list(self)
+
+    def __deepcopy__(self, memo) -> list:  # noqa: ARG002
+        return thaw(self)
+
+    def __reduce__(self):
+        return (_rebuild_list, (list(iter(self)),))
+
+
+def _rebuild_dict(items) -> FrozenDict:
+    return FrozenDict(items)
+
+
+def _rebuild_list(items) -> FrozenList:
+    return FrozenList(items)
+
+
+def freeze(obj: Any) -> Any:
+    """Deep-freeze a JSON-ish tree (dict/list/scalars).
+
+    Already-frozen subtrees are returned as-is, which is what makes
+    partial updates cheap: a new committed version built from an old one
+    shares every untouched subtree instead of copying it.
+    """
+    t = type(obj)
+    if t is FrozenDict or t is FrozenList:
+        return obj
+    if isinstance(obj, dict):
+        return FrozenDict((k, freeze(v)) for k, v in obj.items())
+    if isinstance(obj, (list, tuple)):
+        return FrozenList(freeze(v) for v in obj)
+    return obj
+
+
+def thaw(obj: Any) -> Any:
+    """Deep-copy a (possibly frozen) JSON-ish tree into plain mutable
+    dicts/lists — the escape hatch for callers that need to edit a
+    snapshot. Scalars are shared (they are immutable)."""
+    if isinstance(obj, dict):
+        return {k: thaw(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [thaw(v) for v in obj]
+    return obj
+
+
+__all__ = ["FrozenDict", "FrozenList", "freeze", "thaw"]

@@ -17,7 +17,20 @@ unstructured objects keyed by (apiVersion, kind, namespace, name), with
 - an event recorder (reference events: Deadline/OverridePolicy/FailedCreate/
   TooManyMissedTimes, SURVEY.md §5).
 
-Thread-safe; all returned objects are deep copies.
+Thread-safe. Committed objects are immutable copy-on-write versions
+(:mod:`runtime.frozen`): the read hot path (``list``, watch fan-out)
+hands out one *shared frozen* snapshot per object instead of a deep copy
+per caller, and every write commits a fresh version — so a reader's
+snapshot can never change underneath it and a reader can never corrupt
+store state (mutating a snapshot raises ``TypeError``). ``get`` returns
+a private mutable copy, the natural shape for read-modify-write
+(``get → edit → update``).
+
+Listing is indexed: per-(apiVersion, kind), per-(apiVersion, kind,
+namespace) and per-owner-UID indexes make ``list`` and the GC cascade
+proportional to the result set, not to the whole store — the difference
+between O(N) and O(N²) for an N-Cron reconcile sweep
+(``make bench-controlplane``).
 """
 
 from __future__ import annotations
@@ -33,6 +46,7 @@ from datetime import datetime
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from cron_operator_tpu.api.v1alpha1 import rfc3339
+from cron_operator_tpu.runtime.frozen import freeze, thaw
 from cron_operator_tpu.utils.clock import Clock, RealClock
 
 Unstructured = Dict[str, Any]
@@ -146,13 +160,40 @@ def controller_owner(obj: Unstructured) -> Optional[Dict[str, Any]]:
     return None
 
 
+def _owner_uids(obj: Unstructured) -> Tuple[str, ...]:
+    """UIDs this object names in its ownerReferences (index terms)."""
+    refs = (obj.get("metadata") or {}).get("ownerReferences") or []
+    return tuple(ref["uid"] for ref in refs if ref.get("uid"))
+
+
+def _label_pairs(obj: Unstructured) -> Tuple[Tuple[str, str], ...]:
+    """(key, value) label pairs usable as index terms (string values
+    only — anything exotic still matches via the scan fallback)."""
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    return tuple(
+        (k, v) for k, v in labels.items() if isinstance(v, str)
+    )
+
+
 class APIServer:
     """The embedded control plane store. See module docstring."""
 
     def __init__(self, clock: Optional[Clock] = None):
         self.clock: Clock = clock or RealClock()
         self._lock = threading.RLock()
+        # Committed versions: every value is a frozen tree. The side
+        # indexes below map to the SAME committed objects; _commit/_evict
+        # are the only writers and keep all four in lockstep.
         self._objects: Dict[Key, Unstructured] = {}
+        self._by_gvk: Dict[Tuple[str, str], Dict[Key, Unstructured]] = {}
+        self._by_gvk_ns: Dict[Tuple[str, str, str],
+                              Dict[Key, Unstructured]] = {}
+        # owner uid → ordered set of dependent keys (kube GC's reverse
+        # index; dict used as an ordered set).
+        self._by_owner: Dict[str, Dict[Key, None]] = {}
+        # (label key, label value) → ordered set of keys carrying that
+        # label (informer-indexer analog; serves label-selector lists).
+        self._by_label: Dict[Tuple[str, str], Dict[Key, None]] = {}
         self._events: List[Event] = []
         self._rv = 0
         self._watchers: List[Callable[[WatchEvent], None]] = []
@@ -175,12 +216,79 @@ class APIServer:
         self._rv += 1
         return str(self._rv)
 
-    def _notify(self, ev_type: str, obj: Unstructured) -> None:
-        # Called with the store lock held. Cheap by construction: deep-copy
-        # + queue append; the dispatcher thread does the actual callbacks.
+    def _commit(self, key: Key, committed: Unstructured) -> None:
+        """Install a frozen committed version and index it. Called with
+        the store lock held; ``committed`` must already be frozen."""
+        old = self._objects.get(key)
+        self._objects[key] = committed
+        av, kind, ns, _ = key
+        self._by_gvk.setdefault((av, kind), {})[key] = committed
+        self._by_gvk_ns.setdefault((av, kind, ns), {})[key] = committed
+        new_uids = _owner_uids(committed)
+        new_labels = _label_pairs(committed)
+        if old is not None:
+            for uid in _owner_uids(old):
+                if uid not in new_uids:
+                    self._owner_index_remove(uid, key)
+            for pair in _label_pairs(old):
+                if pair not in new_labels:
+                    self._label_index_remove(pair, key)
+        for uid in new_uids:
+            self._by_owner.setdefault(uid, {})[key] = None
+        for pair in new_labels:
+            self._by_label.setdefault(pair, {})[key] = None
+
+    def _evict(self, key: Key) -> Optional[Unstructured]:
+        """Remove a committed version from the store and every index.
+        Called with the store lock held; returns the evicted version."""
+        obj = self._objects.pop(key, None)
+        if obj is None:
+            return None
+        av, kind, ns, _ = key
+        for index, bucket_key in (
+            (self._by_gvk, (av, kind)),
+            (self._by_gvk_ns, (av, kind, ns)),
+        ):
+            bucket = index.get(bucket_key)
+            if bucket is not None:
+                bucket.pop(key, None)
+                if not bucket:
+                    del index[bucket_key]
+        for uid in _owner_uids(obj):
+            self._owner_index_remove(uid, key)
+        for pair in _label_pairs(obj):
+            self._label_index_remove(pair, key)
+        return obj
+
+    def _owner_index_remove(self, uid: str, key: Key) -> None:
+        bucket = self._by_owner.get(uid)
+        if bucket is not None:
+            bucket.pop(key, None)
+            if not bucket:
+                del self._by_owner[uid]
+
+    def _label_index_remove(self, pair: Tuple[str, str], key: Key) -> None:
+        bucket = self._by_label.get(pair)
+        if bucket is not None:
+            bucket.pop(key, None)
+            if not bucket:
+                del self._by_label[pair]
+
+    def _bump_rv_version(self, obj: Unstructured) -> Unstructured:
+        """New committed version of ``obj`` with a fresh resourceVersion.
+        Shares every subtree except the metadata dict itself. Called with
+        the store lock held."""
+        meta = dict(obj["metadata"])
+        meta["resourceVersion"] = self._next_rv()
+        return freeze({**obj, "metadata": meta})
+
+    def _notify(self, ev_type: str, committed: Unstructured) -> None:
+        # Called with the store lock held and a frozen committed version:
+        # the event shares that snapshot with the store (no copy at all —
+        # it is immutable, so every subscriber can safely read it).
         if not self._watchers or self._closed:
             return
-        event = WatchEvent(type=ev_type, object=copy.deepcopy(obj))
+        event = WatchEvent(type=ev_type, object=committed)
         with self._delivery_cv:
             self._delivery.append((event, list(self._watchers)))
             self._undelivered += 1
@@ -212,7 +320,9 @@ class APIServer:
         """Subscribe to all object changes (controller cache analog).
 
         Delivery is asynchronous (dispatcher thread) but strictly ordered;
-        use :meth:`flush` to barrier on everything published so far."""
+        use :meth:`flush` to barrier on everything published so far. Event
+        objects are shared immutable snapshots — ``deepcopy`` one before
+        editing it."""
         with self._lock:
             self._watchers.append(fn)
             if self._dispatcher is None:
@@ -296,10 +406,7 @@ class APIServer:
         apiservers expire events after ~1h; an in-memory store must cap
         them). Oldest-first by store insertion order."""
         with self._lock:
-            keys = [
-                k for k in self._objects
-                if k[1] == "Event" and k[2] == namespace
-            ]
+            keys = list(self._by_gvk_ns.get(("v1", "Event", namespace), ()))
             excess = keys[: max(0, len(keys) - EVENT_OBJECTS_PER_NAMESPACE)]
         for k in excess:
             try:
@@ -339,18 +446,23 @@ class APIServer:
             meta["uid"] = meta.get("uid") or str(uuid.uuid4())
             meta["creationTimestamp"] = rfc3339(self.clock.now())
             meta["resourceVersion"] = self._next_rv()
-            self._objects[key] = obj
-            self._notify("ADDED", obj)
-            return copy.deepcopy(obj)
+            committed = freeze(obj)
+            self._commit(key, committed)
+            self._notify("ADDED", committed)
+            # `obj` is our private deepcopy and shares no containers with
+            # the frozen committed version — hand it to the caller.
+            return obj
 
     def get(
         self, api_version: str, kind: str, namespace: str, name: str
     ) -> Unstructured:
+        """Fetch one object as a private MUTABLE copy (read-modify-write
+        shape: ``get → edit → update``)."""
         with self._lock:
             obj = self._objects.get((api_version, kind, namespace, name))
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(obj)
+            return thaw(obj)
 
     def try_get(
         self, api_version: str, kind: str, namespace: str, name: str
@@ -366,9 +478,10 @@ class APIServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        owner_uid: Optional[str] = None,
     ) -> List[Unstructured]:
         return self.list_with_rv(api_version, kind, namespace,
-                                 label_selector)[0]
+                                 label_selector, owner_uid)[0]
 
     def list_with_rv(
         self,
@@ -376,22 +489,70 @@ class APIServer:
         kind: str,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        owner_uid: Optional[str] = None,
     ) -> Tuple[List[Unstructured], str]:
         """List plus the store resourceVersion of the SAME snapshot — the
         list-then-watch contract: a watch resuming from this rv must see
         every event after the snapshot, so both must be read under one
-        lock."""
+        lock.
+
+        Served from the narrowest index available — ``owner_uid`` (the
+        dependents of one owner), then (apiVersion, kind, namespace),
+        then (apiVersion, kind) — so cost tracks the result set, not the
+        store. Returned objects are SHARED IMMUTABLE snapshots (zero
+        copies); ``deepcopy`` one before editing it."""
         with self._lock:
+            if owner_uid is not None:
+                keys: Any = self._by_owner.get(owner_uid, ())
+            elif label_selector and all(
+                isinstance(v, str) for v in label_selector.values()
+            ):
+                # Smallest label bucket of the selector is the candidate
+                # set; the full selector re-check below keeps semantics.
+                keys = min(
+                    (
+                        self._by_label.get(pair, {})
+                        for pair in label_selector.items()
+                    ),
+                    key=len,
+                )
+            elif namespace is not None:
+                bucket = self._by_gvk_ns.get(
+                    (api_version, kind, namespace), {})
+                if not label_selector:
+                    return list(bucket.values()), str(self._rv)
+                keys = bucket
+            else:
+                bucket = self._by_gvk.get((api_version, kind), {})
+                if not label_selector:
+                    return list(bucket.values()), str(self._rv)
+                keys = bucket
             out = []
-            for (av, k, ns, _), obj in self._objects.items():
-                if av != api_version or k != kind:
+            for k in keys:
+                av, kd, ns, _ = k
+                if av != api_version or kd != kind:
                     continue
                 if namespace is not None and ns != namespace:
                     continue
-                if not match_labels(obj, label_selector):
-                    continue
-                out.append(copy.deepcopy(obj))
+                obj = self._objects[k]
+                if match_labels(obj, label_selector):
+                    out.append(obj)
             return out, str(self._rv)
+
+    def dependents(
+        self, owner_uid: Optional[str], namespace: Optional[str] = None
+    ) -> List[Unstructured]:
+        """Objects whose ownerReferences name ``owner_uid`` — the kube GC
+        reverse lookup, served from the owner-UID index instead of a full
+        store scan. Shared immutable snapshots."""
+        if not owner_uid:
+            return []
+        with self._lock:
+            return [
+                self._objects[k]
+                for k in self._by_owner.get(owner_uid, ())
+                if namespace is None or k[2] == namespace
+            ]
 
     def update(self, obj: Unstructured) -> Unstructured:
         """Full-object replace with optimistic-concurrency check."""
@@ -412,9 +573,10 @@ class APIServer:
             meta["uid"] = cur_meta.get("uid")
             meta["creationTimestamp"] = cur_meta.get("creationTimestamp")
             meta["resourceVersion"] = self._next_rv()
-            self._objects[key] = obj
-            self._notify("MODIFIED", obj)
-            return copy.deepcopy(obj)
+            committed = freeze(obj)
+            self._commit(key, committed)
+            self._notify("MODIFIED", committed)
+            return obj
 
     def patch_status(
         self,
@@ -436,11 +598,19 @@ class APIServer:
             if current is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             if current.get("status") == status:
-                return copy.deepcopy(current)
-            current["status"] = copy.deepcopy(status)
-            current["metadata"]["resourceVersion"] = self._next_rv()
-            self._notify("MODIFIED", current)
-            return copy.deepcopy(current)
+                return thaw(current)
+            # New committed version sharing every untouched subtree
+            # (spec, labels, ...) with the old one.
+            meta = dict(current["metadata"])
+            meta["resourceVersion"] = self._next_rv()
+            committed = freeze({
+                **current,
+                "metadata": meta,
+                "status": copy.deepcopy(status),
+            })
+            self._commit(key, committed)
+            self._notify("MODIFIED", committed)
+            return thaw(committed)
 
     def delete(
         self,
@@ -454,41 +624,46 @@ class APIServer:
         dependents via ownerReferences (kube GC analog), Orphan does not."""
         with self._lock:
             key = (api_version, kind, namespace, name)
-            obj = self._objects.pop(key, None)
+            obj = self._evict(key)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             # Deletion advances the store version and the final DELETED
             # object carries it (etcd semantics) — watch clients resuming
             # from their last-seen rv must not miss deletions.
-            obj["metadata"]["resourceVersion"] = self._next_rv()
-            self._notify("DELETED", obj)
+            self._notify("DELETED", self._bump_rv_version(obj))
             if propagation in ("Background", "Foreground"):
                 self._cascade_delete(obj["metadata"].get("uid"), namespace)
 
     def _cascade_delete(self, owner_uid: Optional[str], namespace: str) -> None:
+        # Dependents come from the owner-UID index — O(children), not a
+        # scan of every object in the store.
         if not owner_uid:
             return
-        dependents = [
-            k
-            for k, o in self._objects.items()
+        keys = [
+            k for k in self._by_owner.get(owner_uid, {})
             if k[2] == namespace
-            and any(
-                ref.get("uid") == owner_uid
-                for ref in (o.get("metadata") or {}).get("ownerReferences") or []
-            )
         ]
-        for k in dependents:
-            dep = self._objects.pop(k, None)
+        for k in keys:
+            dep = self._evict(k)
             if dep is not None:
-                dep["metadata"]["resourceVersion"] = self._next_rv()
-                self._notify("DELETED", dep)
+                self._notify("DELETED", self._bump_rv_version(dep))
                 self._cascade_delete(dep["metadata"].get("uid"), namespace)
 
     # ---- convenience ------------------------------------------------------
 
     def all_objects(self) -> List[Unstructured]:
+        """Every committed object, as shared immutable snapshots."""
         with self._lock:
-            return [copy.deepcopy(o) for o in self._objects.values()]
+            return list(self._objects.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+    def __bool__(self) -> bool:
+        # A live server is always truthy; without this, __len__ would make
+        # an empty store falsy and break ``api if api else ...`` guards.
+        return True
 
 
 __all__ = [

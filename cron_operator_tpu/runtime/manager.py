@@ -292,6 +292,9 @@ class Manager:
         self._stop = threading.Event()
         self._started = threading.Event()
         self._is_leader = threading.Event()
+        # Workers park on this condition while not leader (instead of
+        # spinning); _set_leadership/stop notify it on every transition.
+        self._leader_cv = threading.Condition()
         api.add_watcher(self._on_watch_event)
 
     # ---- wiring -----------------------------------------------------------
@@ -368,6 +371,8 @@ class Manager:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._leader_cv:
+            self._leader_cv.notify_all()
         for c in self._controllers:
             c.queue.shut_down()
         for t in self._threads:
@@ -380,6 +385,26 @@ class Manager:
         return self.healthz() and (not self.leader_elect or self._is_leader.is_set())
 
     # ---- leader election --------------------------------------------------
+
+    def _set_leadership(self, leader: bool) -> None:
+        """Flip the leadership flag and wake any parked workers. The
+        Event stays (readyz reads it); the condition is the wakeup."""
+        if leader:
+            if not self._is_leader.is_set():
+                self._is_leader.set()
+                with self._leader_cv:
+                    self._leader_cv.notify_all()
+        else:
+            self._is_leader.clear()
+
+    def _await_leadership(self) -> bool:
+        """Park until this manager holds the lease (or is stopping).
+        Returns True iff we are leader and still running — the blocking
+        replacement for the old 50 ms standby poll."""
+        with self._leader_cv:
+            while not self._is_leader.is_set() and not self._stop.is_set():
+                self._leader_cv.wait()
+        return self._is_leader.is_set() and not self._stop.is_set()
 
     def _leader_loop(self) -> None:
         """Lease-based leader election against the API server (parity with
@@ -408,7 +433,7 @@ class Manager:
                             },
                         }
                     )
-                    self._is_leader.set()
+                    self._set_leadership(True)
                 except Exception:
                     pass
             else:
@@ -427,24 +452,37 @@ class Manager:
                     lease["spec"] = spec
                     try:
                         self.api.update(lease)
-                        self._is_leader.set()
+                        self._set_leadership(True)
                     except Exception:
-                        self._is_leader.clear()
+                        self._set_leadership(False)
                 elif holder != self.identity:
-                    self._is_leader.clear()
-            time.sleep(min(2.0, self.lease_duration_s / 3))
+                    self._set_leadership(False)
+            # Interruptible renewal cadence: stop() wakes this instantly
+            # instead of waiting out a sleep.
+            self._stop.wait(min(2.0, self.lease_duration_s / 3))
 
     # ---- worker -----------------------------------------------------------
 
     def _worker(self, c: _Controller) -> None:
+        # Fully event-driven: standby workers park on the leadership
+        # condition and idle workers block in queue.get() — zero wakeups
+        # while there is nothing to do (the old loop spun at 50 ms while
+        # standby and woke every 200 ms while idle).
         while not self._stop.is_set():
             if self.leader_elect and not self._is_leader.is_set():
-                time.sleep(0.05)
-                continue
-            req = c.queue.get(timeout=0.2)
-            if req is None:
-                if c.queue.is_shut_down:
+                if not self._await_leadership():
                     return
+            req = c.queue.get()
+            if req is None:
+                if c.queue.is_shut_down or self._stop.is_set():
+                    return
+                continue
+            if self.leader_elect and not self._is_leader.is_set():
+                # Demoted between get() and processing: hand the item
+                # back untouched (add marks it dirty; done re-queues it)
+                # so the new leader reconciles it.
+                c.queue.add(req)
+                c.queue.done(req)
                 continue
             start = time.monotonic()
             try:

@@ -186,13 +186,12 @@ class WorkQueue(Generic[T]):
         self.rate_limiter.forget(item)
 
     def _delay_loop(self) -> None:
-        # Deadline-aware, not fixed-cadence: sleep until the earliest
-        # pending deadline (add_after notifies the condition when a new
-        # earlier item lands). A fixed 5 ms poll burned ~200 wakeups/s
-        # per controller even while completely idle — measurable CPU
-        # stolen from co-located training dispatch on small hosts, for
-        # zero latency benefit. Capped at 100 ms so pathological clock
-        # weirdness can't wedge the loop.
+        # Deadline-aware AND notify-driven: with no pending deadlines the
+        # loop waits indefinitely (add_after and shut_down notify the
+        # condition), otherwise it sleeps until the earliest deadline —
+        # zero wakeups while idle. The earlier fixed-cadence polls (5 ms,
+        # then 100 ms) burned steady CPU on every controller even when
+        # completely idle, stolen from co-located training dispatch.
         while True:
             due: List[T] = []
             with self._cond:
@@ -203,11 +202,9 @@ class WorkQueue(Generic[T]):
                     _, _, item = heapq.heappop(self._delayed)
                     due.append(item)
                 if not due:
-                    wait = (
-                        min(0.1, self._delayed[0][0] - now)
-                        if self._delayed else 0.1
+                    self._delay_cond.wait(
+                        self._delayed[0][0] - now if self._delayed else None
                     )
-                    self._delay_cond.wait(wait)
                     continue
             for item in due:
                 self.add(item)

@@ -127,7 +127,8 @@ class Tracer:
         attrs: Optional[Dict[str, Any]] = None,
     ) -> Span:
         """Record a completed span directly from two timestamps."""
-        span = self.start_span(name, trace_id, start_s, parent_id=parent_id, attrs=attrs)
+        span = self.start_span(name, trace_id, start_s,
+                               parent_id=parent_id, attrs=attrs)
         return self.finish(span, end_s)
 
     @contextmanager

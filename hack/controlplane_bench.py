@@ -1,0 +1,317 @@
+"""Reproducible control-plane benchmark (``make bench-controlplane``).
+
+Measures what the embedded control plane sustains at 1k/5k Crons using
+the REAL stack — ``APIServer`` + ``Manager`` worker pool + ``CronReconciler``
+on a ``FakeClock`` — not a stripped-down reconcile loop:
+
+- populate: N Cron creates (objects/s),
+- ``list()`` latency: the two controller-shaped hot calls, all-Crons and
+  label-selector workload listing (mean µs/call),
+- fire sweep: advance the fake clock so every Cron has a due tick, start
+  the manager (informer seed enqueues all N), and time until every Cron
+  has created its workload — creation-bound by design; reconciles/s plus
+  p50/p99 reconcile latency read from the live
+  ``controller_runtime_reconcile_time_seconds`` histogram,
+- list+reconcile sweep: a full no-tick-due reconcile pass over all N
+  Crons against the now-populated store (every reconcile lists its
+  children, recomputes the schedule, syncs status). This is the
+  steady-state hot loop the indexes and schedule cache target, and the
+  headline throughput number.
+
+Emits a JSON artifact. ``--baseline-ref <git-ref>`` additionally runs the
+same measurement against a detached worktree of that ref (the script only
+touches APIs present on both sides) and reports before/after speedups —
+how the committed BENCH_CONTROLPLANE.json numbers were produced.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# Code under test: an explicit tree (baseline subprocess) or this repo.
+_TREE = os.environ.get("CPBENCH_TREE", REPO_ROOT)
+sys.path.insert(0, _TREE)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CRON_API_VERSION = "apps.kubedl.io/v1alpha1"
+WORKLOAD_API_VERSION = "kubeflow.org/v1"
+WORKLOAD_KIND = "JAXJob"
+LABEL_CRON_NAME = "kubedl.io/cron-name"
+
+SUCCESS_SERIES = (
+    'controller_runtime_reconcile_total'
+    '{controller="cron",result="success"}'
+)
+ERROR_SERIES = (
+    'controller_runtime_reconcile_errors_total{controller="cron"}'
+)
+RECONCILE_HIST = (
+    'controller_runtime_reconcile_time_seconds{controller="cron"}'
+)
+
+
+def _cron(i: int) -> dict:
+    # Half standard 5-field specs (60 distinct minute offsets — exercises
+    # the bit-scan engine and gives the compiled-schedule cache a realistic
+    # key population), half one shared @every spec.
+    schedule = f"{i % 60} * * * *" if i % 2 == 0 else "@every 3600s"
+    return {
+        "apiVersion": CRON_API_VERSION,
+        "kind": "Cron",
+        "metadata": {"name": f"bench-{i}", "namespace": "default"},
+        "spec": {
+            "schedule": schedule,
+            "concurrencyPolicy": "Allow",
+            "historyLimit": 3,
+            "template": {"workload": {
+                "apiVersion": WORKLOAD_API_VERSION,
+                "kind": WORKLOAD_KIND,
+                "metadata": {"annotations": {
+                    "tpu.kubedl.io/accelerator": "v5e",
+                    "tpu.kubedl.io/topology": "2x2",
+                }},
+                "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+            }},
+        },
+    }
+
+
+def _hist_percentile(h, q: float):
+    """Percentile upper bound from cumulative histogram buckets."""
+    if not h or not h["count"]:
+        return None
+    target = q * h["count"]
+    cum = 0
+    for le, n in zip(h["buckets"], h["counts"]):
+        cum += n
+        if cum >= target:
+            return le
+    return float("inf")
+
+
+def _time_calls(fn, repeat: int) -> float:
+    """Mean µs per call."""
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        fn()
+    return (time.perf_counter() - t0) / repeat * 1e6
+
+
+def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
+    from datetime import timedelta
+    from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+    from cron_operator_tpu.controller import CronReconciler
+    from cron_operator_tpu.runtime import APIServer, Manager
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    api = APIServer(clock=clock)
+
+    t0 = time.perf_counter()
+    for i in range(n_crons):
+        api.create(_cron(i))
+    populate_s = time.perf_counter() - t0
+
+    list_repeat = max(5, min(50, 20000 // n_crons))
+    cron_list_us = _time_calls(
+        lambda: api.list(CRON_API_VERSION, "Cron", namespace="default"),
+        list_repeat,
+    )
+    # The reconciler's per-Cron child listing shape (label selector).
+    label_list_us = _time_calls(
+        lambda: api.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace="default",
+            label_selector={LABEL_CRON_NAME: "bench-0"},
+        ),
+        list_repeat,
+    )
+
+    # Count workload creations through a watch subscriber: identical cost
+    # on every tree, and avoids polling list() during the timed sweep.
+    import threading
+
+    created = threading.Semaphore(0)
+    created_n = [0]
+
+    def _count(ev):
+        if ev.type == "ADDED" and ev.object.get("kind") == WORKLOAD_KIND:
+            created_n[0] += 1
+            created.release()
+
+    api.add_watcher(_count)
+
+    mgr = Manager(api, max_concurrent_reconciles=10)
+    rec = CronReconciler(api, metrics=mgr.metrics)
+    mgr.add_controller(
+        "cron", rec.reconcile, for_gvk=GVK_CRON,
+        owns=default_scheme().workload_kinds(),
+    )
+    # Every standard spec fires within the next 60 min; the @every specs
+    # have exactly one due tick after 61 min.
+    clock.advance(timedelta(minutes=61))
+
+    t0 = time.perf_counter()
+    mgr.start()
+    deadline = t0 + sweep_timeout_s
+    done = 0
+    while done < n_crons and time.perf_counter() < deadline:
+        if created.acquire(timeout=min(1.0, deadline - time.perf_counter())):
+            done += 1
+    fire_s = time.perf_counter() - t0
+    timed_out = done < n_crons
+    successes = mgr.metrics.get(SUCCESS_SERIES)
+    errors = mgr.metrics.get(ERROR_SERIES)
+
+    # The headline: a full list+reconcile pass over every Cron with no
+    # tick due — each reconcile lists its child workloads, recomputes
+    # the schedule and syncs status against the populated store.
+    t0 = time.perf_counter()
+    for i in range(n_crons):
+        rec.reconcile("default", f"bench-{i}")
+    list_reconcile_s = time.perf_counter() - t0
+
+    hist = mgr.metrics.histogram(RECONCILE_HIST)
+    mgr.stop()
+    api.close()
+
+    return {
+        "n_crons": n_crons,
+        "populate_objects_per_s": round(n_crons / populate_s, 1),
+        "cron_list_us": round(cron_list_us, 1),
+        "workload_label_list_us": round(label_list_us, 1),
+        "fire_sweep_s": round(fire_s, 3),
+        "fire_sweep_timed_out": timed_out,
+        "fire_sweep_workloads_created": done,
+        "fire_sweep_crons_per_s": (
+            round(done / fire_s, 1) if fire_s else 0.0
+        ),
+        "fire_sweep_reconciles_per_s": (
+            round(successes / fire_s, 1) if fire_s else 0.0
+        ),
+        "reconcile_errors": errors,
+        "reconcile_p50_s": _hist_percentile(hist, 0.50),
+        "reconcile_p99_s": _hist_percentile(hist, 0.99),
+        "list_reconcile_sweep_per_s": round(
+            n_crons / list_reconcile_s, 1),
+    }
+
+
+def _git_ref(tree: str) -> str:
+    try:
+        return subprocess.run(
+            ["git", "-C", tree, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def run_suite(sizes, sweep_timeout_s: float) -> dict:
+    return {
+        "schema": "controlplane-bench/v1",
+        "git_ref": _git_ref(_TREE),
+        "results": [run_one(n, sweep_timeout_s) for n in sizes],
+    }
+
+
+def _run_baseline(ref: str, sizes, timeout_s: float) -> dict:
+    """Run this same script against a detached worktree of ``ref``."""
+    tree = tempfile.mkdtemp(prefix="cpbench-baseline-")
+    subprocess.run(
+        ["git", "-C", REPO_ROOT, "worktree", "add", "--detach", tree, ref],
+        check=True, capture_output=True, text=True,
+    )
+    try:
+        env = dict(os.environ, CPBENCH_TREE=tree, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--sizes", ",".join(str(s) for s in sizes),
+             "--sweep-timeout", str(timeout_s), "--stdout"],
+            env=env, capture_output=True, text=True,
+            timeout=timeout_s * (len(sizes) + 1) + 600,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"baseline run failed rc={out.returncode}: "
+                f"{out.stderr[-800:]}"
+            )
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    finally:
+        subprocess.run(
+            ["git", "-C", REPO_ROOT, "worktree", "remove", "--force", tree],
+            capture_output=True,
+        )
+
+
+def _speedups(before: dict, after: dict) -> list:
+    out = []
+    by_n = {r["n_crons"]: r for r in before["results"]}
+    for a in after["results"]:
+        b = by_n.get(a["n_crons"])
+        if not b:
+            continue
+
+        def ratio(key, invert=False):
+            x, y = b.get(key), a.get(key)
+            if not x or not y:
+                return None
+            return round(x / y, 2) if invert else round(y / x, 2)
+
+        out.append({
+            "n_crons": a["n_crons"],
+            "list_reconcile_sweep_per_s": ratio(
+                "list_reconcile_sweep_per_s"),
+            "fire_sweep_crons_per_s": ratio("fire_sweep_crons_per_s"),
+            "cron_list_us": ratio("cron_list_us", invert=True),
+            "workload_label_list_us": ratio(
+                "workload_label_list_us", invert=True),
+            "populate_objects_per_s": ratio("populate_objects_per_s"),
+        })
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--sizes", default="1000,5000",
+                   help="comma-separated Cron counts")
+    p.add_argument("--out", default=os.path.join(
+        REPO_ROOT, "BENCH_CONTROLPLANE.json"))
+    p.add_argument("--baseline-ref", default=None,
+                   help="git ref to measure as the 'before' tree")
+    p.add_argument("--sweep-timeout", type=float, default=900.0)
+    p.add_argument("--stdout", action="store_true",
+                   help="print the artifact JSON to stdout only")
+    args = p.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+
+    after = run_suite(sizes, args.sweep_timeout)
+    artifact = after
+    if args.baseline_ref:
+        before = _run_baseline(args.baseline_ref, sizes, args.sweep_timeout)
+        artifact = {
+            "schema": "controlplane-bench-compare/v1",
+            "before": before,
+            "after": after,
+            "speedup": _speedups(before, after),
+        }
+
+    text = json.dumps(artifact, indent=2, sort_keys=True)
+    if args.stdout:
+        print(json.dumps(artifact))
+    else:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(text)
+        print(f"\nwrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

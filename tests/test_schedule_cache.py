@@ -1,0 +1,147 @@
+"""Compiled-schedule cache (``parse_standard_cached``) and bit-scan
+``CronSchedule.next`` equivalence against a minute-stepping reference.
+
+The cache is keyed by the spec string: identical specs share ONE compiled
+object across every Cron, an edited spec is a new key (instant recompile),
+and unparseable specs are never cached so a bad edit keeps raising its
+terminal error on every reconcile. The bit-scan rewrite of ``next`` must
+be observationally identical to stepping one minute at a time through the
+masks — verified here over a seeded randomized spec sweep that includes
+the vixie dom/dow OR rule, names, steps and ``@every``.
+"""
+
+import random
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from cron_operator_tpu.controller.schedule import (
+    CronSchedule,
+    EverySchedule,
+    parse_standard,
+    parse_standard_cached,
+)
+
+
+def utc(*args):
+    return datetime(*args, tzinfo=timezone.utc)
+
+
+class TestCompiledScheduleCache:
+    def test_identical_specs_share_one_compiled_object(self):
+        a = parse_standard_cached("*/5 9-17 * * MON-FRI")
+        b = parse_standard_cached("*/5 9-17 * * MON-FRI")
+        assert a is b
+
+    def test_spec_change_recompiles(self):
+        a = parse_standard_cached("0 * * * *")
+        b = parse_standard_cached("1 * * * *")
+        assert a is not b
+        assert a.next(utc(2026, 1, 1)) != b.next(utc(2026, 1, 1))
+
+    def test_cached_matches_uncached(self):
+        for expr in ["*/7 * * * *", "@hourly", "@every 90s",
+                     "15,45 */2 1-15 JAN,jul *"]:
+            t = utc(2026, 3, 14, 1, 59)
+            assert parse_standard_cached(expr).next(t) == \
+                parse_standard(expr).next(t)
+
+    def test_unparseable_spec_errors_every_time(self):
+        # lru_cache must not memoize the exception: an unparseable edit
+        # keeps surfacing its terminal error on every reconcile.
+        for _ in range(3):
+            with pytest.raises(ValueError):
+                parse_standard_cached("61 * * * *")
+
+    def test_every_schedule_cached_too(self):
+        a = parse_standard_cached("@every 1h30m")
+        assert isinstance(a, EverySchedule)
+        assert parse_standard_cached("@every 1h30m") is a
+
+
+# ---- bit-scan vs minute-stepping equivalence ----------------------------
+
+
+def _next_by_stepping(sched: CronSchedule, after: datetime) -> datetime:
+    """Reference implementation: advance one minute at a time and test
+    every candidate against the compiled masks directly."""
+    t = after.replace(second=0, microsecond=0) + timedelta(minutes=1)
+    limit = after + timedelta(days=366 * 2)
+    while t <= limit:
+        if (
+            sched.month & (1 << t.month)
+            and sched._day_matches(t)
+            and sched.hour & (1 << t.hour)
+            and sched.minute & (1 << t.minute)
+        ):
+            return t
+        t += timedelta(minutes=1)
+    raise AssertionError("no activation within 2 years")
+
+
+def _random_field(rng, lo, hi, names=None):
+    kind = rng.randrange(5)
+    if kind == 0:
+        return "*"
+    if kind == 1:
+        return f"*/{rng.randint(2, 20)}"
+    if kind == 2:
+        a = rng.randint(lo, hi - 1)
+        b = rng.randint(a, hi)
+        expr = f"{a}-{b}"
+        if rng.random() < 0.5:
+            expr += f"/{rng.randint(1, 5)}"
+        return expr
+    if kind == 3 and names:
+        return rng.choice(list(names)).upper()
+    return ",".join(
+        str(rng.randint(lo, hi)) for _ in range(rng.randint(1, 3))
+    )
+
+
+class TestBitScanEquivalence:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_specs_match_stepping(self, seed):
+        from cron_operator_tpu.controller.schedule import (
+            DOW_NAMES,
+            MONTH_NAMES,
+        )
+
+        rng = random.Random(seed)
+        for _ in range(25):
+            expr = " ".join([
+                _random_field(rng, 0, 59),
+                _random_field(rng, 0, 23),
+                _random_field(rng, 1, 28),  # stay clear of 29-31
+                _random_field(rng, 1, 12, MONTH_NAMES),
+                _random_field(rng, 0, 6, DOW_NAMES),
+            ])
+            try:
+                sched = parse_standard(expr)
+            except ValueError:
+                continue
+            after = utc(2026, 1, 1) + timedelta(
+                minutes=rng.randrange(0, 400 * 24 * 60),
+                seconds=rng.randrange(0, 60),
+            )
+            assert sched.next(after) == _next_by_stepping(sched, after), (
+                f"spec {expr!r} after {after}"
+            )
+
+    def test_vixie_dom_dow_or_rule(self):
+        # Both restricted: a time matching EITHER field fires. Feb 2026:
+        # the 13th is a Friday; "0 0 1 * FRI" must hit Feb 1 (dom) then
+        # Feb 6 (dow) — never require both.
+        sched = parse_standard("0 0 1 * FRI")
+        t = sched.next(utc(2026, 1, 31, 12, 0))
+        assert t == utc(2026, 2, 1)
+        assert sched.next(t) == utc(2026, 2, 6)
+
+    def test_sparse_schedule_jumps_straight_to_activation(self):
+        assert parse_standard("30 4 * * *").next(
+            utc(2026, 6, 1, 4, 31)
+        ) == utc(2026, 6, 2, 4, 30)
+
+    def test_every_duration_unchanged(self):
+        sched = parse_standard("@every 2h")
+        assert sched.next(utc(2026, 1, 1, 1, 2, 3)) == utc(2026, 1, 1, 3, 2, 3)

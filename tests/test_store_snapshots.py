@@ -1,0 +1,241 @@
+"""Copy-on-write snapshot isolation and index routing in the APIServer.
+
+The mutation-isolation guard of the PR: ``list()`` and watch events hand
+out SHARED frozen snapshots, so a buggy caller that tries to mutate one
+must get ``TypeError`` — and the store must be provably uncorrupted
+afterwards. ``deepcopy``/``thaw`` stay the sanctioned escape hatch.
+Index tests pin the owner-UID / label / namespace routing that makes
+``list`` and cascade GC proportional to their result sets.
+"""
+
+import copy
+import json
+import threading
+
+import pytest
+
+from cron_operator_tpu.runtime.frozen import FrozenDict, FrozenList, freeze, thaw
+from cron_operator_tpu.runtime.kube import APIServer, WatchEvent
+
+
+def job(name, ns="default", labels=None, owners=None):
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = dict(labels)
+    if owners:
+        meta["ownerReferences"] = owners
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": meta,
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+    }
+
+
+def owner_ref(obj, controller=True):
+    meta = obj["metadata"]
+    return {
+        "apiVersion": obj["apiVersion"],
+        "kind": obj["kind"],
+        "name": meta["name"],
+        "uid": meta["uid"],
+        "controller": controller,
+    }
+
+
+class TestSnapshotIsolation:
+    def test_list_snapshot_refuses_mutation_everywhere(self, api):
+        api.create(job("a", labels={"app": "x"}))
+        snap = api.list("kubeflow.org/v1", "JAXJob")[0]
+        with pytest.raises(TypeError):
+            snap["status"] = {"phase": "Hacked"}
+        with pytest.raises(TypeError):
+            snap["metadata"]["labels"]["app"] = "evil"
+        with pytest.raises(TypeError):
+            del snap["spec"]
+        with pytest.raises(TypeError):
+            snap.update({"kind": "Other"})
+        # The store is untouched by every failed attempt.
+        obj = api.get("kubeflow.org/v1", "JAXJob", "default", "a")
+        assert "status" not in obj
+        assert obj["metadata"]["labels"] == {"app": "x"}
+
+    def test_nested_lists_frozen_too(self, api):
+        o = job("a")
+        o["spec"]["containers"] = [{"name": "c", "args": ["x"]}]
+        api.create(o)
+        snap = api.list("kubeflow.org/v1", "JAXJob")[0]
+        with pytest.raises(TypeError):
+            snap["spec"]["containers"].append({})
+        with pytest.raises(TypeError):
+            snap["spec"]["containers"][0]["args"][0] = "y"
+
+    def test_watch_event_object_is_frozen(self, api):
+        events = []
+        api.add_watcher(events.append)
+        api.create(job("a"))
+        assert api.flush()
+        ev: WatchEvent = events[0]
+        with pytest.raises(TypeError):
+            ev.object["metadata"]["name"] = "b"
+        # Every subscriber shares ONE committed snapshot with the store.
+        assert ev.object is api.list("kubeflow.org/v1", "JAXJob")[0]
+
+    def test_deepcopy_thaws_to_private_mutable_copy(self, api):
+        api.create(job("a", labels={"app": "x"}))
+        snap = api.list("kubeflow.org/v1", "JAXJob")[0]
+        mine = copy.deepcopy(snap)
+        assert type(mine) is dict
+        mine["metadata"]["labels"]["app"] = "mine"
+        mine["status"] = {"phase": "Running"}
+        fresh = api.list("kubeflow.org/v1", "JAXJob")[0]
+        assert fresh["metadata"]["labels"]["app"] == "x"
+        assert "status" not in fresh
+
+    def test_get_returns_mutable_read_modify_write_copy(self, api):
+        api.create(job("a"))
+        obj = api.get("kubeflow.org/v1", "JAXJob", "default", "a")
+        obj["spec"]["replicaSpecs"]["Worker"]["replicas"] = 4
+        api.update(obj)
+        assert api.list("kubeflow.org/v1", "JAXJob")[0]["spec"][
+            "replicaSpecs"]["Worker"]["replicas"] == 4
+
+    def test_snapshot_survives_later_writes(self, api):
+        api.create(job("a"))
+        before = api.list("kubeflow.org/v1", "JAXJob")[0]
+        rv = before["metadata"]["resourceVersion"]
+        obj = api.get("kubeflow.org/v1", "JAXJob", "default", "a")
+        obj["spec"]["replicaSpecs"]["Worker"]["replicas"] = 8
+        api.update(obj)
+        # The old snapshot is a committed version: stable forever.
+        assert before["metadata"]["resourceVersion"] == rv
+        assert before["spec"]["replicaSpecs"]["Worker"]["replicas"] == 1
+
+    def test_snapshots_json_serializable(self, api):
+        api.create(job("a", labels={"app": "x"}))
+        snap = api.list("kubeflow.org/v1", "JAXJob")[0]
+        assert json.loads(json.dumps(snap))["metadata"]["name"] == "a"
+
+
+class TestFrozenPrimitives:
+    def test_freeze_shares_already_frozen_subtrees(self):
+        inner = freeze({"a": [1, 2]})
+        outer = freeze({"inner": inner})
+        assert outer["inner"] is inner
+
+    def test_thaw_round_trip(self):
+        src = {"a": {"b": [1, {"c": 2}]}}
+        plain = thaw(freeze(src))
+        assert plain == src
+        assert type(plain["a"]["b"]) is list
+        assert type(plain["a"]["b"][1]) is dict
+
+    def test_frozen_types_still_behave_like_builtins(self):
+        d = freeze({"a": 1})
+        l = freeze([1, 2])
+        assert isinstance(d, dict) and isinstance(l, list)
+        assert d == {"a": 1} and l == [1, 2]
+        assert FrozenDict is type(d) and FrozenList is type(l)
+
+
+class TestIndexedRouting:
+    def test_dependents_served_from_owner_index(self, api):
+        owner = api.create(job("owner"))
+        for i in range(3):
+            api.create(job(f"child-{i}", owners=[owner_ref(owner)]))
+        api.create(job("stranger"))
+        uid = owner["metadata"]["uid"]
+        deps = api.dependents(uid)
+        assert sorted(d["metadata"]["name"] for d in deps) == [
+            "child-0", "child-1", "child-2"]
+        assert api.dependents(uid, namespace="other") == []
+        assert api.dependents(None) == []
+
+    def test_list_by_owner_uid(self, api):
+        owner = api.create(job("owner"))
+        api.create(job("child", owners=[owner_ref(owner)]))
+        api.create(job("stranger"))
+        out = api.list("kubeflow.org/v1", "JAXJob",
+                       owner_uid=owner["metadata"]["uid"])
+        assert [o["metadata"]["name"] for o in out] == ["child"]
+
+    def test_owner_index_follows_updates(self, api):
+        owner = api.create(job("owner"))
+        child = api.create(job("child", owners=[owner_ref(owner)]))
+        uid = owner["metadata"]["uid"]
+        assert len(api.dependents(uid)) == 1
+        child["metadata"]["ownerReferences"] = []
+        api.update(child)
+        assert api.dependents(uid) == []
+
+    def test_cascade_delete_via_index_reaches_grandchildren(self, api):
+        owner = api.create(job("owner"))
+        child = api.create(job("child", owners=[owner_ref(owner)]))
+        api.create(job("grandchild", owners=[owner_ref(child)]))
+        api.create(job("stranger"))
+        api.delete("kubeflow.org/v1", "JAXJob", "default", "owner")
+        names = [o["metadata"]["name"]
+                 for o in api.list("kubeflow.org/v1", "JAXJob")]
+        assert names == ["stranger"]
+
+    def test_label_index_follows_label_edits(self, api):
+        api.create(job("a", labels={"app": "x"}))
+        sel = {"app": "x"}
+        assert len(api.list("kubeflow.org/v1", "JAXJob",
+                            label_selector=sel)) == 1
+        obj = api.get("kubeflow.org/v1", "JAXJob", "default", "a")
+        obj["metadata"]["labels"] = {"app": "y"}
+        api.update(obj)
+        assert api.list("kubeflow.org/v1", "JAXJob",
+                        label_selector=sel) == []
+        assert len(api.list("kubeflow.org/v1", "JAXJob",
+                            label_selector={"app": "y"})) == 1
+
+    def test_multi_key_selector_requires_all_pairs(self, api):
+        api.create(job("a", labels={"app": "x", "tier": "web"}))
+        api.create(job("b", labels={"app": "x"}))
+        out = api.list("kubeflow.org/v1", "JAXJob",
+                       label_selector={"app": "x", "tier": "web"})
+        assert [o["metadata"]["name"] for o in out] == ["a"]
+
+    def test_namespace_index_isolates_namespaces(self, api):
+        api.create(job("a", ns="ns1"))
+        api.create(job("b", ns="ns2"))
+        out = api.list("kubeflow.org/v1", "JAXJob", namespace="ns1")
+        assert [o["metadata"]["name"] for o in out] == ["a"]
+        assert len(api.list("kubeflow.org/v1", "JAXJob")) == 2
+
+    def test_indexes_consistent_under_concurrent_churn(self, api):
+        owner = api.create(job("owner"))
+        errs = []
+
+        def churn(k):
+            try:
+                for i in range(30):
+                    name = f"c{k}-{i}"
+                    api.create(job(name, owners=[owner_ref(owner)],
+                                   labels={"batch": f"b{k}"}))
+                    if i % 3 == 0:
+                        api.delete("kubeflow.org/v1", "JAXJob",
+                                   "default", name)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=churn, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        uid = owner["metadata"]["uid"]
+        live = {o["metadata"]["name"]
+                for o in api.list("kubeflow.org/v1", "JAXJob")} - {"owner"}
+        assert {d["metadata"]["name"] for d in api.dependents(uid)} == live
+        by_label = {
+            o["metadata"]["name"]
+            for k in range(4)
+            for o in api.list("kubeflow.org/v1", "JAXJob",
+                              label_selector={"batch": f"b{k}"})
+        }
+        assert by_label == live
