@@ -33,7 +33,12 @@ dryrun:
 bench:
 	python bench.py
 
-# Control-plane throughput/latency at 1k/5k Crons (no device involved).
-# BASELINE=<git-ref> additionally measures that ref and reports speedups.
+# Control-plane throughput/latency at 1k/5k Crons (no device involved):
+# steady-state list+reconcile sweep, same-tick fire storm (every Cron due
+# on one minute), and a per-verb write-path microbench
+# (update/patch_status/create µs). BASELINE=<git-ref> additionally
+# measures that ref, reports speedups, and prints a one-line
+# OK/REGRESSION verdict over the headline metrics; add CHECK=1 to make a
+# regression fail the target.
 bench-controlplane:
-	python hack/controlplane_bench.py $(if $(BASELINE),--baseline-ref $(BASELINE))
+	python hack/controlplane_bench.py $(if $(BASELINE),--baseline-ref $(BASELINE)) $(if $(CHECK),--check)

@@ -349,7 +349,17 @@ class CronTemplateSpec:
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "CronTemplateSpec":
         d = d or {}
         wl = d.get("workload")
-        return cls(workload=copy.deepcopy(wl) if wl is not None else None)
+        if wl is None:
+            return cls(workload=None)
+        # A frozen template (store snapshot) is immutable, so it can be
+        # SHARED instead of deep-copied — the reconciler hot path parses
+        # one Cron per pass and every template consumer already copies
+        # before mutating. Mutable input keeps the defensive deepcopy.
+        from cron_operator_tpu.runtime.frozen import FrozenDict
+
+        if type(wl) is FrozenDict:
+            return cls(workload=wl)
+        return cls(workload=copy.deepcopy(wl))
 
 
 @dataclass

@@ -55,7 +55,7 @@ from cron_operator_tpu.controller.workload import (
     get_default_job_name,
     is_workload_finished,
     get_job_status,
-    new_empty_workload,
+    validate_workload_template,
     sort_by_creation_timestamp,
 )
 from cron_operator_tpu.backends.tpu import inject_tpu_topology
@@ -131,7 +131,16 @@ class CronReconciler:
         # Wall-clock anchor for the "reconcile" span (tracer spans use the
         # time.time domain so spans from other processes line up).
         t_start = time.time()
-        raw = self.api.try_get(API_VERSION, KIND_CRON, namespace, name)
+        # Zero-copy read when the backend offers it (embedded APIServer):
+        # Cron.from_dict below copies everything it keeps, so the shared
+        # frozen snapshot never leaks mutable aliases. Cluster-backed
+        # clients fall back to the plain thawing read.
+        get_frozen = getattr(self.api, "get_frozen", None)
+        raw = (
+            get_frozen(API_VERSION, KIND_CRON, namespace, name)
+            if get_frozen is not None
+            else self.api.try_get(API_VERSION, KIND_CRON, namespace, name)
+        )
         if raw is None:
             log.debug("not found; skipping")
             # Drop per-Cron dedup state so a long-lived operator churning
@@ -140,21 +149,32 @@ class CronReconciler:
             self._first_step_observed.pop((namespace, name), None)
             return ReconcileResult()
 
-        old_cron = Cron.from_dict(raw)
-        cron = old_cron.deepcopy()
+        cron = Cron.from_dict(raw)
+        # Committed-status snapshot for the exit comparison — the stored
+        # status as-is, no render. Statuses are written exclusively from
+        # to_dict() output, so the stored form IS the normal form and a
+        # single exit render suffices for the changed/unchanged test. A
+        # hand-seeded fixture status in a different-but-equal shape costs
+        # at most one converging patch (which the store's own no-op
+        # elision may still drop).
+        old_status = raw.get("status") or {}
 
         try:
-            return self._reconcile(cron, t_start)
+            return self._reconcile(cron, t_start, log)
         finally:
-            # Deferred status patch iff semantically changed.
-            if cron.status.to_dict() != old_cron.status.to_dict():
+            # Deferred status patch iff semantically changed: the
+            # steady-state sweep (nothing due, nothing flapping) must
+            # perform ZERO store writes (reference short-circuit,
+            # cron_controller.go:107-120).
+            new_status = cron.status.to_dict()
+            if new_status != old_status:
                 try:
                     self.api.patch_status(
                         API_VERSION,
                         KIND_CRON,
                         namespace,
                         name,
-                        cron.status.to_dict(),
+                        new_status,
                     )
                 except NotFoundError:
                     pass
@@ -162,13 +182,17 @@ class CronReconciler:
     # -- core ---------------------------------------------------------------
 
     def _reconcile(
-        self, cron: Cron, t_start: Optional[float] = None
+        self, cron: Cron, t_start: Optional[float] = None, log=None
     ) -> ReconcileResult:
         ns, name = cron.metadata.namespace, cron.metadata.name
-        log = request_logger("cron", ns, name)
+        if log is None:
+            log = request_logger("cron", ns, name)
 
         try:
-            workload_tpl = new_empty_workload(cron)
+            # Validation only, no copy: the template is already private to
+            # this Cron object, and every consumer below (Replace dry-run,
+            # tick instantiation) deepcopies before mutating.
+            workload_tpl = validate_workload_template(cron)
         except ValueError as err:
             # Invalid template: terminal until the spec is edited.
             log.error("%s", err)
@@ -255,24 +279,20 @@ class CronReconciler:
                 self._count('cron_ticks_skipped_total{policy="Forbid"}')
             return scheduled
 
-        # Validate TPU annotations BEFORE any destructive concurrency action:
-        # with Replace policy, deleting the healthy active workload and then
-        # failing admission would leave nothing running. Dry-run on a copy —
-        # the real injection below only differs in instance name/namespace,
-        # which cannot affect validity.
-        try:
-            inject_tpu_topology(copy.deepcopy(workload_tpl))
-        except ValueError as err:
-            self.api.record_event(
-                cron.to_dict(),
-                "Warning",
-                "FailedTPUAdmission",
-                f"invalid TPU annotations on workload template: {err}",
-            )
-            log.error("TPU admission failed: %s", err)
-            return scheduled
-
         if cron.spec.concurrency_policy == ConcurrencyPolicy.REPLACE:
+            # Validate TPU annotations BEFORE the destructive delete:
+            # removing the healthy active workload and then failing
+            # admission would leave nothing running. Dry-run on a copy —
+            # the real injection below only differs in instance name/
+            # namespace, which cannot affect validity. Non-Replace ticks
+            # skip this extra deepcopy+inject: for them a failed
+            # admission (caught below) destroys nothing.
+            if active:
+                try:
+                    inject_tpu_topology(copy.deepcopy(workload_tpl))
+                except ValueError as err:
+                    self._tpu_admission_failed(cron, log, err)
+                    return scheduled
             for w in active:
                 meta = w.get("metadata") or {}
                 try:
@@ -305,8 +325,11 @@ class CronReconciler:
         # cluster and embedded modes. inject_tpu_topology is idempotent and a
         # no-op for non-TPU workloads, so the LocalExecutor's own call (which
         # covers workloads created outside this controller) stays safe.
-        # Cannot raise: the template was dry-run-validated above.
-        tpu_spec = inject_tpu_topology(workload)
+        try:
+            tpu_spec = inject_tpu_topology(workload)
+        except ValueError as err:
+            self._tpu_admission_failed(cron, log, err)
+            return scheduled
         if tpu_spec is not None:
             log.debug(
                 "TPU admission %s %s → %d host(s) × %d chip(s)",
@@ -347,6 +370,17 @@ class CronReconciler:
         return scheduled
 
     # -- helpers ------------------------------------------------------------
+
+    def _tpu_admission_failed(self, cron: Cron, log, err: Exception) -> None:
+        """Event + log for a workload template that fails TPU admission.
+        The tick is skipped; scheduling continues (a spec fix heals it)."""
+        self.api.record_event(
+            cron.to_dict(),
+            "Warning",
+            "FailedTPUAdmission",
+            f"invalid TPU annotations on workload template: {err}",
+        )
+        log.error("TPU admission failed: %s", err)
 
     def _record_tick_spans(
         self,
@@ -439,13 +473,17 @@ class CronReconciler:
             namespace=ns,
             label_selector={LABEL_CRON_NAME: cron.metadata.name},
         )
-        seen = {
-            ((w.get("metadata") or {}).get("uid") or id(w)) for w in owned
-        }
-        owned.extend(
-            w for w in labeled
-            if ((w.get("metadata") or {}).get("uid") or id(w)) not in seen
-        )
+        # Dedup by (namespace, name) — unique per GVK in any store, and
+        # stable across the two result sets. (An id(w) fallback for
+        # uid-less objects could never match: each list() materializes
+        # distinct snapshots, so uid-less children were double-counted
+        # into status.active.)
+        def _key(w: Unstructured) -> Tuple[str, str]:
+            meta = w.get("metadata") or {}
+            return (meta.get("namespace", ""), meta.get("name", ""))
+
+        seen = {_key(w) for w in owned}
+        owned.extend(w for w in labeled if _key(w) not in seen)
         return owned
 
     def _sync_status(
@@ -484,7 +522,14 @@ class CronReconciler:
         beyond historyLimit (their history entries disappear with them —
         parity with ``cron_controller.go:307-346``). ``finished`` is stamped
         with the sync time, not read from job conditions (reference quirk,
-        kept so history output matches)."""
+        kept so history output matches) — but only ONCE per workload: the
+        committed entry's timestamp is preserved on later passes, so an
+        unchanged history is bit-stable and the no-op elision holds (the
+        old per-pass re-stamp made every steady-state sweep a status
+        write on any Cron with history)."""
+        prev_finished = {
+            h.uid: h.finished for h in cron.status.history if h.finished
+        }
         sort_by_creation_timestamp(terminated)
         n = len(terminated)
         limit = (
@@ -520,7 +565,9 @@ class CronReconciler:
                 created=parse_time(meta.get("creationTimestamp")),
             )
             if finished:
-                entry.finished = self.clock.now()
+                entry.finished = (
+                    prev_finished.get(entry.uid) or self.clock.now()
+                )
             history.append(entry)
         cron.status.history = history
 

@@ -164,7 +164,11 @@ class CronSchedule:
     """Compiled 5-field schedule; ``next(t)`` is the activation strictly after t."""
 
     __slots__ = ("minute", "hour", "dom", "month", "dow", "dom_star",
-                 "dow_star", "source")
+                 "dow_star", "source", "_next_memo")
+
+    # Bound on the per-schedule activation memo (see ``next``). Small on
+    # purpose: a sweep only ever probes a handful of distinct instants.
+    _NEXT_MEMO_MAX = 128
 
     def __init__(self, expr: str):
         fields = expr.split()
@@ -178,6 +182,7 @@ class CronSchedule:
         self.dom, self.dom_star = _parse_field(fields[2], 1, 31)
         self.month, _ = _parse_field(fields[3], 1, 12, MONTH_NAMES)
         self.dow, self.dow_star = _parse_field(fields[4], 0, 6, DOW_NAMES)
+        self._next_memo: dict = {}
 
     def _day_matches(self, t: datetime) -> bool:
         dom_ok = bool(self.dom & (1 << t.day))
@@ -191,6 +196,33 @@ class CronSchedule:
         return dom_ok or dow_ok  # both restricted → vixie OR rule
 
     def next(self, after: datetime) -> datetime:
+        """Memoized activation lookup.
+
+        ``next`` is a pure function of (compiled schedule, ``after``), and
+        compiled schedules are shared across Crons via
+        ``parse_standard_cached`` — so in a fleet where many Crons carry the
+        same expression, a same-tick sweep evaluates ``next`` for the same
+        handful of instants thousands of times. The memo turns those repeats
+        into one dict hit each. Reads/writes are single GIL-atomic dict ops,
+        so concurrent reconcile workers at worst duplicate a computation;
+        the map is cleared (not evicted) at a small cap since a sweep only
+        touches a few distinct keys.
+        """
+        # tzinfo is part of the key: aware datetimes with equal instants
+        # but different zones compare (and hash) equal, yet the scan walks
+        # *wall-clock* fields, so their activations differ.
+        key = (after, after.tzinfo)
+        memo = self._next_memo
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
+        result = self._next_scan(after)
+        if len(memo) >= self._NEXT_MEMO_MAX:
+            memo.clear()
+        memo[key] = result
+        return result
+
+    def _next_scan(self, after: datetime) -> datetime:
         # First candidate: the next whole minute strictly after `after`.
         # Within a matching day, the hour and minute are found by
         # bit-scanning the field masks (lowest set bit at/above the

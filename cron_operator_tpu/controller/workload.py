@@ -60,11 +60,14 @@ class WorkloadTemplateError(ValueError):
     """Raised when the Cron's workload template is missing or invalid."""
 
 
-def new_empty_workload(cron: Cron) -> Unstructured:
-    """Instantiate a fresh unstructured workload from the Cron's template.
+def validate_workload_template(cron: Cron) -> Unstructured:
+    """Validate the Cron's workload template and return it WITHOUT copying.
 
     Validation parity with ``newEmptyWorkload`` (``cron_util.go:40-56``):
     the template must be present, be an object, and carry a full GVK.
+    The returned object is ``cron.spec.template.workload`` itself — the
+    reconciler hot path reads it and copies only when instantiating a
+    tick (``Cron.from_dict`` already made it private to this Cron).
     """
     workload = cron.spec.template.workload
     if workload is None:
@@ -77,20 +80,23 @@ def new_empty_workload(cron: Cron) -> Unstructured:
             f"cron {cron.metadata.namespace}/{cron.metadata.name}: "
             "workload template is not an object"
         )
-    obj = copy.deepcopy(workload)
-    if gvk_of(obj) is None:
+    if gvk_of(workload) is None:
         raise WorkloadTemplateError(
             f"cron {cron.metadata.namespace}/{cron.metadata.name}: "
             "workload template has empty group/version/kind"
         )
-    return obj
+    return workload
+
+
+def new_empty_workload(cron: Cron) -> Unstructured:
+    """A fresh PRIVATE instantiation of the validated workload template."""
+    return copy.deepcopy(validate_workload_template(cron))
 
 
 def get_workload_gvk(cron: Cron) -> GVK:
     """GVK declared by the Cron's workload template (``cron_util.go:59-65``)."""
-    obj = new_empty_workload(cron)
-    gvk = gvk_of(obj)
-    assert gvk is not None  # validated by new_empty_workload
+    gvk = gvk_of(validate_workload_template(cron))
+    assert gvk is not None  # validated above
     return gvk
 
 
@@ -136,6 +142,7 @@ def sort_by_creation_timestamp(workloads: List[Unstructured]) -> None:
 
 __all__ = [
     "WorkloadTemplateError",
+    "validate_workload_template",
     "new_empty_workload",
     "get_workload_gvk",
     "get_default_job_name",

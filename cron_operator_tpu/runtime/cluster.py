@@ -413,7 +413,13 @@ class ClusterAPIServer:
 
     # ---- watches (informer analog) ----------------------------------------
 
-    def add_watcher(self, fn: Callable[[WatchEvent], None]) -> None:
+    def add_watcher(
+        self, fn: Callable[[WatchEvent], None], coalesce: bool = False
+    ) -> None:
+        # ``coalesce`` is accepted for APIServer signature parity; real
+        # watch streams deliver as the server sends them (client-side
+        # coalescing would have to buffer, trading latency for nothing —
+        # the workqueue already dedups by key).
         self._watchers.append(fn)
 
     def start_watches(

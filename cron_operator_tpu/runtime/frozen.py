@@ -99,20 +99,95 @@ def freeze(obj: Any) -> Any:
     partial updates cheap: a new committed version built from an old one
     shares every untouched subtree instead of copying it.
     """
+    t = obj.__class__
+    # Leaf fast path first: the vast majority of nodes in an
+    # unstructured tree are scalars, and the exact-type checks here are
+    # several times cheaper than falling through isinstance chains.
+    if t is str or t is int or t is float or t is bool or obj is None:
+        return obj
+    if t is FrozenDict or t is FrozenList:
+        return obj
+    if t is dict:
+        return FrozenDict({k: freeze(v) for k, v in obj.items()})
+    if t is list or t is tuple:
+        return FrozenList([freeze(v) for v in obj])
+    if isinstance(obj, dict):
+        return FrozenDict({k: freeze(v) for k, v in obj.items()})
+    if isinstance(obj, (list, tuple)):
+        return FrozenList([freeze(v) for v in obj])
+    return obj
+
+
+_MISSING = object()
+
+
+def freeze_delta(obj: Any, prev: Any) -> Any:
+    """Freeze ``obj`` while structurally sharing with ``prev``.
+
+    ``prev`` is the previously committed frozen version of the same
+    (sub)tree. Wherever the new value is semantically equal to the old
+    one, the OLD frozen subtree is returned by identity instead of a
+    fresh copy — so a status-only patch shares the entire ``spec``
+    subtree with the previous version, commit cost tracks the number of
+    *changed* keys, and downstream consumers (index maintenance, watch
+    coalescing, equality checks) can use ``is`` as a cheap
+    nothing-changed test.
+
+    Falls back to plain :func:`freeze` behavior when ``prev`` has a
+    different shape. Already-frozen inputs are returned as-is (they are
+    immutable and safe to share, same contract as ``freeze``).
+    """
     t = type(obj)
     if t is FrozenDict or t is FrozenList:
         return obj
     if isinstance(obj, dict):
-        return FrozenDict((k, freeze(v)) for k, v in obj.items())
+        if type(prev) is not FrozenDict:
+            return FrozenDict((k, freeze_delta(v, _MISSING))
+                              for k, v in obj.items())
+        shared = len(obj) == len(prev)
+        out = {}
+        for k, v in obj.items():
+            pv = dict.get(prev, k, _MISSING)
+            fv = freeze_delta(v, pv)
+            out[k] = fv
+            if shared and fv is not pv and not _scalar_equal(fv, pv):
+                shared = False
+        return prev if shared else FrozenDict(out)
     if isinstance(obj, (list, tuple)):
-        return FrozenList(freeze(v) for v in obj)
+        if type(prev) is not FrozenList:
+            return FrozenList(freeze_delta(v, _MISSING) for v in obj)
+        shared = len(obj) == len(prev)
+        out = []
+        for i, v in enumerate(obj):
+            pv = list.__getitem__(prev, i) if i < len(prev) else _MISSING
+            fv = freeze_delta(v, pv)
+            out.append(fv)
+            if shared and fv is not pv and not _scalar_equal(fv, pv):
+                shared = False
+        return prev if shared else FrozenList(out)
     return obj
+
+
+def _scalar_equal(a: Any, b: Any) -> bool:
+    """Equality for the sharing decision on leaf values only — containers
+    must have been shared by identity already (a rebuilt-but-equal
+    container means its children were rebuilt too, so sharing the parent
+    would discard the new tree for no savings). Type-checked so 1/True
+    and 1/1.0 don't alias."""
+    return (
+        not isinstance(a, (dict, list))
+        and type(a) is type(b)
+        and a == b
+    )
 
 
 def thaw(obj: Any) -> Any:
     """Deep-copy a (possibly frozen) JSON-ish tree into plain mutable
     dicts/lists — the escape hatch for callers that need to edit a
     snapshot. Scalars are shared (they are immutable)."""
+    t = obj.__class__
+    if t is str or t is int or t is float or t is bool or obj is None:
+        return obj
     if isinstance(obj, dict):
         return {k: thaw(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -120,4 +195,4 @@ def thaw(obj: Any) -> Any:
     return obj
 
 
-__all__ = ["FrozenDict", "FrozenList", "freeze", "thaw"]
+__all__ = ["FrozenDict", "FrozenList", "freeze", "freeze_delta", "thaw"]

@@ -17,6 +17,7 @@ import heapq
 import itertools
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Generic, Hashable, List, Optional, Tuple, TypeVar
 
 T = TypeVar("T", bound=Hashable)
@@ -62,7 +63,9 @@ class WorkQueue(Generic[T]):
         # waking the delay loop instead would strand the added item until
         # the next notify.
         self._delay_cond = threading.Condition(lock)
-        self._queue: List[T] = []
+        # deque: a same-tick fire storm enqueues thousands of items at
+        # once, and list.pop(0) would make draining them O(n²).
+        self._queue: "deque[T]" = deque()
         self._dirty: set = set()
         self._processing: set = set()
         self._shutdown = False
@@ -77,6 +80,9 @@ class WorkQueue(Generic[T]):
         # Optional metrics wiring (see instrument()).
         self._metrics = None
         self._metrics_name = ""
+        self._s_depth = 'workqueue_depth{name=""}'
+        self._s_adds = 'workqueue_adds_total{name=""}'
+        self._s_qdur = 'workqueue_queue_duration_seconds{name=""}'
         self._queue_buckets: tuple = _QUEUE_DURATION_BUCKETS
         self._added_at: Dict[T, float] = {}
 
@@ -91,6 +97,13 @@ class WorkQueue(Generic[T]):
         with self._cond:
             self._metrics = metrics
             self._metrics_name = name
+            # Series names are interned once here — the add/get hot path
+            # must not rebuild label strings per call.
+            self._s_depth = f'workqueue_depth{{name="{name}"}}'
+            self._s_adds = f'workqueue_adds_total{{name="{name}"}}'
+            self._s_qdur = (
+                f'workqueue_queue_duration_seconds{{name="{name}"}}'
+            )
             if buckets is not None:
                 self._queue_buckets = tuple(buckets)
             self._record_depth()
@@ -99,17 +112,12 @@ class WorkQueue(Generic[T]):
         # Called with self._cond held; Metrics has its own lock and never
         # calls back into the queue, so the ordering is deadlock-free.
         if self._metrics is not None:
-            self._metrics.set(
-                f'workqueue_depth{{name="{self._metrics_name}"}}',
-                float(len(self._queue)),
-            )
+            self._metrics.set(self._s_depth, float(len(self._queue)))
 
     def _record_enqueue(self, item: T) -> None:
         self._added_at.setdefault(item, time.monotonic())
         if self._metrics is not None:
-            self._metrics.inc(
-                f'workqueue_adds_total{{name="{self._metrics_name}"}}'
-            )
+            self._metrics.inc(self._s_adds)
             self._record_depth()
 
     # ---- core add/get/done ------------------------------------------------
@@ -138,15 +146,14 @@ class WorkQueue(Generic[T]):
                 self._cond.wait(remaining)
             if self._shutdown and not self._queue:
                 return None
-            item = self._queue.pop(0)
+            item = self._queue.popleft()
             self._processing.add(item)
             self._dirty.discard(item)
             enqueued = self._added_at.pop(item, None)
             if self._metrics is not None:
                 if enqueued is not None:
                     self._metrics.observe(
-                        'workqueue_queue_duration_seconds'
-                        f'{{name="{self._metrics_name}"}}',
+                        self._s_qdur,
                         time.monotonic() - enqueued,
                         buckets=self._queue_buckets,
                     )
@@ -174,10 +181,16 @@ class WorkQueue(Generic[T]):
         with self._cond:
             if self._shutdown:
                 return
-            heapq.heappush(
-                self._delayed, (time.monotonic() + delay_s, next(self._seq), item)
-            )
-            self._delay_cond.notify()
+            entry = (time.monotonic() + delay_s, next(self._seq), item)
+            heapq.heappush(self._delayed, entry)
+            # Wake the delay thread only when this entry becomes the new
+            # earliest deadline (or the heap was empty — same check: the
+            # pushed entry is at the root). A same-tick storm schedules
+            # thousands of far-future requeues; waking the delay thread
+            # for each one is a pointless context switch per reconcile,
+            # since its current timed wait already covers a later entry.
+            if self._delayed[0] is entry:
+                self._delay_cond.notify()
 
     def add_rate_limited(self, item: T) -> None:
         self.add_after(item, self.rate_limiter.when(item))

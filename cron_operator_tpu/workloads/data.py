@@ -269,15 +269,25 @@ class Prefetcher:
         return item
 
     def close(self) -> None:
+        import logging as _logging
+        import queue as _queue
+
         self._stop.set()
         self._finished = True
-        # Unblock a producer parked on a full queue.
+        # Unblock a producer parked on a full queue. Only Empty ends the
+        # drain — anything else is a real bug and must surface, not be
+        # swallowed into a silent thread leak.
         try:
             while True:
                 self._q.get_nowait()
-        except Exception:
+        except _queue.Empty:
             pass
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            _logging.getLogger("workloads.data").warning(
+                "prefetch producer thread still alive 5s after close(); "
+                "a place()/generator call is blocked — leaking the thread"
+            )
 
 
 __all__ = ["mnist_batches", "imagenet_batches", "token_batches",

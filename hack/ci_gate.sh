@@ -44,6 +44,21 @@ _golden full.yaml --set metrics.serviceMonitor.enable=true \
 echo "==> chart README in sync (helm-docs analog)"
 python hack/chart_docs.py --check
 
+echo "==> control-plane write-path smoke (fire storm + zero-write steady state)"
+# Small-N run of the real bench harness: catches a wedged fire storm or a
+# reappearing steady-state store write long before the full 1k/5k bench.
+python hack/controlplane_bench.py --sizes 200 --sweep-timeout 120 --stdout \
+    | python -c '
+import json, sys
+r = json.loads(sys.stdin.readlines()[-1])["results"][0]
+assert not r["fire_storm_timed_out"], r
+assert r["fire_storm_workloads_created"] == 200, r
+assert r["list_reconcile_store_writes"] == 0, (
+    "steady-state sweep wrote to the store: %r" % r)
+print("    storm %s Crons/s; steady-state store writes: 0"
+      % r["fire_storm_crons_per_s"])
+'
+
 echo "==> unit + integration tests"
 # With pytest-cov installed (CI always; optional locally) the suite runs
 # under coverage and hack/ci_gate enforces the pyproject fail_under

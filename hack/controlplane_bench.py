@@ -16,12 +16,22 @@ on a ``FakeClock`` — not a stripped-down reconcile loop:
   Crons against the now-populated store (every reconcile lists its
   children, recomputes the schedule, syncs status). This is the
   steady-state hot loop the indexes and schedule cache target, and the
-  headline throughput number.
+  headline throughput number. The sweep also reports how many store
+  commits it performed — with no-op status elision the target is zero,
+- fire storm: the worst-case tick — every Cron in the fleet shares one
+  schedule and fires on the SAME minute (its own APIServer+Manager
+  stack, best of 2 runs). Crons/s from first enqueue to last workload
+  create; this is the write-path headline,
+- write-path microbench: mean µs per ``update`` / ``patch_status`` /
+  no-op ``patch_status`` / ``create`` against the populated store,
+  measured with the manager stopped so only the store is on the clock.
 
 Emits a JSON artifact. ``--baseline-ref <git-ref>`` additionally runs the
 same measurement against a detached worktree of that ref (the script only
-touches APIs present on both sides) and reports before/after speedups —
-how the committed BENCH_CONTROLPLANE.json numbers were produced.
+touches APIs present on both sides), reports before/after speedups, and
+prints a one-line OK/REGRESSION verdict over the headline metrics;
+``--check`` exits non-zero when that verdict is REGRESSION — how the
+committed BENCH_CONTROLPLANE.json numbers were produced and gated.
 """
 
 from __future__ import annotations
@@ -83,6 +93,15 @@ def _cron(i: int) -> dict:
     }
 
 
+def _storm_cron(i: int) -> dict:
+    """Same-tick variant: every Cron shares one schedule, so one clock
+    advance makes the ENTIRE fleet due at once — the thundering-herd
+    write storm the structural-sharing commit path targets."""
+    c = _cron(i)
+    c["spec"]["schedule"] = "0 * * * *"
+    return c
+
+
 def _hist_percentile(h, q: float):
     """Percentile upper bound from cumulative histogram buckets."""
     if not h or not h["count"]:
@@ -102,6 +121,162 @@ def _time_calls(fn, repeat: int) -> float:
     for _ in range(repeat):
         fn()
     return (time.perf_counter() - t0) / repeat * 1e6
+
+
+def _storm_once(n_crons: int, sweep_timeout_s: float, workers: int) -> dict:
+    """One same-tick fire storm on a fresh stack: populate N identical-
+    schedule Crons, advance the clock past their shared activation, and
+    time from manager start to the last workload create."""
+    import threading
+    from datetime import timedelta
+    from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+    from cron_operator_tpu.controller import CronReconciler
+    from cron_operator_tpu.runtime import APIServer, Manager
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    api = APIServer(clock=clock)
+    for i in range(n_crons):
+        api.create(_storm_cron(i))
+
+    created = threading.Semaphore(0)
+
+    def _count(ev):
+        if ev.type == "ADDED" and ev.object.get("kind") == WORKLOAD_KIND:
+            created.release()
+
+    api.add_watcher(_count)
+
+    mgr = Manager(api, max_concurrent_reconciles=workers)
+    rec = CronReconciler(api, metrics=mgr.metrics)
+    mgr.add_controller(
+        "cron", rec.reconcile, for_gvk=GVK_CRON,
+        owns=default_scheme().workload_kinds(),
+    )
+    clock.advance(timedelta(minutes=61))
+
+    # GC hygiene for the timed window: a cyclic-GC pass during the storm
+    # scans every object the earlier (bigger) suite runs left behind and
+    # can cost 20%+ of the measurement. Collect up front, then keep the
+    # collector out of the storm — identical discipline on every tree.
+    import gc
+
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        mgr.start()
+        deadline = t0 + sweep_timeout_s
+        done = 0
+        while done < n_crons and time.perf_counter() < deadline:
+            if created.acquire(
+                timeout=min(1.0, deadline - time.perf_counter())
+            ):
+                done += 1
+        storm_s = time.perf_counter() - t0
+    finally:
+        gc.enable()
+    reconciles = mgr.metrics.get(SUCCESS_SERIES)
+    mgr.stop()
+    api.close()
+    return {
+        "fire_storm_s": round(storm_s, 3),
+        "fire_storm_timed_out": done < n_crons,
+        "fire_storm_workloads_created": done,
+        "fire_storm_crons_per_s": (
+            round(done / storm_s, 1) if storm_s else 0.0
+        ),
+        "fire_storm_reconciles_at_done": reconciles,
+    }
+
+
+def storm_best_of(
+    n_crons: int, sweep_timeout_s: float, workers: int = 1, reps: int = 2
+) -> dict:
+    """Best of ``reps`` storms (throughput benches conventionally report
+    the least-interfered-with run, cf. ``timeit``'s min-of-repeats).
+
+    ``workers`` defaults to 1: the storm is pure CPU against an
+    in-process store, so extra workers only add GIL contention — more
+    wall-clock AND more run-to-run noise on every tree measured. The
+    parallel-worker configuration is still covered by the mixed-schedule
+    fire sweep above (workers=10).
+    """
+    best = None
+    for _ in range(reps):
+        r = _storm_once(n_crons, sweep_timeout_s, workers)
+        if best is None or (
+            r["fire_storm_crons_per_s"] > best["fire_storm_crons_per_s"]
+        ):
+            best = r
+    best["fire_storm_workers"] = workers
+    best["fire_storm_reps"] = reps
+    return best
+
+
+def _write_microbench(api, repeat: int = 200) -> dict:
+    """Mean µs per store write verb against the populated store. Run with
+    the manager STOPPED so the numbers isolate the commit path (on trees
+    without generation-predicate filtering, a running manager would
+    react to every metadata touch and pollute the timing with reconcile
+    work)."""
+    import copy
+
+    def _update_once():
+        obj = copy.deepcopy(
+            api.try_get(CRON_API_VERSION, "Cron", "default", "bench-0")
+        )
+        labels = obj["metadata"].setdefault("labels", {})
+        labels["bench-touch"] = obj["metadata"]["resourceVersion"]
+        api.update(obj)
+
+    update_us = _time_calls(_update_once, repeat)
+
+    seq = [0]
+
+    def _patch_changed():
+        seq[0] += 1
+        api.patch_status(
+            CRON_API_VERSION, "Cron", "default", "bench-1",
+            {"benchSeq": str(seq[0])},
+        )
+
+    patch_us = _time_calls(_patch_changed, repeat)
+
+    # Same status every time: with no-op elision this never commits.
+    noop_status = {"benchSeq": "steady"}
+
+    def _patch_noop():
+        api.patch_status(
+            CRON_API_VERSION, "Cron", "default", "bench-2",
+            dict(noop_status),
+        )
+
+    _patch_noop()  # seed so every timed call is a true no-op
+    noop_us = _time_calls(_patch_noop, repeat)
+
+    mk = [0]
+
+    def _create_once():
+        mk[0] += 1
+        api.create({
+            "apiVersion": WORKLOAD_API_VERSION,
+            "kind": WORKLOAD_KIND,
+            "metadata": {
+                "name": f"mb-{mk[0]}", "namespace": "default",
+                "labels": {LABEL_CRON_NAME: "bench-0"},
+            },
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+        })
+
+    create_us = _time_calls(_create_once, repeat)
+
+    return {
+        "update_us": round(update_us, 1),
+        "patch_status_us": round(patch_us, 1),
+        "noop_patch_status_us": round(noop_us, 1),
+        "create_us": round(create_us, 1),
+    }
 
 
 def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
@@ -171,17 +346,35 @@ def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
 
     # The headline: a full list+reconcile pass over every Cron with no
     # tick due — each reconcile lists its child workloads, recomputes
-    # the schedule and syncs status against the populated store.
+    # the schedule and syncs status against the populated store. The
+    # resourceVersion counter brackets the sweep: every commit bumps it
+    # exactly once, so the delta IS the sweep's store-write count (and
+    # with no-op status elision it must be zero). A short settle first
+    # lets in-flight manager writes from the fire sweep drain so they
+    # don't land inside the bracket.
+    time.sleep(0.5)
+    rv_before = getattr(api, "_rv", None)
     t0 = time.perf_counter()
     for i in range(n_crons):
         rec.reconcile("default", f"bench-{i}")
     list_reconcile_s = time.perf_counter() - t0
+    rv_after = getattr(api, "_rv", None)
+    sweep_writes = (
+        rv_after - rv_before
+        if rv_before is not None and rv_after is not None else None
+    )
 
     hist = mgr.metrics.histogram(RECONCILE_HIST)
     mgr.stop()
+    write_us = _write_microbench(api)
     api.close()
 
+    storm = storm_best_of(n_crons, sweep_timeout_s)
+
     return {
+        **write_us,
+        **storm,
+        "list_reconcile_store_writes": sweep_writes,
         "n_crons": n_crons,
         "populate_objects_per_s": round(n_crons / populate_s, 1),
         "cron_list_us": round(cron_list_us, 1),
@@ -205,10 +398,15 @@ def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
 
 def _git_ref(tree: str) -> str:
     try:
-        return subprocess.run(
+        ref = subprocess.run(
             ["git", "-C", tree, "rev-parse", "--short", "HEAD"],
             capture_output=True, text=True, check=True,
         ).stdout.strip()
+        porcelain = subprocess.run(
+            ["git", "-C", tree, "status", "--porcelain"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        return f"{ref}-dirty" if porcelain else ref
     except Exception:
         return "unknown"
 
@@ -269,12 +467,43 @@ def _speedups(before: dict, after: dict) -> list:
             "list_reconcile_sweep_per_s": ratio(
                 "list_reconcile_sweep_per_s"),
             "fire_sweep_crons_per_s": ratio("fire_sweep_crons_per_s"),
+            "fire_storm_crons_per_s": ratio("fire_storm_crons_per_s"),
             "cron_list_us": ratio("cron_list_us", invert=True),
             "workload_label_list_us": ratio(
                 "workload_label_list_us", invert=True),
             "populate_objects_per_s": ratio("populate_objects_per_s"),
+            "update_us": ratio("update_us", invert=True),
+            "patch_status_us": ratio("patch_status_us", invert=True),
+            "noop_patch_status_us": ratio(
+                "noop_patch_status_us", invert=True),
+            "create_us": ratio("create_us", invert=True),
         })
     return out
+
+
+# The metrics the OK/REGRESSION verdict (and ``--check``) gates on: the
+# steady-state headline and the write-path headline.
+HEADLINE_METRICS = ("list_reconcile_sweep_per_s", "fire_storm_crons_per_s")
+
+
+def _verdict(speedups: list) -> dict:
+    """One-line regression verdict over the headline speedups."""
+    parts = []
+    worst = None
+    for s in speedups:
+        for key in HEADLINE_METRICS:
+            r = s.get(key)
+            if r is None:
+                continue
+            parts.append(f"{key}@{s['n_crons']}={r}x")
+            if worst is None or r < worst:
+                worst = r
+    status = "OK" if worst is not None and worst >= 1.0 else "REGRESSION"
+    if worst is None:
+        summary = "REGRESSION: no comparable headline metrics"
+    else:
+        summary = f"{status}: worst headline speedup {worst}x ({', '.join(parts)})"
+    return {"status": status, "worst_speedup": worst, "summary": summary}
 
 
 def main() -> int:
@@ -288,18 +517,27 @@ def main() -> int:
     p.add_argument("--sweep-timeout", type=float, default=900.0)
     p.add_argument("--stdout", action="store_true",
                    help="print the artifact JSON to stdout only")
+    p.add_argument("--check", action="store_true",
+                   help="with --baseline-ref: exit non-zero when any "
+                        "headline metric regressed")
     args = p.parse_args()
+    if args.check and not args.baseline_ref:
+        p.error("--check requires --baseline-ref")
     sizes = [int(s) for s in args.sizes.split(",") if s]
 
     after = run_suite(sizes, args.sweep_timeout)
     artifact = after
+    verdict = None
     if args.baseline_ref:
         before = _run_baseline(args.baseline_ref, sizes, args.sweep_timeout)
+        speedup = _speedups(before, after)
+        verdict = _verdict(speedup)
         artifact = {
             "schema": "controlplane-bench-compare/v1",
             "before": before,
             "after": after,
-            "speedup": _speedups(before, after),
+            "speedup": speedup,
+            "verdict": verdict,
         }
 
     text = json.dumps(artifact, indent=2, sort_keys=True)
@@ -310,6 +548,10 @@ def main() -> int:
             f.write(text + "\n")
         print(text)
         print(f"\nwrote {args.out}", file=sys.stderr)
+    if verdict is not None:
+        print(verdict["summary"], file=sys.stderr)
+        if args.check and verdict["status"] != "OK":
+            return 2
     return 0
 
 

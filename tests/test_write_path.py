@@ -1,0 +1,439 @@
+"""Write-path hot loop: structural-sharing commits, no-op status
+elision, generation semantics, coalesced watch fan-out, and the
+zero-write steady-state guarantee under a live Manager.
+
+Companion to tests/test_kube_store.py (which pins the store's base
+semantics — rv monotonicity, conflict detection, snapshot isolation);
+this file pins the *performance contracts* the fire-storm bench
+(hack/controlplane_bench.py) relies on.
+"""
+
+from __future__ import annotations
+
+import threading
+from datetime import timedelta
+
+from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+from cron_operator_tpu.controller import CronReconciler
+from cron_operator_tpu.runtime import APIServer, Manager
+from cron_operator_tpu.runtime.frozen import (
+    FrozenDict,
+    FrozenList,
+    freeze,
+    freeze_delta,
+)
+from cron_operator_tpu.utils.clock import FakeClock
+
+CRON_API = "apps.kubedl.io/v1alpha1"
+WL_API = "kubeflow.org/v1"
+WL_KIND = "JAXJob"
+LABEL_CRON_NAME = "kubedl.io/cron-name"
+
+COMMIT_VERBS = ("create", "update", "patch_status", "delete")
+
+
+def _commits(metrics) -> float:
+    return sum(
+        metrics.get(f'apiserver_commits_total{{verb="{v}"}}') or 0.0
+        for v in COMMIT_VERBS
+    )
+
+
+def _cron(name: str, schedule: str = "0 * * * *") -> dict:
+    return {
+        "apiVersion": CRON_API,
+        "kind": "Cron",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "schedule": schedule,
+            "concurrencyPolicy": "Allow",
+            "template": {"workload": {
+                "apiVersion": WL_API,
+                "kind": WL_KIND,
+                "metadata": {},
+                "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+            }},
+        },
+    }
+
+
+class TestFreezeDelta:
+    """Structural sharing: unchanged subtrees are the PREVIOUS frozen
+    objects by identity, making ``is`` a free nothing-changed test."""
+
+    def test_identical_tree_returns_prev_by_identity(self):
+        prev = freeze({"spec": {"a": [1, 2]}, "status": {"x": "y"}})
+        assert freeze_delta({"spec": {"a": [1, 2]}, "status": {"x": "y"}},
+                            prev) is prev
+
+    def test_status_only_change_shares_spec_subtree(self):
+        prev = freeze({"spec": {"deep": {"tree": [1, 2, 3]}},
+                       "status": {"n": 1}})
+        new = freeze_delta({"spec": {"deep": {"tree": [1, 2, 3]}},
+                            "status": {"n": 2}}, prev)
+        assert new is not prev
+        assert new["spec"] is prev["spec"]
+        assert new["status"] is not prev["status"]
+
+    def test_changed_list_rebuilt_unchanged_sibling_shared(self):
+        prev = freeze({"a": [1, 2], "b": [3, 4]})
+        new = freeze_delta({"a": [1, 2], "b": [3, 5]}, prev)
+        assert new["a"] is prev["a"]
+        assert new["b"] is not prev["b"]
+        assert isinstance(new["b"], FrozenList)
+
+    def test_scalar_type_change_not_shared(self):
+        # 1 == True but they are different values to a serializer.
+        prev = freeze({"a": True})
+        new = freeze_delta({"a": 1}, prev)
+        assert new is not prev
+        assert new["a"] is not prev["a"]
+
+    def test_result_is_deeply_frozen(self):
+        new = freeze_delta({"a": {"b": [1]}}, None)
+        assert isinstance(new, FrozenDict)
+        assert isinstance(new["a"], FrozenDict)
+        assert isinstance(new["a"]["b"], FrozenList)
+
+
+class TestGenerationSemantics:
+    """metadata.generation follows kube semantics: 1 at create, bumped
+    only by spec changes — the hook GenerationChangedPredicate-style
+    event filtering needs."""
+
+    def setup_method(self):
+        self.api = APIServer(clock=FakeClock())
+
+    def teardown_method(self):
+        self.api.close()
+
+    def test_create_sets_generation_1(self):
+        got = self.api.create(_cron("g1"))
+        assert got["metadata"]["generation"] == 1
+
+    def test_spec_change_bumps_generation(self):
+        import copy
+
+        self.api.create(_cron("g2"))
+        cur = copy.deepcopy(
+            self.api.get(CRON_API, "Cron", "default", "g2"))
+        cur["spec"]["schedule"] = "5 * * * *"
+        got = self.api.update(cur)
+        assert got["metadata"]["generation"] == 2
+
+    def test_metadata_only_change_keeps_generation(self):
+        import copy
+
+        self.api.create(_cron("g3"))
+        cur = copy.deepcopy(
+            self.api.get(CRON_API, "Cron", "default", "g3"))
+        cur["metadata"]["labels"] = {"touched": "yes"}
+        got = self.api.update(cur)
+        assert got["metadata"]["generation"] == 1
+        # status patches never move it either
+        self.api.patch_status(
+            CRON_API, "Cron", "default", "g3", {"n": "1"})
+        after = self.api.get(CRON_API, "Cron", "default", "g3")
+        assert after["metadata"]["generation"] == 1
+
+
+class TestNoopStatusElision:
+    def setup_method(self):
+        self.api = APIServer(clock=FakeClock())
+
+    def teardown_method(self):
+        self.api.close()
+
+    def test_identical_status_patch_is_a_no_write(self):
+        self.api.create(_cron("s1"))
+        first = self.api.patch_status(
+            CRON_API, "Cron", "default", "s1", {"active": [], "n": "1"})
+        rv = first["metadata"]["resourceVersion"]
+
+        events = []
+        self.api.add_watcher(events.append)
+        again = self.api.patch_status(
+            CRON_API, "Cron", "default", "s1", {"active": [], "n": "1"})
+        self.api.flush()
+        # same committed snapshot back, rv untouched, no watch event
+        assert again is first
+        assert again["metadata"]["resourceVersion"] == rv
+        assert events == []
+
+    def test_changed_status_still_commits(self):
+        self.api.create(_cron("s2"))
+        a = self.api.patch_status(
+            CRON_API, "Cron", "default", "s2", {"n": "1"})
+        b = self.api.patch_status(
+            CRON_API, "Cron", "default", "s2", {"n": "2"})
+        assert (int(b["metadata"]["resourceVersion"])
+                > int(a["metadata"]["resourceVersion"]))
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def __call__(self, ev):
+        self.events.append(ev)
+
+    def types_for(self, name):
+        return [
+            e.type for e in self.events
+            if e.object["metadata"]["name"] == name
+        ]
+
+
+class TestWatchDelivery:
+    """Delivery contracts on both sides of the coalesce flag."""
+
+    def setup_method(self):
+        self.api = APIServer(clock=FakeClock())
+
+    def teardown_method(self):
+        self.api.close()
+
+    def _update(self, name, schedule):
+        import copy
+
+        cur = copy.deepcopy(self.api.get(CRON_API, "Cron", "default", name))
+        cur["spec"]["schedule"] = schedule
+        self.api.update(cur)
+
+    def test_plain_subscriber_sees_every_event_in_order(self):
+        rec = _Recorder()
+        self.api.add_watcher(rec)
+        self.api.create(_cron("w1"))
+        for m in (1, 2, 3):
+            self._update("w1", f"{m} * * * *")
+        self.api.delete(CRON_API, "Cron", "default", "w1")
+        self.api.flush()
+        assert rec.types_for("w1") == [
+            "ADDED", "MODIFIED", "MODIFIED", "MODIFIED", "DELETED"]
+        rvs = [int(e.object["metadata"]["resourceVersion"])
+               for e in rec.events]
+        assert rvs == sorted(rvs)
+
+    def _plugged(self):
+        """Block the dispatcher inside a sacrificial delivery so every
+        event published while plugged lands in ONE drained batch."""
+        in_plug = threading.Event()
+        release = threading.Event()
+
+        def plug_watcher(ev):
+            if ev.object["metadata"]["name"] == "plug":
+                in_plug.set()
+                release.wait(5)
+
+        self.api.add_watcher(plug_watcher)
+        return in_plug, release
+
+    def test_coalescing_subscriber_gets_latest_wins_modifieds(self):
+        in_plug, release = self._plugged()
+        plain = _Recorder()
+        coal = _Recorder()
+        self.api.add_watcher(plain)
+        self.api.add_watcher(coal, coalesce=True)
+
+        self.api.create(_cron("plug"))
+        assert in_plug.wait(5)
+        # Dispatcher is now stuck: a MODIFIED flap queues up behind it.
+        self.api.create(_cron("w2"))
+        for m in (1, 2, 3):
+            self._update("w2", f"{m} * * * *")
+        release.set()
+        self.api.flush()
+
+        # Strict subscriber: the full flap, in order.
+        assert plain.types_for("w2") == [
+            "ADDED", "MODIFIED", "MODIFIED", "MODIFIED"]
+        # Coalescing subscriber: ADDED plus only the NEWEST modified.
+        assert coal.types_for("w2") == ["ADDED", "MODIFIED"]
+        mods = [e for e in coal.events
+                if e.type == "MODIFIED"
+                and e.object["metadata"]["name"] == "w2"]
+        assert mods[0].object["spec"]["schedule"] == "3 * * * *"
+
+    def test_added_and_deleted_never_elided(self):
+        in_plug, release = self._plugged()
+        coal = _Recorder()
+        self.api.add_watcher(coal, coalesce=True)
+
+        self.api.create(_cron("plug"))
+        assert in_plug.wait(5)
+        self.api.create(_cron("w3"))
+        self._update("w3", "7 * * * *")
+        self._update("w3", "8 * * * *")
+        self.api.delete(CRON_API, "Cron", "default", "w3")
+        release.set()
+        self.api.flush()
+
+        # First MODIFIED coalesced into the second; lifecycle edges kept.
+        assert coal.types_for("w3") == ["ADDED", "MODIFIED", "DELETED"]
+
+    def test_coalesced_deliveries_are_counted(self):
+        class _Metrics:
+            def __init__(self):
+                self.values = {}
+
+            def inc(self, series, amount=1.0):
+                self.values[series] = self.values.get(series, 0.0) + amount
+
+        metrics = _Metrics()
+        self.api.instrument(metrics)
+        in_plug, release = self._plugged()
+        self.api.add_watcher(_Recorder(), coalesce=True)
+
+        self.api.create(_cron("plug"))
+        assert in_plug.wait(5)
+        self.api.create(_cron("w4"))
+        for m in (1, 2, 3):
+            self._update("w4", f"{m} * * * *")
+        release.set()
+        self.api.flush()
+        assert metrics.values.get("watch_events_coalesced_total") == 2.0
+
+
+class TestSteadyStateZeroWrites:
+    """The tentpole guarantee, end to end on the REAL stack: once a fired
+    fleet has converged, a full list+reconcile sweep performs ZERO store
+    writes — no rv movement, no commits counted."""
+
+    def test_converged_sweep_commits_nothing(self):
+        n = 20
+        clock = FakeClock()
+        api = APIServer(clock=clock)
+        for i in range(n):
+            api.create(_cron(f"steady-{i}"))
+
+        created = threading.Semaphore(0)
+
+        def count(ev):
+            if ev.type == "ADDED" and ev.object.get("kind") == WL_KIND:
+                created.release()
+
+        api.add_watcher(count)
+        mgr = Manager(api, max_concurrent_reconciles=2)
+        rec = CronReconciler(api, metrics=mgr.metrics)
+        mgr.add_controller(
+            "cron", rec.reconcile, for_gvk=GVK_CRON,
+            owns=default_scheme().workload_kinds(),
+        )
+        clock.advance(timedelta(minutes=61))
+        mgr.start()
+        try:
+            for _ in range(n):
+                assert created.acquire(timeout=10), "storm did not finish"
+            # Quiesce: wait until the rv counter stops moving (manager
+            # workers may still be flushing trailing status patches).
+            import time as _time
+
+            last = None
+            for _ in range(100):
+                cur = api._rv
+                if cur == last:
+                    break
+                last = cur
+                _time.sleep(0.05)
+
+            rv_before = api._rv
+            commits_before = _commits(mgr.metrics)
+            for i in range(n):
+                rec.reconcile("default", f"steady-{i}")
+            assert api._rv == rv_before
+            assert _commits(mgr.metrics) == commits_before
+        finally:
+            mgr.stop()
+            api.close()
+
+
+class TestListWorkloadsDedup:
+    """A child that is both owner-referenced and label-matched must be
+    listed exactly once (it used to be double-counted into
+    status.active when the uid was absent)."""
+
+    def setup_method(self):
+        self.api = APIServer(clock=FakeClock())
+
+    def teardown_method(self):
+        self.api.close()
+
+    def _reconciler(self):
+        return CronReconciler(self.api)
+
+    def test_owner_and_label_overlap_listed_once(self):
+        from cron_operator_tpu.api.v1alpha1 import Cron
+
+        committed = self.api.create(_cron("d1"))
+        cron = Cron.from_dict(committed)
+        self.api.create({
+            "apiVersion": WL_API,
+            "kind": WL_KIND,
+            "metadata": {
+                "name": "d1-child",
+                "namespace": "default",
+                "labels": {LABEL_CRON_NAME: "d1"},
+                "ownerReferences": [{
+                    "apiVersion": CRON_API, "kind": "Cron",
+                    "name": "d1", "uid": committed["metadata"]["uid"],
+                    "controller": True,
+                }],
+            },
+            "spec": {},
+        })
+        rec = self._reconciler()
+        from cron_operator_tpu.api.scheme import GVK
+
+        got = rec._list_workloads(cron, GVK("kubeflow.org", "v1", WL_KIND))
+        assert len(got) == 1
+
+    def test_uid_less_objects_deduped_by_ns_name(self):
+        """Even when snapshots carry no uid at all, (namespace, name)
+        collapses duplicates across the two result sets."""
+        from cron_operator_tpu.api.scheme import GVK
+        from cron_operator_tpu.api.v1alpha1 import Cron
+
+        committed = self.api.create(_cron("d2"))
+        cron = Cron.from_dict(committed)
+        self.api.create({
+            "apiVersion": WL_API,
+            "kind": WL_KIND,
+            "metadata": {
+                "name": "d2-child",
+                "namespace": "default",
+                "labels": {LABEL_CRON_NAME: "d2"},
+            },
+            "spec": {},
+        })
+        rec = self._reconciler()
+
+        # Simulate a store whose owner index ALSO returns the labeled
+        # child (snapshots without uid): dedup must still hold.
+        labeled = self.api.list(
+            WL_API, WL_KIND, namespace="default",
+            label_selector={LABEL_CRON_NAME: "d2"},
+        )
+        stripped = []
+        for w in labeled:
+            import copy
+
+            w = copy.deepcopy(w)
+            w["metadata"].pop("uid", None)
+            stripped.append(w)
+        rec.api = _OwnerIndexStub(self.api, stripped)
+        got = rec._list_workloads(cron, GVK("kubeflow.org", "v1", WL_KIND))
+        assert len(got) == 1
+
+
+class _OwnerIndexStub:
+    """Pass-through to a real APIServer, with a canned dependents()."""
+
+    def __init__(self, api, owned):
+        self._api = api
+        self._owned = owned
+
+    def dependents(self, owner_uid, namespace=None):  # noqa: ARG002
+        return list(self._owned)
+
+    def __getattr__(self, name):
+        return getattr(self._api, name)
