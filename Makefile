@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane chaos-soak
 
 all: gate
 
@@ -42,3 +42,15 @@ bench:
 # regression fail the target.
 bench-controlplane:
 	python hack/controlplane_bench.py $(if $(BASELINE),--baseline-ref $(BASELINE)) $(if $(CHECK),--check)
+
+# Seeded chaos soak: N Crons reconciled under a deterministic fault
+# schedule (conflicts, transient server errors, latency, submit
+# failures, watch breaks, leader revocations, slice-preemption storms),
+# then replayed fault-free from the same seed. Asserts the five
+# invariants documented in README "Fault tolerance & chaos testing" and
+# writes CHAOS.json. SEED=<n> reproduces a run exactly; N= / ROUNDS=
+# scale it.
+chaos-soak:
+	python hack/chaos_soak.py --seed $(or $(SEED),0) \
+	    --crons $(or $(N),200) --rounds $(or $(ROUNDS),6) \
+	    --out CHAOS.json

@@ -37,7 +37,14 @@ from cron_operator_tpu.backends.registry import (
 )
 from cron_operator_tpu.backends.tpu import inject_tpu_topology
 from cron_operator_tpu.controller.schedule import parse_go_duration
-from cron_operator_tpu.runtime.kube import APIServer, NotFoundError, WatchEvent
+from cron_operator_tpu.runtime.kube import (
+    AlreadyExistsError,
+    ApiError,
+    APIServer,
+    NotFoundError,
+    WatchEvent,
+)
+from cron_operator_tpu.runtime.retry import with_conflict_retry
 from cron_operator_tpu.runtime.manager import PHASE_BUCKETS
 from cron_operator_tpu.telemetry import ANNOTATION_TRACE_ID
 
@@ -479,11 +486,12 @@ class LocalExecutor:
         meta = obj.get("metadata") or {}
         n = self._replicas(obj, ctx)
         for i in range(n):
+            pod_name = f"{name}-worker-{i}"
             pod = {
                 "apiVersion": "v1",
                 "kind": "Pod",
                 "metadata": {
-                    "name": f"{name}-worker-{i}",
+                    "name": pod_name,
                     "namespace": ns,
                     "labels": {
                         "tpu.kubedl.io/job-name": name,
@@ -506,9 +514,19 @@ class LocalExecutor:
                 "status": {"phase": "Running"},
             }
             try:
-                self.api.create(pod)
-            except Exception:
-                pass  # re-run after restart may find existing pods
+                with_conflict_retry(lambda p=pod: self.api.create(p))
+            except AlreadyExistsError:
+                # Re-run after restart adopts the existing pods.
+                logger.debug(
+                    "pod %s/%s already exists; adopting", ns, pod_name
+                )
+            except ApiError as err:
+                # The pod objects are observability decoration — the job
+                # itself runs regardless — so a persistent API failure
+                # here must not kill the launch.
+                logger.debug(
+                    "could not create pod %s/%s: %s", ns, pod_name, err
+                )
 
     def _finish_pods(self, key: JobKey, obj: Dict[str, Any]) -> None:
         _, _, ns, name = key
@@ -516,12 +534,24 @@ class LocalExecutor:
             "v1", "Pod", namespace=ns,
             label_selector={"tpu.kubedl.io/job-name": name},
         ):
-            # list() hands out shared immutable snapshots — rebuild the
-            # top level instead of mutating in place.
+            pod_name = (pod.get("metadata") or {}).get("name", "")
+
+            def _flip(pod_name=pod_name) -> None:
+                # Re-read per attempt: the retry contract requires the
+                # mutation to start from current state, and list() hands
+                # out shared immutable snapshots anyway — rebuild the top
+                # level instead of mutating in place.
+                cur = self.api.try_get("v1", "Pod", ns, pod_name)
+                if cur is None:
+                    return  # deleted underneath us; nothing to finish
+                self.api.update({**cur, "status": {"phase": "Succeeded"}})
+
             try:
-                self.api.update({**pod, "status": {"phase": "Succeeded"}})
-            except Exception:
-                pass
+                with_conflict_retry(_flip)
+            except ApiError as err:
+                logger.debug(
+                    "could not finish pod %s/%s: %s", ns, pod_name, err
+                )
 
     def _delete_pods(self, ns: str, name: str) -> None:
         for pod in self.api.list(
@@ -540,13 +570,22 @@ class LocalExecutor:
             return
         self._emit_telemetry(key, ctx)
         av, kind, ns, name = key
-        try:
+
+        def _apply() -> None:
             obj = self.api.get(av, kind, ns, name)
             status = obj.get("status") or {}
             status["trainingProgress"] = dict(ctx.progress)
             self.api.patch_status(av, kind, ns, name, status)
+
+        try:
+            with_conflict_retry(_apply)
         except NotFoundError:
             pass
+        except ApiError as err:
+            # Progress publication is best-effort telemetry; the next
+            # publish carries a superset of this one.
+            logger.debug("progress publish for %s/%s dropped: %s",
+                         ns, name, err)
 
     def _emit_telemetry(self, key: JobKey, ctx: JobContext) -> None:
         """Forward training progress into the operator telemetry sinks.
@@ -625,24 +664,32 @@ class LocalExecutor:
         extra: Optional[Dict[str, Any]] = None,
     ) -> None:
         av, kind, ns, name = key
-        obj = self.api.get(av, kind, ns, name)
-        status = obj.get("status") or {}
-        conds = list(status.get("conditions") or [])
-        now = rfc3339(self.api.clock.now())
-        conds.append(
-            {
-                "type": cond_type,
-                "status": "True",
-                "reason": reason,
-                "message": message,
-                "lastUpdateTime": now,
-                "lastTransitionTime": now,
-            }
-        )
-        status["conditions"] = conds
-        if extra:
-            status.update(extra)
-        self.api.patch_status(av, kind, ns, name, status)
+
+        def _apply() -> None:
+            # Get-mutate-patch under conflict retry: a terminal condition
+            # flip must not be lost to a racing status writer (the chaos
+            # soak's replay-equivalence invariant depends on exactly
+            # this). NotFound propagates to callers as before.
+            obj = self.api.get(av, kind, ns, name)
+            status = obj.get("status") or {}
+            conds = list(status.get("conditions") or [])
+            now = rfc3339(self.api.clock.now())
+            conds.append(
+                {
+                    "type": cond_type,
+                    "status": "True",
+                    "reason": reason,
+                    "message": message,
+                    "lastUpdateTime": now,
+                    "lastTransitionTime": now,
+                }
+            )
+            status["conditions"] = conds
+            if extra:
+                status.update(extra)
+            self.api.patch_status(av, kind, ns, name, status)
+
+        with_conflict_retry(_apply)
 
     # ---- failure injection ------------------------------------------------
 

@@ -234,6 +234,14 @@ def build_parser() -> argparse.ArgumentParser:
     start.add_argument("--run-for", type=float, default=None,
                        metavar="SECONDS",
                        help="exit after N seconds (default: run until signal)")
+    start.add_argument("--chaos-seed", type=int, default=None,
+                       metavar="SEED",
+                       help="embedded mode only: wrap the control plane in "
+                            "the seeded fault injector (runtime/faults.py) — "
+                            "deterministic conflict/transient/latency "
+                            "injection for resilience drills; faults are "
+                            "counted in faults_injected_total{kind}. See "
+                            "README 'Fault tolerance & chaos testing'")
 
     # kubectl-style inspection for standalone mode: the reference relies
     # on kubectl + CRD printcolumns (cron_types.go:33-36); with no
@@ -362,6 +370,17 @@ def cmd_start(args: argparse.Namespace) -> int:
         log.info("cluster mode: reconciling against %s", cfg.server)
     else:
         api = APIServer()
+
+    if args.chaos_seed is not None:
+        if args.api_server == "cluster":
+            log.error("--chaos-seed requires the embedded control plane "
+                      "(never inject faults into a real cluster)")
+            return 2
+        from cron_operator_tpu.runtime.faults import FaultInjector, FaultPlan
+
+        api = FaultInjector(api, FaultPlan.default_chaos(args.chaos_seed))
+        log.warning("CHAOS MODE: injecting seeded faults (seed=%d) into "
+                    "the embedded control plane", args.chaos_seed)
 
     if args.backend is None:
         # In cluster mode workloads run as real pods; executing them

@@ -63,7 +63,9 @@ from cron_operator_tpu.runtime.kube import (
     AlreadyExistsError,
     APIServer,
     NotFoundError,
+    ServerTimeoutError,
 )
+from cron_operator_tpu.runtime.retry import with_conflict_retry
 from cron_operator_tpu.telemetry import ANNOTATION_TRACE_ID, new_trace_id
 from cron_operator_tpu.utils.clock import Clock
 from cron_operator_tpu.utils.logctx import request_logger
@@ -81,6 +83,20 @@ TOO_MANY_MISSED = 100
 # named after nextRun and lastScheduleTime is set to now), so capping
 # costs nothing but protects the control loop from decades-of-skew input.
 CATCHUP_ITERATION_CAP = 100_000
+# Bound on the per-tick skip-dedup map. NotFound and deletion already
+# evict their own entry, but a fleet cycling through distinct Cron names
+# faster than reconciles observe the deletions could still grow the map
+# without limit — so cap it and shed oldest-inserted entries. Evicting a
+# live Forbid Cron costs at most one re-counted skip tick, never
+# correctness.
+SKIP_DEDUP_CAP = 4096
+# Bounded submit retry budget for transient API failures (injected by the
+# chaos layer or surfaced by a real apiserver as 429/503). Exhaustion
+# raises after a terminal Warning event; the reconcile error then takes
+# the normal rate-limited-requeue path.
+SUBMIT_ATTEMPTS = 6
+SUBMIT_BACKOFF_BASE_S = 0.01
+SUBMIT_BACKOFF_CAP_S = 0.5
 
 
 @dataclass
@@ -168,16 +184,24 @@ class CronReconciler:
             # cron_controller.go:107-120).
             new_status = cron.status.to_dict()
             if new_status != old_status:
-                try:
-                    self.api.patch_status(
-                        API_VERSION,
-                        KIND_CRON,
-                        namespace,
-                        name,
-                        new_status,
-                    )
-                except NotFoundError:
-                    pass
+                # Conflict-retried: a status merge-patch is position-
+                # independent, so resending the same payload is the
+                # correct retry when another writer (or the chaos layer)
+                # raced this one. Exhaustion propagates — the manager's
+                # rate-limited requeue re-runs the whole reconcile.
+                def _patch() -> None:
+                    try:
+                        self.api.patch_status(
+                            API_VERSION,
+                            KIND_CRON,
+                            namespace,
+                            name,
+                            new_status,
+                        )
+                    except NotFoundError:
+                        pass
+
+                with_conflict_retry(_patch, log=log)
 
     # -- core ---------------------------------------------------------------
 
@@ -277,6 +301,13 @@ class CronReconciler:
             if self._last_skipped_tick.get((ns, name)) != missed_run:
                 self._last_skipped_tick[(ns, name)] = missed_run
                 self._count('cron_ticks_skipped_total{policy="Forbid"}')
+                if len(self._last_skipped_tick) > SKIP_DEDUP_CAP:
+                    # Shed oldest-inserted entries (dict preserves
+                    # insertion order); see SKIP_DEDUP_CAP.
+                    excess = len(self._last_skipped_tick) - SKIP_DEDUP_CAP
+                    for key in list(self._last_skipped_tick)[:excess]:
+                        if key != (ns, name):
+                            del self._last_skipped_tick[key]
             return scheduled
 
         if cron.spec.concurrency_policy == ConcurrencyPolicy.REPLACE:
@@ -339,7 +370,7 @@ class CronReconciler:
 
         submit_start = time.time()
         try:
-            self.api.create(workload)
+            self._submit_workload(cron, gvk, workload, log)
             self._count("cron_ticks_fired_total")
             if missed_count > 1:
                 # Ticks the catch-up loop passed over; counted only when the
@@ -370,6 +401,44 @@ class CronReconciler:
         return scheduled
 
     # -- helpers ------------------------------------------------------------
+
+    def _submit_workload(
+        self, cron: Cron, gvk: GVK, workload: Unstructured, log
+    ) -> None:
+        """Create the tick's workload with a bounded retry budget for
+        transient API failures. Retries are counted
+        (``cron_submit_retries_total``); exhaustion records a terminal
+        Warning event naming the workload, then re-raises (the caller's
+        generic handler adds FailedCreate and the reconcile error takes
+        the rate-limited-requeue path). AlreadyExists propagates on the
+        first attempt — it is a semantic answer, not a transient."""
+        wl_name = (workload.get("metadata") or {}).get("name", "")
+        for attempt in range(SUBMIT_ATTEMPTS):
+            try:
+                self.api.create(workload)
+                return
+            except ServerTimeoutError as err:
+                if attempt == SUBMIT_ATTEMPTS - 1:
+                    self.api.record_event(
+                        cron.to_dict(),
+                        "Warning",
+                        "SubmitRetriesExhausted",
+                        f"giving up creating {gvk.kind} {wl_name} after "
+                        f"{SUBMIT_ATTEMPTS} attempts: {err}",
+                    )
+                    raise
+                self._count("cron_submit_retries_total")
+                delay = min(
+                    SUBMIT_BACKOFF_BASE_S * (2 ** attempt),
+                    SUBMIT_BACKOFF_CAP_S,
+                )
+                log.debug(
+                    "transient submit failure for %s %s "
+                    "(attempt %d/%d), backing off %.3fs: %s",
+                    gvk.kind, wl_name, attempt + 1, SUBMIT_ATTEMPTS,
+                    delay, err,
+                )
+                time.sleep(delay)
 
     def _tpu_admission_failed(self, cron: Cron, log, err: Exception) -> None:
         """Event + log for a workload template that fails TPU admission.

@@ -14,11 +14,13 @@ from cron_operator_tpu.runtime.kube import (
     NotFoundError,
     AlreadyExistsError,
     ConflictError,
+    ServerTimeoutError,
     InvalidError,
     Event,
     WatchEvent,
 )
 from cron_operator_tpu.runtime.manager import Manager, Request
+from cron_operator_tpu.runtime.retry import with_conflict_retry
 
 __all__ = [
     "APIServer",
@@ -26,9 +28,11 @@ __all__ = [
     "NotFoundError",
     "AlreadyExistsError",
     "ConflictError",
+    "ServerTimeoutError",
     "InvalidError",
     "Event",
     "WatchEvent",
     "Manager",
     "Request",
+    "with_conflict_retry",
 ]

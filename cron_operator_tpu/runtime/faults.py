@@ -1,0 +1,437 @@
+"""Seeded, deterministic fault injection for the embedded control plane.
+
+The chaos layer: a :class:`FaultInjector` wraps an
+:class:`~cron_operator_tpu.runtime.kube.APIServer` with the same client
+surface and injects failures on the way through — optimistic-concurrency
+conflicts and transient server errors on writes, added latency, bounded
+submit failures for workload creates, broken watch streams, and
+leadership revocation.  Everything is driven by a :class:`FaultPlan`
+whose every decision is a pure function of ``(seed, injection point)``
+via a keyed PRF, so a fault run is replayable from a single integer:
+same seed → same fault schedule, same per-call-site decisions
+(``hack/chaos_soak.py`` is the harness that proves the operator's
+invariants hold under it).
+
+Design notes:
+
+- **Stateless PRF, not a shared RNG.**  A ``random.Random`` stream would
+  make decisions depend on thread interleaving.  Instead each decision
+  hashes ``seed | kind | verb | per-verb call index`` (blake2b), so the
+  *sequence* of decisions per verb is fixed regardless of which thread
+  draws which call.
+- **Watch breaks are transport frames, not rv games.**  A broken stream
+  drops events and delivers a synthetic ``WatchEvent("ERROR")`` — what a
+  real watch client observes at stream EOF.  Repair delivers
+  ``WatchEvent("BOOKMARK")``: "stream live again, you may have missed
+  events; re-list."  The Manager's resync path consumes exactly these
+  two frames (see :meth:`Manager._on_watch_event`).
+- **Reads are never failed**, only (optionally) slowed: a level-triggered
+  controller that cannot read cannot make progress at all, and the
+  interesting failure modes are all on the write/watch side.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from cron_operator_tpu.api.v1alpha1 import parse_time, rfc3339
+from cron_operator_tpu.runtime.kube import (
+    APIServer,
+    ConflictError,
+    ServerTimeoutError,
+    Unstructured,
+    WatchEvent,
+)
+
+logger = logging.getLogger("faults")
+
+#: Workload kinds whose ``create`` is treated as a backend submit (the
+#: per-name bounded submit-failure fault targets these).
+SUBMIT_KINDS = ("JAXJob", "PyTorchJob", "TFJob", "MPIJob", "XGBoostJob")
+
+
+def seeded_fraction(seed: int, *parts: object) -> float:
+    """Deterministic uniform in ``[0, 1)`` from ``(seed, *parts)``.
+
+    A keyed PRF (blake2b over the joined key), not an RNG stream: the
+    value for a given injection point is identical in every run with
+    that seed, independent of call order or threading.
+    """
+    key = "|".join([str(seed)] + [str(p) for p in parts])
+    h = hashlib.blake2b(key.encode("utf-8"), digest_size=8)
+    return int.from_bytes(h.digest(), "big") / 2.0 ** 64
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Per-fault probabilities + the seed that makes them replayable.
+
+    ``schedule(rounds)`` expands the round-granular faults (watch breaks,
+    leader revocations, preemption storms) into an explicit event list —
+    a pure function of the plan, which is what "same seed → same fault
+    trace" means for the scheduled part.  Per-call faults (conflict /
+    transient / latency / submit failure) are decided by the same PRF at
+    injection time.
+    """
+
+    seed: int = 0
+    # -- per-call API faults -------------------------------------------------
+    conflict_prob: float = 0.0       # update/patch_status -> ConflictError
+    transient_prob: float = 0.0      # any write -> ServerTimeoutError
+    latency_prob: float = 0.0        # any verb -> added real latency
+    latency_s: float = 0.001
+    # -- bounded submit failures (per workload name) -------------------------
+    submit_fail_prob: float = 0.0    # P(a given workload name is selected)
+    submit_fail_max: int = 0         # <= failures per selected name
+    # -- round-granular scheduled faults (expanded by schedule()) ------------
+    watch_break_prob: float = 0.0    # P(round starts with a broken stream)
+    leader_revoke_prob: float = 0.0  # P(round revokes the leader lease)
+    preempt_prob: float = 0.0        # P(round is a slice-preemption storm)
+    preempt_frac: float = 0.5        # fraction of running workloads hit
+
+    @classmethod
+    def default_chaos(cls, seed: int) -> "FaultPlan":
+        """The storm profile used by ``--chaos-seed`` and the soak: every
+        fault class enabled, probabilities hot enough that a short run
+        exercises all of them, bounded so hardened consumers survive
+        (submit failures stay below the reconciler's retry budget)."""
+        return cls(
+            seed=seed,
+            conflict_prob=0.15,
+            transient_prob=0.03,
+            latency_prob=0.05,
+            latency_s=0.001,
+            submit_fail_prob=0.25,
+            submit_fail_max=3,
+            watch_break_prob=0.4,
+            leader_revoke_prob=0.2,
+            preempt_prob=0.35,
+            preempt_frac=0.5,
+        )
+
+    @classmethod
+    def quiet(cls, seed: int) -> "FaultPlan":
+        """No API/watch/leader faults — the fault-free replay profile.
+        (Workload outcomes and preemption storms are applied by the soak
+        harness from the same seed in both runs; only infrastructure
+        faults differ between the chaotic run and the replay.)"""
+        return cls(seed=seed)
+
+    def schedule(self, rounds: int) -> List[Dict[str, object]]:
+        """Expand the round-granular fault schedule. Pure function of the
+        plan — calling it twice (or in another process) yields the same
+        list, which the soak uses to prove trace determinism."""
+        events: List[Dict[str, object]] = []
+        for r in range(rounds):
+            if seeded_fraction(self.seed, "sched", "watch", r) < self.watch_break_prob:
+                events.append({"round": r, "fault": "watch_break"})
+            if (
+                seeded_fraction(self.seed, "sched", "leader", r)
+                < self.leader_revoke_prob
+            ):
+                events.append({"round": r, "fault": "leader_revoke"})
+            if (
+                seeded_fraction(self.seed, "sched", "preempt", r)
+                < self.preempt_prob
+            ):
+                events.append({"round": r, "fault": "preempt_storm"})
+        return events
+
+    def trace_hash(self, rounds: int) -> str:
+        """Stable digest of the expanded schedule + per-call parameters —
+        the replayable identity of this plan's fault trace."""
+        h = hashlib.blake2b(digest_size=16)
+        h.update(repr(self).encode("utf-8"))
+        h.update(repr(self.schedule(rounds)).encode("utf-8"))
+        return h.hexdigest()
+
+    def planned_submit_failures(self, name: str) -> int:
+        """How many consecutive submit failures workload ``name`` gets
+        (0 for unselected names). Bounded by ``submit_fail_max`` so a
+        reconciler with a larger retry budget always gets through."""
+        if self.submit_fail_max <= 0 or self.submit_fail_prob <= 0.0:
+            return 0
+        if seeded_fraction(self.seed, "submitsel", name) >= self.submit_fail_prob:
+            return 0
+        return 1 + int(
+            seeded_fraction(self.seed, "submitcnt", name) * self.submit_fail_max
+        )
+
+
+@dataclass
+class _WatchChannel:
+    """One subscription routed through the injector. While ``broken``,
+    store events are dropped (counted); break/repair deliver the
+    synthetic ERROR/BOOKMARK transport frames to the subscriber."""
+
+    fn: object
+    broken: bool = False
+    dropped: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def deliver(self, ev: WatchEvent) -> None:
+        with self.lock:
+            if self.broken:
+                self.dropped += 1
+                return
+        self.fn(ev)
+
+
+class FaultInjector:
+    """Wraps an APIServer with the same client surface, injecting faults
+    per a :class:`FaultPlan`. Undeclared attributes forward to the inner
+    store, so consumers (Manager, reconcilers, executors, HTTP facade)
+    run unmodified against it.
+
+    ``disarm()`` stops all per-call injection (the "faults stop" phase of
+    a soak); scheduled watch/leader faults are driven explicitly by the
+    harness via :meth:`break_watches` / :meth:`repair_watches` /
+    :meth:`revoke_leader`.
+    """
+
+    def __init__(self, inner: APIServer, plan: FaultPlan):
+        self.inner = inner
+        self.plan = plan
+        self.clock = inner.clock
+        self._lock = threading.Lock()
+        self._verb_calls: Dict[str, int] = {}
+        self._submit_attempts: Dict[str, int] = {}
+        self._trace: List[Tuple[str, str, object]] = []
+        self._channels: List[_WatchChannel] = []
+        self._armed = True
+        self._metrics = None
+
+    # ---- arming / introspection -------------------------------------------
+
+    def arm(self) -> None:
+        self._armed = True
+
+    def disarm(self) -> None:
+        """Stop injecting per-call faults (convergence phase)."""
+        self._armed = False
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def trace(self) -> List[Tuple[str, str, object]]:
+        """Injected faults so far as ``(kind, verb, detail)`` tuples."""
+        with self._lock:
+            return list(self._trace)
+
+    def fault_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for kind, _verb, _detail in self.trace():
+            counts[kind] = counts.get(kind, 0) + 1
+        return counts
+
+    def instrument(self, metrics) -> None:
+        self._metrics = metrics
+        self.inner.instrument(metrics)
+
+    # ---- fault machinery ---------------------------------------------------
+
+    def _record(self, kind: str, verb: str, detail: object) -> None:
+        with self._lock:
+            self._trace.append((kind, verb, detail))
+        if self._metrics is not None:
+            self._metrics.inc(f'faults_injected_total{{kind="{kind}"}}')
+        logger.debug("injected %s on %s (%s)", kind, verb, detail)
+
+    def _next_call(self, verb: str) -> int:
+        with self._lock:
+            k = self._verb_calls.get(verb, 0)
+            self._verb_calls[verb] = k + 1
+            return k
+
+    def _maybe_fault(self, verb: str, mutating: bool) -> None:
+        plan = self.plan
+        if not self._armed:
+            return
+        k = self._next_call(verb)
+        if plan.latency_prob > 0.0 and (
+            seeded_fraction(plan.seed, "latency", verb, k) < plan.latency_prob
+        ):
+            self._record("latency", verb, k)
+            time.sleep(plan.latency_s)
+        if not mutating:
+            return
+        if (
+            verb in ("update", "patch_status")
+            and plan.conflict_prob > 0.0
+            and seeded_fraction(plan.seed, "conflict", verb, k) < plan.conflict_prob
+        ):
+            self._record("conflict", verb, k)
+            raise ConflictError(f"injected conflict ({verb} #{k})")
+        if plan.transient_prob > 0.0 and (
+            seeded_fraction(plan.seed, "transient", verb, k) < plan.transient_prob
+        ):
+            self._record("transient", verb, k)
+            raise ServerTimeoutError(f"injected transient error ({verb} #{k})")
+
+    # ---- verbs (faulted) ---------------------------------------------------
+
+    def create(self, obj: Unstructured) -> Unstructured:
+        if self._armed and obj.get("kind") in SUBMIT_KINDS:
+            name = (obj.get("metadata") or {}).get("name", "")
+            planned = self.plan.planned_submit_failures(name)
+            if planned:
+                with self._lock:
+                    done = self._submit_attempts.get(name, 0)
+                    fail = done < planned
+                    if fail:
+                        self._submit_attempts[name] = done + 1
+                if fail:
+                    self._record("submit_fail", "create", f"{name}#{done}")
+                    raise ServerTimeoutError(
+                        f"injected submit failure for {name} "
+                        f"({done + 1}/{planned})"
+                    )
+        self._maybe_fault("create", mutating=True)
+        return self.inner.create(obj)
+
+    def update(self, obj: Unstructured) -> Unstructured:
+        self._maybe_fault("update", mutating=True)
+        return self.inner.update(obj)
+
+    def patch_status(self, *args, **kwargs) -> Unstructured:
+        self._maybe_fault("patch_status", mutating=True)
+        return self.inner.patch_status(*args, **kwargs)
+
+    def delete(self, *args, **kwargs):
+        self._maybe_fault("delete", mutating=True)
+        return self.inner.delete(*args, **kwargs)
+
+    def list(self, *args, **kwargs):
+        self._maybe_fault("list", mutating=False)
+        return self.inner.list(*args, **kwargs)
+
+    def get(self, *args, **kwargs):
+        self._maybe_fault("get", mutating=False)
+        return self.inner.get(*args, **kwargs)
+
+    # ---- watch stream faults ----------------------------------------------
+
+    def add_watcher(self, fn, coalesce: bool = False) -> None:
+        """Subscribe through a breakable channel. The inner dispatcher
+        still provides ordering/coalescing; the channel models the
+        client's transport, which can lose its stream."""
+        ch = _WatchChannel(fn=fn)
+        with self._lock:
+            self._channels.append(ch)
+        self.inner.add_watcher(ch.deliver, coalesce=coalesce)
+
+    def break_watches(self) -> None:
+        """Break every watch stream subscribed through the injector:
+        subsequent store events are dropped and each subscriber receives
+        a synthetic ERROR frame (stream EOF)."""
+        with self._lock:
+            channels = list(self._channels)
+        for ch in channels:
+            with ch.lock:
+                already = ch.broken
+                ch.broken = True
+            if not already:
+                self._record("watch_break", "watch", id(ch))
+                ch.fn(WatchEvent(type="ERROR", object={}))
+
+    def repair_watches(self) -> None:
+        """Re-establish broken streams. Each subscriber receives a
+        BOOKMARK frame — "stream live again, events may have been
+        missed" — which is the Manager's cue to resync."""
+        with self._lock:
+            channels = list(self._channels)
+        for ch in channels:
+            with ch.lock:
+                was_broken = ch.broken
+                ch.broken = False
+            if was_broken:
+                logger.debug(
+                    "watch channel repaired (%d events dropped)", ch.dropped
+                )
+                ch.fn(WatchEvent(type="BOOKMARK", object={}))
+
+    def dropped_events(self) -> int:
+        with self._lock:
+            return sum(ch.dropped for ch in self._channels)
+
+    # ---- leadership faults -------------------------------------------------
+
+    def revoke_leader(self, identity: str = "chaos-rival") -> bool:
+        """Steal the leader-election lease for a rival holder with a
+        fresh renew time — the current leader observes another live
+        holder and must demote. Writes go to the *inner* store (the
+        revocation itself is not subject to injected faults). Returns
+        False when no lease exists yet."""
+        from cron_operator_tpu.runtime.manager import (
+            LEADER_LEASE_NAME,
+            LEASE_API_VERSION,
+            LEASE_KIND,
+        )
+        from cron_operator_tpu.runtime.retry import with_conflict_retry
+
+        def _steal() -> bool:
+            lease = self.inner.try_get(
+                LEASE_API_VERSION, LEASE_KIND, "kube-system", LEADER_LEASE_NAME
+            )
+            if lease is None:
+                return False
+            spec = dict(lease.get("spec") or {})
+            spec["holderIdentity"] = identity
+            spec["renewTime"] = rfc3339(self.clock.now())
+            lease = dict(lease)
+            lease["spec"] = spec
+            self.inner.update(lease)
+            return True
+
+        stolen = with_conflict_retry(_steal, log=logger)
+        if stolen:
+            self._record("leader_revoke", "lease", identity)
+        return bool(stolen)
+
+    def expire_leader_lease(self) -> bool:
+        """Rewind the lease renew time far enough that any holder is
+        expired — lets a revoked manager re-acquire without waiting out
+        real lease time. Returns False when no lease exists."""
+        from cron_operator_tpu.runtime.manager import (
+            LEADER_LEASE_NAME,
+            LEASE_API_VERSION,
+            LEASE_KIND,
+        )
+        from cron_operator_tpu.runtime.retry import with_conflict_retry
+        from datetime import timedelta
+
+        def _expire() -> bool:
+            lease = self.inner.try_get(
+                LEASE_API_VERSION, LEASE_KIND, "kube-system", LEADER_LEASE_NAME
+            )
+            if lease is None:
+                return False
+            spec = dict(lease.get("spec") or {})
+            dur = float(spec.get("leaseDurationSeconds") or 15.0)
+            renew = parse_time(spec.get("renewTime")) or self.clock.now()
+            spec["renewTime"] = rfc3339(
+                min(renew, self.clock.now()) - timedelta(seconds=10.0 * dur)
+            )
+            lease = dict(lease)
+            lease["spec"] = spec
+            self.inner.update(lease)
+            return True
+
+        return bool(with_conflict_retry(_expire, log=logger))
+
+    # ---- transparent forwarding -------------------------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+    def __len__(self) -> int:
+        return len(self.inner)
+
+    def __bool__(self) -> bool:
+        return True

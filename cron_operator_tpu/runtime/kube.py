@@ -71,6 +71,14 @@ class ConflictError(ApiError):
     pass
 
 
+class ServerTimeoutError(ApiError):
+    """Transient server-side failure (429/503/etcd-timeout analog) —
+    always safe to retry. The embedded store never raises it on its own;
+    it comes from the chaos layer (:mod:`runtime.faults`) and from
+    cluster transports, and :func:`runtime.retry.with_conflict_retry`
+    treats it as retriable alongside :class:`ConflictError`."""
+
+
 class InvalidError(ApiError):
     pass
 
@@ -831,6 +839,7 @@ __all__ = [
     "NotFoundError",
     "AlreadyExistsError",
     "ConflictError",
+    "ServerTimeoutError",
     "InvalidError",
     "Event",
     "WatchEvent",

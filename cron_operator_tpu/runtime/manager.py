@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from cron_operator_tpu.api.scheme import GVK, gvk_of
-from cron_operator_tpu.runtime.kube import APIServer, WatchEvent
+from cron_operator_tpu.runtime.kube import APIServer, ApiError, WatchEvent
 from cron_operator_tpu.runtime.workqueue import WorkQueue
 
 logger = logging.getLogger("runtime.manager")
@@ -116,6 +116,18 @@ _FAMILY_META: Dict[str, tuple] = {
     "workload_tokens_per_s": (
         "gauge", "Most recently reported training throughput in tokens "
                  "per second across running workloads"),
+    "watch_resyncs_total": (
+        "counter", "Full re-list + enqueue-all resyncs performed after a "
+                   "watch stream signalled a break (ERROR then BOOKMARK "
+                   "transport frames)"),
+    "faults_injected_total": (
+        "counter", "Faults injected by the chaos layer (label kind: "
+                   "conflict, transient, latency, submit_fail, "
+                   "watch_break, leader_revoke)"),
+    "cron_submit_retries_total": (
+        "counter", "Workload submit attempts retried after a transient "
+                   "API error (bounded; exhaustion raises a Warning "
+                   "event)"),
 }
 
 
@@ -309,6 +321,12 @@ class Manager:
         self._stop = threading.Event()
         self._started = threading.Event()
         self._is_leader = threading.Event()
+        # Watch-stream health: an ERROR transport frame (stream broke)
+        # degrades readyz until the BOOKMARK frame (stream back) triggers
+        # a resync. ``resync_on_watch_error`` exists so the chaos soak
+        # can demonstrate the pre-hardening behavior by turning it off.
+        self._watch_healthy = True
+        self.resync_on_watch_error = True
         # Workers park on this condition while not leader (instead of
         # spinning); _set_leadership/stop notify it on every transition.
         self._leader_cv = threading.Condition()
@@ -342,6 +360,19 @@ class Manager:
         self._for_kinds.add(for_gvk)
 
     def _on_watch_event(self, ev: WatchEvent) -> None:
+        # Transport frames from the watch stream itself (no object
+        # payload). ERROR: the stream died — events may be getting lost,
+        # stop claiming readiness. BOOKMARK: the stream is back — re-list
+        # everything and enqueue all keys, the informer relist a real
+        # controller performs after a watch disconnect.
+        if ev.type == "ERROR":
+            logger.warning("watch stream broken; degrading readyz until resync")
+            self._watch_healthy = False
+            return
+        if ev.type == "BOOKMARK":
+            if self.resync_on_watch_error:
+                self.resync(from_watch_error=True)
+            return
         obj = ev.object
         gvk = gvk_of(obj)
         if gvk is None:
@@ -421,11 +452,39 @@ class Manager:
         for t in self._threads:
             t.join(timeout=2.0)
 
+    def resync(self, *, from_watch_error: bool = False) -> None:
+        """Re-list every For kind and enqueue all keys — the informer
+        relist performed after a broken watch stream (and usable by
+        harnesses as a level-triggered 'reconcile everything' kick).
+        Only the watch-error path counts ``watch_resyncs_total`` and
+        restores watch health; a plain resync is just an enqueue sweep.
+        """
+        for c in self._controllers:
+            try:
+                objs = self.api.list(c.for_gvk.api_version, c.for_gvk.kind)
+            except ApiError as err:
+                logger.warning("resync list failed for %s: %s",
+                               c.for_gvk.kind, err)
+                return
+            for obj in objs:
+                meta = obj.get("metadata") or {}
+                c.queue.add(
+                    Request(meta.get("namespace", ""), meta.get("name", ""))
+                )
+        if from_watch_error:
+            self.metrics.inc("watch_resyncs_total")
+            self._watch_healthy = True
+            logger.info("watch stream resynced; readyz restored")
+
     def healthz(self) -> bool:
         return self._started.is_set() and not self._stop.is_set()
 
     def readyz(self) -> bool:
-        return self.healthz() and (not self.leader_elect or self._is_leader.is_set())
+        return (
+            self.healthz()
+            and self._watch_healthy
+            and (not self.leader_elect or self._is_leader.is_set())
+        )
 
     # ---- leader election --------------------------------------------------
 

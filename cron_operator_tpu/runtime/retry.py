@@ -1,0 +1,72 @@
+"""Optimistic-concurrency retry for get-mutate-update round trips.
+
+Every writer that races another client on the same object needs the same
+three lines of ceremony: re-read the current version, re-apply the
+mutation, write again when the store answers 409.  The reference operator
+gets this from client-go's ``retry.RetryOnConflict``; this module is the
+embedded-control-plane analog, extended to cover transient server
+failures (:class:`~cron_operator_tpu.runtime.kube.ServerTimeoutError`)
+injected by the chaos layer or surfaced by a cluster transport.
+
+The contract mirrors client-go's: the closure passed to
+:func:`with_conflict_retry` must RE-READ current state on every call —
+retrying a write built from a stale snapshot just re-manufactures the
+same conflict.  Status merge-patches (``patch_status``) are the one
+exception: the payload is position-independent, so resending it verbatim
+is the correct retry.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Optional
+
+from cron_operator_tpu.runtime.kube import ConflictError, ServerTimeoutError
+
+logger = logging.getLogger("retry")
+
+# Module-level default so a whole process can be dropped back to the
+# pre-hardening single-attempt behavior (hack/chaos_soak.py --unhardened
+# does exactly that to demonstrate the invariant violations this helper
+# exists to prevent).
+DEFAULT_ATTEMPTS = 5
+
+#: Errors that indicate "the write lost a race or hit a transient server
+#: hiccup" — safe to retry.  NotFound/Invalid/AlreadyExists are semantic
+#: answers, not races, and propagate immediately.
+RETRIABLE_ERRORS = (ConflictError, ServerTimeoutError)
+
+
+def with_conflict_retry(
+    fn: Callable[[], Any],
+    *,
+    attempts: Optional[int] = None,
+    base_s: float = 0.005,
+    cap_s: float = 0.5,
+    log: Optional[logging.Logger] = None,
+) -> Any:
+    """Run ``fn``, retrying on :data:`RETRIABLE_ERRORS` with exponential
+    backoff (``base_s * 2**attempt``, capped at ``cap_s``).  Returns
+    ``fn``'s result; re-raises the last error once ``attempts`` is
+    exhausted.  Backoff sleeps are real wall-clock time — they must not
+    advance a fake clock, or retries would perturb the scheduling
+    timeline they are trying to repair.
+    """
+    n = DEFAULT_ATTEMPTS if attempts is None else attempts
+    if n < 1:
+        raise ValueError(f"attempts must be >= 1, got {n}")
+    lg = log or logger
+    for attempt in range(n):
+        try:
+            return fn()
+        except RETRIABLE_ERRORS as err:
+            if attempt == n - 1:
+                raise
+            delay = min(base_s * (2 ** attempt), cap_s)
+            lg.debug(
+                "retriable %s (attempt %d/%d), backing off %.3fs: %s",
+                type(err).__name__, attempt + 1, n, delay, err,
+            )
+            time.sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -172,6 +172,20 @@ class WorkQueue(Generic[T]):
         with self._cond:
             return len(self._queue)
 
+    def stats(self) -> Tuple[int, int, Optional[float]]:
+        """``(queued, processing, seconds-until-earliest-delayed-add)``.
+
+        The idleness probe quiesce loops need: a queue is drained only
+        when nothing is queued, nothing is being processed, and no
+        delayed add is about to fire (the third element is None when no
+        delayed adds are pending, and may be negative when one is due)."""
+        with self._cond:
+            next_delay = (
+                self._delayed[0][0] - time.monotonic()
+                if self._delayed else None
+            )
+            return len(self._queue), len(self._processing), next_delay
+
     # ---- delayed / rate-limited adds --------------------------------------
 
     def add_after(self, item: T, delay_s: float) -> None:

@@ -1,0 +1,624 @@
+"""Invariant-checking chaos soak (``make chaos-soak`` → ``CHAOS.json``).
+
+Drives the REAL operator stack — ``APIServer`` + ``Manager`` worker pool +
+leader election + ``CronReconciler`` on a ``FakeClock`` — through a seeded
+fault storm injected by :mod:`cron_operator_tpu.runtime.faults`, then
+asserts five end-state invariants:
+
+- **I1 forbid_no_concurrent** — at no point in the run (observed on the
+  raw store's every-event watch stream) does a ``Forbid`` Cron have more
+  than one non-terminal workload.
+- **I2 history_bounded** — every Cron ends with
+  ``len(status.history) <= historyLimit``.
+- **I3 tick_exactly_once** — ``cron_ticks_fired_total`` equals the number
+  of workload ADDED events (every fired tick yields exactly one
+  workload), and no workload name is ever created twice.
+- **I4 converges_zero_writes** — once faults stop and the system
+  quiesces, a direct synchronous reconcile sweep over every Cron
+  performs ZERO store writes (resourceVersion bracketing).
+- **I5 matches_fault_free_replay** — the semantic end state (per-cron
+  fired-tick names, workload names + terminal phases, history entries,
+  active sets) is identical to a replay of the same seed with all
+  API/watch/leader faults disabled.
+
+Determinism model: every fault decision and every simulated workload
+outcome is a pure function of ``(seed, injection point)`` (see
+``runtime/faults.seeded_fraction``), the clock is fake and advances in
+fixed rounds, and the harness quiesces the manager between rounds — so
+one seed defines one fault trace (``fault_trace_hash``) and one
+convergent end state.  Workload outcomes and slice-preemption storms are
+*environment*, not infrastructure: the fault-free replay applies them
+identically, and only conflicts/transients/latency/watch-breaks/leader
+revocations differ between the two runs.
+
+``--unhardened`` reverts the process to the pre-hardening behavior
+(single-attempt writes, no resync on watch error) to demonstrate that
+the invariants genuinely depend on the hardening — expect I5 (and
+possibly others) to fail there.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from dataclasses import asdict
+from datetime import timedelta
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CRON_API_VERSION = "apps.kubedl.io/v1alpha1"
+WORKLOAD_API_VERSION = "kubeflow.org/v1"
+WORKLOAD_KIND = "JAXJob"
+LABEL_CRON_NAME = "kubedl.io/cron-name"
+POLICIES = ("Forbid", "Allow", "Replace")
+HISTORY_LIMIT = 2
+NAMESPACE = "default"
+
+
+def _cron(i: int) -> dict:
+    return {
+        "apiVersion": CRON_API_VERSION,
+        "kind": "Cron",
+        "metadata": {"name": f"chaos-{i}", "namespace": NAMESPACE},
+        "spec": {
+            "schedule": "*/1 * * * *",
+            "concurrencyPolicy": POLICIES[i % len(POLICIES)],
+            "historyLimit": HISTORY_LIMIT,
+            "template": {"workload": {
+                "apiVersion": WORKLOAD_API_VERSION,
+                "kind": WORKLOAD_KIND,
+                "metadata": {},
+                "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+            }},
+        },
+    }
+
+
+def _is_terminal(obj: dict) -> str:
+    """Terminal condition type ('' while running) per the JobStatus
+    last-condition convention used across the operator."""
+    conds = (obj.get("status") or {}).get("conditions") or []
+    if conds:
+        last = conds[-1].get("type", "")
+        if last in ("Succeeded", "Failed"):
+            return last
+    return ""
+
+
+class WatchLog:
+    """Every-event subscriber on the RAW store (immune to injected watch
+    breaks): tracks workload creations per Cron and the live concurrency
+    level of Forbid Crons — the I1/I3 evidence stream."""
+
+    def __init__(self, forbid_crons) -> None:
+        self._forbid = set(forbid_crons)
+        self._lock = threading.Lock()
+        self.created: dict = {}       # cron -> [workload names, ADDED order]
+        self.created_count = 0
+        self._active: dict = {}       # workload name -> cron
+        self._level: dict = {}        # cron -> current non-terminal count
+        self.violations: list = []    # I1 breaches, as readable strings
+
+    def __call__(self, ev) -> None:
+        obj = ev.object
+        if obj.get("kind") != WORKLOAD_KIND:
+            return
+        meta = obj.get("metadata") or {}
+        cron = (meta.get("labels") or {}).get(LABEL_CRON_NAME)
+        if not cron:
+            return
+        name = meta.get("name", "")
+        terminal = bool(_is_terminal(obj))
+        with self._lock:
+            if ev.type == "ADDED":
+                self.created.setdefault(cron, []).append(name)
+                self.created_count += 1
+                if not terminal:
+                    self._mark_active(cron, name)
+            elif ev.type == "MODIFIED":
+                if terminal:
+                    self._mark_inactive(name)
+                else:
+                    self._mark_active(cron, name)
+            elif ev.type == "DELETED":
+                self._mark_inactive(name)
+
+    def _mark_active(self, cron: str, name: str) -> None:
+        if name in self._active:
+            return
+        self._active[name] = cron
+        level = self._level.get(cron, 0) + 1
+        self._level[cron] = level
+        if cron in self._forbid and level > 1:
+            self.violations.append(
+                f"{cron}: {level} concurrent workloads (latest {name})"
+            )
+
+    def _mark_inactive(self, name: str) -> None:
+        cron = self._active.pop(name, None)
+        if cron is not None:
+            self._level[cron] = self._level.get(cron, 1) - 1
+
+
+def _queues_idle(mgr, horizon_s: float = 2.0) -> bool:
+    for c in mgr._controllers:
+        queued, processing, next_delay = c.queue.stats()
+        if queued or processing:
+            return False
+        if next_delay is not None and next_delay < horizon_s:
+            # A rate-limited requeue is about to fire — not idle yet.
+            # (RequeueAfter schedule timers sit a fake-minute out in real
+            # seconds and are correctly treated as idle.)
+            return False
+    return True
+
+
+def _quiesce(mgr, store, timeout_s: float) -> bool:
+    """Drain to a fixed point: watch events delivered, queues empty,
+    nothing processing, no imminent rate-limited requeue, and (when
+    electing) leadership held."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if mgr.leader_elect and not mgr._is_leader.is_set():
+            time.sleep(0.02)
+            continue
+        store.flush(2.0)
+        if _queues_idle(mgr):
+            store.flush(1.0)
+            if _queues_idle(mgr):
+                return True
+        time.sleep(0.005)
+    return False
+
+
+def run_soak(
+    seed: int,
+    n_crons: int,
+    rounds: int,
+    workers: int = 4,
+    chaotic: bool = True,
+    unhardened: bool = False,
+    quiesce_timeout_s: float = 30.0,
+) -> dict:
+    """One soak run. ``chaotic=False`` is the fault-free replay: same
+    seed, same rounds, same workload outcomes and preemption storms, but
+    no API/watch/leader faults."""
+    from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+    from cron_operator_tpu.api.v1alpha1 import rfc3339
+    from cron_operator_tpu.controller.cron_controller import CronReconciler
+    from cron_operator_tpu.runtime import retry as retry_mod
+    from cron_operator_tpu.runtime.faults import (
+        FaultInjector,
+        FaultPlan,
+        seeded_fraction,
+    )
+    from cron_operator_tpu.runtime.kube import (
+        APIServer,
+        ConflictError,
+        NotFoundError,
+        ServerTimeoutError,
+    )
+    from cron_operator_tpu.runtime.manager import Manager
+    from cron_operator_tpu.runtime.retry import with_conflict_retry
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    storm_plan = FaultPlan.default_chaos(seed)
+    plan = storm_plan if chaotic else FaultPlan.quiet(seed)
+    schedule = storm_plan.schedule(rounds)
+    by_round: dict = {}
+    for ev in schedule:
+        by_round.setdefault(ev["round"], set()).add(ev["fault"])
+
+    clock = FakeClock()
+    store = APIServer(clock=clock)
+    api = FaultInjector(store, plan)
+
+    forbid = {
+        f"chaos-{i}" for i in range(n_crons)
+        if POLICIES[i % len(POLICIES)] == "Forbid"
+    }
+    watchlog = WatchLog(forbid)
+    store.add_watcher(watchlog)
+
+    for i in range(n_crons):
+        store.create(_cron(i))
+
+    prev_attempts = retry_mod.DEFAULT_ATTEMPTS
+    retry_mod.DEFAULT_ATTEMPTS = 1 if unhardened else 5
+    mgr = Manager(
+        api,
+        max_concurrent_reconciles=workers,
+        leader_elect=True,
+        identity="chaos-soak",
+        lease_duration_s=1.0,
+    )
+    mgr.resync_on_watch_error = not unhardened
+    rec = CronReconciler(api, metrics=mgr.metrics)
+    mgr.add_controller(
+        "cron", rec.reconcile, for_gvk=GVK_CRON,
+        owns=default_scheme().workload_kinds(),
+    )
+
+    first_seen: dict = {}   # workload name -> round index first observed
+    preempted: set = set()
+    lost_flips = 0
+    quiesce_timeouts = 0
+    readyz_degraded_seen = False
+    leadership_lost_seen = False
+
+    def _dur(name: str) -> int:
+        # Rounds a workload runs before its terminal flip (0..2) — long
+        # enough that Forbid Crons regularly carry an active workload
+        # across a tick (exercising skips).
+        return int(seeded_fraction(seed, "dur", name) * 3)
+
+    def _terminal_for(name: str) -> str:
+        return (
+            "Succeeded"
+            if seeded_fraction(seed, "term", name) < 0.8 else "Failed"
+        )
+
+    def _flip(name: str, cond_type: str, reason: str) -> None:
+        """Harness-driven status flip through the (possibly faulty) API —
+        the executor-status-write analog the conflict-retry helper
+        hardens. In unhardened mode exhausted retries surface here and
+        the flip is LOST, exactly like the pre-hardening executor."""
+        nonlocal lost_flips
+
+        def _apply() -> None:
+            obj = api.try_get(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                              NAMESPACE, name)
+            if obj is None:
+                return
+            status = dict(obj.get("status") or {})
+            conds = list(status.get("conditions") or [])
+            now = rfc3339(clock.now())
+            conds.append({
+                "type": cond_type, "status": "True", "reason": reason,
+                "lastUpdateTime": now, "lastTransitionTime": now,
+            })
+            status["conditions"] = conds
+            status["completionTime"] = now
+            api.patch_status(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                             NAMESPACE, name, status)
+
+        try:
+            with_conflict_retry(_apply)
+        except (ConflictError, ServerTimeoutError):
+            lost_flips += 1
+        except NotFoundError:
+            pass
+
+    def _environment_step(r: int) -> None:
+        """Deterministic workload environment for round ``r``: the
+        scheduled preemption storm plus age-based terminal flips. Applied
+        identically in the chaotic run and the replay — only the API
+        faults underneath the flips differ."""
+        workloads = store.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+        )
+        running = []
+        for w in workloads:
+            name = (w.get("metadata") or {}).get("name", "")
+            first_seen.setdefault(name, r)
+            if not _is_terminal(w):
+                running.append(name)
+        storm = "preempt_storm" in by_round.get(r, ())
+        for name in sorted(running):
+            age = r - first_seen[name]
+            if (
+                storm
+                and age < _dur(name)
+                and seeded_fraction(seed, "preempt", r, name)
+                < storm_plan.preempt_frac
+            ):
+                preempted.add(name)
+                _flip(name, "Failed", "TPUSlicePreempted")
+            elif name not in preempted and age >= _dur(name):
+                flip_to = _terminal_for(name)
+                _flip(name, flip_to,
+                      "JobSucceeded" if flip_to == "Succeeded"
+                      else "JobFailed")
+
+    t0 = time.monotonic()
+    try:
+        mgr.start()
+        if not _quiesce(mgr, store, quiesce_timeout_s):
+            quiesce_timeouts += 1
+
+        for r in range(rounds):
+            faults_now = by_round.get(r, set()) if chaotic else set()
+            clock.advance(timedelta(seconds=60))
+            if "watch_break" in faults_now:
+                api.break_watches()
+            if "leader_revoke" in faults_now:
+                api.revoke_leader()
+                deadline = time.monotonic() + 3.0
+                while time.monotonic() < deadline:
+                    if not mgr._is_leader.is_set():
+                        leadership_lost_seen = True
+                        break
+                    time.sleep(0.02)
+                api.expire_leader_lease()
+            # Round tick: level-triggered enqueue-all (a real operator
+            # gets this from its RequeueAfter timers; the soak drives it
+            # explicitly so rounds stay aligned with the fake clock).
+            mgr.resync()
+            if "watch_break" in faults_now and not mgr.readyz():
+                readyz_degraded_seen = True
+            if not _quiesce(mgr, store, quiesce_timeout_s):
+                quiesce_timeouts += 1
+            _environment_step(r)
+            if "watch_break" in faults_now:
+                # Stream comes back: BOOKMARK frame → hardened managers
+                # resync (re-list + enqueue all); unhardened ones ignore
+                # it and stay degraded.
+                api.repair_watches()
+            if not _quiesce(mgr, store, quiesce_timeout_s):
+                quiesce_timeouts += 1
+
+        # ---- faults stop: convergence phase ------------------------------
+        api.disarm()
+        api.repair_watches()
+        mgr.resync()
+        if not _quiesce(mgr, store, quiesce_timeout_s):
+            quiesce_timeouts += 1
+
+        surface = _surface(store, watchlog)
+        fired_metric = mgr.metrics.get(
+            'controller_runtime_reconcile_total{controller="cron",'
+            'result="success"}'
+        )
+        metrics = {
+            "reconciles_ok": fired_metric,
+            "reconcile_errors": mgr.metrics.get(
+                'controller_runtime_reconcile_errors_total'
+                '{controller="cron"}'
+            ),
+            "ticks_fired": mgr.metrics.get("cron_ticks_fired_total"),
+            "ticks_skipped": mgr.metrics.get(
+                'cron_ticks_skipped_total{policy="Forbid"}'
+            ),
+            "missed_runs": mgr.metrics.get("cron_missed_runs_total"),
+            "watch_resyncs": mgr.metrics.get("watch_resyncs_total"),
+            "submit_retries": mgr.metrics.get("cron_submit_retries_total"),
+        }
+    finally:
+        mgr.stop()
+        retry_mod.DEFAULT_ATTEMPTS = prev_attempts
+
+    # ---- I4: converged state needs zero further writes -------------------
+    # Manager stopped, faults disarmed: a direct sweep over every Cron
+    # must not commit anything (rv bracketing counts store writes).
+    rv_before = int(getattr(store, "_rv"))
+    for i in range(n_crons):
+        rec.reconcile(NAMESPACE, f"chaos-{i}")
+    final_sweep_writes = int(getattr(store, "_rv")) - rv_before
+    store.close()
+
+    duplicate_names = sorted(
+        name
+        for names in watchlog.created.values()
+        for name in {n for n in names if names.count(n) > 1}
+    )
+
+    return {
+        "seed": seed,
+        "chaotic": chaotic,
+        "unhardened": unhardened,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "plan": asdict(plan),
+        "fault_schedule": schedule,
+        "fault_trace_hash": storm_plan.trace_hash(rounds),
+        "faults_injected": api.fault_counts(),
+        "dropped_watch_events": api.dropped_events(),
+        "lost_flips": lost_flips,
+        "quiesce_timeouts": quiesce_timeouts,
+        "readyz_degraded_seen": readyz_degraded_seen,
+        "leadership_lost_seen": leadership_lost_seen,
+        "metrics": metrics,
+        "surface": surface,
+        "created_count": watchlog.created_count,
+        "duplicate_names": duplicate_names,
+        "forbid_violations": list(watchlog.violations),
+        "final_sweep_writes": final_sweep_writes,
+    }
+
+
+def _surface(store, watchlog) -> dict:
+    """Semantic end state, shorn of run-varying identifiers (uids,
+    resourceVersions, timestamps): the I5 comparison surface."""
+    out: dict = {}
+    for cron in store.list(CRON_API_VERSION, "Cron", namespace=NAMESPACE):
+        name = (cron.get("metadata") or {}).get("name", "")
+        st = cron.get("status") or {}
+        out[name] = {
+            "active": sorted(
+                (ref.get("name", "") for ref in st.get("active") or []),
+            ),
+            "history": sorted(
+                (
+                    (h.get("object") or {}).get("name", ""),
+                    h.get("status", ""),
+                )
+                for h in st.get("history") or []
+            ),
+            "fired": sorted(watchlog.created.get(name, [])),
+        }
+    workloads: dict = {}
+    for w in store.list(
+        WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+    ):
+        meta = w.get("metadata") or {}
+        cron = (meta.get("labels") or {}).get(LABEL_CRON_NAME, "?")
+        workloads.setdefault(cron, []).append(
+            (meta.get("name", ""), _is_terminal(w) or "Running")
+        )
+    for cron, entries in workloads.items():
+        out.setdefault(cron, {})["workloads"] = sorted(entries)
+    return out
+
+
+def check_invariants(chaotic: dict, replay: dict, history_limit: int) -> dict:
+    """The five invariants, each with a human-readable detail string."""
+    inv: dict = {}
+
+    inv["I1_forbid_no_concurrent"] = {
+        "ok": not chaotic["forbid_violations"],
+        "detail": chaotic["forbid_violations"][:5] or "never exceeded 1",
+    }
+
+    over = [
+        (name, len(state.get("history", [])))
+        for name, state in chaotic["surface"].items()
+        if len(state.get("history", [])) > history_limit
+    ]
+    inv["I2_history_bounded"] = {
+        "ok": not over,
+        "detail": over[:5] or f"all <= historyLimit={history_limit}",
+    }
+
+    fired = chaotic["metrics"]["ticks_fired"]
+    created = chaotic["created_count"]
+    dups = chaotic["duplicate_names"]
+    inv["I3_tick_exactly_once"] = {
+        "ok": fired == created and not dups,
+        "detail": (
+            f"cron_ticks_fired_total={fired} workload_creates={created} "
+            f"duplicate_names={dups[:5]}"
+        ),
+    }
+
+    inv["I4_converges_zero_writes"] = {
+        "ok": chaotic["final_sweep_writes"] == 0,
+        "detail": (
+            f"{chaotic['final_sweep_writes']} store writes in the "
+            "post-convergence sweep"
+        ),
+    }
+
+    diffs = []
+    crons = sorted(set(chaotic["surface"]) | set(replay["surface"]))
+    for name in crons:
+        a = chaotic["surface"].get(name)
+        b = replay["surface"].get(name)
+        if a != b:
+            diffs.append({"cron": name, "chaotic": a, "replay": b})
+    inv["I5_matches_fault_free_replay"] = {
+        "ok": not diffs,
+        "detail": diffs[:3] or "chaotic end state == replay end state",
+    }
+    return inv
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--crons", type=int, default=200)
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--quiesce-timeout", type=float, default=30.0)
+    ap.add_argument("--unhardened", action="store_true", default=False,
+                    help="pre-hardening mode: single-attempt writes, no "
+                         "watch resync — demonstrates the invariant "
+                         "violations the hardening prevents")
+    ap.add_argument("--expect-violation", action="store_true", default=False,
+                    help="exit 0 iff at least one invariant is violated "
+                         "(for asserting the --unhardened demonstration)")
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
+    args = ap.parse_args(argv)
+
+    from cron_operator_tpu.runtime.faults import FaultPlan
+
+    # Determinism of the fault trace: the schedule expansion is a pure
+    # function of the plan — expand twice from fresh objects and compare.
+    plan_a = FaultPlan.default_chaos(args.seed)
+    plan_b = FaultPlan.default_chaos(args.seed)
+    deterministic = (
+        plan_a.schedule(args.rounds) == plan_b.schedule(args.rounds)
+        and plan_a.trace_hash(args.rounds) == plan_b.trace_hash(args.rounds)
+    )
+
+    print(
+        f"chaos soak: seed={args.seed} crons={args.crons} "
+        f"rounds={args.rounds} unhardened={args.unhardened}",
+        flush=True,
+    )
+    chaotic = run_soak(
+        args.seed, args.crons, args.rounds, workers=args.workers,
+        chaotic=True, unhardened=args.unhardened,
+        quiesce_timeout_s=args.quiesce_timeout,
+    )
+    print(
+        f"  chaotic run: {chaotic['elapsed_s']}s "
+        f"faults={chaotic['faults_injected']} "
+        f"dropped_events={chaotic['dropped_watch_events']} "
+        f"lost_flips={chaotic['lost_flips']}",
+        flush=True,
+    )
+    replay = run_soak(
+        args.seed, args.crons, args.rounds, workers=args.workers,
+        chaotic=False, unhardened=False,
+        quiesce_timeout_s=args.quiesce_timeout,
+    )
+    print(f"  replay run: {replay['elapsed_s']}s", flush=True)
+
+    invariants = check_invariants(chaotic, replay, HISTORY_LIMIT)
+    ok = all(v["ok"] for v in invariants.values()) and deterministic
+
+    report = {
+        "seed": args.seed,
+        "n_crons": args.crons,
+        "rounds": args.rounds,
+        "workers": args.workers,
+        "unhardened": args.unhardened,
+        "deterministic_schedule": deterministic,
+        "fault_trace_hash": chaotic["fault_trace_hash"],
+        "fault_schedule": chaotic["fault_schedule"],
+        "faults_injected": chaotic["faults_injected"],
+        "dropped_watch_events": chaotic["dropped_watch_events"],
+        "lost_flips": chaotic["lost_flips"],
+        "quiesce_timeouts": chaotic["quiesce_timeouts"],
+        "readyz_degraded_seen": chaotic["readyz_degraded_seen"],
+        "leadership_lost_seen": chaotic["leadership_lost_seen"],
+        "metrics": chaotic["metrics"],
+        "elapsed_s": {
+            "chaotic": chaotic["elapsed_s"],
+            "replay": replay["elapsed_s"],
+        },
+        "invariants": invariants,
+        "ok": ok,
+    }
+    # The full surfaces are bulky at N>=200; persist only on divergence.
+    if not invariants["I5_matches_fault_free_replay"]["ok"]:
+        report["surface_chaotic"] = chaotic["surface"]
+        report["surface_replay"] = replay["surface"]
+
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+        f.write("\n")
+
+    for name, v in invariants.items():
+        mark = "PASS" if v["ok"] else "FAIL"
+        print(f"  [{mark}] {name}: {v['detail']}")
+    print(f"wrote {args.out} (ok={ok})")
+
+    if args.expect_violation:
+        violated = not all(v["ok"] for v in invariants.values())
+        if violated:
+            print("expected violation observed — unhardened mode "
+                  "demonstrably breaks an invariant")
+            return 0
+        print("ERROR: expected an invariant violation but all passed")
+        return 1
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,15 @@ print("    storm %s Crons/s; steady-state store writes: 0"
       % r["fire_storm_crons_per_s"])
 '
 
+echo "==> chaos smoke (fixed-seed fault injection, 5 invariants)"
+# Short seeded soak: 40 Crons x 3 rounds under the default chaos plan
+# (conflicts, transient errors, watch breaks, leader loss, preemption
+# storms), then a fault-free replay from the same seed. Exits non-zero
+# if any of the five invariants (Forbid exclusion, bounded history,
+# exactly-once ticks, zero-write convergence, replay equivalence) is
+# violated. Full run: make chaos-soak (writes CHAOS.json).
+python hack/chaos_soak.py --seed 7 --crons 40 --rounds 3 --out /dev/null
+
 echo "==> unit + integration tests"
 # With pytest-cov installed (CI always; optional locally) the suite runs
 # under coverage and hack/ci_gate enforces the pyproject fail_under

@@ -1,0 +1,423 @@
+"""Chaos-layer specs: the seeded fault injector, the conflict-retry
+helper, and the Manager's watch-resync hardening.
+
+The determinism contract under test is the one ``hack/chaos_soak.py``
+relies on: a :class:`FaultPlan`'s schedule expansion, trace hash, and
+per-call-index decisions are pure functions of the seed — never of
+wall-clock time, thread interleaving, or call order across verbs."""
+
+from datetime import timedelta
+
+import pytest
+
+from cron_operator_tpu.api.v1alpha1 import (
+    API_VERSION,
+    KIND_CRON,
+    parse_time,
+    rfc3339,
+)
+from cron_operator_tpu.controller.cron_controller import (
+    SUBMIT_ATTEMPTS,
+    CronReconciler,
+)
+from cron_operator_tpu.runtime.faults import (
+    FaultInjector,
+    FaultPlan,
+    seeded_fraction,
+)
+from cron_operator_tpu.runtime.kube import (
+    ApiError,
+    ConflictError,
+    ServerTimeoutError,
+)
+from cron_operator_tpu.runtime.manager import (
+    LEADER_LEASE_NAME,
+    LEASE_API_VERSION,
+    LEASE_KIND,
+    Manager,
+    Metrics,
+)
+from cron_operator_tpu.runtime.retry import with_conflict_retry
+
+JAX_AV, JAX_KIND = "kubeflow.org/v1", "JAXJob"
+
+
+def make_cron(api, name="demo", schedule="*/1 * * * *"):
+    return api.create({
+        "apiVersion": API_VERSION,
+        "kind": KIND_CRON,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "schedule": schedule,
+            "template": {"workload": {
+                "apiVersion": JAX_AV,
+                "kind": JAX_KIND,
+                "metadata": {},
+                "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+            }},
+        },
+    })
+
+
+def make_job(api, name="job-0"):
+    return api.create({
+        "apiVersion": JAX_AV,
+        "kind": JAX_KIND,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+    })
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan: PRF + schedule determinism
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_seeded_fraction_is_a_pure_function(self):
+        a = seeded_fraction(7, "latency", "update", 3)
+        b = seeded_fraction(7, "latency", "update", 3)
+        assert a == b
+        assert 0.0 <= a < 1.0
+        # distinct injection points decide independently
+        assert a != seeded_fraction(7, "latency", "update", 4)
+        assert a != seeded_fraction(8, "latency", "update", 3)
+
+    def test_schedule_expansion_is_deterministic(self):
+        rounds = 50
+        s1 = FaultPlan.default_chaos(3).schedule(rounds)
+        s2 = FaultPlan.default_chaos(3).schedule(rounds)
+        assert s1 == s2
+        assert FaultPlan.default_chaos(3).trace_hash(rounds) == \
+            FaultPlan.default_chaos(3).trace_hash(rounds)
+        # with 50 rounds at the default probabilities every scheduled
+        # fault class appears, and a different seed gives a different trace
+        kinds = {e["fault"] for e in s1}
+        assert kinds == {"watch_break", "leader_revoke", "preempt_storm"}
+        assert FaultPlan.default_chaos(4).trace_hash(rounds) != \
+            FaultPlan.default_chaos(3).trace_hash(rounds)
+
+    def test_quiet_plan_schedules_nothing(self):
+        assert FaultPlan.quiet(3).schedule(100) == []
+
+    def test_planned_submit_failures_bounded_and_deterministic(self):
+        plan = FaultPlan(seed=1, submit_fail_prob=0.5, submit_fail_max=3)
+        names = [f"wl-{i}" for i in range(200)]
+        planned = [plan.planned_submit_failures(n) for n in names]
+        assert planned == [plan.planned_submit_failures(n) for n in names]
+        assert all(0 <= p <= 3 for p in planned)
+        assert any(p == 0 for p in planned) and any(p > 0 for p in planned)
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector: per-call faults, bounded submit failures, forwarding
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_conflict_injection_on_update(self, api):
+        inj = FaultInjector(api, FaultPlan(seed=0, conflict_prob=1.0))
+        obj = make_job(inj)
+        with pytest.raises(ConflictError):
+            inj.update(dict(obj))
+        assert inj.fault_counts() == {"conflict": 1}
+
+    def test_transient_injection_on_create(self, api):
+        inj = FaultInjector(api, FaultPlan(seed=0, transient_prob=1.0))
+        with pytest.raises(ServerTimeoutError):
+            make_cron(inj)
+        assert inj.fault_counts() == {"transient": 1}
+
+    def test_reads_are_never_failed(self, api):
+        make_job(api)
+        inj = FaultInjector(
+            api, FaultPlan(seed=0, conflict_prob=1.0, transient_prob=1.0)
+        )
+        assert len(inj.list(JAX_AV, JAX_KIND, namespace="default")) == 1
+        assert inj.get(JAX_AV, JAX_KIND, "default", "job-0")
+
+    def test_disarm_stops_injection(self, api):
+        inj = FaultInjector(api, FaultPlan(seed=0, transient_prob=1.0))
+        inj.disarm()
+        make_cron(inj)
+        assert inj.fault_counts() == {}
+        inj.arm()
+        with pytest.raises(ServerTimeoutError):
+            make_job(inj, "other")
+
+    def test_faults_injected_total_metric(self, api):
+        inj = FaultInjector(api, FaultPlan(seed=0, conflict_prob=1.0))
+        metrics = Metrics()
+        inj.instrument(metrics)
+        obj = make_job(inj)
+        with pytest.raises(ConflictError):
+            inj.update(dict(obj))
+        assert metrics.counters['faults_injected_total{kind="conflict"}'] == 1.0
+
+    def test_submit_failures_bounded_per_name(self, api):
+        # Every workload name selected, at most 3 failures each: the 4th
+        # create of the same name must reach the store.
+        plan = FaultPlan(seed=5, submit_fail_prob=1.0, submit_fail_max=3)
+        inj = FaultInjector(api, plan)
+        planned = plan.planned_submit_failures("job-0")
+        assert 1 <= planned <= 3
+        failures = 0
+        for _ in range(planned):
+            with pytest.raises(ServerTimeoutError):
+                make_job(inj)
+            failures += 1
+        made = make_job(inj)  # budget spent — goes through
+        assert made["metadata"]["name"] == "job-0"
+        assert failures == planned
+        assert inj.fault_counts()["submit_fail"] == planned
+
+    def test_non_workload_creates_skip_submit_faults(self, api):
+        inj = FaultInjector(
+            api, FaultPlan(seed=5, submit_fail_prob=1.0, submit_fail_max=3)
+        )
+        make_cron(inj)  # Cron is not a SUBMIT_KIND
+        assert inj.fault_counts() == {}
+
+    def test_forwarding_preserves_store_surface(self, api):
+        inj = FaultInjector(api, FaultPlan.quiet(0))
+        make_job(inj)
+        assert len(inj) == len(api)
+        assert inj.clock is api.clock
+        assert inj.events() == []
+        assert bool(inj)
+
+    def test_watch_break_drops_events_and_repair_resumes(self, api):
+        inj = FaultInjector(api, FaultPlan.quiet(0))
+        frames = []
+        inj.add_watcher(frames.append)
+        make_job(inj, "before")
+        api.flush(timeout=2.0)
+        assert [f.type for f in frames] == ["ADDED"]
+
+        inj.break_watches()
+        make_job(inj, "during")
+        api.flush(timeout=2.0)
+        assert [f.type for f in frames] == ["ADDED", "ERROR"]
+        assert inj.dropped_events() >= 1
+
+        inj.repair_watches()
+        make_job(inj, "after")
+        api.flush(timeout=2.0)
+        types = [f.type for f in frames]
+        assert types[:3] == ["ADDED", "ERROR", "BOOKMARK"]
+        assert types[-1] == "ADDED"
+        names = [
+            (f.object.get("metadata") or {}).get("name")
+            for f in frames if f.type == "ADDED"
+        ]
+        assert names == ["before", "after"]  # "during" was dropped
+
+    def test_leadership_revoke_and_expire(self, api, fake_clock):
+        inj = FaultInjector(api, FaultPlan.quiet(0))
+        assert inj.revoke_leader() is False  # no lease yet
+        api.create({
+            "apiVersion": LEASE_API_VERSION,
+            "kind": LEASE_KIND,
+            "metadata": {
+                "namespace": "kube-system", "name": LEADER_LEASE_NAME,
+            },
+            "spec": {
+                "holderIdentity": "manager-0",
+                "renewTime": rfc3339(fake_clock.now()),
+                "leaseDurationSeconds": 15,
+            },
+        })
+        assert inj.revoke_leader() is True
+        lease = api.get(
+            LEASE_API_VERSION, LEASE_KIND, "kube-system", LEADER_LEASE_NAME
+        )
+        assert lease["spec"]["holderIdentity"] == "chaos-rival"
+
+        assert inj.expire_leader_lease() is True
+        lease = api.get(
+            LEASE_API_VERSION, LEASE_KIND, "kube-system", LEADER_LEASE_NAME
+        )
+        renew = parse_time(lease["spec"]["renewTime"])
+        # rewound ≥ 10× the lease duration: any holder reads as expired
+        assert fake_clock.now() - renew >= timedelta(seconds=150)
+
+
+# ---------------------------------------------------------------------------
+# with_conflict_retry
+# ---------------------------------------------------------------------------
+
+
+class TestWithConflictRetry:
+    def test_succeeds_after_transient_conflicts(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConflictError("stale rv")
+            return "ok"
+
+        assert with_conflict_retry(flaky, attempts=5, base_s=0.0) == "ok"
+        assert calls["n"] == 3
+
+    def test_retries_server_timeout_too(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ServerTimeoutError("503")
+            return calls["n"]
+
+        assert with_conflict_retry(flaky, attempts=2, base_s=0.0) == 2
+
+    def test_exhaustion_reraises_last_error(self):
+        def always():
+            raise ConflictError("never converges")
+
+        with pytest.raises(ConflictError):
+            with_conflict_retry(always, attempts=3, base_s=0.0)
+
+    def test_non_retriable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def broken():
+            calls["n"] += 1
+            raise ApiError("schema rejected")
+
+        with pytest.raises(ApiError):
+            with_conflict_retry(broken, attempts=5, base_s=0.0)
+        assert calls["n"] == 1
+
+    def test_attempts_must_be_positive(self):
+        with pytest.raises(ValueError):
+            with_conflict_retry(lambda: None, attempts=0)
+
+
+# ---------------------------------------------------------------------------
+# Manager hardening: ERROR degrades readyz, BOOKMARK resyncs
+# ---------------------------------------------------------------------------
+
+
+def _drain(mgr, api, timeout_s=5.0):
+    import time as _t
+    deadline = _t.monotonic() + timeout_s
+    while _t.monotonic() < deadline:
+        api.flush(timeout=1.0)
+        if all(c.queue.stats()[:2] == (0, 0) for c in mgr._controllers):
+            return
+        _t.sleep(0.01)
+
+
+class TestManagerWatchResync:
+    def _started_manager(self, api):
+        from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+
+        rec = CronReconciler(api)
+        mgr = Manager(api, max_concurrent_reconciles=2)
+        mgr.add_controller(
+            "cron", rec.reconcile, for_gvk=GVK_CRON,
+            owns=default_scheme().workload_kinds(),
+        )
+        mgr.start()
+        return mgr
+
+    def test_error_frame_degrades_readyz(self, api):
+        inj = FaultInjector(api, FaultPlan.quiet(0))
+        mgr = self._started_manager(inj)
+        try:
+            assert mgr.readyz()
+            inj.break_watches()
+            api.flush(timeout=2.0)
+            assert not mgr.readyz()
+            assert mgr.healthz()  # degraded, not dead
+        finally:
+            mgr.stop()
+
+    def test_bookmark_resyncs_and_restores_readyz(self, api, fake_clock):
+        inj = FaultInjector(api, FaultPlan.quiet(0))
+        mgr = self._started_manager(inj)
+        try:
+            make_cron(inj)
+            _drain(mgr, api)
+            inj.break_watches()
+            api.flush(timeout=2.0)
+            # Edit made while the stream is down: the tick comes due but
+            # no MODIFIED/ADDED event reaches the manager.
+            fake_clock.advance(timedelta(minutes=2))
+            assert not mgr.readyz()
+
+            inj.repair_watches()
+            _drain(mgr, api)
+            assert mgr.readyz()
+            assert mgr.metrics.counters["watch_resyncs_total"] == 1.0
+            # The resync's enqueue-all sweep reconciled the due tick.
+            assert len(api.list(JAX_AV, JAX_KIND, namespace="default")) == 1
+        finally:
+            mgr.stop()
+
+    def test_resync_opt_out_keeps_prepr_behavior(self, api):
+        inj = FaultInjector(api, FaultPlan.quiet(0))
+        mgr = self._started_manager(inj)
+        mgr.resync_on_watch_error = False
+        try:
+            inj.break_watches()
+            inj.repair_watches()
+            api.flush(timeout=2.0)
+            assert not mgr.readyz()  # BOOKMARK ignored: stays degraded
+            assert "watch_resyncs_total" not in mgr.metrics.counters
+        finally:
+            mgr.stop()
+
+
+# ---------------------------------------------------------------------------
+# Reconciler submit retries
+# ---------------------------------------------------------------------------
+
+
+class _AlwaysFailSubmit:
+    """API wrapper whose workload creates always time out."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.clock = inner.clock
+        self.creates = 0
+
+    def create(self, obj):
+        if obj.get("kind") == JAX_KIND:
+            self.creates += 1
+            raise ServerTimeoutError("injected: backend submit down")
+        return self.inner.create(obj)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class TestSubmitRetries:
+    def test_bounded_submit_failures_are_retried_through(self, api, fake_clock):
+        # Planned failures (≤3) stay below SUBMIT_ATTEMPTS (6): the
+        # reconciler's retry loop always gets the workload through.
+        inj = FaultInjector(
+            api, FaultPlan(seed=5, submit_fail_prob=1.0, submit_fail_max=3)
+        )
+        metrics = Metrics()
+        rec = CronReconciler(inj, metrics=metrics)
+        make_cron(inj)
+        fake_clock.advance(timedelta(minutes=2))
+        rec.reconcile("default", "demo")
+        assert len(api.list(JAX_AV, JAX_KIND, namespace="default")) == 1
+        assert metrics.counters["cron_submit_retries_total"] >= 1.0
+        assert api.events(reason="SubmitRetriesExhausted") == []
+
+    def test_exhaustion_records_warning_event_and_raises(self, api, fake_clock):
+        wrapped = _AlwaysFailSubmit(api)
+        rec = CronReconciler(wrapped)
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        with pytest.raises(ServerTimeoutError):
+            rec.reconcile("default", "demo")
+        assert wrapped.creates == SUBMIT_ATTEMPTS
+        events = api.events(reason="SubmitRetriesExhausted")
+        assert len(events) == 1
+        assert events[0].type == "Warning"
+        assert "demo-" in events[0].message

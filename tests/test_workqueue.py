@@ -1,0 +1,145 @@
+"""Workqueue specs: per-item exponential backoff (client-go
+ItemExponentialFailureRateLimiter parity), rate-limited/delayed add
+ordering, and the stats() idleness probe the chaos soak quiesces on."""
+
+import time
+
+from cron_operator_tpu.runtime.workqueue import (
+    ItemExponentialBackoff,
+    WorkQueue,
+)
+
+
+class TestItemExponentialBackoff:
+    def test_delay_doubles_per_failure(self):
+        rl = ItemExponentialBackoff(base_s=0.005, cap_s=1000.0)
+        assert [rl.when("a") for _ in range(4)] == [
+            0.005, 0.01, 0.02, 0.04,
+        ]
+
+    def test_items_backoff_independently(self):
+        rl = ItemExponentialBackoff(base_s=0.005)
+        rl.when("a")
+        rl.when("a")
+        assert rl.when("b") == 0.005  # fresh item starts at base
+
+    def test_cap_is_1000s(self):
+        rl = ItemExponentialBackoff(base_s=0.005, cap_s=1000.0)
+        for _ in range(17):  # 0.005 * 2**17 = 655.36 — still under
+            rl.when("a")
+        assert rl.when("a") == 0.005 * 2 ** 17
+        assert rl.when("a") == 1000.0  # 2**18 would be 1310.72 — capped
+
+    def test_overflow_clamp_for_persistent_failures(self):
+        # 2**n overflows float around n=1024; the limiter clamps the
+        # exponent rather than raising OverflowError at failure ~1030.
+        rl = ItemExponentialBackoff(base_s=0.005, cap_s=1000.0)
+        for _ in range(2000):
+            delay = rl.when("a")
+            assert delay <= 1000.0
+        assert rl.num_requeues("a") == 2000
+
+    def test_forget_resets_backoff(self):
+        rl = ItemExponentialBackoff(base_s=0.005)
+        for _ in range(5):
+            rl.when("a")
+        assert rl.num_requeues("a") == 5
+        rl.forget("a")
+        assert rl.num_requeues("a") == 0
+        assert rl.when("a") == 0.005
+
+    def test_forget_unknown_item_is_noop(self):
+        rl = ItemExponentialBackoff()
+        rl.forget("ghost")
+        assert rl.num_requeues("ghost") == 0
+
+    def test_num_requeues_counts_without_mutating(self):
+        rl = ItemExponentialBackoff()
+        rl.when("a")
+        assert rl.num_requeues("a") == 1
+        assert rl.num_requeues("a") == 1  # reading doesn't bump
+
+
+class TestRateLimitedAdds:
+    def test_add_rate_limited_first_failure_is_near_immediate(self):
+        q = WorkQueue()
+        try:
+            q.add_rate_limited("a")
+            assert q.get(timeout=2.0) == "a"  # base 5ms delay
+        finally:
+            q.shut_down()
+
+    def test_add_rate_limited_orders_by_accumulated_backoff(self):
+        # "hot" has failed 6 times (320ms delay), "cold" once (5ms):
+        # enqueued together, cold must surface first.
+        q = WorkQueue()
+        try:
+            for _ in range(6):
+                q.rate_limiter.when("hot")
+            q.add_rate_limited("hot")
+            q.add_rate_limited("cold")
+            assert q.get(timeout=2.0) == "cold"
+            q.done("cold")
+            assert q.get(timeout=2.0) == "hot"
+        finally:
+            q.shut_down()
+
+    def test_forget_propagates_to_rate_limiter(self):
+        q = WorkQueue()
+        try:
+            for _ in range(8):
+                q.rate_limiter.when("a")
+            q.forget("a")
+            assert q.rate_limiter.num_requeues("a") == 0
+        finally:
+            q.shut_down()
+
+    def test_add_after_orders_by_deadline_not_insertion(self):
+        q = WorkQueue()
+        try:
+            q.add_after("late", 0.25)
+            q.add_after("early", 0.01)
+            assert q.get(timeout=2.0) == "early"
+            q.done("early")
+            assert q.get(timeout=2.0) == "late"
+        finally:
+            q.shut_down()
+
+    def test_add_after_zero_delay_enqueues_directly(self):
+        q = WorkQueue()
+        try:
+            q.add_after("now", 0.0)
+            assert q.stats()[0] == 1  # queued, no delayed entry
+            assert q.get(timeout=1.0) == "now"
+        finally:
+            q.shut_down()
+
+
+class TestStats:
+    def test_stats_tracks_queued_processing_and_delayed(self):
+        q = WorkQueue()
+        try:
+            assert q.stats() == (0, 0, None)
+            q.add("a")
+            assert q.stats() == (1, 0, None)
+            assert q.get(timeout=1.0) == "a"
+            assert q.stats() == (0, 1, None)  # being processed
+            q.done("a")
+            assert q.stats() == (0, 0, None)
+
+            q.add_after("b", 30.0)
+            queued, processing, next_delay = q.stats()
+            assert (queued, processing) == (0, 0)
+            assert next_delay is not None and 0 < next_delay <= 30.0
+        finally:
+            q.shut_down()
+
+    def test_stats_delay_shrinks_toward_deadline(self):
+        q = WorkQueue()
+        try:
+            q.add_after("b", 5.0)
+            first = q.stats()[2]
+            time.sleep(0.05)
+            assert q.stats()[2] < first
+        finally:
+            q.shut_down()
