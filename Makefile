@@ -45,12 +45,19 @@ bench-controlplane:
 
 # Seeded chaos soak: N Crons reconciled under a deterministic fault
 # schedule (conflicts, transient server errors, latency, submit
-# failures, watch breaks, leader revocations, slice-preemption storms),
-# then replayed fault-free from the same seed. Asserts the five
-# invariants documented in README "Fault tolerance & chaos testing" and
-# writes CHAOS.json. SEED=<n> reproduces a run exactly; N= / ROUNDS=
-# scale it.
+# failures, watch breaks, leader revocations, slice-preemption storms)
+# plus crash-restart rounds (seeded kill-points in the WAL write path,
+# recovery from --data-dir), then replayed fault-free from the same
+# seed. Asserts the seven invariants documented in README "Durability &
+# crash recovery" and writes CHAOS.json; afterwards re-runs the same
+# kill schedule WITHOUT durability and requires the restart-integrity
+# invariant (I7) to break — the counter-proof that the soak detects the
+# loss the WAL prevents. SEED=<n> reproduces a run exactly; N= /
+# ROUNDS= scale it.
 chaos-soak:
 	python hack/chaos_soak.py --seed $(or $(SEED),0) \
 	    --crons $(or $(N),200) --rounds $(or $(ROUNDS),6) \
 	    --out CHAOS.json
+	python hack/chaos_soak.py --seed $(or $(SEED),0) \
+	    --crons $(or $(N),200) --rounds $(or $(ROUNDS),6) \
+	    --no-durability --expect-violation --out /dev/null

@@ -14,7 +14,9 @@ Schema parity notes (reference CRD properties):
   ``x-kubernetes-preserve-unknown-fields`` (the RawExtension seam),
 - ``spec.concurrencyPolicy`` enum Allow/Forbid/Replace,
 - ``spec.suspend`` bool, ``spec.deadline`` date-time, ``spec.historyLimit``
-  int (+ our ``spec.timezone`` extension),
+  int (+ our ``spec.timezone`` and ``spec.startingDeadlineSeconds``
+  extensions — the latter is batch/v1 CronJob parity, bounding how stale a
+  missed run may be and still fire during catch-up),
 - status subresource with active/history/lastScheduleTime,
 - printcolumns Schedule/Suspend/Last Schedule/Age.
 """
@@ -126,6 +128,17 @@ def crd_manifest() -> Dict[str, Any]:
                 "description": (
                     "IANA timezone for schedule evaluation (extension; the "
                     "reference can only inherit the container timezone)."
+                ),
+            },
+            "startingDeadlineSeconds": {
+                "type": "integer",
+                "format": "int64",
+                "minimum": 1,
+                "description": (
+                    "Deadline in seconds for starting a missed run; a tick "
+                    "older than this when the controller catches up (after "
+                    "downtime or crash recovery) is skipped as a missed "
+                    "run instead of fired (batch/v1 CronJob parity)."
                 ),
             },
         },

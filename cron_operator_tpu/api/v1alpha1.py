@@ -377,6 +377,11 @@ class CronSpec:
     # mount of /etc/localtime (chart `useHostTimezone`); a spec field is the
     # declarative version of the same capability.
     timezone: Optional[str] = None
+    # CronJob-parity bound on missed-run catch-up: a tick more than this
+    # many seconds in the past when the controller gets to it (downtime,
+    # crash recovery, long suspension) is skipped instead of fired.
+    # None = no deadline, every in-policy missed run fires.
+    starting_deadline_seconds: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -397,6 +402,8 @@ class CronSpec:
             out["historyLimit"] = self.history_limit
         if self.timezone is not None:
             out["timezone"] = self.timezone
+        if self.starting_deadline_seconds is not None:
+            out["startingDeadlineSeconds"] = self.starting_deadline_seconds
         return out
 
     @classmethod
@@ -408,6 +415,7 @@ class CronSpec:
         except ValueError:
             policy = ConcurrencyPolicy.ALLOW
         hl = d.get("historyLimit")
+        sds = d.get("startingDeadlineSeconds")
         return cls(
             schedule=d.get("schedule", ""),
             template=CronTemplateSpec.from_dict(d.get("template")),
@@ -416,6 +424,7 @@ class CronSpec:
             deadline=parse_time(d.get("deadline")),
             history_limit=int(hl) if hl is not None else None,
             timezone=d.get("timezone"),
+            starting_deadline_seconds=int(sds) if sds is not None else None,
         )
 
 

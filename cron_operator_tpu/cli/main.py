@@ -159,7 +159,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="':8080' to enable, '0' to disable (default)")
     # Secure-metrics trio (reference start.go:226-242; default-secure,
     # default-no-h2 per the Rapid-Reset CVE guidance it cites):
+    # nargs='?' + const=True: Go flag parity — bare `--metrics-secure`
+    # means true, `--metrics-secure=false` still works.
     start.add_argument("--metrics-secure", type=_bool_arg, default=True,
+                       nargs="?", const=True,
                        metavar="BOOL",
                        help="serve /metrics over HTTPS (default true; "
                             "--metrics-secure=false for plain HTTP). With "
@@ -242,6 +245,16 @@ def build_parser() -> argparse.ArgumentParser:
                             "injection for resilience drills; faults are "
                             "counted in faults_injected_total{kind}. See "
                             "README 'Fault tolerance & chaos testing'")
+    start.add_argument("--data-dir", default=None, metavar="DIR",
+                       help="embedded mode only: persist control-plane "
+                            "state to DIR (append-only WAL + compacted "
+                            "snapshots) and recover it on startup — Crons, "
+                            "workloads, lastScheduleTime and resource "
+                            "versions survive a crash/restart; ticks "
+                            "missed during downtime fire or are skipped "
+                            "per concurrencyPolicy and spec."
+                            "startingDeadlineSeconds. Unset = in-memory "
+                            "only (state lost on exit)")
 
     # kubectl-style inspection for standalone mode: the reference relies
     # on kubectl + CRD printcolumns (cron_types.go:33-36); with no
@@ -371,6 +384,31 @@ def cmd_start(args: argparse.Namespace) -> int:
     else:
         api = APIServer()
 
+    persistence = None
+    recovered = None
+    if args.data_dir:
+        if args.api_server == "cluster":
+            log.error("--data-dir applies to the embedded control plane "
+                      "only; cluster mode persists in etcd")
+            return 2
+        from cron_operator_tpu.runtime.persistence import Persistence
+
+        # Attach to the raw store (before any chaos wrapper): the WAL
+        # hooks live inside APIServer's commit path.
+        persistence = Persistence(args.data_dir)
+        recovered = persistence.start(api)
+        if recovered.empty:
+            log.info("durability: empty data dir %s; starting fresh",
+                     args.data_dir)
+        else:
+            log.info(
+                "durability: recovered %d object(s) at rv=%d from %s "
+                "(snapshot=%s, wal records replayed=%d, torn dropped=%d)",
+                len(recovered.objects), recovered.rv, args.data_dir,
+                recovered.had_snapshot, recovered.wal_records_replayed,
+                recovered.torn_records_dropped,
+            )
+
     if args.chaos_seed is not None:
         if args.api_server == "cluster":
             log.error("--chaos-seed requires the embedded control plane "
@@ -390,6 +428,9 @@ def cmd_start(args: argparse.Namespace) -> int:
         api,
         max_concurrent_reconciles=args.max_concurrent_reconciles,
         leader_elect=args.leader_elect,
+        # After recovering real state, hold readyz until the catch-up
+        # enqueue sweep drains once (missed ticks fired/skipped).
+        recovering=recovered is not None and not recovered.empty,
     )
     # One tracer per process: the cron tick's trace id links reconcile/
     # submit spans (controller) to compile/first-step spans (backend) on
@@ -522,10 +563,20 @@ def cmd_start(args: argparse.Namespace) -> int:
                     "TokenReview/SubjectAccessReview"
                 )
             else:
+                # Divergence from the reference: its FilterProvider can
+                # lean on the cluster's TokenReview/SubjectAccessReview
+                # for every scrape (start.go:121-133); embedded mode has
+                # no tokenreview authority, so instead of serving TLS
+                # without authentication we mint a per-process bearer
+                # token. Logged exactly once, at startup — copy it into
+                # the scraper, or pass --metrics-token to pin one.
+                import secrets
+
+                metrics_token = secrets.token_urlsafe(32)
                 log.warning(
-                    "metrics served over TLS without authentication — "
-                    "set --metrics-token (or --serve-api-token) to "
-                    "require a bearer token"
+                    "metrics auth: no --metrics-token/--serve-api-token "
+                    "set; generated bearer token for this process: %s",
+                    metrics_token,
                 )
         servers.append(
             _serve(
@@ -602,6 +653,8 @@ def cmd_start(args: argparse.Namespace) -> int:
         api.stop()  # ClusterAPIServer: stop watch threads
     else:
         api.close()  # embedded store: stop the watch dispatcher
+    if persistence is not None:
+        persistence.close()  # flush + fsync the WAL tail
     for s in servers:
         s.shutdown()
     return 0

@@ -138,6 +138,23 @@ class CronReconciler:
         if self.metrics is not None:
             self.metrics.inc(name, value)
 
+    def _note_skipped_tick(self, ns: str, name: str,
+                           missed_run: datetime) -> bool:
+        """Record that ``missed_run`` was skipped for this Cron; True iff
+        it is a fresh skip (count/emit once per tick, not per reconcile —
+        the same pending tick is re-seen until it fires or is
+        superseded). Map capped at SKIP_DEDUP_CAP by shedding
+        oldest-inserted entries."""
+        if self._last_skipped_tick.get((ns, name)) == missed_run:
+            return False
+        self._last_skipped_tick[(ns, name)] = missed_run
+        if len(self._last_skipped_tick) > SKIP_DEDUP_CAP:
+            excess = len(self._last_skipped_tick) - SKIP_DEDUP_CAP
+            for key in list(self._last_skipped_tick)[:excess]:
+                if key != (ns, name):
+                    del self._last_skipped_tick[key]
+        return True
+
     # -- entry point --------------------------------------------------------
 
     def reconcile(self, namespace: str, name: str) -> ReconcileResult:
@@ -289,6 +306,32 @@ class CronReconciler:
             return scheduled
 
         if (
+            cron.spec.starting_deadline_seconds is not None
+            and (now - missed_run).total_seconds()
+            > cron.spec.starting_deadline_seconds
+        ):
+            # batch/v1 CronJob startingDeadlineSeconds: the tick is too
+            # stale to start (typically after downtime or crash recovery).
+            # Skip it without advancing lastScheduleTime — the next
+            # in-deadline tick fires normally and sweeps past this one.
+            log.info(
+                "skip tick %s: %.0fs past startingDeadlineSeconds=%d",
+                missed_run, (now - missed_run).total_seconds(),
+                cron.spec.starting_deadline_seconds,
+            )
+            if self._note_skipped_tick(ns, name, missed_run):
+                self._count(
+                    'cron_ticks_skipped_total{policy="StartingDeadline"}'
+                )
+                self.api.record_event(
+                    cron.to_dict(),
+                    "Warning",
+                    "MissedStartDeadline",
+                    f"missed start deadline for tick {missed_run}; skipped",
+                )
+            return scheduled
+
+        if (
             cron.spec.concurrency_policy == ConcurrencyPolicy.FORBID
             and len(active) > 0
         ):
@@ -298,16 +341,8 @@ class CronReconciler:
             )
             # Count each distinct skipped tick once, not once per reconcile
             # (the same pending tick is re-seen until it fires/expires).
-            if self._last_skipped_tick.get((ns, name)) != missed_run:
-                self._last_skipped_tick[(ns, name)] = missed_run
+            if self._note_skipped_tick(ns, name, missed_run):
                 self._count('cron_ticks_skipped_total{policy="Forbid"}')
-                if len(self._last_skipped_tick) > SKIP_DEDUP_CAP:
-                    # Shed oldest-inserted entries (dict preserves
-                    # insertion order); see SKIP_DEDUP_CAP.
-                    excess = len(self._last_skipped_tick) - SKIP_DEDUP_CAP
-                    for key in list(self._last_skipped_tick)[:excess]:
-                        if key != (ns, name):
-                            del self._last_skipped_tick[key]
             return scheduled
 
         if cron.spec.concurrency_policy == ConcurrencyPolicy.REPLACE:

@@ -93,6 +93,7 @@ class FaultPlan:
     leader_revoke_prob: float = 0.0  # P(round revokes the leader lease)
     preempt_prob: float = 0.0        # P(round is a slice-preemption storm)
     preempt_frac: float = 0.5        # fraction of running workloads hit
+    kill_prob: float = 0.0           # P(round ends in a process kill+restart)
 
     @classmethod
     def default_chaos(cls, seed: int) -> "FaultPlan":
@@ -140,6 +141,17 @@ class FaultPlan:
                 < self.preempt_prob
             ):
                 events.append({"round": r, "fault": "preempt_storm"})
+            if seeded_fraction(self.seed, "sched", "kill", r) < self.kill_prob:
+                events.append({"round": r, "fault": "kill"})
+        if self.kill_prob > 0.0 and not any(
+            e["fault"] == "kill" for e in events
+        ):
+            # A crash-restart soak with zero kills proves nothing; force
+            # exactly one, at a PRF-chosen round, so it stays replayable.
+            frac = seeded_fraction(self.seed, "sched", "killforce")
+            forced = int(frac * rounds)
+            events.append({"round": forced, "fault": "kill"})
+            events.sort(key=lambda e: e["round"])
         return events
 
     def trace_hash(self, rounds: int) -> str:
@@ -161,6 +173,66 @@ class FaultPlan:
         return 1 + int(
             seeded_fraction(self.seed, "submitcnt", name) * self.submit_fail_max
         )
+
+
+#: Where a seeded kill strikes relative to the persistence layer's WAL.
+#: ``before_append``: process dies before the record reaches the log (the
+#: commit and its record are both lost).  ``after_append``: record is
+#: forced durable, then death before the in-memory commit (recovery sees
+#: an op the crashed process never acknowledged).  ``torn_tail``: death
+#: mid-write leaves a half-record at the end of the log (recovery must
+#: truncate it).  ``mid_snapshot``: death after writing the snapshot temp
+#: file but before the atomic rename (recovery must ignore the orphan).
+KILL_POINTS = ("before_append", "after_append", "torn_tail", "mid_snapshot")
+
+
+class KillSwitch:
+    """A seeded, one-shot process-kill trigger for the persistence layer.
+
+    The :class:`~cron_operator_tpu.runtime.persistence.Persistence` layer
+    consults :meth:`on_append` on every WAL append; on the PRF-chosen
+    ``kill_at``-th append it returns the PRF-chosen kill point and the
+    persistence layer simulates process death there (raising
+    ``SimulatedCrash`` into the committing caller).  Both choices are
+    pure functions of ``(seed, round)``, so a crash-restart soak round is
+    replayable from the same two integers.
+    """
+
+    def __init__(self, seed: int, round_idx: int, max_appends: int = 40):
+        self.seed = seed
+        self.round_idx = round_idx
+        self.point = KILL_POINTS[
+            int(seeded_fraction(seed, "killpoint", round_idx) * len(KILL_POINTS))
+        ]
+        # 1-based: never kill "before the 0th append" (that is just a
+        # clean shutdown and exercises nothing).
+        self.kill_at = 1 + int(
+            seeded_fraction(seed, "killidx", round_idx) * max(1, max_appends)
+        )
+        self.fired = False
+        self._appends = 0
+        self._lock = threading.Lock()
+
+    def on_append(self) -> str | None:
+        """Called by the persistence layer once per WAL append (before
+        writing). Returns the kill point exactly once, on append number
+        ``kill_at``; ``None`` otherwise."""
+        with self._lock:
+            if self.fired:
+                return None
+            self._appends += 1
+            if self._appends == self.kill_at:
+                self.fired = True
+                return self.point
+        return None
+
+    def describe(self) -> Dict[str, object]:
+        return {
+            "round": self.round_idx,
+            "point": self.point,
+            "kill_at": self.kill_at,
+            "fired": self.fired,
+        }
 
 
 @dataclass

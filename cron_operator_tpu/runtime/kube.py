@@ -242,6 +242,11 @@ class APIServer:
         self._closed = False
         # Optional Metrics registry (see instrument()).
         self._metrics = None
+        # Optional durability layer (runtime.persistence.Persistence).
+        # When attached, every committed verb appends one WAL record
+        # BEFORE the in-memory commit — see _persist_put for the ordering
+        # contract — and snapshot rotation piggybacks on the write path.
+        self._wal = None
 
     # ---- metrics ----------------------------------------------------------
 
@@ -257,6 +262,58 @@ class APIServer:
             self._metrics.inc(
                 f'apiserver_commits_total{{verb="{verb}"}}'
             )
+
+    # ---- durability -------------------------------------------------------
+
+    def attach_persistence(self, wal) -> None:
+        """Attach a :class:`runtime.persistence.Persistence`. From now on
+        every committed create/update/patch_status/delete appends a WAL
+        record, and the store triggers compacted snapshots when the WAL
+        grows past the persistence layer's rotation threshold."""
+        with self._lock:
+            self._wal = wal
+
+    def restore_state(self, objects: List[Unstructured], rv: int) -> None:
+        """Seed an EMPTY store from recovered state: install every object
+        (frozen, fully indexed) and restore the resourceVersion counter so
+        fresh writes never collide with persisted history. No watch events
+        fire — a restarted operator re-lists on startup (informer initial
+        sync), exactly like a controller reconnecting to etcd."""
+        with self._lock:
+            if self._objects:
+                raise InvalidError(
+                    "restore_state requires an empty store "
+                    f"({len(self._objects)} objects present)"
+                )
+            for obj in objects:
+                committed = freeze(obj)
+                self._commit(object_key(committed), committed)
+            self._rv = max(self._rv, int(rv))
+
+    def _persist_put(self, verb: str, committed: Unstructured) -> None:
+        """WAL hook for create/update/patch_status. Called with the store
+        lock held, BEFORE the in-memory commit: if the append dies at a
+        kill-point, memory never applied the write the WAL may or may not
+        carry — recovery then lands on a prefix-consistent state either
+        way (see runtime/persistence.py module docstring)."""
+        wal = self._wal
+        if wal is not None:
+            wal.append_put(verb, committed)
+
+    def _persist_delete(self, key: Key) -> None:
+        """WAL hook for delete/cascade — records the post-bump rv so
+        replay restores the counter past the deletion."""
+        wal = self._wal
+        if wal is not None:
+            wal.append_delete(key, self._rv)
+
+    def _maybe_rotate(self) -> None:
+        """Compact when the WAL passes its rotation threshold. Called with
+        the store lock held, AFTER the commit/evict, so the snapshot
+        captures the state the just-appended record produced."""
+        wal = self._wal
+        if wal is not None and wal.rotation_due():
+            wal.write_snapshot(list(self._objects.values()), self._rv)
 
     # ---- internal helpers -------------------------------------------------
 
@@ -567,9 +624,11 @@ class APIServer:
             meta["resourceVersion"] = self._next_rv()
             meta["generation"] = 1
             committed = freeze(obj)
+            self._persist_put("create", committed)
             self._commit(key, committed)
             self._count_commit("create")
             self._notify("ADDED", committed)
+            self._maybe_rotate()
             # `obj` carries the server-set metadata (uid/rv/timestamp) in
             # a fresh metadata dict; non-metadata subtrees still belong to
             # the caller, the committed version shares nothing mutable.
@@ -730,9 +789,11 @@ class APIServer:
             # of re-frozen — commit cost is O(changed keys), and _commit's
             # index fast path sees unchanged labels/owners by identity.
             committed = freeze_delta(obj, current)
+            self._persist_put("update", committed)
             self._commit(key, committed)
             self._count_commit("update")
             self._notify("MODIFIED", committed)
+            self._maybe_rotate()
             return obj
 
     def patch_status(
@@ -773,9 +834,11 @@ class APIServer:
                 "metadata": meta,
                 "status": freeze_delta(status, current.get("status")),
             })
+            self._persist_put("patch_status", committed)
             self._commit(key, committed)
             self._count_commit("patch_status")
             self._notify("MODIFIED", committed)
+            self._maybe_rotate()
             return committed
 
     def delete(
@@ -790,14 +853,18 @@ class APIServer:
         dependents via ownerReferences (kube GC analog), Orphan does not."""
         with self._lock:
             key = (api_version, kind, namespace, name)
-            obj = self._evict(key)
+            obj = self._objects.get(key)
             if obj is None:
                 raise NotFoundError(f"{kind} {namespace}/{name} not found")
             # Deletion advances the store version and the final DELETED
             # object carries it (etcd semantics) — watch clients resuming
             # from their last-seen rv must not miss deletions.
+            final = self._bump_rv_version(obj)
+            self._persist_delete(key)
+            self._evict(key)
             self._count_commit("delete")
-            self._notify("DELETED", self._bump_rv_version(obj))
+            self._notify("DELETED", final)
+            self._maybe_rotate()
             if propagation in ("Background", "Foreground"):
                 self._cascade_delete(obj["metadata"].get("uid"), namespace)
 
@@ -811,10 +878,15 @@ class APIServer:
             if k[2] == namespace
         ]
         for k in keys:
-            dep = self._evict(k)
-            if dep is not None:
-                self._notify("DELETED", self._bump_rv_version(dep))
-                self._cascade_delete(dep["metadata"].get("uid"), namespace)
+            dep = self._objects.get(k)
+            if dep is None:
+                continue
+            final = self._bump_rv_version(dep)
+            self._persist_delete(k)
+            self._evict(k)
+            self._notify("DELETED", final)
+            self._maybe_rotate()
+            self._cascade_delete(dep["metadata"].get("uid"), namespace)
 
     # ---- convenience ------------------------------------------------------
 

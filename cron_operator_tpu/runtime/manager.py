@@ -128,6 +128,14 @@ _FAMILY_META: Dict[str, tuple] = {
         "counter", "Workload submit attempts retried after a transient "
                    "API error (bounded; exhaustion raises a Warning "
                    "event)"),
+    "wal_records_total": (
+        "counter", "Write-ahead-log records appended by the persistence "
+                   "layer (label op: put, del); zero in a steady-state "
+                   "no-op reconcile sweep"),
+    "wal_fsync_total": (
+        "counter", "Group-commit fsync batches flushed to the WAL"),
+    "wal_snapshots_total": (
+        "counter", "Compacted snapshots written (each truncates the WAL)"),
 }
 
 
@@ -300,12 +308,14 @@ class Manager:
         leader_elect: bool = False,
         identity: str = "manager-0",
         lease_duration_s: float = 15.0,
+        recovering: bool = False,
     ):
         self.api = api
         self.max_concurrent_reconciles = max_concurrent_reconciles
         self.leader_elect = leader_elect
         self.identity = identity
         self.lease_duration_s = lease_duration_s
+        self.recovering = recovering
         self.metrics = Metrics()
         self._controllers: List[_Controller] = []
         # GenerationChangedPredicate state: last seen metadata.generation
@@ -327,6 +337,14 @@ class Manager:
         # can demonstrate the pre-hardening behavior by turning it off.
         self._watch_healthy = True
         self.resync_on_watch_error = True
+        # Recovery gate: after a crash-restart the store is rebuilt from
+        # the WAL but catch-up reconciles have not run yet — readyz stays
+        # false until the initial enqueue sweep drains once, so a load
+        # balancer cannot route to a replica still replaying its past.
+        # (Set immediately when not recovering.)
+        self._recovery_synced = threading.Event()
+        if not recovering:
+            self._recovery_synced.set()
         # Workers park on this condition while not leader (instead of
         # spinning); _set_leadership/stop notify it on every transition.
         self._leader_cv = threading.Condition()
@@ -442,6 +460,30 @@ class Manager:
             for obj in self.api.list(c.for_gvk.api_version, c.for_gvk.kind):
                 meta = obj.get("metadata") or {}
                 c.queue.add(Request(meta.get("namespace", ""), meta.get("name", "")))
+        if self.recovering and not self._recovery_synced.is_set():
+            t = threading.Thread(
+                target=self._recovery_drain_loop,
+                name="recovery-drain",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _recovery_drain_loop(self) -> None:
+        """Poll until every queue drains once after the post-recovery
+        initial enqueue sweep (queued == processing == 0), then flip the
+        recovery gate so readyz can go true. A one-shot thread: exits as
+        soon as the gate opens or the manager stops."""
+        while not self._stop.is_set():
+            idle = all(
+                c.queue.stats()[0] == 0 and c.queue.stats()[1] == 0
+                for c in self._controllers
+            )
+            if idle:
+                self._recovery_synced.set()
+                logger.info("recovery catch-up drained; readyz unblocked")
+                return
+            time.sleep(0.05)
 
     def stop(self) -> None:
         self._stop.set()
@@ -483,6 +525,7 @@ class Manager:
         return (
             self.healthz()
             and self._watch_healthy
+            and self._recovery_synced.is_set()
             and (not self.leader_elect or self._is_leader.is_set())
         )
 

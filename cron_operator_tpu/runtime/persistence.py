@@ -1,0 +1,484 @@
+"""Durable control-plane state for the embedded API server.
+
+The reference operator survives ``kill -9`` for free because every Cron,
+``status.lastScheduleTime`` and history entry lives in etcd; the embedded
+:class:`~cron_operator_tpu.runtime.kube.APIServer` is pure in-memory, so
+until this module a crash silently reset exactly-once and catch-up
+semantics. This is the etcd analog: an append-only JSONL write-ahead log
+(one record per committed verb) plus periodic compacted snapshots under a
+``--data-dir``.
+
+Layout of a data dir::
+
+    snapshot.json       # full store dump at some rv (atomic rename)
+    snapshot.json.tmp   # in-flight snapshot (ignored by recovery)
+    wal.jsonl           # one JSON record per commit since the snapshot
+
+Record shapes::
+
+    {"op": "put", "verb": "create|update|patch_status", "rv": N, "obj": {...}}
+    {"op": "del", "rv": N, "key": [apiVersion, kind, namespace, name]}
+
+Durability model — **fsync-batched**: records accumulate in a userspace
+buffer and are flushed+fsynced every ``fsync_every`` records (and on
+snapshot/close).  A crash therefore loses at most the buffered suffix of
+the commit sequence; because the WAL is strictly commit-ordered (appends
+happen under the store lock, before the in-memory commit), recovery
+always yields a *prefix-consistent* past state.  That is the property the
+Cron catch-up logic needs: a workload create always precedes the
+``lastScheduleTime`` status patch that acknowledges it, so a recovered
+state can under-report progress (catch-up re-fires, deduplicated by the
+deterministic workload name) but never claim a tick fired whose workload
+is missing.
+
+Counter restoration: the store ``resourceVersion`` counter is restored to
+the highest rv seen in snapshot+WAL (fresh writes can never collide with
+persisted history); ``metadata.generation`` and uids travel inside the
+persisted objects themselves (uids are 128-bit random, so post-restart
+minting cannot collide with recovered ones).
+
+Recovery tolerates a **torn tail**: a record whose final line is
+truncated or corrupt (the classic crash-during-append artifact, and one
+of the seeded kill-points in :mod:`runtime.faults`) is dropped and the
+file is truncated back to the last intact record.  Records at or below
+the snapshot rv are skipped on replay, which makes the
+snapshot-then-truncate rotation crash-safe at every intermediate step.
+
+The write hook sits *before* the in-memory commit (see
+``APIServer._persist_put``), so a simulated crash at a kill-point leaves
+WAL and memory in one of exactly three relations — record lost + commit
+lost (before-append / torn), record durable + commit lost (after-append:
+the "fsynced but client never saw the 200" window), or both applied —
+all of which recovery + catch-up converge out of.
+
+Semantic no-op status patches never reach the hook (the store elides
+them before committing), so a steady-state reconcile sweep appends
+**zero** WAL records — measured in ``hack/controlplane_bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from cron_operator_tpu.runtime.kube import ApiError, object_key
+
+logger = logging.getLogger("runtime.persistence")
+
+SNAPSHOT_NAME = "snapshot.json"
+SNAPSHOT_TMP_NAME = "snapshot.json.tmp"
+WAL_NAME = "wal.jsonl"
+SCHEMA_VERSION = 1
+
+#: Records buffered before a flush+fsync (group commit). 1 = fsync per
+#: commit (maximum durability, maximum latency); the default trades a
+#: bounded crash-loss window for write-path cost that stays flat.
+DEFAULT_FSYNC_EVERY = 64
+#: WAL records between compacted snapshots.
+DEFAULT_SNAPSHOT_EVERY = 4096
+#: Upper bound (seconds) a committed write may sit in the userspace
+#: buffer before the background flusher fsyncs it: crash loss is bounded
+#: in TIME as well as in records. Without it a low-write-rate deployment
+#: that never fills an fsync batch could lose its entire session to a
+#: kill -9. 0 disables the flusher (the chaos soak does, so its flush
+#: points stay seed-deterministic).
+DEFAULT_FLUSH_INTERVAL_S = 0.25
+
+
+class SimulatedCrash(ApiError):
+    """Raised by a persistence layer whose seeded kill-point has fired:
+    the process is "dead" from this instant — every further write is
+    refused so in-memory state freezes at the kill point, exactly like
+    ``kill -9``. Only the chaos harness ever arms a kill switch; a real
+    deployment never sees this."""
+
+
+@dataclass
+class RecoveredState:
+    """Result of replaying a data dir: the objects and counters a fresh
+    store must be seeded with, plus replay forensics."""
+
+    objects: List[Dict[str, Any]] = field(default_factory=list)
+    rv: int = 0
+    had_snapshot: bool = False
+    snapshot_rv: int = 0
+    wal_records_replayed: int = 0
+    wal_records_skipped: int = 0  # at/below the snapshot rv (idempotence)
+    torn_records_dropped: int = 0
+    #: Keys whose replayed ``del`` record is their final WAL disposition
+    #: (no later ``put`` re-created them). A crash between a delete's WAL
+    #: append and its in-memory evict (the after-append kill-point) makes
+    #: the delete durable without its DELETED watch event ever firing;
+    #: observers reconciling across the restart need the disk's verdict.
+    wal_deleted_keys: List[tuple] = field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return not self.objects and self.rv == 0
+
+
+class Persistence:
+    """WAL + snapshot writer for one data dir.
+
+    Thread-safety: every public method takes the internal lock;
+    ``append_put``/``append_delete``/``write_snapshot`` are invoked by the
+    APIServer under ITS lock, so WAL order is exactly commit order.
+    """
+
+    def __init__(
+        self,
+        data_dir: str,
+        fsync_every: int = DEFAULT_FSYNC_EVERY,
+        snapshot_every: int = DEFAULT_SNAPSHOT_EVERY,
+        kill_switch: Optional[Any] = None,
+        flush_interval_s: float = DEFAULT_FLUSH_INTERVAL_S,
+    ):
+        self.data_dir = data_dir
+        self.fsync_every = max(1, int(fsync_every))
+        self.snapshot_every = max(1, int(snapshot_every))
+        self.flush_interval_s = float(flush_interval_s)
+        #: Chaos seam (:class:`runtime.faults.KillSwitch`): consulted on
+        #: every append; when it fires, this layer dies mid-operation.
+        self.kill_switch = kill_switch
+        self._lock = threading.RLock()
+        self._wal_path = os.path.join(data_dir, WAL_NAME)
+        self._snap_path = os.path.join(data_dir, SNAPSHOT_NAME)
+        self._snap_tmp_path = os.path.join(data_dir, SNAPSHOT_TMP_NAME)
+        self._f: Optional[Any] = None  # binary append handle, open()ed
+        self._buf: List[bytes] = []    # serialized records awaiting flush
+        self._flusher: Optional[threading.Thread] = None
+        self._stop_flusher = threading.Event()
+        self._since_snapshot = 0
+        self._dead = False
+        self._die_mid_snapshot = False
+        self._metrics = None
+        # Forensics (also surfaced as metrics when instrumented).
+        self.records_appended = 0
+        self.fsyncs = 0
+        self.snapshots_written = 0
+        os.makedirs(data_dir, exist_ok=True)
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def instrument(self, metrics) -> None:
+        """Attach a ``Metrics`` registry (wal_records_total etc.)."""
+        self._metrics = metrics
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name, value)
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def open(self) -> None:
+        """Open the WAL for appending (creating it if absent) and start
+        the background flusher (when ``flush_interval_s`` > 0)."""
+        with self._lock:
+            if self._f is None:
+                self._f = open(self._wal_path, "ab")
+            if (self.flush_interval_s > 0 and self._flusher is None
+                    and not self._dead):
+                self._stop_flusher.clear()
+                self._flusher = threading.Thread(
+                    target=self._flush_loop, name="wal-flusher", daemon=True
+                )
+                self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        # Bounds buffered-suffix loss in wall time: a record written just
+        # after an fsync batch is durable within flush_interval_s even if
+        # the batch never fills.
+        while not self._stop_flusher.wait(self.flush_interval_s):
+            with self._lock:
+                if self._dead:
+                    return
+                if self._buf:
+                    self._flush_locked(fsync=True)
+
+    def close(self) -> None:
+        """Flush, fsync and close. Safe to call on a dead layer (no-op:
+        a crashed process never gets to run its shutdown hooks)."""
+        self._stop_flusher.set()
+        flusher = self._flusher
+        with self._lock:
+            self._flusher = None
+            if not self._dead and self._f is not None:
+                self._flush_locked(fsync=True)
+                self._f.close()
+                self._f = None
+        # Join OUTSIDE the lock: the flusher may be blocked acquiring it.
+        if flusher is not None and flusher is not threading.current_thread():
+            flusher.join(timeout=2.0)
+
+    def kill(self, point: str = "external") -> None:
+        """Simulate ``kill -9`` at a clean boundary: the unflushed buffer
+        is lost and every further operation is refused. Used by the soak
+        when a round's kill switch never fired organically."""
+        with self._lock:
+            self._die(point)
+
+    def _die(self, point: str) -> None:
+        # Buffered records are USERSPACE state — a killed process loses
+        # them, so drop them rather than letting close()/GC flush them.
+        self._stop_flusher.set()
+        self._buf.clear()
+        self._dead = True
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+        logger.debug("persistence killed at %s", point)
+
+    # ---- write path -------------------------------------------------------
+
+    def append_put(self, verb: str, obj: Dict[str, Any]) -> None:
+        """One WAL record for a committed create/update/patch_status.
+        ``obj`` is the frozen committed version (FrozenDict subclasses
+        dict, so it serializes natively)."""
+        rv = int((obj.get("metadata") or {}).get("resourceVersion") or 0)
+        self._append({"op": "put", "verb": verb, "rv": rv, "obj": obj})
+
+    def append_delete(self, key: Tuple[str, str, str, str], rv: int) -> None:
+        self._append({"op": "del", "rv": int(rv), "key": list(key)})
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        line = (
+            json.dumps(rec, separators=(",", ":"), default=str) + "\n"
+        ).encode("utf-8")
+        with self._lock:
+            if self._dead:
+                raise SimulatedCrash("persistence layer is dead (kill-point fired)")
+            if self._f is None:
+                self.open()
+            ks = self.kill_switch
+            action = ks.on_append() if ks is not None else None
+            if action == "before_append":
+                # Crash before the record ever reaches the buffer: the
+                # commit this record describes is lost entirely.
+                self._die(action)
+                raise SimulatedCrash("kill-point: crash before WAL append")
+            if action == "torn_tail":
+                # Everything earlier is made durable, then the record is
+                # torn mid-line — recovery must truncate it away.
+                self._flush_locked(fsync=True)
+                assert self._f is not None
+                self._f.write(line[: max(1, len(line) // 2)])
+                self._f.flush()
+                os.fsync(self._f.fileno())
+                self._die(action)
+                raise SimulatedCrash("kill-point: torn final WAL record")
+            self._buf.append(line)
+            self.records_appended += 1
+            self._since_snapshot += 1
+            self._count(f'wal_records_total{{op="{rec["op"]}"}}')
+            if action == "after_append":
+                # Record made durable, then death — the client never saw
+                # the response ("fsynced, 200 lost" window).
+                self._flush_locked(fsync=True)
+                self._die(action)
+                raise SimulatedCrash("kill-point: crash after WAL append")
+            if action == "mid_snapshot":
+                # Force rotation NOW; write_snapshot (called by the store
+                # right after this append) will die before the rename.
+                self._since_snapshot = self.snapshot_every
+                self._die_mid_snapshot = True
+            if len(self._buf) >= self.fsync_every:
+                self._flush_locked(fsync=True)
+
+    def flush(self, fsync: bool = True) -> None:
+        with self._lock:
+            if self._dead:
+                return
+            self._flush_locked(fsync=fsync)
+
+    def _flush_locked(self, fsync: bool) -> None:
+        if not self._buf:
+            return
+        if self._f is None:
+            self.open()
+        assert self._f is not None
+        self._f.write(b"".join(self._buf))
+        self._buf.clear()
+        self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
+            self.fsyncs += 1
+            self._count("wal_fsync_total")
+
+    # ---- snapshots --------------------------------------------------------
+
+    def rotation_due(self) -> bool:
+        return not self._dead and self._since_snapshot >= self.snapshot_every
+
+    def write_snapshot(self, objects: List[Dict[str, Any]], rv: int) -> None:
+        """Write a compacted snapshot and truncate the WAL.
+
+        Crash-safe at every step: the snapshot lands under a tmp name and
+        is atomically renamed over the old one; until the rename the old
+        snapshot + full WAL are authoritative, and after it the stale WAL
+        records (rv <= snapshot rv) are skipped on replay, so dying
+        between rename and truncate also recovers cleanly."""
+        with self._lock:
+            if self._dead:
+                return  # a dead process compacts nothing
+            # WAL first: the snapshot claims to cover everything <= rv.
+            self._flush_locked(fsync=True)
+            payload = {
+                "schema": SCHEMA_VERSION,
+                "rv": int(rv),
+                "objects": objects,
+            }
+            with open(self._snap_tmp_path, "w") as f:
+                json.dump(payload, f, separators=(",", ":"), default=str)
+                f.flush()
+                os.fsync(f.fileno())
+            if self._die_mid_snapshot:
+                # Kill-point: tmp written, rename never happens — recovery
+                # must ignore the orphaned tmp file. No raise: the commit
+                # that triggered this rotation already succeeded (record
+                # durable, memory committed, watch notified) — process
+                # death during background compaction cannot unwind it.
+                # The NEXT write observes the dead layer and crashes.
+                self._die("mid_snapshot")
+                return
+            os.replace(self._snap_tmp_path, self._snap_path)
+            # Start a fresh WAL segment for the new snapshot generation.
+            if self._f is not None:
+                self._f.close()
+            self._f = open(self._wal_path, "wb")
+            self._fsync_dir()
+            self._since_snapshot = 0
+            self.snapshots_written += 1
+            self._count("wal_snapshots_total")
+
+    def _fsync_dir(self) -> None:
+        try:
+            dfd = os.open(self.data_dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:  # platform without directory fsync
+            pass
+
+    # ---- recovery ---------------------------------------------------------
+
+    def recover(self) -> RecoveredState:
+        """Replay snapshot + WAL into a :class:`RecoveredState`.
+
+        Pure function of the on-disk bytes (modulo the one repair it
+        performs: truncating a torn tail) — recovering the same dir twice
+        yields identical state, which is invariant I6 of the chaos soak.
+        """
+        state = RecoveredState()
+        objects: Dict[Tuple[str, str, str, str], Dict[str, Any]] = {}
+        # Orphaned tmp from a crash mid-snapshot: the rename never
+        # happened, so it is dead bytes.
+        if os.path.exists(self._snap_tmp_path):
+            logger.warning("removing orphaned %s (crash mid-snapshot)",
+                           SNAPSHOT_TMP_NAME)
+            os.unlink(self._snap_tmp_path)
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path) as f:
+                payload = json.load(f)
+            state.had_snapshot = True
+            state.snapshot_rv = int(payload.get("rv") or 0)
+            state.rv = state.snapshot_rv
+            for obj in payload.get("objects") or []:
+                objects[object_key(obj)] = obj
+        self._replay_wal(state, objects)
+        state.objects = list(objects.values())
+        return state
+
+    def _replay_wal(self, state: RecoveredState, objects: Dict) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        good_end = 0
+        with open(self._wal_path, "rb") as f:
+            data = f.read()
+        pos = 0
+        deleted: set = set()
+        while pos < len(data):
+            nl = data.find(b"\n", pos)
+            if nl < 0:
+                # Final record has no newline — torn mid-append.
+                state.torn_records_dropped += 1
+                break
+            line = data[pos:nl]
+            try:
+                rec = json.loads(line)
+                op = rec["op"]
+                rv = int(rec["rv"])
+            except (ValueError, KeyError, TypeError):
+                # Corrupt record: everything from here on is untrustworthy
+                # (appends are strictly ordered, so a bad record means the
+                # tail was torn, not that a later record is fine).
+                state.torn_records_dropped += 1
+                break
+            if rv <= state.snapshot_rv:
+                state.wal_records_skipped += 1
+            else:
+                if op == "put":
+                    obj = rec["obj"]
+                    key = object_key(obj)
+                    objects[key] = obj
+                    deleted.discard(key)
+                elif op == "del":
+                    key = tuple(rec["key"])
+                    objects.pop(key, None)
+                    deleted.add(key)
+                state.wal_records_replayed += 1
+                state.rv = max(state.rv, rv)
+            pos = good_end = nl + 1
+        state.wal_deleted_keys = sorted(deleted)
+        if good_end < len(data):
+            logger.warning(
+                "truncating torn WAL tail: %d byte(s) after the last "
+                "intact record", len(data) - good_end,
+            )
+            with open(self._wal_path, "r+b") as f:
+                f.truncate(good_end)
+
+    def start(self, api) -> RecoveredState:
+        """Recover this data dir into ``api``, compact, and attach.
+
+        The boot sequence of ``--data-dir``: snapshot load → WAL tail
+        replay → install objects + restore the rv counter → write a fresh
+        compacted snapshot (so the next crash replays a short WAL) →
+        hook every future commit. Returns the recovered state so the
+        caller can log it / gate readiness on the catch-up reconcile."""
+        state = self.recover()
+        if not state.empty:
+            api.restore_state(state.objects, state.rv)
+        self.open()
+        self.write_snapshot(api.all_objects(), int(getattr(api, "_rv", state.rv)))
+        api.attach_persistence(self)
+        return state
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "records_appended": self.records_appended,
+                "fsyncs": self.fsyncs,
+                "snapshots_written": self.snapshots_written,
+                "buffered": len(self._buf),
+            }
+
+
+__all__ = [
+    "Persistence",
+    "RecoveredState",
+    "SimulatedCrash",
+    "DEFAULT_FSYNC_EVERY",
+    "DEFAULT_SNAPSHOT_EVERY",
+    "SNAPSHOT_NAME",
+    "WAL_NAME",
+]

@@ -27,11 +27,14 @@ from __future__ import annotations
 
 import datetime
 import ipaddress
+import logging
 import os
 import ssl
 import tempfile
 import threading
 from typing import Optional
+
+logger = logging.getLogger("tlsutil")
 
 
 def self_signed_cert(
@@ -130,6 +133,12 @@ class CertWatcher:
         self._thread: Optional[threading.Thread] = None
         self._stamp = self._mtimes()
         self.reloads = 0  # observability + test hook
+        self.reload_errors = 0
+        # Rate limit for reload-failure warnings: one per rotation
+        # attempt (keyed by the mtime stamp that failed), so a
+        # half-written pair that takes several polls to complete warns
+        # once, not every 30 s — but a *new* bad rotation warns again.
+        self._warned_stamp = None
 
     def _mtimes(self):
         try:
@@ -145,11 +154,22 @@ class CertWatcher:
             return False
         try:
             self._ctx.load_cert_chain(self._cert, self._key)
-        except (OSError, ssl.SSLError):
+        except (OSError, ssl.SSLError) as err:
             # Half-written rotation (cert replaced, key not yet): keep
-            # serving the old pair; next poll retries.
+            # serving the old pair; next poll retries. Warn once per
+            # failing stamp — silence here means a bad rotation is only
+            # discovered when the old cert expires.
+            self.reload_errors += 1
+            if stamp != self._warned_stamp:
+                self._warned_stamp = stamp
+                logger.warning(
+                    "cert rotation reload failed for %s / %s (%s); "
+                    "still serving the previous pair, will retry",
+                    self._cert, self._key, err,
+                )
             return False
         self._stamp = stamp
+        self._warned_stamp = None
         self.reloads += 1
         return True
 

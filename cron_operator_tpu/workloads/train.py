@@ -80,6 +80,12 @@ class TrainConfig:
     # this pins the measured rate to the chip. The data stream is
     # IDENTICAL to steps_per_call=1: each in-scan step derives its batch
     # from the live state.step.
+    #
+    # Stop granularity: a dispatched K-step program runs to completion —
+    # an external stop (preemption, budget, deadline) lands between
+    # dispatches, so the run can overshoot the stop point by up to K-1
+    # optimizer steps. Pick K against checkpoint/stop granularity, not
+    # just dispatch amortization.
     steps_per_call: int = 1
     # Block on the loss every N steps (1 = every step). Fetching a scalar
     # is a full host↔device round trip — ~80 ms on a tunneled device,
@@ -261,7 +267,14 @@ class Trainer:
                 "steps_per_call > 1 requires fused data (sample_fn): "
                 "external batches cannot be replayed inside the scan"
             )
-        self._multi: Dict[int, Any] = {}  # chunk length → jitted scan
+        # Chunk length → jitted scan program. Bounded: a steady run uses
+        # at most two lengths (full chunk + partial tail), but a caller
+        # driving step(chunk=) with varying lengths would otherwise
+        # accumulate one compiled program per distinct length for the
+        # process lifetime. FIFO-evict beyond the cap — recompiling a
+        # rare length is cheap next to leaking compiled executables.
+        self._multi: Dict[int, Any] = {}
+        self._multi_cap = 8
         self._batch_struct = None  # set on first put_batch (flops_per_step)
         self._flops_per_step: Optional[float] = None
         # Wall-clock of this process's first dispatch (XLA compile + first
@@ -299,6 +312,8 @@ class Trainer:
                 return state, losses[-1]
 
             fn = jax.jit(multi, **self._jit_kwargs)
+            while len(self._multi) >= self._multi_cap:
+                self._multi.pop(next(iter(self._multi)))
             self._multi[chunk] = fn
         return fn
 

@@ -59,14 +59,24 @@ print("    storm %s Crons/s; steady-state store writes: 0"
       % r["fire_storm_crons_per_s"])
 '
 
-echo "==> chaos smoke (fixed-seed fault injection, 5 invariants)"
+echo "==> chaos smoke (fixed-seed fault injection + crash-restart, 7 invariants)"
 # Short seeded soak: 40 Crons x 3 rounds under the default chaos plan
 # (conflicts, transient errors, watch breaks, leader loss, preemption
-# storms), then a fault-free replay from the same seed. Exits non-zero
-# if any of the five invariants (Forbid exclusion, bounded history,
-# exactly-once ticks, zero-write convergence, replay equivalence) is
-# violated. Full run: make chaos-soak (writes CHAOS.json).
+# storms) PLUS kill+restart rounds against the WAL/snapshot durability
+# layer, then a fault-free replay from the same seed. Exits non-zero if
+# any of the seven invariants (Forbid exclusion, bounded history,
+# exactly-once ticks, zero-write convergence, replay equivalence,
+# recovery==WAL-replay, restart tick integrity) is violated. Full run:
+# make chaos-soak (writes CHAOS.json).
 python hack/chaos_soak.py --seed 7 --crons 40 --rounds 3 --out /dev/null
+
+echo "==> durability counter-proof (same kills, no durability -> I7 must break)"
+# The same fixed-seed kill schedule restarted from an EMPTY data dir
+# must lose in-window ticks (permanently_lost non-empty): proves the
+# soak genuinely detects the failure mode the WAL exists to prevent,
+# i.e. the I7 PASS above is not vacuous.
+python hack/chaos_soak.py --seed 7 --crons 40 --rounds 3 \
+    --no-durability --expect-violation --out /dev/null
 
 echo "==> unit + integration tests"
 # With pytest-cov installed (CI always; optional locally) the suite runs

@@ -279,6 +279,58 @@ def _write_microbench(api, repeat: int = 200) -> dict:
     }
 
 
+def _wal_microbench(repeat: int = 200) -> dict:
+    """The same write-verb microbench against a WAL-attached store on a
+    private tempdir — the steady-state durability overhead. Also proves
+    (not just reports) that no-op status elision keeps the WAL silent:
+    a bracketed no-op loop must append ZERO records."""
+    try:
+        from cron_operator_tpu.runtime.persistence import Persistence
+    except ImportError:  # baseline trees predate the durability layer
+        return {}
+    import shutil
+
+    from cron_operator_tpu.runtime import APIServer
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    data_dir = tempfile.mkdtemp(prefix="cpbench-wal-")
+    try:
+        api = APIServer(clock=FakeClock())
+        pers = Persistence(data_dir)
+        pers.start(api)
+        for i in range(3):
+            api.create(_cron(i))
+        out = {
+            f"wal_{k}": v
+            for k, v in _write_microbench(api, repeat).items()
+        }
+        # No-op elision reaches the WAL layer: re-patching an unchanged
+        # status never commits, so it never appends either.
+        api.patch_status(
+            CRON_API_VERSION, "Cron", "default", "bench-2",
+            {"benchSeq": "steady"},
+        )
+        before = pers.stats()["records_appended"]
+        for _ in range(repeat):
+            api.patch_status(
+                CRON_API_VERSION, "Cron", "default", "bench-2",
+                {"benchSeq": "steady"},
+            )
+        noop_records = pers.stats()["records_appended"] - before
+        assert noop_records == 0, (
+            f"no-op patches appended {noop_records} WAL records"
+        )
+        stats = pers.stats()
+        out["wal_noop_records"] = noop_records
+        out["wal_records_appended"] = stats["records_appended"]
+        out["wal_fsyncs"] = stats["fsyncs"]
+        pers.close()
+        api.close()
+        return out
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
 def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
     from datetime import timedelta
     from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
@@ -367,6 +419,7 @@ def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
     hist = mgr.metrics.histogram(RECONCILE_HIST)
     mgr.stop()
     write_us = _write_microbench(api)
+    write_us.update(_wal_microbench())
     api.close()
 
     storm = storm_best_of(n_crons, sweep_timeout_s)
@@ -477,6 +530,9 @@ def _speedups(before: dict, after: dict) -> list:
             "noop_patch_status_us": ratio(
                 "noop_patch_status_us", invert=True),
             "create_us": ratio("create_us", invert=True),
+            "wal_create_us": ratio("wal_create_us", invert=True),
+            "wal_patch_status_us": ratio(
+                "wal_patch_status_us", invert=True),
         })
     return out
 

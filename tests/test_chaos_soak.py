@@ -4,7 +4,13 @@ Runs ``hack/chaos_soak.py`` in-process at small N: the hardened
 operator must hold all five invariants under the seeded fault storm,
 and the same storm against the un-hardened configuration (single-shot
 writes, no watch resync) must demonstrably violate at least one —
-the regression the chaos layer exists to catch."""
+the regression the chaos layer exists to catch.
+
+Crash mode adds kill+restart rounds on top of the storm: the durable
+(WAL + snapshot) configuration must additionally hold I6 (recovered
+state == independent WAL replay) and I7 (no tick fires twice across a
+restart, no in-window tick permanently lost), while the same kill
+schedule WITHOUT durability must demonstrably violate I7."""
 
 import importlib.util
 import pathlib
@@ -47,6 +53,44 @@ class TestHardenedSoak:
         b = FaultPlan.default_chaos(7)
         assert a.schedule(6) == b.schedule(6)
         assert a.trace_hash(6) == b.trace_hash(6)
+
+
+class TestCrashRestartSoak:
+    def test_invariants_hold_across_kill_restart(self, soak):
+        chaotic = soak.run_soak(seed=7, n_crons=12, rounds=4, crash=True)
+        replay = soak.run_soak(
+            seed=7, n_crons=12, rounds=4, chaotic=False, crash=True
+        )
+        inv = soak.check_invariants(chaotic, replay, soak.HISTORY_LIMIT)
+        failed = {k: v["detail"] for k, v in inv.items() if not v["ok"]}
+        assert not failed, failed
+        # The kill schedule actually killed, and recovery actually ran.
+        assert chaotic["kills"], "crash mode scheduled no kills"
+        assert "I6_recovery_equals_replay" in inv
+        assert "I7_restart_tick_integrity" in inv
+        for k in chaotic["kills"]:
+            assert k["i6_recovery_equals_replay"], k
+
+    def test_kill_schedule_is_deterministic(self, soak):
+        a = soak.run_soak(seed=11, n_crons=8, rounds=4, crash=True)
+        b = soak.run_soak(seed=11, n_crons=8, rounds=4, crash=True)
+        assert [k["point"] for k in a["kills"]] == [
+            k["point"] for k in b["kills"]
+        ]
+        assert a["fault_trace_hash"] == b["fault_trace_hash"]
+
+    def test_no_durability_violates_restart_integrity(self, soak):
+        chaotic = soak.run_soak(
+            seed=7, n_crons=12, rounds=4, crash=True, durability=False
+        )
+        replay = soak.run_soak(
+            seed=7, n_crons=12, rounds=4, chaotic=False, crash=True
+        )
+        inv = soak.check_invariants(chaotic, replay, soak.HISTORY_LIMIT)
+        assert not inv["I7_restart_tick_integrity"]["ok"], (
+            "restarting from an empty data dir held I7 — the soak no "
+            "longer demonstrates the loss the WAL exists to prevent"
+        )
 
 
 class TestUnhardenedSoak:

@@ -1,0 +1,529 @@
+"""Durability layer: WAL + snapshot persistence, crash recovery,
+kill-points, and restart catch-up semantics.
+
+Covers the contracts the chaos soak relies on:
+
+- snapshot + WAL-tail replay reconstructs the store exactly (rv and
+  counter restoration included), property-style over random verb
+  sequences;
+- a torn final record is dropped and the file repaired, and recovery is
+  idempotent (invariant I6's "pure function of the bytes");
+- each seeded kill-point has its documented durability outcome
+  (before_append loses the record, after_append orphans it, torn_tail
+  truncates it, mid_snapshot leaves an orphaned tmp the next boot
+  removes);
+- restart catch-up re-fires a missed tick, and
+  ``startingDeadlineSeconds`` caps how stale a tick may be and still
+  fire after downtime.
+"""
+
+import json
+import random
+import unittest
+import tempfile
+import os
+import shutil
+from datetime import timedelta
+
+from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.runtime.persistence import (
+    Persistence,
+    SimulatedCrash,
+    SNAPSHOT_TMP_NAME,
+    WAL_NAME,
+)
+from cron_operator_tpu.runtime.faults import KILL_POINTS, KillSwitch
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.utils.clock import FakeClock
+
+WORKLOAD_API_VERSION = "kubeflow.org/v1"
+WORKLOAD_KIND = "JAXJob"
+
+
+def _obj(name: str, ns: str = "default", kind: str = WORKLOAD_KIND) -> dict:
+    return {
+        "apiVersion": WORKLOAD_API_VERSION,
+        "kind": kind,
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+    }
+
+
+def _canonical(objects, rv) -> str:
+    return json.dumps(
+        {"rv": int(rv), "objects": sorted(
+            (dict(o) for o in objects),
+            key=lambda o: json.dumps(o, sort_keys=True, default=str),
+        )},
+        sort_keys=True, default=str,
+    )
+
+
+def _store_canonical(store) -> str:
+    return _canonical(store.all_objects(), getattr(store, "_rv"))
+
+
+class _TmpDirTest(unittest.TestCase):
+    def setUp(self):
+        self.dir = tempfile.mkdtemp(prefix="persistence-test-")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+
+
+class TestSnapshotWalEquivalence(_TmpDirTest):
+    def _random_soak(self, seed: int, ops: int, fsync_every: int,
+                     snapshot_every: int) -> APIServer:
+        """Drive a random verb sequence through a persisted store."""
+        rng = random.Random(seed)
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=fsync_every,
+                           snapshot_every=snapshot_every)
+        pers.start(store)
+        live: list = []
+        for i in range(ops):
+            verb = rng.choice(("create", "create", "update",
+                               "patch_status", "delete"))
+            if verb == "create" or not live:
+                name = f"w-{seed}-{i}"
+                store.create(_obj(name))
+                live.append(name)
+            elif verb == "update":
+                name = rng.choice(live)
+                cur = dict(store.get(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                                     "default", name))
+                cur["spec"] = dict(cur["spec"])
+                cur["spec"]["round"] = i
+                store.update(cur)
+            elif verb == "patch_status":
+                name = rng.choice(live)
+                store.patch_status(
+                    WORKLOAD_API_VERSION, WORKLOAD_KIND, "default", name,
+                    {"phase": f"step-{i}"},
+                )
+            else:
+                name = live.pop(rng.randrange(len(live)))
+                store.delete(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                             "default", name)
+        # One post-loop create so the WAL tail is non-empty even when the
+        # final random op happened to land exactly on a rotation.
+        store.create(_obj(f"w-{seed}-final"))
+        pers.flush()
+        pers.close()
+        return store
+
+    def test_replay_reconstructs_store_exactly(self):
+        # Property-style over three seeds: random create/update/patch/
+        # delete sequences, small fsync batches, rotations mid-sequence.
+        for seed in (0, 1, 2):
+            with self.subTest(seed=seed):
+                sub = os.path.join(self.dir, str(seed))
+                os.makedirs(sub)
+                old_dir, self.dir = self.dir, sub
+                try:
+                    store = self._random_soak(
+                        seed, ops=120, fsync_every=7, snapshot_every=40
+                    )
+                    state = Persistence(sub).recover()
+                    self.assertEqual(
+                        _store_canonical(store),
+                        _canonical(state.objects, state.rv),
+                    )
+                    # Rotation happened mid-sequence, so the final state
+                    # genuinely exercises snapshot + WAL-tail merge.
+                    self.assertTrue(state.had_snapshot)
+                    self.assertGreater(state.wal_records_replayed, 0)
+                finally:
+                    self.dir = old_dir
+
+    def test_counters_restored_across_restart(self):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir)
+        pers.start(store)
+        store.create(_obj("a"))
+        store.create(_obj("b"))
+        rv_before = int(getattr(store, "_rv"))
+        uids = {o["metadata"]["uid"] for o in store.all_objects()}
+        pers.close()
+
+        store2 = APIServer(clock=FakeClock())
+        state = Persistence(self.dir).start(store2)
+        self.assertEqual(int(getattr(store2, "_rv")), rv_before)
+        self.assertEqual(state.rv, rv_before)
+        created = store2.create(_obj("c"))
+        # rv strictly advances past everything ever committed, uid
+        # minting never collides with recovered objects, generation
+        # restarts per-object as usual.
+        self.assertGreater(
+            int(created["metadata"]["resourceVersion"]), rv_before
+        )
+        self.assertNotIn(created["metadata"]["uid"], uids)
+
+    def test_noop_patch_writes_no_wal_records(self):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir)
+        pers.start(store)
+        store.create(_obj("a"))
+        store.patch_status(WORKLOAD_API_VERSION, WORKLOAD_KIND, "default",
+                           "a", {"phase": "Running"})
+        before = pers.stats()["records_appended"]
+        # Semantic no-op: the write path elides the commit entirely, so
+        # the WAL sees nothing — steady-state sweeps are persistence-free.
+        store.patch_status(WORKLOAD_API_VERSION, WORKLOAD_KIND, "default",
+                           "a", {"phase": "Running"})
+        self.assertEqual(pers.stats()["records_appended"], before)
+        pers.close()
+
+    def test_boot_compaction_writes_snapshot(self):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir)
+        pers.start(store)
+        store.create(_obj("a"))
+        pers.flush()
+        pers.close()
+        store2 = APIServer(clock=FakeClock())
+        Persistence(self.dir).start(store2)
+        # Boot compacted: a third recovery sees the snapshot and no
+        # pre-snapshot WAL tail to replay.
+        state = Persistence(self.dir).recover()
+        self.assertTrue(state.had_snapshot)
+        self.assertEqual(state.wal_records_replayed, 0)
+        self.assertEqual(len(state.objects), 1)
+
+
+class TestTimeBoundedFlush(_TmpDirTest):
+    def test_background_flusher_bounds_loss_in_wall_time(self):
+        # A deployment writing fewer than fsync_every records must still
+        # be durable within flush_interval_s: kill -9 after the interval
+        # loses nothing even though no batch ever filled. (Found by a
+        # live CLI drive: trigger + kill -9 lost the whole session.)
+        import time
+
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=64, flush_interval_s=0.05)
+        pers.start(store)
+        store.create(_obj("w-0"))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with open(os.path.join(self.dir, WAL_NAME), "rb") as f:
+                if f.read():
+                    break
+            time.sleep(0.02)
+        pers.kill()  # drops any still-buffered suffix, like kill -9
+        state = Persistence(self.dir).recover()
+        self.assertEqual(
+            {o["metadata"]["name"] for o in state.objects}, {"w-0"}
+        )
+
+    def test_interval_zero_disables_the_flusher(self):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=64, flush_interval_s=0)
+        pers.start(store)
+        store.create(_obj("w-0"))
+        self.assertIsNone(pers._flusher)
+        pers.kill()  # nothing was flushed — the record is gone
+        state = Persistence(self.dir).recover()
+        self.assertEqual(state.objects, [])
+
+
+class TestTornTail(_TmpDirTest):
+    def test_torn_tail_dropped_and_repaired(self):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=1)
+        pers.start(store)
+        for i in range(3):
+            store.create(_obj(f"w-{i}"))
+        pers.close()
+        wal = os.path.join(self.dir, WAL_NAME)
+        with open(wal, "ab") as f:
+            f.write(b'{"op":"put","rv":999,"obj":{"tor')  # torn mid-line
+        state = Persistence(self.dir).recover()
+        self.assertEqual(state.torn_records_dropped, 1)
+        self.assertEqual(len(state.objects), 3)
+        # The repair truncated the file: recovery is now idempotent and
+        # clean (I6: recover twice == recover once).
+        again = Persistence(self.dir).recover()
+        self.assertEqual(again.torn_records_dropped, 0)
+        self.assertEqual(
+            _canonical(state.objects, state.rv),
+            _canonical(again.objects, again.rv),
+        )
+
+    def test_corrupt_middle_record_truncates_rest(self):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=1)
+        pers.start(store)
+        store.create(_obj("w-0"))
+        pers.close()
+        wal = os.path.join(self.dir, WAL_NAME)
+        with open(wal, "ab") as f:
+            f.write(b"garbage-not-json\n")
+            f.write(b'{"op":"put","rv":1000,"obj":{}}\n')
+        state = Persistence(self.dir).recover()
+        # Appends are strictly ordered: one bad record invalidates the
+        # tail; the (syntactically fine) record after it must NOT apply.
+        self.assertEqual(len(state.objects), 1)
+        self.assertLess(state.rv, 1000)
+
+
+class TestKillPoints(_TmpDirTest):
+    def _crash_run(self, seed: int, data_dir: str):
+        """Create objects until the seeded kill fires; returns
+        (store, pers, names_attempted, crashed_name)."""
+        store = APIServer(clock=FakeClock())
+        # fsync_every=1 keeps the pre-kill prefix durable, so each test
+        # isolates its kill-point's OWN record semantics (fsync batching
+        # and suffix loss have their own coverage in the chaos soak).
+        pers = Persistence(data_dir, fsync_every=1,
+                           kill_switch=KillSwitch(seed, 0))
+        pers.start(store)
+        crashed = None
+        names = []
+        for i in range(64):
+            name = f"w-{i}"
+            names.append(name)
+            try:
+                store.create(_obj(name))
+            except SimulatedCrash:
+                crashed = name
+                break
+        return store, pers, names, crashed
+
+    def test_kill_switch_is_deterministic(self):
+        for seed in range(8):
+            a, b = KillSwitch(seed, 0), KillSwitch(seed, 0)
+            self.assertEqual(a.describe(), b.describe())
+            self.assertIn(a.point, KILL_POINTS)
+
+    def test_same_seed_same_crash_same_recovery(self):
+        # Seeds chosen to pin each kill-point (see KillSwitch PRF):
+        # 25=before_append, 8=after_append, 13=torn_tail, 1=mid_snapshot.
+        for seed in (25, 8, 13, 1):
+            with self.subTest(seed=seed):
+                d1 = os.path.join(self.dir, f"a{seed}")
+                d2 = os.path.join(self.dir, f"b{seed}")
+                s1 = self._crash_run(seed, d1)
+                s2 = self._crash_run(seed, d2)
+                self.assertTrue(s1[1].dead)
+                self.assertEqual(s1[3], s2[3])  # same create crashed
+                r1 = Persistence(d1).recover()
+                r2 = Persistence(d2).recover()
+
+                def scrub(objects):
+                    # uids are minted from os randomness (correctly NOT
+                    # seeded); everything else must match bit-for-bit.
+                    out = []
+                    for o in objects:
+                        o = json.loads(json.dumps(o, default=str))
+                        o.get("metadata", {}).pop("uid", None)
+                        out.append(o)
+                    return out
+
+                self.assertEqual(
+                    _canonical(scrub(r1.objects), r1.rv),
+                    _canonical(scrub(r2.objects), r2.rv),
+                )
+
+    def test_before_append_loses_record_and_commit(self):
+        store, pers, names, crashed = self._crash_run(25, self.dir)
+        self.assertEqual(pers.kill_switch.point, "before_append")
+        self.assertIsNotNone(crashed)
+        state = Persistence(self.dir).recover()
+        recovered = {o["metadata"]["name"] for o in state.objects}
+        in_store = {o["metadata"]["name"] for o in store.all_objects()}
+        # Lost entirely: neither durable nor committed — a clean failure
+        # the caller saw an exception for.
+        self.assertNotIn(crashed, recovered)
+        self.assertNotIn(crashed, in_store)
+        self.assertEqual(recovered, in_store)
+
+    def test_after_append_orphans_the_record(self):
+        store, pers, names, crashed = self._crash_run(8, self.dir)
+        self.assertEqual(pers.kill_switch.point, "after_append")
+        state = Persistence(self.dir).recover()
+        recovered = {o["metadata"]["name"] for o in state.objects}
+        in_store = {o["metadata"]["name"] for o in store.all_objects()}
+        # The "fsynced but the 200 was lost" window: durable on disk,
+        # never committed in memory — recovery resurrects an object the
+        # submitter believes failed (the chaos soak's "orphan").
+        self.assertIn(crashed, recovered)
+        self.assertNotIn(crashed, in_store)
+
+    def test_after_append_on_delete_is_a_phantom_delete(self):
+        # The mirror image of the orphan: after_append fires on a DEL
+        # record, so the delete is durable but the in-memory evict (and
+        # its DELETED watch event) never happened. Recovery must honor
+        # the disk — and surface the key via wal_deleted_keys so
+        # restart-aware observers can reconcile the missing event.
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=1,
+                           kill_switch=KillSwitch(8, 0))  # after_append@3
+        pers.start(store)
+        store.create(_obj("w-0"))
+        store.create(_obj("w-1"))
+        with self.assertRaises(SimulatedCrash):
+            store.delete(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                         "default", "w-1")
+        self.assertEqual(pers.kill_switch.point, "after_append")
+        in_store = {o["metadata"]["name"] for o in store.all_objects()}
+        self.assertIn("w-1", in_store)  # evict aborted — memory kept it
+        state = Persistence(self.dir).recover()
+        recovered = {o["metadata"]["name"] for o in state.objects}
+        self.assertEqual(recovered, {"w-0"})  # disk's verdict: deleted
+        self.assertIn(
+            (WORKLOAD_API_VERSION, WORKLOAD_KIND, "default", "w-1"),
+            [tuple(k) for k in state.wal_deleted_keys],
+        )
+
+    def test_torn_tail_truncates_the_record(self):
+        store, pers, names, crashed = self._crash_run(13, self.dir)
+        self.assertEqual(pers.kill_switch.point, "torn_tail")
+        state = Persistence(self.dir).recover()
+        recovered = {o["metadata"]["name"] for o in state.objects}
+        self.assertEqual(state.torn_records_dropped, 1)
+        self.assertNotIn(crashed, recovered)
+        # Everything before the torn record was force-flushed.
+        self.assertEqual(
+            recovered, {o["metadata"]["name"] for o in store.all_objects()}
+        )
+
+    def test_mid_snapshot_leaves_orphan_tmp_commit_survives(self):
+        store, pers, names, crashed = self._crash_run(1, self.dir)
+        self.assertEqual(pers.kill_switch.point, "mid_snapshot")
+        # The TRIGGERING commit succeeded (death happened in background
+        # compaction, after the rename's tmp was written) — it is the
+        # NEXT create that observes the dead layer and crashes.
+        self.assertTrue(pers.dead)
+        trigger = names[-2]
+        self.assertIn(
+            trigger,
+            {o["metadata"]["name"] for o in store.all_objects()},
+        )
+        self.assertTrue(
+            os.path.exists(os.path.join(self.dir, SNAPSHOT_TMP_NAME))
+        )
+        state = Persistence(self.dir).recover()
+        # Orphaned tmp removed; WAL (flushed before the snapshot was
+        # attempted) covers every commit including the triggering one.
+        self.assertFalse(
+            os.path.exists(os.path.join(self.dir, SNAPSHOT_TMP_NAME))
+        )
+        self.assertEqual(
+            {o["metadata"]["name"] for o in state.objects},
+            {o["metadata"]["name"] for o in store.all_objects()},
+        )
+
+
+class TestRestartCatchup(_TmpDirTest):
+    """Downtime crosses tick boundaries: catch-up fires the missed tick
+    unless ``startingDeadlineSeconds`` says it is too stale."""
+
+    def _setup(self, starting_deadline=None):
+        from cron_operator_tpu.controller.cron_controller import (
+            CronReconciler,
+        )
+
+        clock = FakeClock()
+        store = APIServer(clock=clock)
+        pers = Persistence(self.dir, fsync_every=1)
+        pers.start(store)
+        spec = {
+            "schedule": "*/1 * * * *",
+            "concurrencyPolicy": "Allow",
+            "historyLimit": 3,
+            "template": {"workload": {
+                "apiVersion": WORKLOAD_API_VERSION,
+                "kind": WORKLOAD_KIND,
+                "metadata": {},
+                "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+            }},
+        }
+        if starting_deadline is not None:
+            spec["startingDeadlineSeconds"] = starting_deadline
+        store.create({
+            "apiVersion": "apps.kubedl.io/v1alpha1",
+            "kind": "Cron",
+            "metadata": {"name": "nightly", "namespace": "default"},
+            "spec": spec,
+        })
+        metrics = Metrics()
+        rec = CronReconciler(store, metrics=metrics)
+        return clock, store, pers, rec, metrics
+
+    def _workload_names(self, store):
+        return sorted(
+            w["metadata"]["name"] for w in store.list(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace="default"
+            )
+        )
+
+    def _restart(self, pers, clock):
+        from cron_operator_tpu.controller.cron_controller import (
+            CronReconciler,
+        )
+
+        pers.kill("test-crash")
+        store = APIServer(clock=clock)
+        metrics = Metrics()
+        pers2 = Persistence(self.dir)
+        pers2.start(store)
+        return store, pers2, CronReconciler(store, metrics=metrics), metrics
+
+    def test_catchup_fires_missed_tick_after_downtime(self):
+        clock, store, pers, rec, _ = self._setup()
+        clock.advance(timedelta(seconds=60))
+        rec.reconcile("default", "nightly")
+        before = self._workload_names(store)
+        self.assertEqual(len(before), 1)
+
+        store2, pers2, rec2, _ = self._restart(pers, clock)
+        # 90 s of downtime: one tick boundary crossed while dead.
+        clock.advance(timedelta(seconds=90))
+        rec2.reconcile("default", "nightly")
+        after = self._workload_names(store2)
+        self.assertEqual(len(after), 2)
+        self.assertEqual(after[0], before[0])  # recovered, not re-fired
+
+    def test_starting_deadline_skips_stale_tick(self):
+        clock, store, pers, rec, _ = self._setup(starting_deadline=20)
+        clock.advance(timedelta(seconds=60))
+        rec.reconcile("default", "nightly")
+        before = self._workload_names(store)
+
+        store2, pers2, rec2, metrics = self._restart(pers, clock)
+        # Missed tick is 30 s stale on recovery — past the 20 s deadline.
+        clock.advance(timedelta(seconds=90))
+        rec2.reconcile("default", "nightly")
+        self.assertEqual(self._workload_names(store2), before)
+        self.assertEqual(
+            metrics.get(
+                'cron_ticks_skipped_total{policy="StartingDeadline"}'
+            ),
+            1.0,
+        )
+        # Skip did not advance lastScheduleTime: the tick stays visibly
+        # missed (and is re-skipped, deduped) until superseded.
+        rec2.reconcile("default", "nightly")
+        self.assertEqual(
+            metrics.get(
+                'cron_ticks_skipped_total{policy="StartingDeadline"}'
+            ),
+            1.0,
+        )
+
+    def test_fresh_tick_fires_despite_deadline(self):
+        clock, store, pers, rec, _ = self._setup(starting_deadline=20)
+        clock.advance(timedelta(seconds=60))
+        rec.reconcile("default", "nightly")
+        before = self._workload_names(store)
+
+        store2, pers2, rec2, _ = self._restart(pers, clock)
+        clock.advance(timedelta(seconds=90))
+        rec2.reconcile("default", "nightly")  # stale tick: skipped
+        # Ten more seconds brings a NEW tick boundary within deadline.
+        clock.advance(timedelta(seconds=40))
+        rec2.reconcile("default", "nightly")
+        after = self._workload_names(store2)
+        self.assertEqual(len(after), len(before) + 1)
+
+
+if __name__ == "__main__":
+    unittest.main()
