@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane chaos-soak
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards chaos-soak
 
 all: gate
 
@@ -42,6 +42,25 @@ bench:
 # regression fail the target.
 bench-controlplane:
 	python hack/controlplane_bench.py $(if $(BASELINE),--baseline-ref $(BASELINE)) $(if $(CHECK),--check)
+
+# Sharded control-plane sweep (runtime/shard.py): the same steady-state
+# list+reconcile sweep at TOTAL Crons, run per shard count in COUNTS
+# (default 1,4). Emits per-shard AND aggregate verdicts into the
+# "sharded" key of BENCH_CONTROLPLANE.json; the aggregate is the sum of
+# sequentially-measured per-shard throughputs (shared-nothing scale-out
+# projection — see PERF.md). Verdict is OK iff aggregate scale-up at the
+# highest shard count is >= MIN_SCALEUP (default 3.0) over the 1-shard
+# leg AND every shard's steady-state sweep performs zero store writes;
+# CHECK=1 makes a REGRESSION fail the target.
+TOTAL ?= 100000
+COUNTS ?= 1,4
+MIN_SCALEUP ?= 3.0
+bench-shards:
+	python hack/controlplane_bench.py --shards-sweep \
+	    --shards-total $(TOTAL) \
+	    --shard-counts $(COUNTS) \
+	    --shards-min-scaleup $(MIN_SCALEUP) \
+	    $(if $(CHECK),--check)
 
 # Seeded chaos soak: N Crons reconciled under a deterministic fault
 # schedule (conflicts, transient server errors, latency, submit

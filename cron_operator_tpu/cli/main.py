@@ -255,6 +255,21 @@ def build_parser() -> argparse.ArgumentParser:
                             "per concurrencyPolicy and spec."
                             "startingDeadlineSeconds. Unset = in-memory "
                             "only (state lost on exit)")
+    start.add_argument("--shards", type=int, default=1, metavar="N",
+                       help="embedded mode only: partition the control "
+                            "plane into N shards by a stable hash of "
+                            "(namespace, name). Each shard owns its own "
+                            "store, WAL directory (<data-dir>/shard-i), "
+                            "worker pool and leader lease; a router "
+                            "preserves the single-store client surface. "
+                            "See README 'Scale-out'")
+    start.add_argument("--replicas", type=int, default=0, choices=[0, 1],
+                       metavar="R",
+                       help="embedded mode only: hot-standby follower "
+                            "replicas per shard (0 or 1). Followers "
+                            "replay the shard's WAL byte stream "
+                            "continuously and are promotable on leader "
+                            "failure; requires --data-dir")
 
     # kubectl-style inspection for standalone mode: the reference relies
     # on kubectl + CRD printcolumns (cron_types.go:33-36); with no
@@ -384,67 +399,158 @@ def cmd_start(args: argparse.Namespace) -> int:
     else:
         api = APIServer()
 
-    persistence = None
-    recovered = None
-    if args.data_dir:
-        if args.api_server == "cluster":
-            log.error("--data-dir applies to the embedded control plane "
-                      "only; cluster mode persists in etcd")
-            return 2
-        from cron_operator_tpu.runtime.persistence import Persistence
+    sharded = args.shards > 1 or args.replicas > 0
+    if args.api_server == "cluster" and (args.shards != 1 or args.replicas):
+        log.error("--shards/--replicas apply to the embedded control "
+                  "plane only; a real cluster scales out via "
+                  "etcd/apiserver replicas")
+        return 2
+    if args.shards < 1:
+        log.error("--shards must be >= 1, got %d", args.shards)
+        return 2
 
-        # Attach to the raw store (before any chaos wrapper): the WAL
-        # hooks live inside APIServer's commit path.
-        persistence = Persistence(args.data_dir)
-        recovered = persistence.start(api)
-        if recovered.empty:
-            log.info("durability: empty data dir %s; starting fresh",
-                     args.data_dir)
-        else:
-            log.info(
-                "durability: recovered %d object(s) at rv=%d from %s "
-                "(snapshot=%s, wal records replayed=%d, torn dropped=%d)",
-                len(recovered.objects), recovered.rv, args.data_dir,
-                recovered.had_snapshot, recovered.wal_records_replayed,
-                recovered.torn_records_dropped,
-            )
-
-    if args.chaos_seed is not None:
-        if args.api_server == "cluster":
-            log.error("--chaos-seed requires the embedded control plane "
-                      "(never inject faults into a real cluster)")
-            return 2
-        from cron_operator_tpu.runtime.faults import FaultInjector, FaultPlan
-
-        api = FaultInjector(api, FaultPlan.default_chaos(args.chaos_seed))
-        log.warning("CHAOS MODE: injecting seeded faults (seed=%d) into "
-                    "the embedded control plane", args.chaos_seed)
-
-    if args.backend is None:
-        # In cluster mode workloads run as real pods; executing them
-        # in-process inside the operator is opt-in only.
-        args.backend = "none" if args.api_server == "cluster" else "local"
-    manager = Manager(
-        api,
-        max_concurrent_reconciles=args.max_concurrent_reconciles,
-        leader_elect=args.leader_elect,
-        # After recovering real state, hold readyz until the catch-up
-        # enqueue sweep drains once (missed ticks fired/skipped).
-        recovering=recovered is not None and not recovered.empty,
-    )
     # One tracer per process: the cron tick's trace id links reconcile/
     # submit spans (controller) to compile/first-step spans (backend) on
     # /debug/traces.
     from cron_operator_tpu.telemetry import Tracer
 
     tracer = Tracer()
-    reconciler = CronReconciler(api, metrics=manager.metrics, tracer=tracer)
-    manager.add_controller(
-        "cron",
-        reconciler.reconcile,
-        for_gvk=GVK_CRON,
-        owns=scheme.workload_kinds(),
-    )
+
+    persistence = None
+    recovered = None
+    plane = None
+    managers: List[Manager] = []
+    if sharded:
+        # Sharded control plane (runtime/shard.py): N hash-partitioned
+        # vertical slices, each with its own store, WAL dir, worker pool
+        # and leader lease, behind a router that preserves the
+        # single-store client surface for --serve-api/--load/backends.
+        from cron_operator_tpu.runtime.manager import Metrics
+        from cron_operator_tpu.runtime.shard import (
+            ShardedControlPlane,
+            ShardMetrics,
+            ShardRouter,
+        )
+
+        shared_metrics = Metrics()
+        try:
+            plane = ShardedControlPlane(
+                n_shards=args.shards, replicas=args.replicas,
+                data_dir=args.data_dir, metrics=shared_metrics,
+            )
+        except ValueError as err:
+            log.error("%s", err)
+            return 2
+        for s in plane.shards:
+            if s.recovered is not None and not s.recovered.empty:
+                log.info(
+                    "durability: shard %d recovered %d object(s) at rv=%d "
+                    "from %s", s.index, len(s.recovered.objects),
+                    s.recovered.rv, s.data_dir,
+                )
+        shard_backends = [s.store for s in plane.shards]
+        if args.chaos_seed is not None:
+            from cron_operator_tpu.runtime.faults import (
+                FaultInjector,
+                FaultPlan,
+            )
+
+            # Per-shard injectors with decorrelated seeds: shard i must
+            # not see the same fault schedule as shard 0.
+            shard_backends = [
+                FaultInjector(b, FaultPlan.default_chaos(args.chaos_seed + i))
+                for i, b in enumerate(shard_backends)
+            ]
+            log.warning("CHAOS MODE: injecting seeded faults (seed=%d) "
+                        "into all %d shards", args.chaos_seed, args.shards)
+        api = ShardRouter(shard_backends)
+        log.info(
+            "sharded control plane: %d shard(s), %d hot-standby "
+            "replica(s) per shard%s", args.shards, args.replicas,
+            f", data dir {args.data_dir}" if args.data_dir else "",
+        )
+        if args.backend is None:
+            args.backend = "local"
+        for i, backend in enumerate(shard_backends):
+            s = plane.shards[i]
+            m = Manager(
+                backend,
+                max_concurrent_reconciles=args.max_concurrent_reconciles,
+                leader_elect=args.leader_elect,
+                recovering=s.recovered is not None and not s.recovered.empty,
+                metrics=ShardMetrics(shared_metrics, i),
+            )
+            # Each shard's reconciler talks DIRECTLY to its shard's
+            # backend: workloads land on their owner's shard, keeping
+            # ownerReferences and cascade delete intra-shard.
+            rec = CronReconciler(backend, metrics=m.metrics, tracer=tracer)
+            m.add_controller(
+                "cron",
+                rec.reconcile,
+                for_gvk=GVK_CRON,
+                owns=scheme.workload_kinds(),
+            )
+            managers.append(m)
+        manager = managers[0]  # registry-wide reads (/metrics) go anywhere
+    else:
+        if args.data_dir:
+            if args.api_server == "cluster":
+                log.error("--data-dir applies to the embedded control "
+                          "plane only; cluster mode persists in etcd")
+                return 2
+            from cron_operator_tpu.runtime.persistence import Persistence
+
+            # Attach to the raw store (before any chaos wrapper): the WAL
+            # hooks live inside APIServer's commit path.
+            persistence = Persistence(args.data_dir)
+            recovered = persistence.start(api)
+            if recovered.empty:
+                log.info("durability: empty data dir %s; starting fresh",
+                         args.data_dir)
+            else:
+                log.info(
+                    "durability: recovered %d object(s) at rv=%d from %s "
+                    "(snapshot=%s, wal records replayed=%d, torn dropped=%d)",
+                    len(recovered.objects), recovered.rv, args.data_dir,
+                    recovered.had_snapshot, recovered.wal_records_replayed,
+                    recovered.torn_records_dropped,
+                )
+
+        if args.chaos_seed is not None:
+            if args.api_server == "cluster":
+                log.error("--chaos-seed requires the embedded control plane "
+                          "(never inject faults into a real cluster)")
+                return 2
+            from cron_operator_tpu.runtime.faults import (
+                FaultInjector,
+                FaultPlan,
+            )
+
+            api = FaultInjector(api, FaultPlan.default_chaos(args.chaos_seed))
+            log.warning("CHAOS MODE: injecting seeded faults (seed=%d) into "
+                        "the embedded control plane", args.chaos_seed)
+
+        if args.backend is None:
+            # In cluster mode workloads run as real pods; executing them
+            # in-process inside the operator is opt-in only.
+            args.backend = "none" if args.api_server == "cluster" else "local"
+        manager = Manager(
+            api,
+            max_concurrent_reconciles=args.max_concurrent_reconciles,
+            leader_elect=args.leader_elect,
+            # After recovering real state, hold readyz until the catch-up
+            # enqueue sweep drains once (missed ticks fired/skipped).
+            recovering=recovered is not None and not recovered.empty,
+        )
+        reconciler = CronReconciler(api, metrics=manager.metrics,
+                                    tracer=tracer)
+        manager.add_controller(
+            "cron",
+            reconciler.reconcile,
+            for_gvk=GVK_CRON,
+            owns=scheme.workload_kinds(),
+        )
+        managers = [manager]
 
     api_http = None
     api_cert_watcher = None
@@ -481,7 +587,12 @@ def cmd_start(args: argparse.Namespace) -> int:
     if args.backend == "local":
         from cron_operator_tpu.backends.local import LocalExecutor
 
-        executor = LocalExecutor(api, metrics=manager.metrics, tracer=tracer)
+        # The executor is process-wide (it drains workloads from every
+        # shard through the router), so its metrics skip the shard label.
+        executor_metrics = (
+            shared_metrics if sharded else manager.metrics  # noqa: F821
+        )
+        executor = LocalExecutor(api, metrics=executor_metrics, tracer=tracer)
         executor.start()
 
     servers: List[ThreadingHTTPServer] = []
@@ -491,10 +602,14 @@ def cmd_start(args: argparse.Namespace) -> int:
             _serve(
                 health_port,
                 {
+                    # Sharded: the process is healthy/ready only when
+                    # EVERY shard's manager is.
                     "/healthz": lambda: (
-                        "ok" if manager.healthz() else "unhealthy", "text/plain"),
+                        "ok" if all(m.healthz() for m in managers)
+                        else "unhealthy", "text/plain"),
                     "/readyz": lambda: (
-                        "ok" if manager.readyz() else "not ready", "text/plain"),
+                        "ok" if all(m.readyz() for m in managers)
+                        else "not ready", "text/plain"),
                 },
                 "health-probes",
             )
@@ -631,8 +746,10 @@ def cmd_start(args: argparse.Namespace) -> int:
         for sig in (signal.SIGINT, signal.SIGTERM):
             signal.signal(sig, lambda *_: stop.set())
 
-    log.info("starting manager (version %s)", __version__)
-    manager.start()
+    log.info("starting %d manager(s) (version %s)", len(managers),
+             __version__)
+    for m in managers:
+        m.start()
     if args.api_server == "cluster":
         from cron_operator_tpu.api.scheme import GVK_CRON as _cron_gvk
 
@@ -644,12 +761,15 @@ def cmd_start(args: argparse.Namespace) -> int:
         cert_watcher.stop()
     if api_cert_watcher is not None:
         api_cert_watcher.stop()
-    manager.stop()
+    for m in managers:
+        m.stop()
     if api_http is not None:
         api_http.stop()
     if executor is not None:
         executor.stop()
-    if args.api_server == "cluster":
+    if plane is not None:
+        plane.close()  # per-shard stores, WALs and follower stores
+    elif args.api_server == "cluster":
         api.stop()  # ClusterAPIServer: stop watch threads
     else:
         api.close()  # embedded store: stop the watch dispatcher

@@ -359,8 +359,16 @@ class CronReconciler:
                 except ValueError as err:
                     self._tpu_admission_failed(cron, log, err)
                     return scheduled
+            # Fail-over guard: this tick's own workload may already exist
+            # (created by a previous incarnation whose lastScheduleTime
+            # update the crash lost). Deleting it here would destroy the
+            # AlreadyExists collision the deterministic name exists to
+            # provide, and the create below would re-launch the tick.
+            tick_name = get_default_job_name(cron, next_run)
             for w in active:
                 meta = w.get("metadata") or {}
+                if meta.get("name", "") == tick_name:
+                    continue
                 try:
                     self.api.delete(
                         w["apiVersion"], w["kind"],

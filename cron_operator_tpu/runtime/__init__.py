@@ -21,6 +21,13 @@ from cron_operator_tpu.runtime.kube import (
 )
 from cron_operator_tpu.runtime.manager import Manager, Request
 from cron_operator_tpu.runtime.retry import with_conflict_retry
+from cron_operator_tpu.runtime.shard import (
+    FollowerReplica,
+    ShardedControlPlane,
+    ShardMetrics,
+    ShardRouter,
+    shard_index,
+)
 
 __all__ = [
     "APIServer",
@@ -35,4 +42,9 @@ __all__ = [
     "Manager",
     "Request",
     "with_conflict_retry",
+    "shard_index",
+    "ShardMetrics",
+    "ShardRouter",
+    "ShardedControlPlane",
+    "FollowerReplica",
 ]

@@ -117,18 +117,23 @@ class TokenBucket:
         self._lock = threading.Lock()
 
     def acquire(self) -> None:
-        while True:
-            with self._lock:
-                now = time.monotonic()
-                self._tokens = min(
-                    float(self.burst),
-                    self._tokens + (now - self._last) * self.qps,
-                )
-                self._last = now
-                if self._tokens >= 1.0:
-                    self._tokens -= 1.0
-                    return
-                wait = (1.0 - self._tokens) / self.qps
+        # Reservation style (the Go rate.Limiter shape): take the token
+        # under the lock even when the bucket goes negative — the debt IS
+        # the caller's reserved slot — then sleep exactly once, outside
+        # the lock. Concurrent waiters each hold a distinct slot and
+        # sleep overlapping; the earlier loop-and-retry shape woke every
+        # sleeper per refill to race for one token (herd wakeups, O(N²)
+        # sleeps, and unfair wake order under contention).
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                float(self.burst),
+                self._tokens + (now - self._last) * self.qps,
+            )
+            self._last = now
+            self._tokens -= 1.0
+            wait = -self._tokens / self.qps if self._tokens < 0 else 0.0
+        if wait > 0:
             time.sleep(wait)
 
 

@@ -290,6 +290,46 @@ class APIServer:
                 self._commit(object_key(committed), committed)
             self._rv = max(self._rv, int(rv))
 
+    # ---- replication ------------------------------------------------------
+
+    def replicate_put(self, obj: Unstructured) -> None:
+        """Apply one shipped WAL ``put`` record to this store (follower
+        replica path, :mod:`runtime.shard`). The record carries the
+        leader-assigned resourceVersion, so nothing is minted here:
+        the object is frozen, committed, indexed and fanned out to
+        watchers exactly as the leader committed it. Idempotent —
+        a record at or below the already-applied version of its object
+        is skipped, mirroring ``recover()``'s snapshot-rv skip."""
+        committed = freeze(obj)
+        key = object_key(committed)
+        rv = int((committed.get("metadata") or {}).get("resourceVersion") or 0)
+        with self._lock:
+            old = self._objects.get(key)
+            if old is not None and int(
+                (old.get("metadata") or {}).get("resourceVersion") or 0
+            ) >= rv:
+                return
+            self._commit(key, committed)
+            self._rv = max(self._rv, rv)
+            self._notify("ADDED" if old is None else "MODIFIED", committed)
+
+    def replicate_delete(self, key: Key, rv: int) -> None:
+        """Apply one shipped WAL ``del`` record. No cascade: the leader's
+        cascade already produced one ``del`` record per dependent, each
+        shipped and applied individually — replaying the GC here would
+        double-delete ahead of the log."""
+        key = tuple(key)  # type: ignore[assignment]
+        with self._lock:
+            self._rv = max(self._rv, int(rv))
+            obj = self._objects.get(key)
+            if obj is None:
+                return
+            meta = dict(obj["metadata"])
+            meta["resourceVersion"] = str(rv)
+            final = freeze({**obj, "metadata": meta})
+            self._evict(key)
+            self._notify("DELETED", final)
+
     def _persist_put(self, verb: str, committed: Unstructured) -> None:
         """WAL hook for create/update/patch_status. Called with the store
         lock held, BEFORE the in-memory commit: if the append dies at a

@@ -73,14 +73,19 @@ _FAMILY_META: Dict[str, tuple] = {
         "counter", "Total number of reconciliation errors per controller"),
     "controller_runtime_reconcile_time_seconds": (
         "histogram", "Reconcile wall-clock seconds per controller "
-                     "(controller-runtime parity family)"),
+                     "(controller-runtime parity family; sharded "
+                     "deployments add a shard=N label per control-plane "
+                     "partition)"),
     "workqueue_depth": (
-        "gauge", "Current depth of the controller workqueue"),
+        "gauge", "Current depth of the controller workqueue (sharded "
+                 "deployments add a shard=N label per partition)"),
     "workqueue_adds_total": (
-        "counter", "Total items added to the controller workqueue"),
+        "counter", "Total items added to the controller workqueue "
+                   "(sharded deployments add a shard=N label)"),
     "workqueue_queue_duration_seconds": (
         "histogram", "Seconds an item waits in the workqueue before a "
-                     "worker picks it up"),
+                     "worker picks it up (sharded deployments add a "
+                     "shard=N label)"),
     "apiserver_commits_total": (
         "counter", "Committed store writes per verb (create, update, "
                    "patch_status, delete); semantic no-op patches do not "
@@ -131,11 +136,22 @@ _FAMILY_META: Dict[str, tuple] = {
     "wal_records_total": (
         "counter", "Write-ahead-log records appended by the persistence "
                    "layer (label op: put, del); zero in a steady-state "
-                   "no-op reconcile sweep"),
+                   "no-op reconcile sweep (sharded deployments add a "
+                   "shard=N label per WAL)"),
     "wal_fsync_total": (
-        "counter", "Group-commit fsync batches flushed to the WAL"),
+        "counter", "Group-commit fsync batches flushed to the WAL "
+                   "(sharded deployments add a shard=N label)"),
     "wal_snapshots_total": (
-        "counter", "Compacted snapshots written (each truncates the WAL)"),
+        "counter", "Compacted snapshots written (each truncates the WAL; "
+                   "sharded deployments add a shard=N label)"),
+    "wal_shipped_bytes_total": (
+        "counter", "Durable WAL bytes streamed to hot-standby follower "
+                   "replicas (runtime/shard.py WAL shipping; sharded "
+                   "deployments add a shard=N label)"),
+    "shard_failovers_total": (
+        "counter", "Shard leader failovers: a WAL-shipping follower "
+                   "promoted to serve its partition after the leader "
+                   "died (label shard=N)"),
 }
 
 
@@ -309,6 +325,7 @@ class Manager:
         identity: str = "manager-0",
         lease_duration_s: float = 15.0,
         recovering: bool = False,
+        metrics: Optional[Metrics] = None,
     ):
         self.api = api
         self.max_concurrent_reconciles = max_concurrent_reconciles
@@ -316,7 +333,10 @@ class Manager:
         self.identity = identity
         self.lease_duration_s = lease_duration_s
         self.recovering = recovering
-        self.metrics = Metrics()
+        # ``metrics`` lets several managers share one registry (sharded
+        # control plane: each shard's manager records into the process
+        # registry through a shard-labeling view, runtime/shard.py).
+        self.metrics = metrics if metrics is not None else Metrics()
         self._controllers: List[_Controller] = []
         # GenerationChangedPredicate state: last seen metadata.generation
         # per For-kind object. A MODIFIED event whose generation did not

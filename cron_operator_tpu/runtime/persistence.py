@@ -150,6 +150,11 @@ class Persistence:
         self._snap_tmp_path = os.path.join(data_dir, SNAPSHOT_TMP_NAME)
         self._f: Optional[Any] = None  # binary append handle, open()ed
         self._buf: List[bytes] = []    # serialized records awaiting flush
+        # WAL shipping sinks (hot-standby replicas, runtime/shard.py):
+        # each gets the exact byte runs this layer writes to disk, at the
+        # moment they become durable — so a sink's replayed state can
+        # never run ahead of what a crash would leave on disk.
+        self._shippers: List[Any] = []
         self._flusher: Optional[threading.Thread] = None
         self._stop_flusher = threading.Event()
         self._since_snapshot = 0
@@ -270,9 +275,14 @@ class Persistence:
                 # torn mid-line — recovery must truncate it away.
                 self._flush_locked(fsync=True)
                 assert self._f is not None
-                self._f.write(line[: max(1, len(line) // 2)])
+                torn = line[: max(1, len(line) // 2)]
+                self._f.write(torn)
                 self._f.flush()
                 os.fsync(self._f.fileno())
+                # Ship the torn fragment too: a follower buffers the
+                # incomplete line and never applies it — byte-for-byte
+                # the same verdict recovery reaches by truncating it.
+                self._ship(torn)
                 self._die(action)
                 raise SimulatedCrash("kill-point: torn final WAL record")
             self._buf.append(line)
@@ -305,13 +315,47 @@ class Persistence:
         if self._f is None:
             self.open()
         assert self._f is not None
-        self._f.write(b"".join(self._buf))
+        data = b"".join(self._buf)
+        self._f.write(data)
         self._buf.clear()
         self._f.flush()
         if fsync:
             os.fsync(self._f.fileno())
             self.fsyncs += 1
             self._count("wal_fsync_total")
+        self._ship(data)
+
+    def _ship(self, data: bytes) -> None:
+        """Forward a just-written byte run to every shipping sink.
+        Called with the lock held, AFTER the bytes hit the file — a
+        follower therefore only ever sees bytes an independent replay
+        of the on-disk WAL would also see."""
+        if not self._shippers or not data:
+            return
+        self._count("wal_shipped_bytes_total", float(len(data)))
+        for fn in self._shippers:
+            try:
+                fn(data)
+            except Exception:  # noqa: BLE001 — a broken follower must
+                # never fail the leader's write path
+                logger.exception("WAL shipper raised; follower may lag")
+
+    def attach_follower(self, follower) -> "RecoveredState":
+        """Bootstrap ``follower`` from the current on-disk state and
+        subscribe it to every future durable byte — atomically, under
+        the lock, so no record is either missed or double-applied
+        between the bootstrap read and the first shipped run.
+
+        ``follower`` implements ``bootstrap(RecoveredState)`` and
+        ``apply_bytes(bytes)`` (see :class:`runtime.shard.FollowerReplica`).
+        Returns the bootstrap state (forensics/logging)."""
+        with self._lock:
+            if not self._dead:
+                self._flush_locked(fsync=True)
+            state = self.recover()
+            follower.bootstrap(state)
+            self._shippers.append(follower.apply_bytes)
+            return state
 
     # ---- snapshots --------------------------------------------------------
 

@@ -1,0 +1,697 @@
+"""Sharded control plane: hash-partitioned stores with WAL-shipping
+hot standbys.
+
+One embedded :class:`~cron_operator_tpu.runtime.kube.APIServer` tops out
+on a single lock and a single WAL fd. This module scales the control
+plane *horizontally* instead of making that one store faster: the object
+space is partitioned into N shards by a stable hash of
+``(namespace, name)``, and each shard is a complete vertical slice —
+
+- its own frozen-snapshot store (``runtime/kube.py``),
+- its own WAL directory (``runtime/persistence.py``),
+- its own manager + worker pool + leader lease (``runtime/manager.py``),
+- optionally its own WAL-shipping hot-standby follower.
+
+Controllers run UNMODIFIED per shard: a shard's reconciler talks
+directly to the shard's store, so every workload a reconciler creates
+lands on the same shard as its owning Cron — ownerReferences, the
+owner-UID index, and cascade delete all stay intra-shard by
+construction. Only harness-level clients (the CLI, the REST facade,
+benches, the chaos soak) go through :class:`ShardRouter`, a thin fan-out
+that preserves the single-store client surface.
+
+Replication rides the durability layer: ``Persistence`` ships every byte
+run at the moment it becomes durable (``_ship`` on each flush), and a
+:class:`FollowerReplica` replays those bytes continuously into its own
+read-only store. Because the follower only ever sees bytes that are also
+on disk, its state is — at every instant — exactly what an independent
+``Persistence.recover()`` of the shard's data dir would produce (the per
+shard I6 invariant the chaos soak checks before every promotion).
+
+Hash stability: :func:`shard_index` is pinned by test vectors
+(``tests/test_shard.py``). Changing the hash re-homes objects across
+shard WAL directories and orphans the old ones — treat the function as
+an on-disk format.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from cron_operator_tpu.runtime.kube import (
+    APIServer,
+    NotFoundError,
+    Unstructured,
+    WatchEvent,
+    object_key,
+)
+from cron_operator_tpu.runtime.persistence import Persistence, RecoveredState
+from cron_operator_tpu.utils.clock import Clock, RealClock
+
+logger = logging.getLogger(__name__)
+
+#: Subdirectory name for shard ``i`` under the operator ``--data-dir``.
+SHARD_DIR_FMT = "shard-{}"
+
+# Keyed so the partition function can never silently collide with some
+# other blake2b use of the same input; the key is part of the on-disk
+# format (see module docstring) and must never change.
+_HASH_KEY = b"cron-operator-shard-v1"
+
+
+def shard_index(namespace: str, name: str, n_shards: int) -> int:
+    """Stable shard assignment for ``(namespace, name)``.
+
+    Every version of this operator must hash identically — a shard's WAL
+    directory is named after the index, so a hash change would strand
+    durable state under directories no shard owns. Pinned by vector
+    tests for N in {1, 4, 16}.
+    """
+    if n_shards <= 1:
+        return 0
+    h = hashlib.blake2b(
+        f"{namespace}/{name}".encode("utf-8"), digest_size=8, key=_HASH_KEY
+    )
+    return int.from_bytes(h.digest(), "big") % n_shards
+
+
+def shard_dir(data_dir: str, index: int) -> str:
+    return os.path.join(data_dir, SHARD_DIR_FMT.format(index))
+
+
+def canonical_state(objects: Sequence[Dict[str, Any]], rv: int) -> str:
+    """Canonical JSON of a store's full state, for byte-equality checks
+    (the per-shard I6 invariant: follower state vs independent WAL
+    replay). Frozen trees serialize natively — FrozenDict/FrozenList
+    subclass dict/list."""
+    body = sorted((json.dumps(o, sort_keys=True) for o in objects))
+    return json.dumps({"rv": int(rv), "objects": body}, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# metrics: per-shard label injection over a shared registry
+# ---------------------------------------------------------------------------
+
+
+class ShardMetrics:
+    """A view of a shared ``Metrics`` registry that stamps ``shard="i"``
+    onto every series name passing through it.
+
+    Per-shard Managers/stores/queues are handed one of these instead of
+    the bare registry, so every family they emit —
+    ``controller_runtime_reconcile_time_seconds``, ``workqueue_*``,
+    ``wal_*``, ``apiserver_commits_total`` — gains the shard label with
+    zero changes to the emitting code. Rewritten names are interned per
+    instance; the hot path does one dict hit, not string surgery.
+    """
+
+    def __init__(self, inner: Any, shard: int):
+        self._inner = inner
+        self.shard = int(shard)
+        self._suffix = f'shard="{self.shard}"'
+        self._interned: Dict[str, str] = {}
+
+    def _label(self, series: str) -> str:
+        out = self._interned.get(series)
+        if out is None:
+            if series.endswith("}"):
+                out = f"{series[:-1]},{self._suffix}}}"
+            else:
+                out = f"{series}{{{self._suffix}}}"
+            self._interned[series] = out
+        return out
+
+    # -- write side (what instrumented components call) --------------------
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        self._inner.inc(self._label(name), value)
+
+    def set(self, name: str, value: float) -> None:
+        self._inner.set(self._label(name), value)
+
+    def observe(self, name: str, value: float, buckets: Optional[tuple] = None) -> None:
+        if buckets is None:
+            self._inner.observe(self._label(name), value)
+        else:
+            self._inner.observe(self._label(name), value, buckets=buckets)
+
+    # -- read side (tests / health probes on a per-shard view) -------------
+
+    def get(self, name: str) -> float:
+        return self._inner.get(self._label(name))
+
+    def gauge(self, name: str) -> Optional[float]:
+        return self._inner.gauge(self._label(name))
+
+    def histogram(self, family: str) -> Optional[Dict]:
+        return self._inner.histogram(self._label(family))
+
+    def __getattr__(self, item: str) -> Any:
+        # labels()/snapshot()/render_prometheus() and anything else are
+        # registry-wide concerns — delegate to the shared registry.
+        return getattr(self._inner, item)
+
+
+# ---------------------------------------------------------------------------
+# WAL-shipping follower
+# ---------------------------------------------------------------------------
+
+
+class FollowerReplica:
+    """A hot-standby store fed by the leader's WAL byte stream.
+
+    ``Persistence.attach_follower`` calls :meth:`bootstrap` once with the
+    leader's recovered durable state, then :meth:`apply_bytes` with every
+    byte run as it becomes durable. Records are applied through the
+    store's replication verbs (leader-assigned resourceVersions, no new
+    WAL), so the follower serves read-only list/watch at near-zero lag
+    and is promotable the instant the leader dies.
+
+    A torn tail — the leader died mid-record — stays in ``_tail`` and is
+    never applied: the same verdict crash recovery reaches by truncating
+    the torn record. That is what keeps the I6 equivalence exact.
+    """
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self.store = APIServer(clock)
+        self._lock = threading.Lock()
+        self._tail = b""
+        self.records_applied = 0
+        self.records_dropped = 0  # unparseable lines (corrupt mid-stream)
+        self.bootstrap_rv = 0
+        #: Keys whose last shipped record was a ``del`` — the follower's
+        #: running equivalent of ``RecoveredState.wal_deleted_keys``.
+        self.deleted_keys: Dict[tuple, int] = {}
+
+    def bootstrap(self, state: RecoveredState) -> None:
+        if not state.empty:
+            self.store.restore_state(state.objects, state.rv)
+        for key in state.wal_deleted_keys:
+            self.deleted_keys[tuple(key)] = state.rv
+        self.bootstrap_rv = state.rv
+
+    def apply_bytes(self, data: bytes) -> None:
+        """Consume a shipped byte run; applies every COMPLETE line."""
+        with self._lock:
+            buf = self._tail + data
+            while True:
+                nl = buf.find(b"\n")
+                if nl < 0:
+                    break
+                line, buf = buf[:nl], buf[nl + 1:]
+                if line:
+                    self._apply_line(line)
+            self._tail = buf
+
+    def _apply_line(self, line: bytes) -> None:
+        try:
+            rec = json.loads(line)
+            op = rec["op"]
+        except (ValueError, KeyError, TypeError):
+            # Corrupt mid-stream line: recovery would drop it too.
+            self.records_dropped += 1
+            return
+        if op == "put":
+            obj = rec.get("obj")
+            if isinstance(obj, dict):
+                self.store.replicate_put(obj)
+                self.deleted_keys.pop(object_key(obj), None)
+                self.records_applied += 1
+        elif op == "del":
+            key = tuple(rec.get("key") or ())
+            rv = int(rec.get("rv") or 0)
+            if len(key) == 4:
+                self.store.replicate_delete(key, rv)
+                self.deleted_keys[key] = rv
+                self.records_applied += 1
+
+    @property
+    def lag_bytes(self) -> int:
+        """Bytes buffered but not yet applied (a torn/partial record)."""
+        with self._lock:
+            return len(self._tail)
+
+    def state(self) -> str:
+        """Canonical state string (see :func:`canonical_state`)."""
+        return canonical_state(
+            self.store.all_objects(), getattr(self.store, "_rv", 0)
+        )
+
+
+# ---------------------------------------------------------------------------
+# shard bundle + router
+# ---------------------------------------------------------------------------
+
+
+class Shard:
+    """One partition's full vertical slice. ``store`` / ``persistence``
+    / ``follower`` are re-pointed on failover; holders of the Shard (the
+    router, the CLI) observe the swap, holders of the OLD store (a dead
+    manager being torn down) do not."""
+
+    def __init__(
+        self,
+        index: int,
+        store: APIServer,
+        persistence: Optional[Persistence] = None,
+        follower: Optional[FollowerReplica] = None,
+        data_dir: Optional[str] = None,
+        recovered: Optional[RecoveredState] = None,
+    ):
+        self.index = index
+        self.store = store
+        self.persistence = persistence
+        self.follower = follower
+        self.data_dir = data_dir
+        self.recovered = recovered
+        self.failovers = 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Shard(index={self.index}, objects={len(self.store)}, "
+                f"failovers={self.failovers})")
+
+
+class ShardRouter:
+    """The single-store client surface over N shard stores.
+
+    Routing rules:
+
+    - ``create`` routes by :func:`shard_index` of the object's own
+      ``(namespace, name)`` — the primary hash home.
+    - single-object reads/writes try the hash home first, then probe the
+      other shards. The probe exists because reconciler-created children
+      live on their OWNER's shard (co-location, see module docstring),
+      not on their own hash home.
+    - ``list``/``list_with_rv``/``events``/``all_objects``/``dependents``
+      fan out and concatenate; the composite resourceVersion is the SUM
+      of the shard rvs — monotonic under any interleaving of shard
+      writes, which is all rv-bracketing clients (the zero-write bench
+      assertion, no-op elision checks) rely on.
+    - ``add_watcher`` subscribes to every shard's coalescing dispatcher;
+      the merged stream preserves per-object order because an object
+      only ever lives on one shard.
+
+    Cross-shard operations are NOT transactional — exactly the kube
+    posture, where a list spanning resource types is not a snapshot
+    either. Each individual object keeps full optimistic-concurrency
+    semantics on its home shard.
+    """
+
+    def __init__(self, stores: Sequence[Any]):
+        if not stores:
+            raise ValueError("ShardRouter needs at least one shard store")
+        self._stores: List[Any] = list(stores)
+        self.n_shards = len(self._stores)
+
+    # -- topology -----------------------------------------------------------
+
+    @property
+    def clock(self) -> Clock:
+        return self._stores[0].clock
+
+    def store(self, index: int) -> Any:
+        return self._stores[index]
+
+    def stores(self) -> List[Any]:
+        return list(self._stores)
+
+    def replace(self, index: int, store: Any) -> None:
+        """Swap a shard's backend (failover promotion)."""
+        self._stores[index] = store
+
+    def shard_for(self, namespace: str, name: str) -> int:
+        return shard_index(namespace, name, self.n_shards)
+
+    def _home(self, namespace: str, name: str) -> Any:
+        return self._stores[shard_index(namespace, name, self.n_shards)]
+
+    def _locate(
+        self, api_version: str, kind: str, namespace: str, name: str
+    ) -> Any:
+        """Shard holding the object: hash home, else probe. Falls back to
+        the hash home when absent everywhere so the verb raises the same
+        NotFoundError a single store would."""
+        home = self._home(namespace, name)
+        if self.n_shards == 1:
+            return home
+        if home.get_frozen(api_version, kind, namespace, name) is not None:
+            return home
+        for s in self._stores:
+            if s is home:
+                continue
+            if s.get_frozen(api_version, kind, namespace, name) is not None:
+                return s
+        return home
+
+    # -- single-object verbs -------------------------------------------------
+
+    def create(self, obj: Unstructured) -> Unstructured:
+        _, _, ns, name = object_key(obj)
+        return self._home(ns, name).create(obj)
+
+    def get(self, api_version: str, kind: str, namespace: str, name: str):
+        return self._locate(api_version, kind, namespace, name).get(
+            api_version, kind, namespace, name
+        )
+
+    def try_get(self, api_version: str, kind: str, namespace: str, name: str):
+        return self._locate(api_version, kind, namespace, name).try_get(
+            api_version, kind, namespace, name
+        )
+
+    def get_frozen(self, api_version: str, kind: str, namespace: str, name: str):
+        return self._locate(api_version, kind, namespace, name).get_frozen(
+            api_version, kind, namespace, name
+        )
+
+    def update(self, obj: Unstructured) -> Unstructured:
+        av, kind, ns, name = object_key(obj)
+        return self._locate(av, kind, ns, name).update(obj)
+
+    def patch_status(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        status: Dict[str, Any],
+    ) -> Unstructured:
+        return self._locate(api_version, kind, namespace, name).patch_status(
+            api_version, kind, namespace, name, status
+        )
+
+    def delete(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        propagation: str = "Background",
+    ) -> None:
+        self._locate(api_version, kind, namespace, name).delete(
+            api_version, kind, namespace, name, propagation=propagation
+        )
+
+    def record_event(
+        self, involved: Unstructured, etype: str, reason: str, message: str
+    ) -> None:
+        _, _, ns, name = object_key(involved)
+        av = involved.get("apiVersion", "")
+        kind = involved.get("kind", "")
+        self._locate(av, kind, ns, name).record_event(
+            involved, etype, reason, message
+        )
+
+    # -- fan-out reads -------------------------------------------------------
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        owner_uid: Optional[str] = None,
+    ) -> List[Unstructured]:
+        out: List[Unstructured] = []
+        for s in self._stores:
+            out.extend(
+                s.list(api_version, kind, namespace, label_selector, owner_uid)
+            )
+        return out
+
+    def list_with_rv(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        owner_uid: Optional[str] = None,
+    ) -> Tuple[List[Unstructured], str]:
+        out: List[Unstructured] = []
+        rv_sum = 0
+        for s in self._stores:
+            objs, rv = s.list_with_rv(
+                api_version, kind, namespace, label_selector, owner_uid
+            )
+            out.extend(objs)
+            rv_sum += int(rv)
+        return out, str(rv_sum)
+
+    def dependents(
+        self, owner_uid: Optional[str], namespace: Optional[str] = None
+    ) -> List[Unstructured]:
+        out: List[Unstructured] = []
+        for s in self._stores:
+            out.extend(s.dependents(owner_uid, namespace))
+        return out
+
+    def events(self, reason=None, involved_name=None):
+        out: List[Any] = []
+        for s in self._stores:
+            out.extend(s.events(reason=reason, involved_name=involved_name))
+        return out
+
+    def all_objects(self) -> List[Unstructured]:
+        out: List[Unstructured] = []
+        for s in self._stores:
+            out.extend(s.all_objects())
+        return out
+
+    # -- watch / lifecycle ---------------------------------------------------
+
+    def add_watcher(
+        self, fn: Callable[[WatchEvent], None], coalesce: bool = False
+    ) -> None:
+        for s in self._stores:
+            s.add_watcher(fn, coalesce)
+
+    def watch_backlog(self) -> int:
+        return sum(s.watch_backlog() for s in self._stores)
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        ok = True
+        for s in self._stores:
+            remaining = max(0.05, deadline - _time.monotonic())
+            ok = s.flush(timeout=remaining) and ok
+        return ok
+
+    def close(self) -> None:
+        for s in self._stores:
+            s.close()
+
+    # -- misc surface parity -------------------------------------------------
+
+    @property
+    def _rv(self) -> int:
+        # Composite rv (sum of shard rvs): monotonic, and constant iff no
+        # shard committed a write — which is exactly what rv-bracketed
+        # zero-write assertions need.
+        return sum(int(getattr(s, "_rv", 0)) for s in self._stores)
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._stores)
+
+    def __bool__(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# the sharded control plane
+# ---------------------------------------------------------------------------
+
+
+class ShardedControlPlane:
+    """Builds and owns N shard slices plus the router over them.
+
+    With ``data_dir`` set, shard ``i`` persists under
+    ``<data_dir>/shard-i`` (recovery runs per shard on construction).
+    With ``replicas > 0``, each shard additionally gets a WAL-shipping
+    :class:`FollowerReplica` attached to its Persistence — replication
+    REQUIRES a data dir, because the WAL byte stream is the shipping
+    medium.
+
+    Failover (:meth:`promote_follower`): verify the follower's state is
+    byte-identical to an independent replay of the shard's on-disk WAL
+    (per-shard I6), then re-point the shard at the follower's store,
+    give it a fresh Persistence over the same dir (snapshot-first, so
+    the WAL restarts empty), and attach a NEW follower so the promoted
+    leader is itself replicated.
+    """
+
+    def __init__(
+        self,
+        n_shards: int = 1,
+        replicas: int = 0,
+        data_dir: Optional[str] = None,
+        clock: Optional[Clock] = None,
+        metrics: Optional[Any] = None,
+        fsync_every: Optional[int] = None,
+        snapshot_every: Optional[int] = None,
+        flush_interval_s: Optional[float] = None,
+    ):
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        if replicas < 0 or replicas > 1:
+            raise ValueError("replicas must be 0 or 1 (one hot standby per shard)")
+        if replicas and not data_dir:
+            raise ValueError(
+                "--replicas requires --data-dir: followers replay the "
+                "shard's WAL byte stream, which only exists with "
+                "durability enabled"
+            )
+        self.n_shards = n_shards
+        self.replicas = replicas
+        self.data_dir = data_dir
+        self.clock = clock if clock is not None else RealClock()
+        self.metrics = metrics
+        self._pers_kwargs: Dict[str, Any] = {}
+        if fsync_every is not None:
+            self._pers_kwargs["fsync_every"] = fsync_every
+        if snapshot_every is not None:
+            self._pers_kwargs["snapshot_every"] = snapshot_every
+        if flush_interval_s is not None:
+            self._pers_kwargs["flush_interval_s"] = flush_interval_s
+
+        self.shards: List[Shard] = []
+        for i in range(n_shards):
+            store = APIServer(self.clock)
+            pers: Optional[Persistence] = None
+            follower: Optional[FollowerReplica] = None
+            sdir: Optional[str] = None
+            recovered: Optional[RecoveredState] = None
+            if data_dir:
+                sdir = shard_dir(data_dir, i)
+                pers = Persistence(sdir, **self._pers_kwargs)
+                if metrics is not None:
+                    pers.instrument(ShardMetrics(metrics, i))
+                recovered = pers.start(store)
+                if replicas:
+                    follower = FollowerReplica(self.clock)
+                    pers.attach_follower(follower)
+            if metrics is not None:
+                store.instrument(ShardMetrics(metrics, i))
+            self.shards.append(
+                Shard(i, store, pers, follower, sdir, recovered)
+            )
+        self.router = ShardRouter([s.store for s in self.shards])
+
+    @property
+    def recovered_any(self) -> bool:
+        return any(
+            s.recovered is not None and not s.recovered.empty
+            for s in self.shards
+        )
+
+    # -- failover ------------------------------------------------------------
+
+    def promote_follower(self, index: int) -> Dict[str, Any]:
+        """Promote shard ``index``'s hot standby to leader.
+
+        Returns a report dict; ``report["i6_ok"]`` is the per-shard I6
+        verdict (follower state == independent replay of the on-disk
+        WAL), checked BEFORE the promoted store writes a new snapshot.
+        Raises RuntimeError if the shard has no follower attached.
+        """
+        shard = self.shards[index]
+        follower = shard.follower
+        if follower is None:
+            raise RuntimeError(f"shard {index} has no follower to promote")
+        old_pers = shard.persistence
+        if old_pers is not None and not old_pers.dead:
+            # Clean handover (e.g. rolling restart): flush + stop the old
+            # durability layer first so the follower has every byte.
+            old_pers.close()
+
+        # I6, per shard: the follower must equal an independent replay of
+        # exactly the bytes on disk — before the new leader rewrites them.
+        replay = Persistence(shard.data_dir, **self._pers_kwargs).recover()
+        follower_state = follower.state()
+        replay_state = canonical_state(replay.objects, replay.rv)
+        i6_ok = follower_state == replay_state
+
+        store = follower.store
+        new_pers = Persistence(shard.data_dir, **self._pers_kwargs)
+        if self.metrics is not None:
+            new_pers.instrument(ShardMetrics(self.metrics, index))
+        new_pers.open()
+        # Snapshot-first: the promoted store's state becomes the new
+        # snapshot and the WAL restarts empty — the promoted leader's
+        # writes append from here. restore_state() is not needed (the
+        # follower store already HAS the state); start() would refuse a
+        # non-empty store anyway.
+        new_pers.write_snapshot(
+            store.all_objects(), int(getattr(store, "_rv", 0))
+        )
+        store.attach_persistence(new_pers)
+        if self.metrics is not None:
+            store.instrument(ShardMetrics(self.metrics, index))
+
+        new_follower: Optional[FollowerReplica] = None
+        if self.replicas:
+            new_follower = FollowerReplica(self.clock)
+            new_pers.attach_follower(new_follower)
+
+        shard.store = store
+        shard.persistence = new_pers
+        shard.follower = new_follower
+        shard.failovers += 1
+        self.router.replace(index, store)
+        if self.metrics is not None:
+            self.metrics.inc(f'shard_failovers_total{{shard="{index}"}}')
+        logger.info(
+            "shard %d: follower promoted (i6_ok=%s, objects=%d, rv=%d)",
+            index, i6_ok, len(store), int(getattr(store, "_rv", 0)),
+        )
+        return {
+            "shard": index,
+            "i6_ok": i6_ok,
+            "objects": len(store),
+            "rv": int(getattr(store, "_rv", 0)),
+            "replayed_records": replay.wal_records_replayed,
+            "follower_records_applied": follower.records_applied,
+            "wal_deleted_keys": sorted(follower.deleted_keys),
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        for shard in self.shards:
+            try:
+                shard.store.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                logger.exception("shard %d store close failed", shard.index)
+            if shard.persistence is not None and not shard.persistence.dead:
+                try:
+                    shard.persistence.close()
+                except Exception:  # pragma: no cover
+                    logger.exception(
+                        "shard %d persistence close failed", shard.index
+                    )
+            if shard.follower is not None:
+                try:
+                    shard.follower.store.close()
+                except Exception:  # pragma: no cover
+                    logger.exception(
+                        "shard %d follower close failed", shard.index
+                    )
+
+
+__all__ = [
+    "shard_index",
+    "shard_dir",
+    "canonical_state",
+    "ShardMetrics",
+    "FollowerReplica",
+    "Shard",
+    "ShardRouter",
+    "ShardedControlPlane",
+    "SHARD_DIR_FMT",
+]

@@ -163,6 +163,12 @@ class WorkQueue(Generic[T]):
     def done(self, item: T) -> None:
         with self._cond:
             self._processing.discard(item)
+            if self._shutdown:
+                # A dirty item must not be re-queued into a queue that is
+                # tearing down — it would keep get() returning work after
+                # shut_down() and leave the final depth non-zero.
+                self._dirty.discard(item)
+                return
             if item in self._dirty:
                 self._queue.append(item)
                 self._record_enqueue(item)
@@ -239,10 +245,24 @@ class WorkQueue(Generic[T]):
     # ---- shutdown ---------------------------------------------------------
 
     def shut_down(self) -> None:
+        """Wake every blocked waiter and retire the delay thread.
+
+        ``notify_all`` on BOTH conditions releases workers parked in
+        ``get(timeout=None)`` (they observe ``_shutdown`` and return
+        None) and the delay loop (which exits). The delay thread is then
+        joined OUTSIDE the lock — it must reacquire the lock to observe
+        shutdown — so an N-shard teardown leaves zero parked threads
+        behind instead of leaking one ``workqueue-delay`` thread per
+        queue. Pending delayed adds are dropped (their deadlines can
+        never fire) so ``stats()`` reports a clean (0, 0, None)."""
         with self._cond:
             self._shutdown = True
+            self._delayed.clear()
+            self._added_at.clear()
             self._cond.notify_all()
             self._delay_cond.notify_all()
+        if self._delay_thread is not threading.current_thread():
+            self._delay_thread.join(timeout=5.0)
 
     @property
     def is_shut_down(self) -> bool:

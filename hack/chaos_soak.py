@@ -782,6 +782,480 @@ def run_soak(
     }
 
 
+def run_sharded_soak(
+    seed: int,
+    n_crons: int,
+    rounds: int,
+    shards: int,
+    workers: int = 2,
+    chaotic: bool = True,
+    quiesce_timeout_s: float = 30.0,
+) -> dict:
+    """The sharded-control-plane soak (``--shards N``): the same fault
+    storm driven against N hash-partitioned shards (runtime/shard.py),
+    each with its own store, WAL dir, manager, leader lease and a
+    WAL-shipping hot-standby follower.
+
+    Kill rounds differ from the single-store soak in exactly the way
+    the architecture intends: instead of restarting the process and
+    REPLAYING the WAL from disk, the harness kills one PRF-chosen shard
+    leader's durability layer and PROMOTES its follower. The per-shard
+    I6 check runs inside the promotion (``promote_follower``): the
+    follower's state must be byte-identical to an independent replay of
+    the shard's on-disk WAL, BEFORE the promoted store rewrites the
+    snapshot. Everything else — environment flips, quiesce discipline,
+    the seven invariants — is the single-store soak verbatim, observed
+    through the shard router."""
+    from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+    from cron_operator_tpu.api.v1alpha1 import rfc3339
+    from cron_operator_tpu.controller.cron_controller import CronReconciler
+    from cron_operator_tpu.runtime.faults import (
+        FaultInjector,
+        FaultPlan,
+        KillSwitch,
+        seeded_fraction,
+    )
+    from cron_operator_tpu.runtime.kube import (
+        AlreadyExistsError,
+        ConflictError,
+        NotFoundError,
+        ServerTimeoutError,
+    )
+    from cron_operator_tpu.runtime.manager import Manager
+    from cron_operator_tpu.runtime.persistence import SimulatedCrash
+    from cron_operator_tpu.runtime.retry import with_conflict_retry
+    from cron_operator_tpu.runtime.shard import (
+        ShardedControlPlane,
+        ShardRouter,
+        shard_index,
+    )
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    storm_plan = FaultPlan.default_chaos(seed)
+    storm_plan = replace(storm_plan, kill_prob=KILL_PROB)
+    schedule = storm_plan.schedule(rounds)
+    by_round: dict = {}
+    for ev in schedule:
+        by_round.setdefault(ev["round"], set()).add(ev["fault"])
+
+    def _plan_for(si: int):
+        # Decorrelated per-shard fault streams under one round schedule.
+        base = seed * 1000 + si
+        return (
+            replace(FaultPlan.default_chaos(base), kill_prob=KILL_PROB)
+            if chaotic else FaultPlan.quiet(base)
+        )
+
+    data_dir = tempfile.mkdtemp(prefix="chaos-soak-shards-")
+    clock = FakeClock()
+    start_epoch = int(clock.now().timestamp())
+    # flush_interval_s=0: like the single-store soak, the harness owns
+    # every flush point, so WAL suffix loss (and therefore follower lag
+    # at the kill instant) is a pure function of the seed.
+    plane = ShardedControlPlane(
+        n_shards=shards, replicas=1, data_dir=data_dir,
+        clock=clock, flush_interval_s=0,
+    )
+    injectors = [
+        FaultInjector(s.store, _plan_for(s.index)) for s in plane.shards
+    ]
+    # Two router views: the RAW router (invariant evidence, environment
+    # reads) and the FAULTY router (harness-driven writes).
+    raw_router = plane.router
+    faulty_router = ShardRouter(injectors)
+
+    forbid = {
+        f"chaos-{i}" for i in range(n_crons)
+        if POLICIES[i % len(POLICIES)] == "Forbid"
+    }
+    watchlog = WatchLog(forbid)
+    for s in plane.shards:
+        s.store.add_watcher(watchlog)
+
+    for i in range(n_crons):
+        raw_router.create(_cron(i))
+    for s in plane.shards:
+        s.persistence.flush()  # Cron specs durable before any kill
+
+    def _new_manager(si: int, recovering: bool):
+        m = Manager(
+            injectors[si],
+            max_concurrent_reconciles=workers,
+            leader_elect=True,
+            identity=f"chaos-soak-shard-{si}",
+            lease_duration_s=1.0,
+            recovering=recovering,
+        )
+        r = CronReconciler(injectors[si], metrics=m.metrics)
+        m.add_controller(
+            "cron", r.reconcile, for_gvk=GVK_CRON,
+            owns=default_scheme().workload_kinds(),
+        )
+        return m, r
+
+    managers = []
+    recs = []
+    for si in range(shards):
+        m, r = _new_manager(si, recovering=False)
+        managers.append(m)
+        recs.append(r)
+
+    preempted: set = set()
+    lost_flips = 0
+    quiesce_timeouts = 0
+    leadership_lost_seen = False
+    readyz_degraded_seen = False
+    kills: list = []
+    failovers: list = []
+    metric_gens: list = []
+    fault_gens: list = []
+    noise_filter = _CrashNoiseFilter()
+    if chaotic:
+        for h in logging.getLogger().handlers or [logging.lastResort]:
+            h.addFilter(noise_filter)
+
+    def _collect_metrics(m) -> dict:
+        g = m.metrics.get
+        return {
+            "reconciles_ok": g(
+                'controller_runtime_reconcile_total{controller="cron",'
+                'result="success"}'
+            ),
+            "reconcile_errors": g(
+                'controller_runtime_reconcile_errors_total'
+                '{controller="cron"}'
+            ),
+            "ticks_fired": g("cron_ticks_fired_total"),
+            "ticks_skipped": g(
+                'cron_ticks_skipped_total{policy="Forbid"}'
+            ),
+            "ticks_skipped_deadline": g(
+                'cron_ticks_skipped_total{policy="StartingDeadline"}'
+            ),
+            "missed_runs": g("cron_missed_runs_total"),
+            "watch_resyncs": g("watch_resyncs_total"),
+            "submit_retries": g("cron_submit_retries_total"),
+        }
+
+    def _birth_round(name: str) -> int:
+        try:
+            epoch = int(name.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return 0
+        return max(0, (epoch - start_epoch) // 60 - 2)
+
+    def _dur(name: str) -> int:
+        return int(seeded_fraction(seed, "dur", name) * 3)
+
+    def _terminal_for(name: str) -> str:
+        return (
+            "Succeeded"
+            if seeded_fraction(seed, "term", name) < 0.8 else "Failed"
+        )
+
+    def _any_dead() -> bool:
+        return any(
+            s.persistence is not None and s.persistence.dead
+            for s in plane.shards
+        )
+
+    def _flip(name: str, cond_type: str, reason: str) -> None:
+        nonlocal lost_flips
+
+        def _apply() -> None:
+            obj = faulty_router.try_get(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, name
+            )
+            if obj is None:
+                return
+            status = dict(obj.get("status") or {})
+            conds = list(status.get("conditions") or [])
+            now = rfc3339(clock.now())
+            conds.append({
+                "type": cond_type, "status": "True", "reason": reason,
+                "lastUpdateTime": now, "lastTransitionTime": now,
+            })
+            status["conditions"] = conds
+            status["completionTime"] = now
+            faulty_router.patch_status(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, name,
+                status,
+            )
+
+        try:
+            with_conflict_retry(_apply)
+        except (ConflictError, ServerTimeoutError):
+            lost_flips += 1
+        except SimulatedCrash:
+            pass
+        except NotFoundError:
+            pass
+
+    def _environment_step(r: int) -> None:
+        workloads = raw_router.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+        )
+        running = []
+        for w in workloads:
+            name = (w.get("metadata") or {}).get("name", "")
+            if not _is_terminal(w):
+                running.append(name)
+        storm = "preempt_storm" in by_round.get(r, ())
+        for name in sorted(running):
+            if _any_dead():
+                return  # crashed mid-step; the failover redo finishes it
+            age = r - _birth_round(name)
+            if (
+                storm
+                and age < _dur(name)
+                and seeded_fraction(seed, "preempt", r, name)
+                < storm_plan.preempt_frac
+            ):
+                preempted.add(name)
+                _flip(name, "Failed", "TPUSlicePreempted")
+            elif name not in preempted and age >= _dur(name):
+                flip_to = _terminal_for(name)
+                _flip(name, flip_to,
+                      "JobSucceeded" if flip_to == "Succeeded"
+                      else "JobFailed")
+
+    def _quiesce_all() -> str:
+        out = "idle"
+        for si, m in enumerate(managers):
+            s = plane.shards[si]
+            q = _quiesce(m, s.store, quiesce_timeout_s, s.persistence)
+            if q == "dead":
+                return "dead"
+            if q == "timeout":
+                out = "timeout"
+        return out
+
+    def _failover(r: int, si: int) -> None:
+        """A shard leader died: bury its manager generation and promote
+        the WAL-shipping follower. Zero fake time passes, exactly like
+        the single-store restart — recovery catch-up re-fires the
+        crashed round's ticks under the same deterministic names."""
+        nonlocal quiesce_timeouts
+        shard = plane.shards[si]
+        # Settle the SURVIVING shards first: the watchlog generation
+        # rebase below snapshots the router-wide workload list, so no
+        # live shard may be mid-write while it happens.
+        for osi, om in enumerate(managers):
+            if osi == si:
+                continue
+            s = plane.shards[osi]
+            if _quiesce(om, s.store, quiesce_timeout_s,
+                        s.persistence) == "timeout":
+                quiesce_timeouts += 1
+        managers[si].stop()
+        metric_gens.append(_collect_metrics(managers[si]))
+        fault_gens.append(
+            (injectors[si].fault_counts(), injectors[si].dropped_events())
+        )
+        shard.store.close()  # drain the dispatcher into the watchlog
+        kill_info = (
+            dict(shard.persistence.kill_switch.describe())
+            if shard.persistence.kill_switch else
+            {"round": r, "point": "end_of_round", "fired": True}
+        )
+        if not kill_info.get("fired"):
+            kill_info["point"] = "end_of_round"
+        # Promote: I6 (follower == independent WAL replay) is checked
+        # inside, before the promoted store rewrites the snapshot.
+        report = plane.promote_follower(si)
+        # The follower fired replication watch events into its own
+        # dispatcher while it was a standby; drain any still-queued
+        # delivery BEFORE the watchlog attaches, or a late ADDED for a
+        # name the generation rebase already counted as survived would
+        # be misread as a double fire.
+        shard.store.flush(2.0)
+        injectors[si] = FaultInjector(shard.store, _plan_for(si))
+        faulty_router.replace(si, injectors[si])
+        kills.append({
+            **kill_info,
+            "shard": si,
+            "promoted_objects": report["objects"],
+            "promoted_rv": report["rv"],
+            "follower_records_applied": report["follower_records_applied"],
+            "i6_recovery_equals_replay": report["i6_ok"],
+        })
+        failovers.append(si)
+        watchlog.begin_generation(
+            raw_router.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                            namespace=NAMESPACE),
+            wal_deleted_names=[
+                k[3] for k in report["wal_deleted_keys"]
+                if k[1] == WORKLOAD_KIND
+            ],
+        )
+        shard.store.add_watcher(watchlog)
+        for i in range(n_crons):
+            # Durable recovery already holds this shard's Crons; the
+            # re-apply is a no-op AlreadyExists (same as a --load boot).
+            if shard_index(NAMESPACE, f"chaos-{i}", shards) != si:
+                continue
+            try:
+                shard.store.create(_cron(i))
+            except AlreadyExistsError:
+                pass
+        managers[si], recs[si] = _new_manager(si, recovering=True)
+        managers[si].start()
+        if _quiesce_all() != "idle":
+            quiesce_timeouts += 1
+        _environment_step(r)
+        for m in managers:
+            m.resync()
+        if _quiesce_all() != "idle":
+            quiesce_timeouts += 1
+
+    t0 = time.monotonic()
+    try:
+        for m in managers:
+            m.start()
+        if _quiesce_all() != "idle":
+            quiesce_timeouts += 1
+
+        for r in range(rounds):
+            faults_now = by_round.get(r, set()) if chaotic else set()
+            kill_round = chaotic and "kill" in faults_now
+            victim = None
+            if kill_round:
+                victim = int(seeded_fraction(seed, "shardkill", r) * shards)
+                plane.shards[victim].persistence.kill_switch = KillSwitch(
+                    seed, r, max_appends=KILL_MAX_APPENDS
+                )
+            clock.advance(timedelta(seconds=60))
+            if "watch_break" in faults_now:
+                for inj in injectors:
+                    inj.break_watches()
+            if "leader_revoke" in faults_now:
+                # Revoke ONE PRF-chosen shard's lease: per-shard leases
+                # must fail independently, not in lockstep.
+                rsi = int(seeded_fraction(seed, "shardlease", r) * shards)
+                injectors[rsi].revoke_leader()
+                deadline = time.monotonic() + 3.0
+                while time.monotonic() < deadline:
+                    if not managers[rsi]._is_leader.is_set():
+                        leadership_lost_seen = True
+                        break
+                    time.sleep(0.02)
+                injectors[rsi].expire_leader_lease()
+            for m in managers:
+                m.resync()
+            if "watch_break" in faults_now and not all(
+                m.readyz() for m in managers
+            ):
+                readyz_degraded_seen = True
+            q = _quiesce_all()
+            if q == "timeout":
+                quiesce_timeouts += 1
+            if q != "dead":
+                _environment_step(r)
+                if "watch_break" in faults_now:
+                    for inj in injectors:
+                        inj.repair_watches()
+                q = _quiesce_all()
+                if q == "timeout":
+                    quiesce_timeouts += 1
+            if kill_round:
+                vpers = plane.shards[victim].persistence
+                if not vpers.dead:
+                    vpers.kill(f"end_of_round/{r}")
+                _failover(r, victim)
+            for s in plane.shards:
+                if s.persistence is not None and not s.persistence.dead:
+                    s.persistence.flush()
+
+        # ---- faults stop: convergence phase ------------------------------
+        for inj in injectors:
+            inj.disarm()
+            inj.repair_watches()
+        for m in managers:
+            m.resync()
+        if _quiesce_all() != "idle":
+            quiesce_timeouts += 1
+
+        surface = _surface(raw_router, watchlog)
+        for si, m in enumerate(managers):
+            metric_gens.append(_collect_metrics(m))
+            fault_gens.append(
+                (injectors[si].fault_counts(), injectors[si].dropped_events())
+            )
+        metrics = {
+            k: sum(g[k] for g in metric_gens) for k in metric_gens[0]
+        }
+        faults_injected: dict = {}
+        dropped_events = 0
+        for counts, dropped in fault_gens:
+            for k, v in counts.items():
+                faults_injected[k] = faults_injected.get(k, 0) + v
+            dropped_events += dropped
+    finally:
+        for m in managers:
+            m.stop()
+        if chaotic:
+            for h in logging.getLogger().handlers or [logging.lastResort]:
+                h.removeFilter(noise_filter)
+
+    # ---- I4: converged state needs zero further writes -------------------
+    rv_before = int(getattr(raw_router, "_rv"))
+    for i in range(n_crons):
+        name = f"chaos-{i}"
+        recs[shard_index(NAMESPACE, name, shards)].reconcile(NAMESPACE, name)
+    final_sweep_writes = int(getattr(raw_router, "_rv")) - rv_before
+
+    # ---- I7b: nothing permanently lost across failovers ------------------
+    final_names = {
+        (w.get("metadata") or {}).get("name", "")
+        for w in raw_router.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+        )
+    }
+    wal_stats = [
+        s.persistence.stats() for s in plane.shards
+        if s.persistence is not None
+    ]
+    plane.close()
+    shutil.rmtree(data_dir, ignore_errors=True)
+    permanently_lost = sorted(
+        n for n in watchlog.ever_created
+        if n not in watchlog.deleted and n not in final_names
+    )
+
+    return {
+        "seed": seed,
+        "shards": shards,
+        "chaotic": chaotic,
+        "unhardened": False,
+        "crash": True,
+        "durability": True,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "fault_schedule": schedule,
+        "fault_trace_hash": storm_plan.trace_hash(rounds),
+        "faults_injected": faults_injected,
+        "dropped_watch_events": dropped_events,
+        "lost_flips": lost_flips,
+        "quiesce_timeouts": quiesce_timeouts,
+        "readyz_degraded_seen": readyz_degraded_seen,
+        "leadership_lost_seen": leadership_lost_seen,
+        "kills": kills,
+        "failovers": failovers,
+        "generations": watchlog.generation + 1,
+        "orphans": list(watchlog.orphans),
+        "refires": list(watchlog.refires),
+        "resurrections": list(watchlog.resurrections),
+        "phantom_deletes": list(watchlog.phantom_deletes),
+        "dup_violations": list(watchlog.dup_violations),
+        "permanently_lost": permanently_lost,
+        "wal": wal_stats,
+        "metrics": metrics,
+        "surface": surface,
+        "created_count": watchlog.created_count,
+        "forbid_violations": list(watchlog.violations),
+        "final_sweep_writes": final_sweep_writes,
+    }
+
+
 def _surface(store, watchlog) -> dict:
     """Semantic end state, shorn of run-varying identifiers (uids,
     resourceVersions, timestamps): the I5 comparison surface. Fired-tick
@@ -926,6 +1400,12 @@ def main(argv=None) -> int:
                     help="exit 0 iff at least one invariant is violated "
                          "(with --no-durability: I7 specifically) — for "
                          "asserting the violation demonstrations")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="soak a SHARDED control plane (runtime/shard.py) "
+                         "with N shards, each with a WAL-shipping hot "
+                         "standby: kill rounds promote the victim shard's "
+                         "follower instead of replaying from disk (I6 is "
+                         "checked per shard at promotion time)")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
 
@@ -943,6 +1423,87 @@ def main(argv=None) -> int:
         plan_a.schedule(args.rounds) == plan_b.schedule(args.rounds)
         and plan_a.trace_hash(args.rounds) == plan_b.trace_hash(args.rounds)
     )
+
+    if args.shards > 0:
+        if (args.unhardened or args.no_crash or args.no_durability
+                or args.data_dir):
+            print("ERROR: --shards is incompatible with --unhardened/"
+                  "--no-crash/--no-durability/--data-dir (the sharded "
+                  "soak is always hardened, crashy, and durable: WAL "
+                  "bytes are the follower-shipping medium)")
+            return 2
+        print(
+            f"chaos soak (sharded): seed={args.seed} crons={args.crons} "
+            f"rounds={args.rounds} shards={args.shards} replicas=1",
+            flush=True,
+        )
+        chaotic = run_sharded_soak(
+            args.seed, args.crons, args.rounds, args.shards,
+            workers=args.workers, chaotic=True,
+            quiesce_timeout_s=args.quiesce_timeout,
+        )
+        print(
+            f"  chaotic run: {chaotic['elapsed_s']}s "
+            f"faults={chaotic['faults_injected']} "
+            f"dropped_events={chaotic['dropped_watch_events']} "
+            f"failovers={chaotic['failovers']} "
+            f"kills={[k['point'] for k in chaotic['kills']]}",
+            flush=True,
+        )
+        replay = run_sharded_soak(
+            args.seed, args.crons, args.rounds, args.shards,
+            workers=args.workers, chaotic=False,
+            quiesce_timeout_s=args.quiesce_timeout,
+        )
+        print(f"  replay run: {replay['elapsed_s']}s", flush=True)
+
+        invariants = check_invariants(chaotic, replay, HISTORY_LIMIT)
+        ok = all(v["ok"] for v in invariants.values()) and deterministic
+        report = {
+            "seed": args.seed,
+            "n_crons": args.crons,
+            "rounds": args.rounds,
+            "workers": args.workers,
+            "shards": args.shards,
+            "replicas": 1,
+            "crash": True,
+            "durability": True,
+            "deterministic_schedule": deterministic,
+            "fault_trace_hash": chaotic["fault_trace_hash"],
+            "fault_schedule": chaotic["fault_schedule"],
+            "faults_injected": chaotic["faults_injected"],
+            "dropped_watch_events": chaotic["dropped_watch_events"],
+            "lost_flips": chaotic["lost_flips"],
+            "quiesce_timeouts": chaotic["quiesce_timeouts"],
+            "readyz_degraded_seen": chaotic["readyz_degraded_seen"],
+            "leadership_lost_seen": chaotic["leadership_lost_seen"],
+            "kills": chaotic["kills"],
+            "failovers": chaotic["failovers"],
+            "generations": chaotic["generations"],
+            "refires": chaotic["refires"],
+            "orphans": chaotic["orphans"],
+            "resurrections": chaotic["resurrections"],
+            "phantom_deletes": chaotic.get("phantom_deletes", []),
+            "wal": chaotic["wal"],
+            "metrics": chaotic["metrics"],
+            "elapsed_s": {
+                "chaotic": chaotic["elapsed_s"],
+                "replay": replay["elapsed_s"],
+            },
+            "invariants": invariants,
+            "ok": ok,
+        }
+        if not invariants["I5_matches_fault_free_replay"]["ok"]:
+            report["surface_chaotic"] = chaotic["surface"]
+            report["surface_replay"] = replay["surface"]
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+        for name, v in invariants.items():
+            mark = "PASS" if v["ok"] else "FAIL"
+            print(f"  [{mark}] {name}: {v['detail']}")
+        print(f"wrote {args.out} (ok={ok})")
+        return 0 if ok else 1
 
     print(
         f"chaos soak: seed={args.seed} crons={args.crons} "

@@ -70,6 +70,26 @@ echo "==> chaos smoke (fixed-seed fault injection + crash-restart, 7 invariants)
 # make chaos-soak (writes CHAOS.json).
 python hack/chaos_soak.py --seed 7 --crons 40 --rounds 3 --out /dev/null
 
+echo "==> sharded control-plane smoke (per-shard + aggregate scale-out verdicts)"
+# Small-N run of the sharded bench sweep (runtime/shard.py): measures the
+# steady-state list+reconcile sweep at 1 and 2 shards, printing one
+# OK/REGRESSION verdict per shard (zero steady-state store writes on
+# every shard) plus the aggregate scale-up verdict; --check fails the
+# gate on any REGRESSION. Full sweep: make bench-shards (updates
+# BENCH_CONTROLPLANE.json).
+python hack/controlplane_bench.py --shards-sweep --shards-total 2000 \
+    --shard-counts 1,2 --shards-min-scaleup 1.5 --stdout --check \
+    >/dev/null
+
+echo "==> shard-kill failover smoke (2 shards, WAL-shipping hot standby)"
+# Fixed-seed sharded soak: the seed guarantees kill rounds, so every run
+# exercises at least one leader kill + follower promotion. I6 is checked
+# per shard at promotion time (follower state must equal an independent
+# replay of the shipped WAL); all seven invariants must hold across the
+# failovers.
+python hack/chaos_soak.py --seed 11 --crons 24 --rounds 3 --shards 2 \
+    --out /dev/null
+
 echo "==> durability counter-proof (same kills, no durability -> I7 must break)"
 # The same fixed-seed kill schedule restarted from an EMPTY data dir
 # must lose in-window ticks (permanently_lost non-empty): proves the

@@ -449,6 +449,139 @@ def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
     }
 
 
+def _sharded_leg(total: int, n_shards: int) -> dict:
+    """One sharded steady-state leg: ``total`` Crons hash-partitioned
+    over ``n_shards`` shards (runtime/shard.py), each shard running its
+    own reconciler directly against its own store.
+
+    Shards are measured SEQUENTIALLY and the aggregate is their sum:
+    this host is single-CPU, and shards share nothing (no lock, no
+    store, no WAL), so the sum is the shared-nothing scale-out
+    projection — per-shard throughput is the honest primitive, and a
+    deployment with one core per shard achieves the aggregate. The
+    output says so explicitly (``aggregate_is``).
+    """
+    import gc
+
+    from cron_operator_tpu.controller import CronReconciler
+    from cron_operator_tpu.runtime import APIServer
+    from cron_operator_tpu.runtime.shard import ShardRouter, shard_index
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    stores = [APIServer(clock=clock) for _ in range(n_shards)]
+    router = ShardRouter(stores)
+
+    t0 = time.perf_counter()
+    for i in range(total):
+        router.create(_cron(i))
+    populate_s = time.perf_counter() - t0
+
+    # Router fan-in list: the cross-shard read a dashboard/facade makes.
+    router_list_us = _time_calls(
+        lambda: router.list(CRON_API_VERSION, "Cron", namespace="default"),
+        max(3, min(20, 20000 // total)),
+    )
+
+    names_by_shard: list = [[] for _ in range(n_shards)]
+    for i in range(total):
+        name = f"bench-{i}"
+        names_by_shard[shard_index("default", name, n_shards)].append(name)
+
+    shards_out = []
+    aggregate_per_s = 0.0
+    all_zero_writes = True
+    for si, store in enumerate(stores):
+        rec = CronReconciler(store)
+        names = names_by_shard[si]
+        # Warm-up pass: first-touch status syncs and schedule-cache fill
+        # are allowed to write; the TIMED pass below is steady state.
+        for name in names:
+            rec.reconcile("default", name)
+        gc.collect()
+        gc.disable()
+        try:
+            rv_before = getattr(store, "_rv", None)
+            t0 = time.perf_counter()
+            for name in names:
+                rec.reconcile("default", name)
+            sweep_s = time.perf_counter() - t0
+            rv_after = getattr(store, "_rv", None)
+        finally:
+            gc.enable()
+        writes = (
+            rv_after - rv_before
+            if rv_before is not None and rv_after is not None else None
+        )
+        per_s = round(len(names) / sweep_s, 1) if sweep_s else 0.0
+        ok = writes == 0
+        all_zero_writes = all_zero_writes and ok
+        shards_out.append({
+            "shard": si,
+            "crons": len(names),
+            "list_reconcile_sweep_per_s": per_s,
+            "store_writes": writes,
+            "verdict": "OK" if ok else "REGRESSION",
+        })
+        aggregate_per_s += per_s
+    for store in stores:
+        store.close()
+
+    return {
+        "n_shards": n_shards,
+        "total_crons": total,
+        "populate_objects_per_s": round(total / populate_s, 1),
+        "router_cron_list_us": round(router_list_us, 1),
+        "shards": shards_out,
+        "aggregate_list_reconcile_sweep_per_s": round(aggregate_per_s, 1),
+        "all_shards_zero_writes": all_zero_writes,
+        "aggregate_is": (
+            "sum of per-shard throughputs measured sequentially on one "
+            "core; shards share nothing, so a one-core-per-shard "
+            "deployment achieves this aggregate"
+        ),
+    }
+
+
+def run_sharded_suite(total: int, shard_counts, min_scaleup: float) -> dict:
+    """The sharded scale-out sweep (``make bench-shards``): the same
+    100k-Cron steady-state workload at each shard count, with per-shard
+    and aggregate OK/REGRESSION verdicts. The aggregate verdict needs
+    the largest shard count to reach ``min_scaleup``× the smallest's
+    aggregate throughput AND zero steady-state writes on every shard."""
+    legs = [_sharded_leg(total, n) for n in shard_counts]
+    base = min(legs, key=lambda leg: leg["n_shards"])
+    peak = max(legs, key=lambda leg: leg["n_shards"])
+    scaleup = None
+    if base["aggregate_list_reconcile_sweep_per_s"]:
+        scaleup = round(
+            peak["aggregate_list_reconcile_sweep_per_s"]
+            / base["aggregate_list_reconcile_sweep_per_s"], 2,
+        )
+    zero = all(leg["all_shards_zero_writes"] for leg in legs)
+    ok = scaleup is not None and scaleup >= min_scaleup and zero
+    verdict = {
+        "status": "OK" if ok else "REGRESSION",
+        "scaleup": scaleup,
+        "required_scaleup": min_scaleup,
+        "all_shards_zero_writes": zero,
+        "summary": (
+            f"{'OK' if ok else 'REGRESSION'}: aggregate sweep at "
+            f"{peak['n_shards']} shards is {scaleup}x the "
+            f"{base['n_shards']}-shard aggregate (need >= {min_scaleup}x); "
+            f"steady-state store writes "
+            f"{'zero on every shard' if zero else 'NONZERO on some shard'}"
+        ),
+    }
+    return {
+        "schema": "controlplane-bench-sharded/v1",
+        "git_ref": _git_ref(_TREE),
+        "total_crons": total,
+        "legs": legs,
+        "verdict": verdict,
+    }
+
+
 def _git_ref(tree: str) -> str:
     try:
         ref = subprocess.run(
@@ -574,12 +707,60 @@ def main() -> int:
     p.add_argument("--stdout", action="store_true",
                    help="print the artifact JSON to stdout only")
     p.add_argument("--check", action="store_true",
-                   help="with --baseline-ref: exit non-zero when any "
-                        "headline metric regressed")
+                   help="with --baseline-ref (or --shards-sweep): exit "
+                        "non-zero when the verdict is REGRESSION")
+    p.add_argument("--shards-sweep", action="store_true",
+                   help="run the sharded scale-out sweep instead of the "
+                        "single-store suite; merges a 'sharded' section "
+                        "into --out (make bench-shards)")
+    p.add_argument("--shards-total", type=int, default=100000,
+                   help="total Crons for the sharded sweep")
+    p.add_argument("--shard-counts", default="1,4",
+                   help="comma-separated shard counts for the sharded "
+                        "sweep")
+    p.add_argument("--shards-min-scaleup", type=float, default=3.0,
+                   help="required aggregate speedup of the largest shard "
+                        "count over the smallest")
     args = p.parse_args()
-    if args.check and not args.baseline_ref:
-        p.error("--check requires --baseline-ref")
+    if args.check and not (args.baseline_ref or args.shards_sweep):
+        p.error("--check requires --baseline-ref or --shards-sweep")
     sizes = [int(s) for s in args.sizes.split(",") if s]
+
+    if args.shards_sweep:
+        counts = [int(s) for s in args.shard_counts.split(",") if s]
+        sharded = run_sharded_suite(
+            args.shards_total, counts, args.shards_min_scaleup
+        )
+        for leg in sharded["legs"]:
+            for s in leg["shards"]:
+                print(
+                    f"shard {s['shard']}/{leg['n_shards']}: "
+                    f"{s['list_reconcile_sweep_per_s']} crons/s, "
+                    f"store_writes={s['store_writes']} [{s['verdict']}]",
+                    file=sys.stderr,
+                )
+            print(
+                f"aggregate@{leg['n_shards']} shards: "
+                f"{leg['aggregate_list_reconcile_sweep_per_s']} crons/s",
+                file=sys.stderr,
+            )
+        print(sharded["verdict"]["summary"], file=sys.stderr)
+        if args.stdout:
+            print(json.dumps(sharded))
+        else:
+            # Merge into the existing artifact (the single-store suite's
+            # numbers stay authoritative for their sections).
+            merged = {}
+            if os.path.exists(args.out):
+                with open(args.out) as f:
+                    merged = json.load(f)
+            merged["sharded"] = sharded
+            with open(args.out, "w") as f:
+                f.write(json.dumps(merged, indent=2, sort_keys=True) + "\n")
+            print(f"wrote {args.out} (sharded section)", file=sys.stderr)
+        if args.check and sharded["verdict"]["status"] != "OK":
+            return 2
+        return 0
 
     after = run_suite(sizes, args.sweep_timeout)
     artifact = after

@@ -473,6 +473,34 @@ class TestClientFlowControl:
         assert throttled >= 0.15, f"not throttled: {throttled:.3f}s"
         assert throttled > burst_elapsed + 0.05, (burst_elapsed, throttled)
 
+    def test_token_bucket_sleeps_outside_the_lock(self):
+        # Regression: acquire() used to hold the bucket lock across its
+        # sleep, serializing N waiting threads into N full sleeps. With
+        # reservation-style debt the waits overlap: two threads draining
+        # an empty qps=4 bucket reserve slots at +0.25s and +0.5s and
+        # sleep CONCURRENTLY, so wall time is ~0.5s — not the ~0.75s+ a
+        # lock-held sleep would force (0.25 then 0.5 back to back).
+        import threading
+        import time
+
+        from cron_operator_tpu.runtime.cluster import TokenBucket
+
+        tb = TokenBucket(qps=4, burst=1)
+        tb.acquire()  # drain the single burst token
+
+        start = time.monotonic()
+        threads = [threading.Thread(target=tb.acquire) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        elapsed = time.monotonic() - start
+        assert not any(t.is_alive() for t in threads)
+        # Both reservations honored (real throttling)...
+        assert elapsed >= 0.45, f"not throttled: {elapsed:.3f}s"
+        # ...but overlapped, not serialized behind the lock.
+        assert elapsed < 0.70, f"sleeps serialized: {elapsed:.3f}s"
+
     def test_requests_are_limited_end_to_end(self):
         import time
 

@@ -225,6 +225,32 @@ class TestConcurrencyPolicies:
         assert len(jobs) == 1
         assert jobs[0]["metadata"]["name"] != first
 
+    def test_replace_keeps_same_ticks_surviving_workload(
+        self, api, fake_clock, reconciler
+    ):
+        """Fail-over guard: when a re-fired tick's own workload survived a
+        crash (its lastScheduleTime update was lost), Replace must NOT
+        delete-and-relaunch it — the deterministic name exists so the
+        re-run collides on AlreadyExists instead of double-launching."""
+        make_cron(api, policy="Replace")
+        first = self._fire_once(api, fake_clock, reconciler)
+        (job,) = list_jobs(api)
+        uid = job["metadata"]["uid"]
+        # Crash-recovered shape: the workload is durable but the status
+        # update advancing lastScheduleTime was in the lost WAL suffix.
+        cron = get_cron(api)
+        status = dict(cron.get("status") or {})
+        status.pop("lastScheduleTime", None)
+        api.patch_status(
+            cron["apiVersion"], cron["kind"], "default", "demo", status
+        )
+        reconciler.reconcile("default", "demo")  # re-fires the same tick
+        (job,) = list_jobs(api)
+        assert job["metadata"]["name"] == first
+        assert job["metadata"]["uid"] == uid, (
+            "Replace deleted and re-created this tick's own workload"
+        )
+
 
 class TestTPUAdmissionOnControllerPath:
     """The controller-side admission seam (VERDICT r2 #1): workloads the

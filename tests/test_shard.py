@@ -1,0 +1,355 @@
+"""Sharded control plane (runtime/shard.py): partition function pins,
+router surface parity, WAL-shipping followers, and failover promotion."""
+
+import json
+import threading
+
+import pytest
+
+from cron_operator_tpu.runtime.kube import APIServer, NotFoundError
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.runtime.persistence import Persistence
+from cron_operator_tpu.runtime.shard import (
+    FollowerReplica,
+    ShardedControlPlane,
+    ShardMetrics,
+    ShardRouter,
+    canonical_state,
+    shard_dir,
+    shard_index,
+)
+from cron_operator_tpu.utils.clock import FakeClock
+
+
+def _cron(name, ns="default", spec=None):
+    return {
+        "apiVersion": "cron.tpu.example.com/v1alpha1",
+        "kind": "TpuCronJob",
+        "metadata": {"namespace": ns, "name": name},
+        "spec": spec or {"schedule": "* * * * *"},
+    }
+
+
+CRON_GVK = ("cron.tpu.example.com/v1alpha1", "TpuCronJob")
+
+
+class TestShardIndexPinned:
+    """The partition hash is an ON-DISK FORMAT: shard WAL directories are
+    named by index, so a hash change orphans every existing data dir.
+    These vectors must never change; if this test fails, revert the hash
+    — do not re-pin."""
+
+    PAIRS = [
+        ("default", "nightly-backup"),
+        ("default", "bench-0"),
+        ("default", "bench-1"),
+        ("prod", "etl-hourly"),
+        ("prod", "etl-hourly-28916560-abc12"),
+        ("kube-system", "sweep"),
+        ("team-a", "train-7b"),
+        ("team-a", "train-7b-retry"),
+        ("", ""),
+        ("ns", "x" * 63),
+    ]
+    VECTORS = {
+        1: [0, 0, 0, 0, 0, 0, 0, 0, 0, 0],
+        4: [0, 0, 2, 0, 0, 1, 3, 2, 0, 3],
+        16: [12, 4, 2, 4, 8, 13, 3, 2, 12, 11],
+    }
+
+    @pytest.mark.parametrize("n", [1, 4, 16])
+    def test_pinned_vectors(self, n):
+        got = [shard_index(ns, name, n) for ns, name in self.PAIRS]
+        assert got == self.VECTORS[n]
+
+    def test_range_and_determinism(self):
+        for i in range(200):
+            a = shard_index("default", f"obj-{i}", 4)
+            assert 0 <= a < 4
+            assert a == shard_index("default", f"obj-{i}", 4)
+
+    def test_namespace_is_part_of_the_key(self):
+        # "a/bc" vs "ab/c" must not collide via naive concatenation.
+        hits = sum(
+            shard_index("a", f"bc{i}", 16) == shard_index("ab", f"c{i}", 16)
+            for i in range(64)
+        )
+        assert hits < 64
+
+
+class TestShardRouter:
+    def _plane(self, n=4):
+        clock = FakeClock()
+        stores = [APIServer(clock) for _ in range(n)]
+        return ShardRouter(stores), stores
+
+    def test_create_routes_to_hash_home(self):
+        router, stores = self._plane(4)
+        for i in range(40):
+            router.create(_cron(f"c-{i}"))
+        for i in range(40):
+            home = stores[shard_index("default", f"c-{i}", 4)]
+            assert home.get_frozen(*CRON_GVK, "default", f"c-{i}") is not None
+        # distributed, not piled on one shard
+        sizes = [len(s) for s in stores]
+        assert sum(sizes) == 40 and max(sizes) < 40
+
+    def test_list_fans_in_and_rv_sums(self):
+        router, stores = self._plane(4)
+        for i in range(20):
+            router.create(_cron(f"c-{i}"))
+        objs, rv = router.list_with_rv(*CRON_GVK)
+        assert len(objs) == 20
+        assert int(rv) == sum(int(getattr(s, "_rv")) for s in stores)
+        assert router._rv == int(rv)
+
+    def test_rv_bracketing_detects_zero_writes(self):
+        router, _ = self._plane(4)
+        for i in range(10):
+            router.create(_cron(f"c-{i}"))
+        before = router._rv
+        router.list(*CRON_GVK)
+        for i in range(10):
+            router.get_frozen(*CRON_GVK, "default", f"c-{i}")
+        assert router._rv == before
+        router.patch_status(*CRON_GVK, "default", "c-0", {"phase": "Active"})
+        assert router._rv == before + 1
+        # no-op elision must hold through the router too
+        router.patch_status(*CRON_GVK, "default", "c-0", {"phase": "Active"})
+        assert router._rv == before + 1
+
+    def test_probe_fallback_finds_off_home_children(self):
+        # A reconciler creates children directly on its OWN shard store —
+        # the child's hash home is usually a different shard. The router
+        # must still find it.
+        router, stores = self._plane(4)
+        owner_shard = stores[1]
+        child = _cron("child-lives-with-owner", spec={"x": 1})
+        assert shard_index("default", "child-lives-with-owner", 4) != 1
+        owner_shard.create(child)
+        got = router.get(*CRON_GVK, "default", "child-lives-with-owner")
+        assert got["spec"] == {"x": 1}
+        router.patch_status(
+            *CRON_GVK, "default", "child-lives-with-owner", {"ok": True}
+        )
+        assert owner_shard.get_frozen(
+            *CRON_GVK, "default", "child-lives-with-owner"
+        )["status"] == {"ok": True}
+        router.delete(*CRON_GVK, "default", "child-lives-with-owner")
+        assert router.try_get(
+            *CRON_GVK, "default", "child-lives-with-owner"
+        ) is None
+
+    def test_missing_object_raises_not_found(self):
+        router, _ = self._plane(4)
+        with pytest.raises(NotFoundError):
+            router.get(*CRON_GVK, "default", "ghost")
+        assert router.try_get(*CRON_GVK, "default", "ghost") is None
+
+    def test_watch_fans_out_from_every_shard(self):
+        router, _ = self._plane(4)
+        seen = []
+        lock = threading.Lock()
+
+        def watcher(ev):
+            with lock:
+                seen.append((ev.type, ev.object["metadata"]["name"]))
+
+        router.add_watcher(watcher, coalesce=True)
+        for i in range(12):
+            router.create(_cron(f"w-{i}"))
+        assert router.flush(timeout=5.0)
+        with lock:
+            assert sorted(n for t, n in seen if t == "ADDED") == sorted(
+                f"w-{i}" for i in range(12)
+            )
+
+    def test_len_events_all_objects_aggregate(self):
+        router, _ = self._plane(2)
+        obj = router.create(_cron("ev-target"))
+        router.record_event(obj, "Normal", "Fired", "hello")
+        assert len(router) >= 1
+        assert any(e.reason == "Fired" for e in router.events())
+        names = {
+            o["metadata"]["name"]
+            for o in router.all_objects()
+            if o.get("kind") == "TpuCronJob"
+        }
+        assert "ev-target" in names
+        router.close()
+
+
+class TestShardMetrics:
+    def test_label_injection_bare_and_labeled(self):
+        m = Metrics()
+        sm = ShardMetrics(m, 3)
+        sm.inc("wal_records_total")
+        sm.inc('workqueue_adds_total{name="cron"}', 2.0)
+        sm.set('workqueue_depth{name="cron"}', 5.0)
+        sm.observe("reconcile_seconds", 0.5, buckets=(0.1, 1.0))
+        assert m.get('wal_records_total{shard="3"}') == 1.0
+        assert m.get('workqueue_adds_total{name="cron",shard="3"}') == 2.0
+        assert m.gauge('workqueue_depth{name="cron",shard="3"}') == 5.0
+        assert m.histogram('reconcile_seconds{shard="3"}') is not None
+        # the per-shard view reads back its own series
+        assert sm.get("wal_records_total") == 1.0
+        assert sm.gauge('workqueue_depth{name="cron"}') == 5.0
+
+    def test_two_shards_share_one_registry_without_collision(self):
+        m = Metrics()
+        a, b = ShardMetrics(m, 0), ShardMetrics(m, 1)
+        a.inc("apiserver_commits_total")
+        b.inc("apiserver_commits_total")
+        b.inc("apiserver_commits_total")
+        assert m.get('apiserver_commits_total{shard="0"}') == 1.0
+        assert m.get('apiserver_commits_total{shard="1"}') == 2.0
+
+    def test_registry_wide_calls_delegate(self):
+        m = Metrics()
+        sm = ShardMetrics(m, 0)
+        sm.inc("x_total")
+        assert "x_total" in sm.render_prometheus()
+        assert sm.snapshot() == m.snapshot()
+
+
+class TestFollowerReplication:
+    def test_follower_tracks_leader_through_wal_shipping(self, tmp_path):
+        clock = FakeClock()
+        api = APIServer(clock)
+        pers = Persistence(str(tmp_path), flush_interval_s=0)
+        pers.start(api)
+        follower = FollowerReplica(clock)
+        pers.attach_follower(follower)
+        for i in range(10):
+            api.create(_cron(f"f-{i}"))
+        api.patch_status(*CRON_GVK, "default", "f-0", {"phase": "Active"})
+        api.delete(*CRON_GVK, "default", "f-9")
+        pers.flush()
+        assert follower.lag_bytes == 0
+        assert len(follower.store) == len(api)
+        assert follower.store.get_frozen(
+            *CRON_GVK, "default", "f-0"
+        )["status"] == {"phase": "Active"}
+        assert follower.store.get_frozen(*CRON_GVK, "default", "f-9") is None
+        assert (CRON_GVK[0], CRON_GVK[1], "default", "f-9") in (
+            follower.deleted_keys
+        )
+        # I6, the exact promotion precondition: follower state equals an
+        # independent replay of the on-disk bytes.
+        replay = Persistence(str(tmp_path)).recover()
+        assert follower.state() == canonical_state(replay.objects, replay.rv)
+        pers.close()
+        api.close()
+        follower.store.close()
+
+    def test_partial_line_buffered_never_applied(self):
+        follower = FollowerReplica(FakeClock())
+        rec = json.dumps(
+            {"op": "put", "verb": "create", "rv": 1, "obj": _cron("torn")}
+        ).encode() + b"\n"
+        follower.apply_bytes(rec[: len(rec) // 2])
+        assert len(follower.store) == 0
+        assert follower.lag_bytes == len(rec) // 2
+        follower.apply_bytes(rec[len(rec) // 2:])
+        assert len(follower.store) == 1
+        assert follower.lag_bytes == 0
+        # a torn FINAL fragment (leader died mid-record) is never applied
+        follower.apply_bytes(b'{"op":"put","rv":2,"obj":{"apiVers')
+        assert len(follower.store) == 1
+
+    def test_replicated_rvs_match_leader(self, tmp_path):
+        api = APIServer(FakeClock())
+        pers = Persistence(str(tmp_path), flush_interval_s=0)
+        pers.start(api)
+        follower = FollowerReplica()
+        pers.attach_follower(follower)
+        api.create(_cron("rv-check"))
+        api.patch_status(*CRON_GVK, "default", "rv-check", {"n": 1})
+        pers.flush()
+        lead = api.get_frozen(*CRON_GVK, "default", "rv-check")
+        repl = follower.store.get_frozen(*CRON_GVK, "default", "rv-check")
+        assert (repl["metadata"]["resourceVersion"]
+                == lead["metadata"]["resourceVersion"])
+        assert getattr(follower.store, "_rv") == getattr(api, "_rv")
+        pers.close()
+        api.close()
+
+
+class TestShardedControlPlaneFailover:
+    def test_promote_follower_after_leader_kill(self, tmp_path):
+        plane = ShardedControlPlane(
+            n_shards=2, replicas=1, data_dir=str(tmp_path),
+            clock=FakeClock(), metrics=Metrics(), flush_interval_s=0,
+        )
+        try:
+            for i in range(30):
+                plane.router.create(_cron(f"p-{i}"))
+            for s in plane.shards:
+                s.persistence.flush()
+            victim = plane.shards[0]
+            n_before = len(victim.store)
+            victim.persistence.kill()
+            report = plane.promote_follower(0)
+            assert report["i6_ok"] is True
+            assert report["objects"] == n_before
+            assert victim.failovers == 1
+            # the promoted store serves the partition through the router
+            assert len(plane.router) == 30
+            plane.router.create(_cron("after-failover"))
+            assert len(plane.router) == 31
+            # promoted leader is durable again AND replicated again
+            assert victim.persistence is not None
+            assert not victim.persistence.dead
+            assert victim.follower is not None
+            victim.persistence.flush()
+            assert len(victim.follower.store) == len(victim.store)
+            assert plane.metrics.get(
+                'shard_failovers_total{shard="0"}'
+            ) == 1.0
+        finally:
+            plane.close()
+
+    def test_promoted_state_survives_restart(self, tmp_path):
+        clock = FakeClock()
+        plane = ShardedControlPlane(
+            n_shards=2, replicas=1, data_dir=str(tmp_path),
+            clock=clock, flush_interval_s=0,
+        )
+        for i in range(12):
+            plane.router.create(_cron(f"r-{i}"))
+        for s in plane.shards:
+            s.persistence.flush()
+        plane.shards[1].persistence.kill()
+        plane.promote_follower(1)
+        plane.router.create(_cron("written-after-promotion"))
+        state = canonical_state(
+            plane.router.all_objects(), plane.router._rv
+        )
+        plane.close()
+
+        reopened = ShardedControlPlane(
+            n_shards=2, data_dir=str(tmp_path),
+            clock=clock, flush_interval_s=0,
+        )
+        try:
+            assert reopened.recovered_any
+            assert canonical_state(
+                reopened.router.all_objects(), reopened.router._rv
+            ) == state
+        finally:
+            reopened.close()
+
+    def test_replicas_require_data_dir(self):
+        with pytest.raises(ValueError):
+            ShardedControlPlane(n_shards=2, replicas=1, data_dir=None)
+
+    def test_shard_dirs_are_per_index(self, tmp_path):
+        plane = ShardedControlPlane(
+            n_shards=3, data_dir=str(tmp_path), flush_interval_s=0
+        )
+        try:
+            for i in range(3):
+                assert plane.shards[i].data_dir == shard_dir(str(tmp_path), i)
+                assert plane.shards[i].data_dir.endswith(f"shard-{i}")
+        finally:
+            plane.close()

@@ -1,7 +1,9 @@
 """Workqueue specs: per-item exponential backoff (client-go
 ItemExponentialFailureRateLimiter parity), rate-limited/delayed add
-ordering, and the stats() idleness probe the chaos soak quiesces on."""
+ordering, shutdown wake/teardown semantics, and the stats() idleness
+probe the chaos soak quiesces on."""
 
+import threading
 import time
 
 from cron_operator_tpu.runtime.workqueue import (
@@ -143,3 +145,53 @@ class TestStats:
             assert q.stats()[2] < first
         finally:
             q.shut_down()
+
+
+class TestShutdown:
+    def test_shut_down_wakes_untimed_getters(self):
+        # Workers park in get(timeout=None) for process lifetime; a
+        # shard teardown must release ALL of them promptly — a missed
+        # notify here deadlocks Manager.stop() joining its workers.
+        q = WorkQueue()
+        results = []
+        threads = [
+            threading.Thread(target=lambda: results.append(q.get()))
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # let every worker reach the untimed wait
+        start = time.monotonic()
+        q.shut_down()
+        for t in threads:
+            t.join(timeout=2.0)
+        assert not any(t.is_alive() for t in threads)
+        assert time.monotonic() - start < 1.0
+        assert results == [None, None, None, None]
+
+    def test_shut_down_joins_delay_thread(self):
+        q = WorkQueue()
+        q.add_after("pending", 60.0)
+        q.shut_down()
+        assert not q._delay_thread.is_alive()
+        # dropped delayed adds leave a clean idle probe
+        assert q.stats() == (0, 0, None)
+
+    def test_done_after_shutdown_does_not_requeue_dirty_item(self):
+        q = WorkQueue()
+        q.add("a")
+        assert q.get(timeout=1.0) == "a"
+        q.add("a")  # dirty while processing → would re-queue on done()
+        q.shut_down()
+        q.done("a")
+        assert q.stats() == (0, 0, None)
+        assert q.get(timeout=0.1) is None
+
+    def test_adds_after_shutdown_are_dropped(self):
+        q = WorkQueue()
+        q.shut_down()
+        q.add("a")
+        q.add_after("b", 0.0)
+        q.add_rate_limited("c")
+        assert q.stats() == (0, 0, None)
+        assert q.get(timeout=0.1) is None
