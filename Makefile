@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards chaos-soak
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards chaos-soak chaos-soak-preempt
 
 all: gate
 
@@ -80,3 +80,18 @@ chaos-soak:
 	python hack/chaos_soak.py --seed $(or $(SEED),0) \
 	    --crons $(or $(N),200) --rounds $(or $(ROUNDS),6) \
 	    --no-durability --expect-violation --out /dev/null
+
+# Preemption-storm soak (elastic training, I8): the classic soak plus an
+# elastic leg where REAL CPU-mesh training jobs (LocalExecutor threads
+# over 8 virtual host devices) are preempted mid-run and must resume on
+# the surviving devices from their last checkpoint; then the same storm
+# WITHOUT elastic resume, which must violate I8 (restart from step 0) —
+# the counter-proof that I8 discriminates. See README "Elastic training".
+chaos-soak-preempt:
+	python hack/chaos_soak.py --seed $(or $(SEED),5) \
+	    --crons $(or $(N),24) --rounds $(or $(ROUNDS),2) \
+	    --preempt-storm --elastic-jobs $(or $(JOBS),3) \
+	    --out CHAOS_PREEMPT.json
+	python hack/chaos_soak.py --seed $(or $(SEED),5) \
+	    --rounds $(or $(ROUNDS),2) --no-elastic \
+	    --elastic-jobs $(or $(JOBS),3) --expect-violation --out /dev/null

@@ -68,6 +68,14 @@ def _history_schema() -> Dict[str, Any]:
             "status": {"type": "string"},
             "created": {"type": "string", "format": "date-time"},
             "finished": {"type": "string", "format": "date-time"},
+            "resumes": {
+                "type": "integer",
+                "description": (
+                    "Elastic resume attempts collapsed into this logical "
+                    "run (preemption recovery on a smaller mesh)."
+                ),
+            },
+            "lastResumedAt": {"type": "string", "format": "date-time"},
         },
     }
 

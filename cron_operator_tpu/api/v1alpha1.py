@@ -302,13 +302,22 @@ class TypedLocalObjectReference:
 
 @dataclass
 class CronHistory:
-    """One finished (or observed) execution (reference ``cron_types.go:160-182``)."""
+    """One finished (or observed) execution (reference ``cron_types.go:160-182``).
+
+    One entry is one LOGICAL run: when a preempted workload is elastically
+    resumed, every resume attempt collapses into the root attempt's entry —
+    ``resumes`` counts the attempts after the first and ``lastResumedAt``
+    is the newest attempt's creation time. Both serialize only when set, so
+    non-elastic histories are byte-identical to before (the controller's
+    no-op status elision depends on that)."""
 
     uid: str = ""
     object: TypedLocalObjectReference = field(default_factory=TypedLocalObjectReference)
     status: str = ""  # JobConditionType string
     created: Optional[datetime] = None
     finished: Optional[datetime] = None
+    resumes: int = 0
+    last_resumed_at: Optional[datetime] = None
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"uid": self.uid, "object": self.object.to_dict()}
@@ -318,6 +327,10 @@ class CronHistory:
             out["created"] = rfc3339(self.created)
         if self.finished:
             out["finished"] = rfc3339(self.finished)
+        if self.resumes:
+            out["resumes"] = int(self.resumes)
+        if self.last_resumed_at:
+            out["lastResumedAt"] = rfc3339(self.last_resumed_at)
         return out
 
     @classmethod
@@ -328,6 +341,8 @@ class CronHistory:
             status=d.get("status", ""),
             created=parse_time(d.get("created")),
             finished=parse_time(d.get("finished")),
+            resumes=int(d.get("resumes") or 0),
+            last_resumed_at=parse_time(d.get("lastResumedAt")),
         )
 
 

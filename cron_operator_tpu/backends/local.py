@@ -79,10 +79,24 @@ class LocalExecutor:
 
     def __init__(self, api: APIServer, scheme=None, isolation: str = "thread",
                  metrics: Optional[Any] = None,
-                 tracer: Optional[Any] = None):
+                 tracer: Optional[Any] = None,
+                 gang_slots: Optional[int] = None):
         if isolation not in ("thread", "subprocess"):
             raise ValueError(f"unknown isolation mode {isolation!r}")
         self.isolation = isolation
+        # Thread-isolation entrypoints share ONE in-process jax client.
+        # Two sharded programs dispatching collectives over the same host
+        # devices from different threads can deadlock inside the runtime
+        # (each device executes programs in its arrival order; interleaved
+        # gangs wait on each other forever). gang_slots=N admits at most N
+        # thread-mode entrypoint jobs to the device pool at once — the
+        # local analog of one gang per slice; queued jobs stay Running
+        # (pods pending) and remain promptly cancellable. None (default)
+        # keeps unbounded admission. Subprocess isolation needs no gate:
+        # each child owns a private jax client.
+        self._gang_slots = (
+            threading.BoundedSemaphore(gang_slots) if gang_slots else None
+        )
         self.api = api
         # Optional telemetry sinks: `metrics` (runtime.manager.Metrics) gets
         # the tick-phase histograms + step/throughput gauges derived from
@@ -106,6 +120,38 @@ class LocalExecutor:
         # (not at dequeue) so there is no window where an event is in
         # neither the queue nor the counter — wait_idle keys off this.
         self._inflight = 0
+        # Devices lost to still-outstanding preemptions; capacity() reports
+        # the pool minus this. restore_capacity() returns them (the cloud
+        # re-provisioned the slice).
+        self._lost_devices = 0
+        self._device_total: Optional[int] = None
+
+    # ---- capacity ---------------------------------------------------------
+
+    def _total_devices(self) -> int:
+        if self._device_total is None:
+            try:
+                import jax
+
+                self._device_total = len(jax.devices())
+            except Exception:
+                self._device_total = 0
+        return self._device_total
+
+    def capacity(self) -> int:
+        """Devices currently schedulable on this backend: everything the
+        local jax runtime exposes minus chips lost to preemptions that
+        have not been re-provisioned. This is the degraded-capacity signal
+        the controller's elastic resume keys off (it reads the per-job
+        snapshot from ``status.preemption``; this probe is the live
+        backend-wide view)."""
+        return max(self._total_devices() - self._lost_devices, 0)
+
+    def restore_capacity(self, devices: Optional[int] = None) -> None:
+        """Return preempted chips to the pool (slice re-provisioned);
+        all of them when ``devices`` is None."""
+        lost = self._lost_devices if devices is None else devices
+        self._lost_devices = max(self._lost_devices - lost, 0)
 
     # ---- lifecycle --------------------------------------------------------
 
@@ -318,7 +364,18 @@ class LocalExecutor:
                 self._execute_subprocess(ctx, entry_ref, ann)
             else:
                 fn = resolve_entrypoint(entry_ref)
-                fn(ctx)
+                if self._gang_slots is None:
+                    fn(ctx)
+                    return
+                # Gang admission: poll in small increments so deleting or
+                # preempting a still-QUEUED job stays prompt.
+                while not ctx.cancel.is_set():
+                    if self._gang_slots.acquire(timeout=0.05):
+                        try:
+                            fn(ctx)
+                        finally:
+                            self._gang_slots.release()
+                        return
             return
         sim = ann.get(ANNOTATION_SIMULATE)
         if sim:
@@ -693,23 +750,134 @@ class LocalExecutor:
 
     # ---- failure injection ------------------------------------------------
 
+    def _mark_pods_preempted(self, ns: str, name: str) -> None:
+        """Record a ``Preempted`` condition on every host pod of the slice
+        before deleting it — the watch stream is how observers tell a
+        preemption (whole slice reclaimed at once) from a pod crash."""
+        now = rfc3339(self.api.clock.now())
+        cond = {
+            "type": "Preempted",
+            "status": "True",
+            "reason": "TPUSlicePreempted",
+            "message": "TPU slice was reclaimed.",
+            "lastTransitionTime": now,
+        }
+        for pod in self.api.list(
+            "v1", "Pod", namespace=ns,
+            label_selector={"tpu.kubedl.io/job-name": name},
+        ):
+            pod_name = pod["metadata"]["name"]
+
+            def _flip() -> None:
+                cur = self.api.try_get("v1", "Pod", ns, pod_name)
+                if cur is None:
+                    return
+                status = dict(cur.get("status") or {})
+                status["conditions"] = list(
+                    status.get("conditions") or []
+                ) + [cond]
+                self.api.update({**cur, "status": status})
+
+            try:
+                with_conflict_retry(_flip)
+            except ApiError as err:
+                logger.debug("could not mark pod %s/%s preempted: %s",
+                             ns, pod_name, err)
+
     def preempt(self, namespace: str, name: str, kind: str = "JAXJob",
-                api_version: str = "kubeflow.org/v1") -> None:
+                api_version: str = "kubeflow.org/v1",
+                lost_devices: Optional[int] = None) -> Dict[str, Any]:
         """Simulate TPU slice preemption: every host pod of the slice
         disappears at once (slice-atomic), and the job's status reflects it
-        through the JobStatus convention."""
+        through the JobStatus convention.
+
+        ``lost_devices`` is how many chips the reclaim took from the pool
+        (default: half the currently-available capacity, at least one) —
+        ``capacity()`` reports the degraded pool afterwards and the job's
+        ``status.preemption`` records the surviving count, which is what
+        the controller's elastic resume replans the mesh against.
+
+        Ordering is the durability guarantee: cancel → join the job thread
+        (the entrypoint's ``finally`` closes its CheckpointStore) → flush
+        any store still open for the job → only then tear pods down and
+        flip conditions. A preemption therefore never loses a completed
+        ``save()``, only steps since the last one.
+        """
         key: JobKey = (api_version, kind, namespace, name)
+        prior = self.capacity()
+        if lost_devices is None:
+            lost_devices = max(prior // 2, 1)
+        lost_devices = min(max(lost_devices, 0), prior)
+        surviving = prior - lost_devices
+
         with self._lock:
             ctx = self._jobs.get(key)
+            thread = self._threads.get(key)
         if ctx:
             ctx.cancel.set()
+        if thread is not None and thread is not threading.current_thread():
+            # Give the trainer a chance to exit between steps and drain its
+            # own store; the flush below covers a thread that outlives this.
+            thread.join(timeout=15.0)
+        try:
+            from cron_operator_tpu.backends.tpu import logical_run_root
+            from cron_operator_tpu.workloads.checkpoint import (
+                flush_open_stores,
+            )
+
+            obj_for_ann = self.api.try_get(api_version, kind, namespace, name)
+            ann0 = ((obj_for_ann or {}).get("metadata") or {}).get(
+                "annotations") or {}
+            flush_open_stores(namespace, name)
+            root = logical_run_root(name, ann0)
+            if root != name:
+                flush_open_stores(namespace, root)
+        except Exception:
+            logger.warning("checkpoint flush on preempt failed",
+                           exc_info=True)
+
+        self._mark_pods_preempted(namespace, name)
         self._delete_pods(namespace, name)
+        self._lost_devices += lost_devices
+
+        record = {
+            "priorDevices": prior,
+            "lostDevices": lost_devices,
+            "survivingDevices": surviving,
+            "preemptedAt": rfc3339(self.api.clock.now()),
+        }
         obj = self.api.try_get(api_version, kind, namespace, name)
         if obj is None:
-            return
+            return record
+        # The reclaim can race completion: the join above is the fence, so
+        # a job that is terminal HERE finished before losing its devices.
+        # Leave its status alone — appending Preempted/Restarting after
+        # Succeeded would resurrect a done job (and strand it non-terminal,
+        # since the re-admit refuses to run a finished spec).
+        from cron_operator_tpu.controller.workload import is_workload_finished
+
+        try:
+            _, finished = is_workload_finished(obj)
+        except ValueError:
+            finished = False
+        if finished:
+            record["jobFinished"] = True
+            return record
+        if self.metrics is not None:
+            self.metrics.inc("cron_workload_preemptions_total")
         ann = (obj.get("metadata") or {}).get("annotations") or {}
         restart = (ann.get(ANNOTATION_RESTART_ON_PREEMPTION, "").lower()
                    in ("1", "true", "yes"))
+        # Distinct Preempted condition first (never the LAST entry — the
+        # Kubeflow convention reads the last condition as the job's final
+        # status, and "Preempted" is a cause, not an outcome), carrying the
+        # capacity snapshot the controller replans against.
+        self._append_condition(
+            key, "Preempted", "TPUSlicePreempted",
+            f"TPU slice was preempted; {surviving} of {prior} devices "
+            "survive.",
+            extra={"preemption": record},
+        )
         if restart:
             self._append_condition(
                 key, "Restarting", "TPUSlicePreempted",
@@ -727,6 +895,7 @@ class LocalExecutor:
                 "TPU slice was preempted.",
                 extra={"completionTime": rfc3339(self.api.clock.now())},
             )
+        return record
 
 
 __all__ = [

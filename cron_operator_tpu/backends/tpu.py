@@ -45,6 +45,51 @@ class TopologyError(ValueError):
     pass
 
 
+# ---- elastic resume contract ----------------------------------------------
+# Set on the workload template to let the controller resubmit a preempted
+# job on whatever devices survive instead of failing the tick.
+ANNOTATION_ELASTIC_RESUME = "tpu.kubedl.io/elastic-resume"
+# Stamped by the controller on every resume attempt: the name of the first
+# (root) attempt — the logical run all attempts belong to — and the 1-based
+# attempt number. `max-resumes` (on the template) caps the chain.
+ANNOTATION_RESUME_OF = "tpu.kubedl.io/resume-of"
+ANNOTATION_RESUME_ATTEMPT = "tpu.kubedl.io/resume-attempt"
+ANNOTATION_MAX_RESUMES = "tpu.kubedl.io/max-resumes"
+DEFAULT_MAX_RESUMES = 5
+
+
+def logical_run_root(name: str, annotations: Optional[Dict[str, str]] = None
+                     ) -> str:
+    """The logical-run name a workload belongs to: resume attempts carry
+    the root attempt's name in ``tpu.kubedl.io/resume-of``; anything else
+    IS its own root. The annotation (not name parsing) is authoritative —
+    a job honestly named ``foo-r2`` must not be mistaken for attempt 2 of
+    ``foo``."""
+    if annotations:
+        root = annotations.get(ANNOTATION_RESUME_OF)
+        if root:
+            return root
+    return name
+
+
+def capacity(spec: Optional[SliceSpec] = None) -> int:
+    """Best-effort probe of schedulable TPU chips.
+
+    With a :class:`SliceSpec`, the slice's static chip count (what GKE
+    provisioned). Without one, the chips the local jax runtime can actually
+    see right now — 0 when no TPU plugin is reachable (CPU-only control
+    planes), which is the honest answer for "can I place a TPU gang here".
+    """
+    if spec is not None:
+        return spec.chips
+    try:
+        import jax
+
+        return len(jax.devices("tpu"))
+    except Exception:
+        return 0
+
+
 @dataclass(frozen=True)
 class SliceSpec:
     """One TPU slice: accelerator family + topology → gang shape."""
@@ -297,6 +342,13 @@ def inject_tpu_topology(job: Dict[str, Any]) -> Optional[SliceSpec]:
 __all__ = [
     "SliceSpec",
     "TopologyError",
+    "capacity",
+    "logical_run_root",
+    "ANNOTATION_ELASTIC_RESUME",
+    "ANNOTATION_RESUME_OF",
+    "ANNOTATION_RESUME_ATTEMPT",
+    "ANNOTATION_MAX_RESUMES",
+    "DEFAULT_MAX_RESUMES",
     "slice_for",
     "slice_for_shorthand",
     "render_coordinator_env",

@@ -58,7 +58,17 @@ from cron_operator_tpu.controller.workload import (
     validate_workload_template,
     sort_by_creation_timestamp,
 )
-from cron_operator_tpu.backends.tpu import inject_tpu_topology
+from cron_operator_tpu.backends.tpu import (
+    ANNOTATION_ELASTIC_RESUME,
+    ANNOTATION_MAX_RESUMES,
+    ANNOTATION_RESUME_ATTEMPT,
+    ANNOTATION_RESUME_OF,
+    DEFAULT_MAX_RESUMES,
+    PARAM_ANNOTATION_PREFIX,
+    inject_tpu_topology,
+    logical_run_root,
+    params_from_annotations,
+)
 from cron_operator_tpu.runtime.kube import (
     AlreadyExistsError,
     APIServer,
@@ -133,6 +143,9 @@ class CronReconciler:
         # safe to drop — FIFO eviction of a *live* UID would re-observe it
         # on the next reconcile and double-count the histogram.
         self._first_step_observed: Dict[Tuple[str, str], Dict[str, bool]] = {}
+        # Logical runs whose resume budget ran out — the Warning event
+        # fires once per run, not once per reconcile of a terminal state.
+        self._resume_exhausted: set = set()
 
     def _count(self, name: str, value: float = 1.0) -> None:
         if self.metrics is not None:
@@ -268,6 +281,17 @@ class CronReconciler:
         )
 
         self._observe_first_step_latency((ns, name), workloads)
+
+        # Elastic resume (reshard-on-preemption): a preempted attempt is a
+        # *continuation* of its logical run, not a new tick — so it is
+        # evaluated before the schedule/concurrency gates and its submitted
+        # attempt joins `active` (Forbid must see the run as still in
+        # flight, and status.active must list it).
+        resumed = self._maybe_resume_preempted(
+            cron, gvk, active, terminated, log
+        )
+        active.extend(resumed)
+
         self._sync_status(cron, gvk, active, terminated)
 
         now = self.clock.now()
@@ -562,6 +586,284 @@ class CronReconciler:
             for uid in [u for u in observed if u not in live]:
                 del observed[uid]
 
+    # -- elastic resume (reshard-on-preemption) -----------------------------
+
+    @staticmethod
+    def _preemption_of(w: Unstructured) -> Optional[Dict[str, Any]]:
+        """The preemption record if ``w`` carries a preemption marker —
+        a ``Preempted`` condition (appended by the executor before the
+        terminal condition, so the last-condition convention still reads
+        the true terminal state) or a legacy ``Failed`` condition with
+        reason ``TPUSlicePreempted``. Returns ``status.preemption``
+        (may be ``{}`` for markers without a capacity record), or None
+        when the workload was not preempted."""
+        status = w.get("status") or {}
+        conds = status.get("conditions") or []
+        hit = any(
+            c.get("type") == "Preempted"
+            or (
+                c.get("type") == "Failed"
+                and c.get("reason") == "TPUSlicePreempted"
+            )
+            for c in conds
+        )
+        if not hit:
+            return None
+        rec = status.get("preemption")
+        return dict(rec) if isinstance(rec, dict) else {}
+
+    @staticmethod
+    def _attempt_number(w: Unstructured) -> int:
+        ann = (w.get("metadata") or {}).get("annotations") or {}
+        try:
+            return int(ann.get(ANNOTATION_RESUME_ATTEMPT, 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _maybe_resume_preempted(
+        self,
+        cron: Cron,
+        gvk: GVK,
+        active: List[Unstructured],
+        terminated: List[Unstructured],
+        log,
+    ) -> List[Unstructured]:
+        """Resubmit preempted elastic workloads on their surviving devices.
+
+        A workload annotated ``tpu.kubedl.io/elastic-resume`` that
+        terminated Failed with a preemption marker is a *continuation*,
+        not a dead run: the controller recomputes the device mesh for the
+        surviving capacity (``parallel.mesh.replan`` — shrink the data
+        axis first, keep model axes where divisibility allows) and
+        submits a successor attempt named ``<root>-r<N>`` that resumes
+        from the lineage's latest checkpoint (``param.checkpoint_job``
+        pins every attempt to the root attempt's checkpoint store).
+        Attempts are chained by ``tpu.kubedl.io/resume-of``;
+        ``_sync_history`` collapses the chain into one logical-run entry.
+
+        Returns the attempts submitted this pass — the caller joins them
+        into ``active`` so the Forbid gate and ``status.active`` see the
+        run as still in flight. Deterministic attempt names make the
+        resubmit crash-safe: a fail-over retry collides on AlreadyExists
+        instead of double-launching.
+        """
+        if cron.metadata.deletion_timestamp is not None:
+            return []
+        if bool(cron.spec.suspend):
+            return []
+
+        # Group every observed attempt (live and terminated) by root.
+        runs: Dict[str, List[Unstructured]] = {}
+        for w in active:
+            meta = w.get("metadata") or {}
+            root = logical_run_root(
+                meta.get("name", ""), meta.get("annotations") or {}
+            )
+            runs.setdefault(root, [])  # active attempt: run is in flight
+        for w in terminated:
+            meta = w.get("metadata") or {}
+            root = logical_run_root(
+                meta.get("name", ""), meta.get("annotations") or {}
+            )
+            runs.setdefault(root, []).append(w)
+        active_roots = {
+            logical_run_root(
+                (w.get("metadata") or {}).get("name", ""),
+                (w.get("metadata") or {}).get("annotations") or {},
+            )
+            for w in active
+        }
+
+        submitted: List[Unstructured] = []
+        for root, attempts in runs.items():
+            if root in active_roots or not attempts:
+                continue  # run still in flight (or only live attempts)
+            latest = max(attempts, key=self._attempt_number)
+            meta = latest.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            if str(ann.get(ANNOTATION_ELASTIC_RESUME, "")).strip().lower() \
+                    not in ("1", "true", "yes"):
+                continue
+            record = self._preemption_of(latest)
+            if record is None:
+                continue
+            status_str, finished = is_workload_finished(latest)
+            if not finished or status_str != "Failed":
+                continue  # e.g. an in-place restart already recovered it
+            next_no = self._attempt_number(latest) + 1
+            try:
+                max_resumes = int(
+                    ann.get(ANNOTATION_MAX_RESUMES, DEFAULT_MAX_RESUMES)
+                )
+            except (TypeError, ValueError):
+                max_resumes = DEFAULT_MAX_RESUMES
+            if next_no > max_resumes:
+                key = (cron.metadata.namespace, root)
+                if key not in self._resume_exhausted:
+                    self._resume_exhausted.add(key)
+                    self.api.record_event(
+                        cron.to_dict(),
+                        "Warning",
+                        "ResumeBudgetExhausted",
+                        f"not resuming {root}: {next_no - 1} resume "
+                        f"attempt(s) already made (max {max_resumes})",
+                    )
+                continue
+
+            resume = self._new_resume_attempt(
+                cron, latest, root, next_no, record, log
+            )
+            rname = resume["metadata"]["name"]
+            try:
+                self._submit_workload(cron, gvk, resume, log)
+            except AlreadyExistsError:
+                # Fail-over replay of a resubmit whose status update was
+                # lost; the successor is (or was) already running.
+                log.info("resume attempt %s already exists", rname)
+                continue
+            self._count("cron_workload_resumes_total")
+            surviving = record.get("survivingDevices")
+            self.api.record_event(
+                cron.to_dict(),
+                "Normal",
+                "ElasticResume",
+                f"resuming preempted run {root} as {rname}"
+                + (
+                    f" on {surviving} surviving device(s)"
+                    if surviving
+                    else ""
+                )
+                + f" (attempt {next_no}/{max_resumes})",
+            )
+            log.info(
+                "elastic resume: %s → %s (attempt %d)", root, rname, next_no
+            )
+            try:  # prefer the committed copy (uid, creationTimestamp)
+                resume = self.api.get(
+                    resume.get("apiVersion", gvk.api_version),
+                    resume.get("kind", gvk.kind),
+                    cron.metadata.namespace,
+                    rname,
+                )
+            except Exception:
+                pass
+            submitted.append(resume)
+        return submitted
+
+    def _new_resume_attempt(
+        self,
+        cron: Cron,
+        preempted: Unstructured,
+        root: str,
+        attempt: int,
+        record: Dict[str, Any],
+        log,
+    ) -> Unstructured:
+        """Build the successor workload for a preempted attempt: same
+        template, deterministic name ``<root>-r<attempt>``, resume
+        annotations, and ``tpu.kubedl.io/param.*`` mesh annotations
+        recomputed for the surviving device count."""
+        w = copy.deepcopy(preempted)
+        w.pop("status", None)
+        meta = w.setdefault("metadata", {})
+        for k in (
+            "uid",
+            "resourceVersion",
+            "creationTimestamp",
+            "generation",
+            "deletionTimestamp",
+            "generateName",
+            "managedFields",
+        ):
+            meta.pop(k, None)
+        meta["name"] = f"{root}-r{attempt}"
+        ann = meta.setdefault("annotations", {})
+        ann[ANNOTATION_RESUME_OF] = root
+        ann[ANNOTATION_RESUME_ATTEMPT] = str(attempt)
+        # Every attempt of a run reads (and keeps extending) the ROOT
+        # attempt's checkpoint lineage — this is the resume-from-checkpoint
+        # contract the runner env inherits as TPU_PARAM_CHECKPOINT_JOB.
+        ann.setdefault(PARAM_ANNOTATION_PREFIX + "checkpoint_job", root)
+        # Fresh trace id: the resume is a new submission, telemetry-wise.
+        ann[ANNOTATION_TRACE_ID] = new_trace_id()
+
+        try:
+            surviving = int(record.get("survivingDevices") or 0)
+        except (TypeError, ValueError):
+            surviving = 0
+        if surviving > 0:
+            params = params_from_annotations(ann)
+
+            def _p(key: str) -> int:
+                try:
+                    return max(int(params.get(key) or 1), 1)
+                except (TypeError, ValueError):
+                    return 1
+
+            new_plan = None
+            try:
+                from cron_operator_tpu.parallel import mesh as _mesh
+
+                old_n = 0
+                try:
+                    old_n = int(
+                        params.get("devices")
+                        or record.get("priorDevices")
+                        or 0
+                    )
+                except (TypeError, ValueError):
+                    pass
+                old_plan = _mesh.plan_for_devices(
+                    old_n if old_n > 0 else surviving,
+                    tensor=_p("tensor"),
+                    seq=_p("seq"),
+                    fsdp=_p("fsdp"),
+                    pipe=_p("pipe"),
+                    expert=_p("expert"),
+                )
+                # A resume never grows past the original mesh even when
+                # more capacity survived than the job was using.
+                new_plan = _mesh.replan(
+                    old_plan, min(surviving, old_plan.n_devices)
+                )
+                axes = {
+                    "tensor": new_plan.axis(_mesh.TENSOR_AXIS),
+                    "seq": new_plan.axis(_mesh.SEQ_AXIS),
+                    "fsdp": new_plan.axis(_mesh.FSDP_AXIS),
+                    "pipe": new_plan.axis(_mesh.PIPE_AXIS),
+                    "expert": new_plan.axis(_mesh.EXPERT_AXIS),
+                }
+            except Exception as err:
+                # Non-divisible axes, pipeline stages, jax unavailable in
+                # the control plane, … — fall back to pure data
+                # parallelism over the survivors (checkpoint restore is
+                # parallelism-independent, so any valid mesh resumes).
+                log.warning(
+                    "replan for %s failed (%s); resuming data-parallel "
+                    "on %d device(s)",
+                    root, err, surviving,
+                )
+                axes = {
+                    "tensor": 1, "seq": 1, "fsdp": 1, "pipe": 1, "expert": 1,
+                }
+            n_devices = new_plan.n_devices if new_plan is not None \
+                else surviving
+            ann[PARAM_ANNOTATION_PREFIX + "devices"] = str(n_devices)
+            for axis, size in axes.items():
+                key = PARAM_ANNOTATION_PREFIX + axis
+                if size > 1 or key in ann:
+                    ann[key] = str(size)
+            # A shrunk device set rarely still factors into the original
+            # slice topology; collapse multi-slice runs to one slice.
+            slices_key = PARAM_ANNOTATION_PREFIX + "slices"
+            if slices_key in ann:
+                ann[slices_key] = "1"
+
+        return attach_cron_ownership(
+            w, cron.metadata.name, cron.metadata.uid,
+            cron.metadata.namespace,
+        )
+
     def _list_workloads(self, cron: Cron, gvk: GVK) -> List[Unstructured]:
         """List workloads of the template's GVK carrying this cron's label
         in the cron's namespace (``cron_controller.go:242-266``).
@@ -606,7 +908,7 @@ class CronReconciler:
         terminated: List[Unstructured],
     ) -> None:
         self._sync_active_list(cron, gvk, active)
-        self._sync_history(cron, gvk, terminated)
+        self._sync_history(cron, gvk, terminated, active)
 
     def _sync_active_list(
         self, cron: Cron, gvk: GVK, active: List[Unstructured]
@@ -628,58 +930,112 @@ class CronReconciler:
         cron.status.active = refs
 
     def _sync_history(
-        self, cron: Cron, gvk: GVK, terminated: List[Unstructured]
+        self,
+        cron: Cron,
+        gvk: GVK,
+        terminated: List[Unstructured],
+        active: Optional[List[Unstructured]] = None,
     ) -> None:
-        """Rebuild ``status.history``; delete the oldest terminated workloads
-        beyond historyLimit (their history entries disappear with them —
-        parity with ``cron_controller.go:307-346``). ``finished`` is stamped
-        with the sync time, not read from job conditions (reference quirk,
-        kept so history output matches) — but only ONCE per workload: the
-        committed entry's timestamp is preserved on later passes, so an
-        unchanged history is bit-stable and the no-op elision holds (the
-        old per-pass re-stamp made every steady-state sweep a status
-        write on any Cron with history)."""
-        prev_finished = {
-            h.uid: h.finished for h in cron.status.history if h.finished
-        }
+        """Rebuild ``status.history``; delete the oldest terminated logical
+        runs beyond historyLimit (their history entries disappear with the
+        workloads — parity with ``cron_controller.go:307-346``).
+
+        Elastic resume attempts (chained by ``tpu.kubedl.io/resume-of``)
+        collapse into ONE entry per logical run: the root attempt supplies
+        ``uid``/``object``/``created``, the newest attempt supplies
+        ``status``, ``resumes`` counts the successor attempts, and
+        ``lastResumedAt`` is the newest resume attempt's creation time. A
+        run with an attempt still running appears in ``status.active``
+        only — its entry lands here (exactly once) when the chain
+        terminates. GC operates on whole runs: evicting a run deletes
+        every attempt.
+
+        ``finished`` is stamped with the sync time, not read from job
+        conditions (reference quirk, kept so history output matches) —
+        but only once per (run, status, resumes) state: the committed
+        entry's timestamp is preserved on later passes, so an unchanged
+        history is bit-stable and the no-op elision holds, while a run
+        that terminates again after a resume is re-stamped."""
+        prev = {h.uid: h for h in cron.status.history}
         sort_by_creation_timestamp(terminated)
-        n = len(terminated)
+        # Group terminated attempts into logical runs, ordered by each
+        # run's earliest attempt creation. Runs with a live attempt are
+        # still in flight — never emitted, never GC'd.
+        order: List[str] = []
+        runs: Dict[str, List[Unstructured]] = {}
+        for w in terminated:
+            meta = w.get("metadata") or {}
+            root = logical_run_root(
+                meta.get("name", ""), meta.get("annotations") or {}
+            )
+            if root not in runs:
+                runs[root] = []
+                order.append(root)
+            runs[root].append(w)
+        in_flight = {
+            logical_run_root(
+                (w.get("metadata") or {}).get("name", ""),
+                (w.get("metadata") or {}).get("annotations") or {},
+            )
+            for w in (active or [])
+        }
+        settled = [r for r in order if r not in in_flight]
+        n = len(settled)
         limit = (
             cron.spec.history_limit
             if cron.spec.history_limit is not None
             else n  # no limit → keep all
         )
         history: List[CronHistory] = []
-        for i, w in enumerate(terminated):
-            meta = w.get("metadata") or {}
+        for i, root in enumerate(settled):
+            attempts = runs[root]
             if i < n - limit:
-                try:
-                    self.api.delete(
-                        w["apiVersion"], w["kind"],
-                        meta.get("namespace", ""), meta.get("name", ""),
-                        propagation="Background",
-                    )
-                    self._count("cron_history_gc_deleted_total")
-                except NotFoundError:
-                    pass
+                for w in attempts:
+                    meta = w.get("metadata") or {}
+                    try:
+                        self.api.delete(
+                            w["apiVersion"], w["kind"],
+                            meta.get("namespace", ""),
+                            meta.get("name", ""),
+                            propagation="Background",
+                        )
+                        self._count("cron_history_gc_deleted_total")
+                    except NotFoundError:
+                        pass
                 continue
-            status_str, finished = is_workload_finished(w)
+            first = min(attempts, key=self._attempt_number)
+            last = max(attempts, key=self._attempt_number)
+            fmeta = first.get("metadata") or {}
+            resumes = self._attempt_number(last)
+            status_str, finished = is_workload_finished(last)
             entry = CronHistory(
-                uid=meta.get("uid", ""),
+                uid=fmeta.get("uid", ""),
                 object=TypedLocalObjectReference(
                     # group/version rather than group alone — reference
                     # back-compat quirk (``cron_controller.go:329-330``).
                     api_group=gvk.api_version,
-                    kind=w.get("kind", gvk.kind),
-                    name=meta.get("name", ""),
+                    kind=first.get("kind", gvk.kind),
+                    name=fmeta.get("name", ""),
                 ),
                 status=status_str,
-                created=parse_time(meta.get("creationTimestamp")),
+                created=parse_time(fmeta.get("creationTimestamp")),
+                resumes=resumes,
             )
-            if finished:
-                entry.finished = (
-                    prev_finished.get(entry.uid) or self.clock.now()
+            if resumes:
+                entry.last_resumed_at = parse_time(
+                    (last.get("metadata") or {}).get("creationTimestamp")
                 )
+            if finished:
+                ph = prev.get(entry.uid)
+                if (
+                    ph is not None
+                    and ph.finished
+                    and ph.status == status_str
+                    and int(ph.resumes or 0) == resumes
+                ):
+                    entry.finished = ph.finished
+                else:
+                    entry.finished = self.clock.now()
             history.append(entry)
         cron.status.history = history
 

@@ -37,6 +37,7 @@ from cron_operator_tpu.parallel.ring import (
     _single_device_attention,
     ring_attention,
 )
+from cron_operator_tpu.parallel.shardmap_compat import shard_map
 from cron_operator_tpu.parallel.ulysses import ulysses_attention
 
 
@@ -158,7 +159,7 @@ def _sharded_flash(q, k, v, mesh, *, causal: bool, interpret: bool = False):
     spec = P(lead, None, heads, None)
 
     fn = partial(flash_attention, causal=causal, interpret=interpret)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -114,6 +114,61 @@ def plan_for_devices(
     return MeshPlan(sizes)
 
 
+def replan(old_plan: MeshPlan, surviving_devices: Any) -> MeshPlan:
+    """Recompute a plan after preemption shrank the device pool.
+
+    ``surviving_devices`` is a device count or a sequence of devices. The
+    ``data`` axis absorbs the shrink first — data parallelism is the one
+    axis a training job can lose without changing what any single device
+    computes (the global batch shrinks; the Tenplex reconfiguration-plan
+    restriction we implement). Model axes (pipe/fsdp/expert/seq/tensor)
+    keep their sizes whenever the surviving count stays divisible by their
+    product; otherwise they are reduced largest-first by prime factors
+    until a valid factorization exists (VirtualFlow's virtual-node remap,
+    collapsed onto our named axes). Raises ValueError when nothing
+    survives or when the pool *grew* — growing is a scale-up decision the
+    caller must make explicitly with :func:`plan_for_devices`.
+    """
+    try:
+        surviving = int(surviving_devices)
+    except (TypeError, ValueError):
+        surviving = len(surviving_devices)
+    if surviving <= 0:
+        raise ValueError("no surviving devices to replan onto")
+    if surviving > old_plan.n_devices:
+        raise ValueError(
+            f"replan is shrink-only: {surviving} surviving > "
+            f"{old_plan.n_devices} planned"
+        )
+    if surviving == old_plan.n_devices:
+        return old_plan
+    model = {
+        name: old_plan.axis(name)
+        for name in (PIPE_AXIS, EXPERT_AXIS, SEQ_AXIS, FSDP_AXIS, TENSOR_AXIS)
+    }
+
+    def _model_par() -> int:
+        n = 1
+        for s in model.values():
+            n *= s
+        return n
+
+    while surviving % _model_par():
+        name = max((a for a in model if model[a] > 1),
+                   key=lambda a: model[a])
+        size = model[name]
+        factor = next(p for p in range(2, size + 1) if size % p == 0)
+        model[name] //= factor
+    return plan_for_devices(
+        surviving,
+        tensor=model[TENSOR_AXIS],
+        seq=model[SEQ_AXIS],
+        fsdp=model[FSDP_AXIS],
+        pipe=model[PIPE_AXIS],
+        expert=model[EXPERT_AXIS],
+    )
+
+
 def make_mesh(plan: MeshPlan, devices: Optional[Sequence[Any]] = None) -> Mesh:
     """Build a Mesh from a plan over the given (or all local) devices.
 
@@ -356,6 +411,7 @@ __all__ = [
     "BATCH_AXES",
     "MeshPlan",
     "plan_for_devices",
+    "replan",
     "make_mesh",
     "mesh_for_devices",
     "mesh_for_slice",

@@ -36,12 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# jax.shard_map is the stable spelling (ring.py/attention.py use it too);
-# the jax.experimental alias warned on every import and is slated for
-# removal.
-shard_map = jax.shard_map
-
 from cron_operator_tpu.parallel.mesh import BATCH_AXES, PIPE_AXIS
+from cron_operator_tpu.parallel.shardmap_compat import shard_map
 
 
 def stack_pipeline_stages(stage_params: List[Any]) -> Any:

@@ -25,6 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from cron_operator_tpu.parallel.mesh import BATCH_AXES, SEQ_AXIS
+from cron_operator_tpu.parallel.shardmap_compat import shard_map
 
 
 def ring_attention_local(
@@ -146,7 +147,7 @@ def seq_sharded_call(
     lead = batch_axes if batch_axes and q.shape[0] % batch_size == 0 else None
     spec = P(lead, seq_axis, None, None)
 
-    return jax.shard_map(
+    return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)

@@ -1,0 +1,25 @@
+"""``shard_map`` across jax versions.
+
+``jax.shard_map`` (with the ``check_vma`` kwarg) is the stable spelling on
+newer jax; older runtimes only ship ``jax.experimental.shard_map.shard_map``
+and spell the same replication-check toggle ``check_rep``. Import
+``shard_map`` from here so every caller (ring/pipeline/attention) runs on
+both without touching the deprecated alias when the stable one exists.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        kw.setdefault("check_rep", check_vma)
+        return _legacy_shard_map(
+            f, mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+__all__ = ["shard_map"]

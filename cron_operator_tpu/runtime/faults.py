@@ -432,6 +432,20 @@ class FaultInjector:
         with self._lock:
             return sum(ch.dropped for ch in self._channels)
 
+    # ---- preemption faults -------------------------------------------------
+
+    def inject_preempt(self, executor, namespace: str, name: str,
+                       **kwargs) -> object:
+        """Preempt one workload's slice through the backend, recording the
+        fault (``faults_injected_total{kind="preempt"}``). The executor
+        does the heavy lifting — checkpoint flush, pod conditions,
+        capacity degradation; this wrapper is the chaos layer's bookkeeped
+        entry point so storms show up in the fault trace like every other
+        injected fault."""
+        record = executor.preempt(namespace, name, **kwargs)
+        self._record("preempt", "preempt", f"{namespace}/{name}")
+        return record
+
     # ---- leadership faults -------------------------------------------------
 
     def revoke_leader(self, identity: str = "chaos-rival") -> bool:

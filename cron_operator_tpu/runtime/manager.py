@@ -128,7 +128,13 @@ _FAMILY_META: Dict[str, tuple] = {
     "faults_injected_total": (
         "counter", "Faults injected by the chaos layer (label kind: "
                    "conflict, transient, latency, submit_fail, "
-                   "watch_break, leader_revoke)"),
+                   "watch_break, leader_revoke, preempt)"),
+    "cron_workload_preemptions_total": (
+        "counter", "Workloads whose TPU slice was preempted (backend "
+                   "preempt path; elastic resume replans survivors)"),
+    "cron_workload_resumes_total": (
+        "counter", "Elastic resume attempts submitted by the controller "
+                   "after a preemption (same logical run, smaller mesh)"),
     "cron_submit_retries_total": (
         "counter", "Workload submit attempts retried after a transient "
                    "API error (bounded; exhaustion raises a Warning "

@@ -13,7 +13,18 @@ state is only ``status.lastScheduleTime`` in etcd). Flow:
   — steps already done are not repeated;
 - checkpoints are sharding-aware: Orbax restores directly into the mesh
   layout the Trainer hands it (no host-side gather), which is what makes
-  this viable for FSDP-sharded states on real slices.
+  this viable for FSDP-sharded states on real slices;
+- checkpoints are parallelism-INDEPENDENT (the Tenplex model): ``restore``
+  accepts a template on a *different* mesh than the save — a job preempted
+  on 8 chips resumes on the 4 that survive. The fast path reads shards
+  straight into the new ``NamedSharding`` layout; if the saved layout
+  can't be mapped directly, the fallback loads host-side and reshards
+  leaf-by-leaf (:meth:`CheckpointStore.restore_resharded`).
+
+Durability: every open store registers itself so :func:`flush_open_stores`
+can drain in-flight async saves at preemption/SIGTERM time — the executor's
+preempt path calls it before pod teardown, so the job loses at most one
+checkpoint *interval*, never a completed ``save()``.
 
 Directory convention: ``<root>/<namespace>/<lineage>``. Default lineage is
 the FULL job name — preemption restarts re-run the same job name, so they
@@ -29,9 +40,17 @@ from __future__ import annotations
 import logging
 import os
 import re
+import threading
+import weakref
 from typing import Any, Optional
 
 logger = logging.getLogger("workloads.checkpoint")
+
+# Every open store, so preempt/SIGTERM paths can drain async saves without
+# holding a reference to the entrypoint's store (weak: a store that was
+# garbage-collected has nothing in flight worth flushing).
+_OPEN_LOCK = threading.Lock()
+_OPEN_STORES: "weakref.WeakSet[CheckpointStore]" = weakref.WeakSet()
 
 DEFAULT_ROOT = os.environ.get("TPU_CHECKPOINT_DIR", "/tmp/cron-operator-tpu/ckpt")
 
@@ -76,6 +95,10 @@ class CheckpointStore:
                 max_to_keep=max_to_keep, create=create
             ),
         )
+        self.namespace = namespace
+        self.job_name = job_name
+        with _OPEN_LOCK:
+            _OPEN_STORES.add(self)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -87,10 +110,61 @@ class CheckpointStore:
 
     def restore(self, step: int, like: Any) -> Any:
         """Restore ``step`` into the sharding/structure of ``like`` (an
-        abstract or concrete TrainState pytree)."""
+        abstract or concrete TrainState pytree).
+
+        ``like`` may live on a different mesh than the save — including a
+        mesh with FEWER devices (elastic resume after preemption). Orbax
+        reads the saved shards directly into ``like``'s ``NamedSharding``
+        layout when it can; when the direct read fails (a layout it can't
+        map), we fall back to :meth:`restore_resharded`.
+        """
         import orbax.checkpoint as ocp
 
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(like))
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(like)
+            )
+        except Exception:
+            logger.warning(
+                "direct sharded restore of step %s failed; resharding "
+                "host-side", step, exc_info=True,
+            )
+            return self.restore_resharded(step, like)
+
+    def _restore_raw(self, step: int) -> Any:
+        """Template-free restore: the checkpoint as saved (nested dicts of
+        arrays in the save-time layout). The explicit empty
+        ``StandardRestore`` matters — a freshly opened manager that has
+        never saved has no handler registered for the item, and a bare
+        ``restore(step)`` raises KeyError instead of reading it."""
+        import orbax.checkpoint as ocp
+
+        return self._mgr.restore(step, args=ocp.args.StandardRestore())
+
+    def restore_resharded(self, step: int, like: Any) -> Any:
+        """Cross-mesh restore via the host: load the checkpoint
+        template-free (plain arrays in the save-time layout), then
+        ``device_put`` each leaf into ``like``'s sharding. This is the
+        Tenplex reconfiguration plan restricted to our save format — the
+        checkpoint is treated as a parallelism-independent tensor
+        collection keyed by tree path, so any source layout maps onto any
+        target mesh whose shardings ``like`` declares."""
+        import jax
+        import numpy as np
+
+        raw = self._restore_raw(step)  # save-time layout, host-addressable
+        leaves = jax.tree_util.tree_flatten_with_path(like)[0]
+        out = []
+        for path, leaf in leaves:
+            host = np.asarray(_lookup_by_path(raw, path))
+            sharding = getattr(leaf, "sharding", None)
+            out.append(
+                jax.device_put(host, sharding) if sharding is not None
+                else jax.numpy.asarray(host)
+            )
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(like), out
+        )
 
     def restore_params(self, step: Optional[int] = None) -> Any:
         """Params-only restore for SERVING — no optimizer-state template
@@ -106,18 +180,84 @@ class CheckpointStore:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}"
             )
-        raw = self._mgr.restore(step)
+        raw = self._restore_raw(step)
         return raw["params"]
 
     def wait(self) -> None:
+        """Block until every async save issued so far is durable on disk."""
         self._mgr.wait_until_finished()
 
     def close(self) -> None:
+        """Flush the async save pipeline, then release the manager.
+
+        The flush-then-close order is the durability guarantee: a job torn
+        down between ``save()`` and the writer-thread drain keeps its final
+        step as long as ``close()`` (or :func:`flush_open_stores`) runs
+        first."""
         try:
             self._mgr.wait_until_finished()
             self._mgr.close()
         except Exception:
             logger.warning("checkpoint manager close failed", exc_info=True)
+        finally:
+            with _OPEN_LOCK:
+                _OPEN_STORES.discard(self)
 
 
-__all__ = ["CheckpointStore", "job_family", "DEFAULT_ROOT"]
+def _lookup_by_path(raw: Any, path: Any) -> Any:
+    """Walk a template-free Orbax restore (nested dict/list containers) by
+    a jax keypath from the typed template — dataclass fields, dict keys and
+    sequence indices all appear as string keys or indices in the raw
+    tree."""
+    node = raw
+    for entry in path:
+        if hasattr(entry, "key"):
+            name = entry.key
+        elif hasattr(entry, "name"):
+            name = entry.name
+        elif hasattr(entry, "idx"):
+            name = entry.idx
+        else:  # pragma: no cover - future keypath kinds
+            name = str(entry)
+        if isinstance(node, dict):
+            node = node[name] if name in node else node[str(name)]
+        elif isinstance(node, (list, tuple)):
+            node = node[int(name)]
+        else:
+            node = getattr(node, str(name))
+    return node
+
+
+def flush_open_stores(
+    namespace: Optional[str] = None, job_name: Optional[str] = None
+) -> int:
+    """Drain the async save pipeline of every open store, optionally
+    filtered to one namespace and/or job. The executor's preempt path calls
+    this before pod teardown (and SIGTERM handling may too) so the last
+    ``save()`` is durable before the job dies; returns how many stores were
+    flushed."""
+    with _OPEN_LOCK:
+        stores = [
+            s for s in list(_OPEN_STORES)
+            if (namespace is None or s.namespace == namespace)
+            and (job_name is None or s.job_name == job_name)
+        ]
+    flushed = 0
+    for store in stores:
+        try:
+            store.wait()
+            flushed += 1
+        except Exception:
+            logger.warning(
+                "checkpoint flush failed for %s", store.directory,
+                exc_info=True,
+            )
+    return flushed
+
+
+__all__ = [
+    "CheckpointStore",
+    "flush_open_stores",
+    "job_family",
+    "DEFAULT_ROOT",
+]

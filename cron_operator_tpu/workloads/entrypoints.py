@@ -41,9 +41,19 @@ from cron_operator_tpu.workloads.train import StepStats, TrainConfig, Trainer
 
 def _devices(ctx: JobContext):
     platform = ctx.params.get("platform")
-    if platform:
-        return jax.devices(platform)
-    return jax.devices()
+    devs = jax.devices(platform) if platform else jax.devices()
+    # param.devices caps the visible device set (first N) — the elastic
+    # resume path resubmits preempted jobs with the surviving count so the
+    # new mesh fits the shrunken capacity.
+    want = int(ctx.params.get("devices", 0) or 0)
+    if want > 0:
+        if want > len(devs):
+            raise ValueError(
+                f"param.devices={want} but only {len(devs)} "
+                f"device(s) visible"
+            )
+        devs = devs[:want]
+    return devs
 
 
 def _mesh(ctx: JobContext, devs=None):
@@ -78,14 +88,17 @@ def _checkpoint_store(ctx: JobContext):
     """CheckpointStore when the job opts in via param.checkpoint=1; the
     preemption-recovery path (restart-on-preemption re-runs the entrypoint,
     which then resumes from the last saved step). param.checkpoint_lineage
-    ("job" default, "family" to continue one run across Forbid ticks)."""
+    ("job" default, "family" to continue one run across Forbid ticks).
+    param.checkpoint_job pins the store to another job's lineage — the
+    elastic resume path sets it to the logical-run root so every resumed
+    attempt reads (and keeps extending) one checkpoint chain."""
     if ctx.params.get("checkpoint", "0") not in ("1", "true", "yes"):
         return None
     from cron_operator_tpu.workloads.checkpoint import CheckpointStore
 
     return CheckpointStore(
         ctx.namespace or "default",
-        ctx.name,
+        ctx.params.get("checkpoint_job") or ctx.name,
         root=ctx.params.get("checkpoint_dir"),
         lineage=ctx.params.get("checkpoint_lineage", "job"),
     )
@@ -188,7 +201,17 @@ def _run(
     ctx.progress["started_at"] = time.time()
     if trainer.steps_done:
         ctx.progress["resumed_from_step"] = trainer.steps_done
+        # The restored steps are DONE (they travel in state.step), so
+        # publish them up front: a resume that restores at or past the
+        # target is a no-op run and would otherwise report no progress
+        # at all.
+        ctx.progress["steps_done"] = trainer.steps_done
     last_publish = [0.0]
+    # param.step_delay_s paces the loop (chaos/CI knob: synthetic steps on
+    # host CPU finish in microseconds, far inside the publish throttle —
+    # a paced job stays observably in flight long enough to be preempted
+    # mid-run instead of racing to Succeeded).
+    step_delay_s = float(ctx.params.get("step_delay_s", 0) or 0)
     # Optional profiling (SURVEY.md §5 "tracing/profiling: none in the
     # reference"): param.profile_dir=<path> captures a jax.profiler trace
     # of the steady-state steps (started after the compile-laden first
@@ -240,6 +263,8 @@ def _run(
                     tokens_per_step / win_avg, 1
                 )
             window[0], window[1] = 0.0, 0
+        if step_delay_s:
+            time.sleep(step_delay_s)
         now = time.time()
         if ctx.publish is not None and (
             first_call or now - last_publish[0] > 1.0

@@ -6,7 +6,7 @@ fault storm injected by :mod:`cron_operator_tpu.runtime.faults`, including
 **crash-restart rounds**: at a PRF-chosen WAL append the control plane is
 killed at a PRF-chosen kill-point (before/after append, torn tail,
 mid-snapshot), then restarted from its ``--data-dir`` (WAL + snapshot
-recovery, :mod:`cron_operator_tpu.runtime.persistence`).  Asserts seven
+recovery, :mod:`cron_operator_tpu.runtime.persistence`).  Asserts these
 end-state invariants:
 
 - **I1 forbid_no_concurrent** — at no point in the run (observed on the
@@ -33,6 +33,16 @@ end-state invariants:
   in-window tick is permanently lost (every name ever created is, at the
   end, either live in the store or was legitimately deleted — crash-lost
   creates must be re-fired by recovery catch-up).
+- **I8 elastic_resume** (``--preempt-storm``) — an extra leg where REAL
+  CPU-mesh training jobs (``make chaos-soak-preempt``) are hit by
+  preemption storms and resumed by the controller on the surviving
+  devices: after the storm every logical run finishes at exactly its
+  step target, each resume restarts at most one checkpoint interval
+  behind the preempted attempt's observed progress, resume chains are
+  step-monotonic, and each run appears exactly once in history with the
+  right ``resumes`` count.  ``--no-elastic`` is the counter-proof: the
+  same storms against restart-on-preemption jobs (no checkpoint) must
+  violate I8 — restarted runs start over at step 0.
 
 Determinism model: every fault decision, kill-point, and simulated
 workload outcome is a pure function of ``(seed, injection point)`` (see
@@ -1256,6 +1266,439 @@ def run_sharded_soak(
     }
 
 
+# ---------------------------------------------------------------------------
+# Elastic leg: reshard-on-preemption storms over REAL CPU-mesh training (I8)
+# ---------------------------------------------------------------------------
+
+#: Checkpoint cadence of the elastic-leg training jobs; I8's "loses at most
+#: one checkpoint interval" is measured against this.
+ELASTIC_SAVE_EVERY = 4
+#: Fraction of in-flight runs each storm round preempts (at least one is
+#: always hit so every round drives the full path).
+ELASTIC_PREEMPT_FRAC = 0.6
+
+
+def _elastic_steps(rounds: int) -> int:
+    """Total-step target per logical run — sized so runs are still in
+    flight for every storm round and train a real remainder after the
+    last resume."""
+    return ELASTIC_SAVE_EVERY * (3 * rounds + 3)
+
+
+def _elastic_cron(i: int, ckpt_root: str, steps: int, elastic: bool) -> dict:
+    ann = {
+        "tpu.kubedl.io/entrypoint": "mnist",
+        "tpu.kubedl.io/param.steps": str(steps),
+        "tpu.kubedl.io/param.batch_size": "8",
+        "tpu.kubedl.io/param.platform": "cpu",
+        # Paced steps: synthetic mnist trains in microseconds per step,
+        # which loses the race against the storm every time — the pacing
+        # keeps runs observably in flight so preemption lands MID-RUN
+        # (that, not post-hoc status surgery, is what I8 exercises).
+        "tpu.kubedl.io/param.step_delay_s": "0.05",
+    }
+    if elastic:
+        ann.update({
+            "tpu.kubedl.io/elastic-resume": "true",
+            "tpu.kubedl.io/param.checkpoint": "1",
+            "tpu.kubedl.io/param.checkpoint_dir": ckpt_root,
+            "tpu.kubedl.io/param.save_every": str(ELASTIC_SAVE_EVERY),
+        })
+    else:
+        # Counter-proof mode: recovery is an in-place restart with NO
+        # checkpoint — the re-run starts over at step 0, violating I8's
+        # "loses at most one checkpoint interval".
+        ann["tpu.kubedl.io/restart-on-preemption"] = "true"
+    return {
+        "apiVersion": CRON_API_VERSION,
+        "kind": "Cron",
+        "metadata": {"name": f"elastic-{i}", "namespace": NAMESPACE},
+        "spec": {
+            "schedule": "*/1 * * * *",
+            "concurrencyPolicy": "Forbid",
+            "historyLimit": 3,
+            "template": {"workload": {
+                "apiVersion": WORKLOAD_API_VERSION,
+                "kind": WORKLOAD_KIND,
+                "metadata": {"annotations": ann},
+                "spec": {},
+            }},
+        },
+    }
+
+
+def _progress(store, name: str) -> dict:
+    obj = store.try_get(WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, name)
+    if obj is None:
+        return {}
+    return (obj.get("status") or {}).get("trainingProgress") or {}
+
+
+def run_preempt_soak(
+    seed: int,
+    n_jobs: int,
+    rounds: int,
+    elastic: bool = True,
+    train_timeout_s: float = 300.0,
+) -> dict:
+    """The elastic leg: REAL CPU-mesh training jobs (LocalExecutor threads
+    over ``--xla_force_host_platform_device_count`` host devices) driven by
+    the REAL ``CronReconciler``, hit by PRF-scheduled preemption storms.
+
+    Each round waits (wall-clock — training is real) for every in-flight
+    run to progress past a checkpoint interval, preempts a PRF-chosen
+    subset through :meth:`FaultInjector.inject_preempt` (recording
+    pre-preemption step counts as I8 evidence), sweeps the reconciler so
+    the resume attempts are submitted against the *degraded* capacity,
+    then restores capacity (the cloud re-provisioned the slice). After the
+    last round every run trains to completion and the end state is
+    collected for :func:`check_i8`.
+
+    ``elastic=False`` is the counter-proof: same storms, but the jobs use
+    restart-on-preemption with no checkpointing — the restarted run starts
+    over at step 0, which :func:`check_i8` flags.
+    """
+    from cron_operator_tpu.backends.local import LocalExecutor
+    from cron_operator_tpu.controller.cron_controller import CronReconciler
+    from cron_operator_tpu.runtime.faults import (
+        FaultInjector,
+        FaultPlan,
+        seeded_fraction,
+    )
+    from cron_operator_tpu.runtime.kube import APIServer
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    t0 = time.time()
+    ckpt_root = tempfile.mkdtemp(prefix="chaos-elastic-ckpt-")
+    clock = FakeClock()
+    store = APIServer(clock=clock)
+    metrics = Metrics()
+    # Quiet injector: the elastic leg injects only preemptions (API/watch
+    # faults are the classic leg's job) but routes them through the fault
+    # layer so storms land in the trace + faults_injected_total.
+    injector = FaultInjector(store, FaultPlan.quiet(seed))
+    injector.instrument(metrics)
+    # gang_slots=1: the leg's jobs all mesh over the SAME 8 virtual host
+    # devices; concurrent sharded programs from different threads can
+    # deadlock XLA collectives, so the local slice admits one gang at a
+    # time (queued jobs wait, exactly like pods pending on a busy slice).
+    ex = LocalExecutor(store, metrics=metrics, gang_slots=1)
+    ex.start()
+    rec = CronReconciler(store, metrics=metrics)
+
+    steps_target = _elastic_steps(rounds)
+    crons = [f"elastic-{i}" for i in range(n_jobs)]
+    for i in range(n_jobs):
+        store.create(_elastic_cron(i, ckpt_root, steps_target, elastic))
+
+    def sweep():
+        for name in crons:
+            rec.reconcile(NAMESPACE, name)
+
+    def latest_attempt(root: str) -> str:
+        """Newest attempt name of a logical run (root, root-r1, ...)."""
+        best, best_no = root, -1
+        for w in store.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+        ):
+            meta = w.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            wroot = ann.get("tpu.kubedl.io/resume-of", meta.get("name", ""))
+            if wroot != root:
+                continue
+            try:
+                no = int(ann.get("tpu.kubedl.io/resume-attempt", 0))
+            except (TypeError, ValueError):
+                no = 0
+            if no > best_no:
+                best, best_no = meta.get("name", ""), no
+        return best
+
+    # Fire exactly one tick per cron: one fake minute, one sweep.
+    clock.advance(timedelta(seconds=61))
+    sweep()
+    roots = {}
+    for w in store.list(
+        WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+    ):
+        meta = w.get("metadata") or {}
+        cron = (meta.get("labels") or {}).get(LABEL_CRON_NAME, "")
+        if cron:
+            roots[cron] = meta.get("name", "")
+    timeouts: list = []
+
+    def wait_progress(job: str, floor: int, deadline: float) -> dict:
+        while time.time() < deadline:
+            obj = store.try_get(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, job
+            )
+            if obj is None:
+                return {}
+            if _is_terminal(obj):
+                return _progress(store, job)
+            prog = _progress(store, job)
+            if int(prog.get("steps_done") or 0) >= floor:
+                return prog
+            time.sleep(0.1)
+        timeouts.append({"job": job, "waiting_for_step": floor})
+        return _progress(store, job)
+
+    events: list = []
+    for r in range(rounds):
+        # Every in-flight run must clear another checkpoint interval
+        # before the storm, so "loses at most one interval" is testable.
+        floor = (ELASTIC_SAVE_EVERY + 2) * (r + 1)
+        deadline = time.time() + train_timeout_s
+        # PRF storm selection, decided up front; force at least one
+        # victim per round so every round drives the full path.
+        chosen = {
+            cron: seeded_fraction(seed, "elastic", r, roots[cron])
+            < ELASTIC_PREEMPT_FRAC
+            for cron in crons if roots.get(cron)
+        }
+        if chosen and not any(chosen.values()):
+            chosen[next(iter(chosen))] = True
+        for cron in crons:
+            root = roots.get(cron)
+            if not root:
+                continue
+            job = latest_attempt(root)
+            pre = wait_progress(job, min(floor, steps_target - 2), deadline)
+            obj = store.try_get(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, job
+            )
+            # Inject IMMEDIATELY after the liveness read — the jobs are
+            # paced but real, so any gap is a window for the run to
+            # finish underneath the storm.
+            if obj is None or _is_terminal(obj) or not chosen.get(cron):
+                continue
+            prior = ex.capacity()
+            if prior <= 1:
+                ex.restore_capacity()
+                prior = ex.capacity()
+            # Halve the pool 1-3 times (PRF-chosen): survivors stay a
+            # power of two, so the resharded data axis always divides the
+            # batch and replan keeps clean factors.
+            halvings = 1 + int(
+                seeded_fraction(seed, "elastic-lost", r, root) * 3
+            )
+            surviving = max(prior >> halvings, 1)
+            lost = prior - surviving
+            record = injector.inject_preempt(
+                ex, NAMESPACE, job, lost_devices=lost
+            )
+            if record.get("jobFinished"):
+                # The run crossed the finish line between the liveness
+                # read and the reclaim; the executor left its terminal
+                # status untouched, so there is no successor to audit.
+                continue
+            events.append({
+                "round": r,
+                "cron": cron,
+                "root": root,
+                "job": job,
+                "pre_steps": int(pre.get("steps_done") or 0),
+                "record": record,
+            })
+        # Resume attempts are computed against the DEGRADED capacity the
+        # preemption recorded; then the slice is re-provisioned.
+        sweep()
+        ex.restore_capacity()
+
+    # Drain: every logical run trains to completion on its final mesh.
+    deadline = time.time() + train_timeout_s
+    for cron in crons:
+        root = roots.get(cron)
+        if not root:
+            continue
+        job = latest_attempt(root)
+        while time.time() < deadline:
+            obj = store.try_get(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, job
+            )
+            if obj is None or _is_terminal(obj):
+                nxt = latest_attempt(root)
+                if nxt == job:
+                    break
+                job = nxt  # terminal-but-preempted: follow the chain
+                continue
+            time.sleep(0.1)
+        else:
+            timeouts.append({"job": job, "waiting_for": "terminal"})
+    # Two sweeps: the first may submit a trailing resume / finish stamps,
+    # the second collapses the settled history.
+    sweep()
+    ex.wait_idle(timeout=train_timeout_s)
+    sweep()
+
+    # ---- end-state evidence ------------------------------------------------
+    runs: dict = {}
+    for cron in crons:
+        root = roots.get(cron, "")
+        chain: list = []
+        for w in store.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+        ):
+            meta = w.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            wroot = ann.get("tpu.kubedl.io/resume-of", meta.get("name", ""))
+            if wroot != root:
+                continue
+            try:
+                no = int(ann.get("tpu.kubedl.io/resume-attempt", 0))
+            except (TypeError, ValueError):
+                no = 0
+            prog = (w.get("status") or {}).get("trainingProgress") or {}
+            chain.append({
+                "attempt": no,
+                "name": meta.get("name", ""),
+                "terminal": _is_terminal(w),
+                "devices": (ann.get("tpu.kubedl.io/param.devices") or ""),
+                "resumed_from_step": prog.get("resumed_from_step"),
+                "steps_done": int(prog.get("steps_done") or 0),
+            })
+        chain.sort(key=lambda a: a["attempt"])
+        cron_obj = store.get(CRON_API_VERSION, "Cron", NAMESPACE, cron)
+        hist = (cron_obj.get("status") or {}).get("history") or []
+        runs[cron] = {
+            "root": root,
+            "chain": chain,
+            "history": [
+                {
+                    "name": (h.get("object") or {}).get("name", ""),
+                    "status": h.get("status", ""),
+                    "resumes": int(h.get("resumes") or 0),
+                }
+                for h in hist
+            ],
+        }
+
+    ex.stop()
+    shutil.rmtree(ckpt_root, ignore_errors=True)
+    return {
+        "elastic": elastic,
+        "n_jobs": n_jobs,
+        "rounds": rounds,
+        "steps_target": steps_target,
+        "save_every": ELASTIC_SAVE_EVERY,
+        "preempt_events": events,
+        "runs": runs,
+        "timeouts": timeouts,
+        "metrics": {
+            "preemptions": metrics.get("cron_workload_preemptions_total"),
+            "resumes": metrics.get("cron_workload_resumes_total"),
+            "faults_preempt": metrics.get(
+                'faults_injected_total{kind="preempt"}'
+            ),
+        },
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+def check_i8(ev: dict) -> dict:
+    """I8 elastic_resume_integrity: after preempt storms every in-flight
+    job (a) finishes, with a monotonically non-decreasing step count
+    across its attempt chain, (b) loses at most one checkpoint interval
+    per preemption (the successor's resume step is >= the pre-preemption
+    step minus ``save_every``), and (c) appears exactly once in its
+    Cron's history, with ``resumes`` matching the attempt chain."""
+    problems: list = []
+    save_every = ev["save_every"]
+    target = ev["steps_target"]
+
+    if ev["timeouts"]:
+        problems.append({"kind": "did_not_finish", "jobs": ev["timeouts"][:5]})
+
+    # (b) per-preemption: successor start step within one interval.
+    for e in ev["preempt_events"]:
+        run = ev["runs"].get(e["cron"]) or {}
+        chain = run.get("chain") or []
+        # The successor EXECUTION of this preemption: the next attempt in
+        # the chain (elastic) or the restarted job itself, whose progress
+        # the in-place re-run overwrote (no-elastic counter-proof).
+        if ev["elastic"]:
+            mine = next(
+                (a["attempt"] for a in chain if a["name"] == e["job"]), 0
+            )
+            after = [a for a in chain if a["attempt"] > mine]
+            nxt = after[0] if after else None
+        else:
+            nxt = next(
+                (a for a in chain if a["name"] == e["job"]), None
+            )
+        if nxt is None:
+            problems.append({"kind": "no_successor", "event": e})
+            continue
+        start = int(nxt.get("resumed_from_step") or 0)
+        if start < e["pre_steps"] - save_every:
+            problems.append({
+                "kind": "lost_more_than_one_interval",
+                "event": e,
+                "successor": nxt["name"],
+                "resumed_from_step": start,
+                "pre_steps": e["pre_steps"],
+                "save_every": save_every,
+            })
+        if start > target:
+            problems.append({
+                "kind": "non_monotonic_resume",
+                "event": e,
+                "resumed_from_step": start,
+            })
+
+    for cron, run in ev["runs"].items():
+        chain = run.get("chain") or []
+        if not chain:
+            problems.append({"kind": "run_vanished", "cron": cron})
+            continue
+        # (a) finishes at the step target, monotonic across the chain.
+        final = chain[-1]
+        if final["terminal"] != "Succeeded" or final["steps_done"] != target:
+            problems.append({
+                "kind": "did_not_complete",
+                "cron": cron,
+                "final": final,
+            })
+        starts = [int(a.get("resumed_from_step") or 0) for a in chain]
+        if any(b < a for a, b in zip(starts, starts[1:])):
+            problems.append({
+                "kind": "non_monotonic_chain",
+                "cron": cron,
+                "resume_steps": starts,
+            })
+        # (c) exactly once in history, resumes == successor attempts.
+        hist = run.get("history") or []
+        entries = [h for h in hist if h["name"] == run["root"]]
+        if len(hist) != 1 or len(entries) != 1:
+            problems.append({
+                "kind": "history_not_exactly_once",
+                "cron": cron,
+                "history": hist,
+            })
+        else:
+            want = max(a["attempt"] for a in chain)
+            if entries[0]["resumes"] != want:
+                problems.append({
+                    "kind": "history_resume_count_wrong",
+                    "cron": cron,
+                    "entry": entries[0],
+                    "expected_resumes": want,
+                })
+
+    n_preempts = len(ev["preempt_events"])
+    ok = not problems and n_preempts > 0
+    return {
+        "ok": ok,
+        "detail": problems[:6] if problems else (
+            f"{n_preempts} preemption(s) across {ev['rounds']} round(s), "
+            f"{int(ev['metrics']['resumes'])} resume(s): every run "
+            f"finished at step {ev['steps_target']}, lost <= 1 checkpoint "
+            f"interval per preemption, exactly one history entry each"
+        ),
+    }
+
+
 def _surface(store, watchlog) -> dict:
     """Semantic end state, shorn of run-varying identifiers (uids,
     resourceVersions, timestamps): the I5 comparison surface. Fired-tick
@@ -1406,8 +1849,29 @@ def main(argv=None) -> int:
                          "standby: kill rounds promote the victim shard's "
                          "follower instead of replaying from disk (I6 is "
                          "checked per shard at promotion time)")
+    ap.add_argument("--preempt-storm", action="store_true", default=False,
+                    help="also run the ELASTIC leg: real CPU-mesh training "
+                         "jobs hit by preemption storms, resumed by the "
+                         "controller on the surviving devices (invariant "
+                         "I8)")
+    ap.add_argument("--no-elastic", action="store_true", default=False,
+                    help="run ONLY the elastic leg with elastic resume "
+                         "disabled (restart-on-preemption, no checkpoint) "
+                         "— the I8 counter-proof: restarted runs start "
+                         "over at step 0")
+    ap.add_argument("--elastic-jobs", type=int, default=3,
+                    help="logical training runs in the elastic leg")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
+
+    if args.preempt_storm or args.no_elastic:
+        # The elastic leg shards real arrays over host devices; the flag
+        # must be set before ANY jax import in this process.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     from cron_operator_tpu.runtime.faults import FaultPlan
 
@@ -1424,11 +1888,50 @@ def main(argv=None) -> int:
         and plan_a.trace_hash(args.rounds) == plan_b.trace_hash(args.rounds)
     )
 
+    if args.no_elastic:
+        # Counter-proof mode: ONLY the elastic leg, with elastic resume
+        # disabled. The jobs recover via in-place restart with no
+        # checkpoint, so a preempted run re-trains from step 0 — I8's
+        # "loses at most one checkpoint interval" must demonstrably fail.
+        print(
+            f"chaos soak (elastic counter-proof): seed={args.seed} "
+            f"jobs={args.elastic_jobs} rounds={args.rounds}",
+            flush=True,
+        )
+        ev = run_preempt_soak(
+            args.seed, args.elastic_jobs, args.rounds, elastic=False
+        )
+        i8 = check_i8(ev)
+        invariants = {"I8_elastic_resume": i8}
+        report = {
+            "seed": args.seed,
+            "mode": "no-elastic",
+            "rounds": args.rounds,
+            "elastic_leg": ev,
+            "invariants": invariants,
+            "ok": i8["ok"],
+        }
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+        mark = "PASS" if i8["ok"] else "FAIL"
+        print(f"  [{mark}] I8_elastic_resume: {i8['detail']}")
+        print(f"wrote {args.out} (ok={i8['ok']})")
+        if args.expect_violation:
+            if not i8["ok"]:
+                print("expected violation observed (I8) — without elastic "
+                      "resume, preempted runs restart from step 0")
+                return 0
+            print("ERROR: expected an I8 violation but the leg passed")
+            return 1
+        return 0 if i8["ok"] else 1
+
     if args.shards > 0:
         if (args.unhardened or args.no_crash or args.no_durability
-                or args.data_dir):
+                or args.data_dir or args.preempt_storm):
             print("ERROR: --shards is incompatible with --unhardened/"
-                  "--no-crash/--no-durability/--data-dir (the sharded "
+                  "--no-crash/--no-durability/--data-dir/--preempt-storm "
+                  "(the sharded "
                   "soak is always hardened, crashy, and durable: WAL "
                   "bytes are the follower-shipping medium)")
             return 2
@@ -1535,6 +2038,25 @@ def main(argv=None) -> int:
     print(f"  replay run: {replay['elapsed_s']}s", flush=True)
 
     invariants = check_invariants(chaotic, replay, HISTORY_LIMIT)
+
+    elastic_ev = None
+    if args.preempt_storm:
+        print(
+            f"  elastic leg: jobs={args.elastic_jobs} "
+            f"rounds={args.rounds} (real CPU-mesh training)",
+            flush=True,
+        )
+        elastic_ev = run_preempt_soak(
+            args.seed, args.elastic_jobs, args.rounds, elastic=True
+        )
+        print(
+            f"  elastic leg: {elastic_ev['elapsed_s']}s "
+            f"preempts={len(elastic_ev['preempt_events'])} "
+            f"resumes={int(elastic_ev['metrics']['resumes'])}",
+            flush=True,
+        )
+        invariants["I8_elastic_resume"] = check_i8(elastic_ev)
+
     ok = all(v["ok"] for v in invariants.values()) and deterministic
 
     report = {
@@ -1569,6 +2091,8 @@ def main(argv=None) -> int:
         "invariants": invariants,
         "ok": ok,
     }
+    if elastic_ev is not None:
+        report["elastic_leg"] = elastic_ev
     # The full surfaces are bulky at N>=200; persist only on divergence.
     if not invariants["I5_matches_fault_free_replay"]["ok"]:
         report["surface_chaotic"] = chaotic["surface"]

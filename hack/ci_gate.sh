@@ -90,6 +90,24 @@ echo "==> shard-kill failover smoke (2 shards, WAL-shipping hot standby)"
 python hack/chaos_soak.py --seed 11 --crons 24 --rounds 3 --shards 2 \
     --out /dev/null
 
+echo "==> preempt-storm smoke (elastic reshard-on-preemption, I8)"
+# Fixed-seed storm over REAL CPU-mesh training jobs: two rounds of
+# PRF-scheduled slice preemptions against paced mnist runs; the
+# reconciler must resume every victim on the shrunken mesh from its
+# latest checkpoint, and I8 (finishes at target, loses <= one
+# checkpoint interval per preemption, exactly one history entry per
+# logical run) must hold. Full run: make chaos-soak-preempt.
+python hack/chaos_soak.py --seed 5 --crons 24 --rounds 2 \
+    --preempt-storm --elastic-jobs 2 --out /dev/null
+
+echo "==> elastic counter-proof (same storms, no resume -> I8 must break)"
+# The same storm schedule against restart-on-preemption jobs with NO
+# checkpointing: the restarted runs start over at step 0, so I8's
+# "loses at most one interval" must be violated — proves the I8 PASS
+# above is not vacuous.
+python hack/chaos_soak.py --seed 5 --rounds 2 --no-elastic \
+    --elastic-jobs 2 --expect-violation --out /dev/null
+
 echo "==> durability counter-proof (same kills, no durability -> I7 must break)"
 # The same fixed-seed kill schedule restarted from an EMPTY data dir
 # must lose in-window ticks (permanently_lost non-empty): proves the

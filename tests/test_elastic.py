@@ -1,0 +1,507 @@
+"""Elastic training (reshard-on-preemption) tests — the PR-7 pipeline end
+to end: ``replan`` mesh shrinking, the CheckpointStore flush-on-teardown
+durability guarantee, parallelism-independent cross-shape restore (save on
+8 devices, resume on 4 then 2 — the Tenplex property), the ``Preempted``
+signal the executor records, and the controller loop that turns that
+signal into a resume attempt on a strictly smaller mesh while history
+collapses the attempts into one logical run.
+
+All meshes are virtual CPU devices (conftest forces an 8-device host
+platform), so the full path runs in CI without TPU hardware.
+"""
+
+import time
+from itertools import repeat
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cron_operator_tpu.parallel.mesh import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    TENSOR_AXIS,
+    mesh_for_devices,
+    plan_for_devices,
+    replan,
+)
+from cron_operator_tpu.workloads.checkpoint import (
+    CheckpointStore,
+    flush_open_stores,
+)
+from cron_operator_tpu.workloads.train import TrainConfig, Trainer
+
+JAX_AV, JAX_KIND = "kubeflow.org/v1", "JAXJob"
+CRON_AV = "apps.kubedl.io/v1alpha1"
+
+
+def wait_for(fn, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError("condition not met in time")
+
+
+# ---------------------------------------------------------------------------
+# replan: the reshard plan
+# ---------------------------------------------------------------------------
+
+
+class TestReplan:
+    def test_data_axis_absorbs_shrink(self):
+        old = plan_for_devices(8, fsdp=2)  # data=4 x fsdp=2
+        new = replan(old, 4)
+        assert new.n_devices == 4
+        assert new.axis(FSDP_AXIS) == 2  # model axis preserved
+        assert new.axis(DATA_AXIS) == 2  # shrink landed on data
+
+    def test_model_axes_reduced_when_indivisible(self):
+        old = plan_for_devices(8, fsdp=4)
+        new = replan(old, 2)  # model par 4 cannot fit in 2
+        assert new.n_devices == 2
+        assert new.axis(FSDP_AXIS) == 2
+        assert new.axis(DATA_AXIS) == 1
+
+    def test_tensor_axis_survives_when_divisible(self):
+        old = plan_for_devices(8, tensor=2, fsdp=2)
+        new = replan(old, 4)
+        assert new.axis(TENSOR_AXIS) == 2
+        assert new.axis(FSDP_AXIS) == 2
+        assert new.axis(DATA_AXIS) == 1
+
+    def test_same_count_is_identity(self):
+        old = plan_for_devices(8, fsdp=2)
+        assert replan(old, 8) is old
+
+    def test_accepts_device_sequence(self):
+        old = plan_for_devices(8)
+        assert replan(old, jax.devices()[:2]).n_devices == 2
+
+    def test_grow_and_empty_rejected(self):
+        old = plan_for_devices(4)
+        with pytest.raises(ValueError):
+            replan(old, 8)  # scale-up is an explicit caller decision
+        with pytest.raises(ValueError):
+            replan(old, 0)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointStore: the flush guarantee (preempt/SIGTERM durability)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_state():
+    return {"w": jnp.arange(8, dtype=jnp.float32), "step": jnp.int32(3)}
+
+
+class TestFlushGuarantee:
+    def test_close_flushes_async_save(self, tmp_path):
+        store = CheckpointStore("ns", "job-a", root=str(tmp_path))
+        store.save(3, _tiny_state())
+        store.close()  # no explicit wait(): close IS the flush
+        fresh = CheckpointStore("ns", "job-a", root=str(tmp_path))
+        assert fresh.latest_step() == 3
+        raw = fresh._restore_raw(3)
+        assert np.array_equal(np.asarray(raw["w"]), np.arange(8))
+        fresh.close()
+
+    def test_flush_open_stores_drains_inflight(self, tmp_path):
+        store = CheckpointStore("ns", "job-b", root=str(tmp_path))
+        store.save(5, _tiny_state())
+        # The executor's preempt path: flush by (namespace, job) without
+        # holding the entrypoint's store reference.
+        assert flush_open_stores("ns", "job-b") >= 1
+        fresh = CheckpointStore("ns", "job-b", root=str(tmp_path))
+        assert fresh.latest_step() == 5
+        fresh.close()
+        store.close()
+
+    def test_close_deregisters(self, tmp_path):
+        store = CheckpointStore("ns", "job-c", root=str(tmp_path))
+        store.close()
+        assert flush_open_stores("ns", "job-c") == 0
+
+
+# ---------------------------------------------------------------------------
+# Cross-shape restore: save on 8 devices, resume on 4, then 2 (Tenplex)
+# ---------------------------------------------------------------------------
+
+DIM, CLASSES, BATCH = 16, 10, 8
+
+
+def _apply(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _sample(key):
+    kx, ky = jax.random.split(key)
+    return {
+        "x": jax.random.normal(kx, (BATCH, DIM), jnp.float32),
+        "y": jax.random.randint(ky, (BATCH,), 0, CLASSES),
+    }
+
+
+def _params0():
+    k = jax.random.PRNGKey(7)
+    return {
+        "w": jax.random.normal(k, (DIM, CLASSES), jnp.float32) * 0.1,
+        "b": jnp.zeros((CLASSES,), jnp.float32),
+    }
+
+
+def _trainer(n_devs, store):
+    mesh = mesh_for_devices(jax.devices()[:n_devs])
+    cfg = TrainConfig(
+        optimizer="sgd", learning_rate=0.05, save_every=4, data_seed=3
+    )
+    return Trainer(_apply, _params0(), mesh, cfg, checkpoint=store,
+                   sample_fn=_sample)
+
+
+def _losses(stats):
+    return {s.step: s.loss for s in stats if s.loss is not None}
+
+
+@pytest.fixture(scope="module")
+def cross_shape(tmp_path_factory):
+    """One elastic chain (8 → 4 → 2 devices) plus an uninterrupted
+    reference run, shared by the assertions below (compiling four train
+    steps once instead of per-test)."""
+    root = str(tmp_path_factory.mktemp("xshape"))
+
+    ref_store = CheckpointStore("t", "ref", root=root)
+    ref = _trainer(8, ref_store)
+    ref_losses = _losses(ref.run(repeat({}), 12))
+    ref_store.close()
+
+    s1 = CheckpointStore("t", "job", root=root)
+    t1 = _trainer(8, s1)
+    l1 = _losses(t1.run(repeat({}), 6))  # checkpoint lands at step 4
+    s1.close()
+
+    s2 = CheckpointStore("t", "job", root=root)
+    t2 = _trainer(4, s2)  # fresh manager: restore path, not save cache
+    resumed2 = t2.steps_done
+    # Snapshot what the 4-device mesh restored BEFORE it trains on.
+    restored4 = jax.tree_util.tree_map(np.asarray, t2.state.params)
+    raw8 = s2.restore_params(4)  # the step-4 save, as written on 8 devs
+    l2 = _losses(t2.run(repeat({}), 9))  # checkpoint lands at step 8
+    s2.close()
+
+    s3 = CheckpointStore("t", "job", root=root)
+    t3 = _trainer(2, s3)
+    resumed3 = t3.steps_done
+    l3 = _losses(t3.run(repeat({}), 12))
+    s3.close()
+
+    chain = {}
+    chain.update(l1)
+    chain.update(l2)
+    chain.update(l3)
+    return {
+        "ref": ref_losses,
+        "chain": chain,
+        "resumed": (resumed2, resumed3),
+        "raw8": raw8,
+        "restored4": restored4,
+    }
+
+
+class TestCrossShapeRestore:
+    def test_resumes_land_on_checkpoint_steps(self, cross_shape):
+        # 8-dev leg saved at 4 (ran to 6), 4-dev leg saved at 8 (ran to 9):
+        # each resume starts from the last completed save, losing at most
+        # steps since that save — never a completed one.
+        assert cross_shape["resumed"] == (4, 8)
+
+    def test_restored_params_bit_exact(self, cross_shape):
+        """The params the 4-device mesh restored are bit-for-bit the
+        params the 8-device mesh saved — resharding moves bytes, never
+        rounds them."""
+        raw8 = cross_shape["raw8"]  # host copy of the step-4 save
+        restored4 = cross_shape["restored4"]
+        assert set(raw8) == set(restored4) == {"w", "b"}
+        for leaf in ("w", "b"):
+            assert np.array_equal(
+                np.asarray(raw8[leaf]), restored4[leaf]
+            ), leaf
+
+    def test_loss_curve_continues(self, cross_shape):
+        ref, chain = cross_shape["ref"], cross_shape["chain"]
+        assert sorted(chain) == sorted(ref) == list(range(1, 13))
+        # Same-mesh prefix (steps 1-6 ran on the identical 8-dev mesh in
+        # both runs): bit-for-bit.
+        for step in range(1, 7):
+            assert np.float32(chain[step]) == np.float32(ref[step]), step
+        # Cross-mesh continuation: the batch at step k is derived from
+        # fold_in(data_seed, k) regardless of mesh, so the curve continues
+        # exactly up to summation order — a 1-ulp reduction-order wobble
+        # is the only permitted difference.
+        for step in range(7, 13):
+            assert np.isclose(
+                chain[step], ref[step], rtol=0.0, atol=1e-6
+            ), (step, chain[step], ref[step])
+
+
+class TestRestoreResharded:
+    def test_bitwise_roundtrip_onto_smaller_mesh(self, tmp_path):
+        """Direct unit for the host-side reshard fallback: every leaf the
+        2-device template receives equals the 8-device save exactly."""
+        mesh8 = mesh_for_devices(jax.devices()[:8])
+        state = {
+            "w": jax.device_put(
+                jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                jax.sharding.NamedSharding(
+                    mesh8, jax.sharding.PartitionSpec(DATA_AXIS)
+                ),
+            ),
+            "step": jnp.int32(9),
+        }
+        store = CheckpointStore("ns", "rt", root=str(tmp_path))
+        store.save(9, state)
+        store.wait()
+        store.close()
+
+        mesh2 = mesh_for_devices(jax.devices()[:2])
+        like = {
+            "w": jax.device_put(
+                jnp.zeros((8, 8), jnp.float32),
+                jax.sharding.NamedSharding(
+                    mesh2, jax.sharding.PartitionSpec(DATA_AXIS)
+                ),
+            ),
+            "step": jnp.int32(0),
+        }
+        fresh = CheckpointStore("ns", "rt", root=str(tmp_path))
+        out = fresh.restore_resharded(9, like)
+        fresh.close()
+        assert out["w"].sharding.mesh.devices.size == 2
+        assert np.array_equal(
+            np.asarray(out["w"]), np.asarray(state["w"])
+        )
+        assert int(out["step"]) == 9
+
+
+# ---------------------------------------------------------------------------
+# The Preempted signal (executor side)
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptedSignal:
+    def test_condition_record_and_metrics(self):
+        from cron_operator_tpu.backends.local import LocalExecutor
+        from cron_operator_tpu.runtime.faults import FaultInjector, FaultPlan
+        from cron_operator_tpu.runtime.kube import APIServer
+        from cron_operator_tpu.runtime.manager import Metrics
+
+        api = APIServer()
+        metrics = Metrics()
+        injector = FaultInjector(api, FaultPlan.quiet(seed=1))
+        injector.instrument(metrics)
+        ex = LocalExecutor(api, metrics=metrics)
+        ex.start()
+        try:
+            api.create({
+                "apiVersion": JAX_AV, "kind": JAX_KIND,
+                "metadata": {
+                    "name": "victim", "namespace": "default",
+                    "annotations": {
+                        "tpu.kubedl.io/simulate-duration": "30s",
+                    },
+                },
+                "spec": {},
+            })
+            wait_for(lambda: "Running" in [
+                c["type"] for c in (api.get(
+                    JAX_AV, JAX_KIND, "default", "victim"
+                ).get("status") or {}).get("conditions", [])
+            ])
+            prior = ex.capacity()
+            record = injector.inject_preempt(
+                ex, "default", "victim", lost_devices=2
+            )
+            obj = api.get(JAX_AV, JAX_KIND, "default", "victim")
+            conds = (obj.get("status") or {}).get("conditions") or []
+            types = [c["type"] for c in conds]
+            # Distinct Preempted cause, then the terminal outcome LAST
+            # (the Kubeflow convention reads the final condition as the
+            # job's status — "Preempted" must never be it).
+            assert "Preempted" in types
+            assert types[-1] == "Failed"
+            assert types.index("Preempted") < types.index("Failed")
+            by_type = {c["type"]: c for c in conds}
+            assert by_type["Preempted"]["reason"] == "TPUSlicePreempted"
+            assert by_type["Failed"]["reason"] == "TPUSlicePreempted"
+            # The capacity snapshot elastic resume replans against.
+            pre = (obj.get("status") or {}).get("preemption") or {}
+            assert pre["priorDevices"] == prior
+            assert pre["lostDevices"] == 2
+            assert pre["survivingDevices"] == prior - 2
+            assert pre["preemptedAt"]
+            assert record["survivingDevices"] == prior - 2
+            assert ex.capacity() == prior - 2
+            ex.restore_capacity()
+            assert ex.capacity() == prior
+            assert metrics.get("cron_workload_preemptions_total") == 1.0
+            assert metrics.get(
+                'faults_injected_total{kind="preempt"}'
+            ) == 1.0
+        finally:
+            ex.stop()
+
+
+# ---------------------------------------------------------------------------
+# End to end: preempt a cron's training job, resume on a smaller mesh
+# ---------------------------------------------------------------------------
+
+
+def _register_paced_entrypoint():
+    """A real training entrypoint (the full param/checkpoint/progress
+    surface via the entrypoints helpers) paced to ``param.pace_s`` per
+    step, so the preemption deterministically lands mid-run — the stock
+    workloads finish faster than the 1 s progress-publish throttle."""
+    from cron_operator_tpu.backends.registry import register_entrypoint
+    from cron_operator_tpu.workloads import entrypoints as eps
+
+    @register_entrypoint("test-elastic-paced")
+    def paced_train(ctx):
+        steps = int(ctx.params.get("steps", 20))
+        pace = float(ctx.params.get("pace_s", 0.05))
+        devs = eps._devices(ctx)
+        with jax.default_device(devs[0]):
+            mesh = eps._mesh(ctx, devs)
+            trainer = Trainer(
+                _apply, _params0(), mesh,
+                TrainConfig(**eps._train_kwargs(
+                    ctx, steps, optimizer="sgd", learning_rate=0.05,
+                    data_seed=3,
+                )),
+                checkpoint=eps._checkpoint_store(ctx),
+                sample_fn=_sample,  # fused: batches below only pace
+            )
+
+            def paced_batches():
+                while True:
+                    time.sleep(pace)
+                    yield {}
+
+            eps._run(ctx, trainer, paced_batches(), steps)
+
+
+class TestElasticEndToEnd:
+    def test_preempted_job_resumes_on_smaller_mesh(self, tmp_path):
+        from cron_operator_tpu.api.v1alpha1 import Cron
+        from cron_operator_tpu.backends.local import LocalExecutor
+        from cron_operator_tpu.controller.cron_controller import CronReconciler
+        from cron_operator_tpu.runtime.kube import APIServer
+        from cron_operator_tpu.runtime.manager import Metrics
+
+        _register_paced_entrypoint()
+        api = APIServer()  # real clock: training is real wall time
+        metrics = Metrics()
+        ex = LocalExecutor(api, metrics=metrics)
+        ex.start()
+        rec = CronReconciler(api, metrics=metrics)
+        try:
+            api.create({
+                "apiVersion": CRON_AV, "kind": "Cron",
+                "metadata": {"name": "elastic", "namespace": "default"},
+                "spec": {
+                    "schedule": "@every 1s",
+                    "concurrencyPolicy": "Forbid",
+                    "template": {"workload": {
+                        "apiVersion": JAX_AV, "kind": JAX_KIND,
+                        "metadata": {"annotations": {
+                            "tpu.kubedl.io/entrypoint": "test-elastic-paced",
+                            "tpu.kubedl.io/elastic-resume": "true",
+                            "tpu.kubedl.io/param.steps": "60",
+                            "tpu.kubedl.io/param.pace_s": "0.05",
+                            "tpu.kubedl.io/param.save_every": "3",
+                            "tpu.kubedl.io/param.checkpoint": "1",
+                            "tpu.kubedl.io/param.checkpoint_dir": str(tmp_path),
+                            "tpu.kubedl.io/param.platform": "cpu",
+                            "tpu.kubedl.io/param.fsdp": "2",
+                        }},
+                        "spec": {},
+                    }},
+                },
+            })
+
+            def sweep():
+                rec.reconcile("default", "elastic")
+
+            def progress(name):
+                obj = api.try_get(JAX_AV, JAX_KIND, "default", name)
+                if obj is None:
+                    return {}
+                return (obj.get("status") or {}).get(
+                    "trainingProgress"
+                ) or {}
+
+            # Fire the first tick (real clock, @every 1s).
+            def tick():
+                sweep()
+                return api.list(JAX_AV, JAX_KIND, namespace="default")
+
+            jobs = wait_for(tick, timeout=15.0, interval=0.3)
+            root = jobs[0]["metadata"]["name"]
+
+            # Let it clear the first checkpoint interval, then preempt
+            # half the slice away mid-run.
+            wait_for(
+                lambda: int(progress(root).get("steps_done") or 0) >= 5,
+                timeout=90.0,
+            )
+            record = ex.preempt("default", root, lost_devices=4)
+            assert record["survivingDevices"] == 4
+
+            # One sweep against the degraded capacity submits the resume.
+            sweep()
+            rname = f"{root}-r1"
+            rj = api.get(JAX_AV, JAX_KIND, "default", rname)
+            ann = rj["metadata"]["annotations"]
+            assert ann["tpu.kubedl.io/resume-of"] == root
+            assert ann["tpu.kubedl.io/resume-attempt"] == "1"
+            assert ann["tpu.kubedl.io/param.devices"] == "4"  # smaller mesh
+            assert ann["tpu.kubedl.io/param.fsdp"] == "2"  # model axis kept
+            assert ann["tpu.kubedl.io/param.checkpoint_job"] == root
+            # While the resume is in flight: it is the cron's active run
+            # and the logical run stays OUT of history.
+            cron = Cron.from_dict(
+                api.get(CRON_AV, "Cron", "default", "elastic")
+            )
+            assert [a.name for a in cron.status.active] == [rname]
+            assert cron.status.history == []
+
+            def done():
+                conds = (api.get(
+                    JAX_AV, JAX_KIND, "default", rname
+                ).get("status") or {}).get("conditions") or []
+                return conds and conds[-1]["type"] in (
+                    "Succeeded", "Failed"
+                )
+
+            wait_for(done, timeout=120.0)
+            sweep()
+
+            prog = progress(rname)
+            # Resumed from the latest completed save, not step 0, and
+            # trained through to the original target.
+            assert int(prog.get("resumed_from_step") or 0) >= 3
+            assert int(prog.get("steps_done") or 0) == 60
+            cron = Cron.from_dict(
+                api.get(CRON_AV, "Cron", "default", "elastic")
+            )
+            hist = cron.status.history
+            assert len(hist) == 1  # one LOGICAL run, not two attempts
+            assert hist[0].status == "Succeeded"
+            assert hist[0].resumes == 1
+            assert hist[0].last_resumed_at is not None
+            assert hist[0].object.name == root  # keyed by the root attempt
+            assert metrics.get("cron_workload_resumes_total") == 1.0
+        finally:
+            ex.stop()

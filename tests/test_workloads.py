@@ -669,6 +669,8 @@ class TestStepsPerCall:
                 tr.step(batch, chunk=4)
 
 
+@pytest.mark.slow  # re-exec without a platform pin makes jax's TPU init
+# retry GCP metadata for minutes on hosts with libtpu but no TPU
 class TestTpuProbeSelfHeal:
     def test_stale_platform_pin_heals_to_registered_backend(self):
         """JAX_PLATFORMS naming an unregistered platform must re-exec
