@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards chaos-soak chaos-soak-preempt
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards chaos-soak chaos-soak-preempt obs-report
 
 all: gate
 
@@ -95,3 +95,13 @@ chaos-soak-preempt:
 	python hack/chaos_soak.py --seed $(or $(SEED),5) \
 	    --rounds $(or $(ROUNDS),2) --no-elastic \
 	    --elastic-jobs $(or $(JOBS),3) --expect-violation --out /dev/null
+
+# Observability / SLO report (hack/obs_report.py -> BENCH_OBS.json): the
+# flight-recorder scenario (audit ≡ WAL cross-check, lineage traces,
+# follower-lag drain) and scheduling-SLO fast legs, plus a real CPU-mesh
+# goodput leg (preempt-storm training, productive/elapsed steps vs the
+# GOODPUT_FLOOR). One OK/REGRESSION verdict over every leg; CHECK=1 runs
+# the fast legs only and fails on REGRESSION (the CI-gate smoke).
+obs-report:
+	python hack/obs_report.py $(if $(CHECK),--check) \
+	    $(if $(SEED),--seed $(SEED))

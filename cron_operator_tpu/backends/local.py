@@ -80,10 +80,14 @@ class LocalExecutor:
     def __init__(self, api: APIServer, scheme=None, isolation: str = "thread",
                  metrics: Optional[Any] = None,
                  tracer: Optional[Any] = None,
-                 gang_slots: Optional[int] = None):
+                 gang_slots: Optional[int] = None,
+                 audit: Optional[Any] = None):
         if isolation not in ("thread", "subprocess"):
             raise ValueError(f"unknown isolation mode {isolation!r}")
         self.isolation = isolation
+        # Audit journal (telemetry.AuditJournal-compatible): preemptions
+        # land as "decision" records with the lost/surviving capacity.
+        self.audit = audit
         # Thread-isolation entrypoints share ONE in-process jax client.
         # Two sharded programs dispatching collectives over the same host
         # devices from different threads can deadlock inside the runtime
@@ -388,6 +392,7 @@ class LocalExecutor:
             now_s = _time.time()
             ctx.progress.setdefault("started_at", now_s)
             ctx.progress.setdefault("first_step_at", now_s)
+            ctx.progress.setdefault("first_step_latency_s", 0.0)
             if ctx.publish:
                 ctx.publish()
             # sleep in small increments so cancellation is prompt
@@ -684,7 +689,15 @@ class LocalExecutor:
             phases["queue"] = started - created.timestamp()
         if compile_s is not None and float(compile_s) >= 0:
             phases["compile"] = float(compile_s)
-        if float(first) >= started:
+        # Prefer the entrypoint's monotonic-derived latency: the wall
+        # timestamps exist for cross-process alignment, and a wall jump
+        # between start and first step would distort (or negative-clamp
+        # away) the phase sample. The wall difference remains as the
+        # fallback for progress streams from older runners.
+        first_latency = p.get("first_step_latency_s")
+        if first_latency is not None and float(first_latency) >= 0:
+            phases["first_step"] = float(first_latency)
+        elif float(first) >= started:
             phases["first_step"] = float(first) - started
 
         if self.metrics is not None:
@@ -866,6 +879,15 @@ class LocalExecutor:
         if self.metrics is not None:
             self.metrics.inc("cron_workload_preemptions_total")
         ann = (obj.get("metadata") or {}).get("annotations") or {}
+        if self.audit is not None:
+            self.audit.record(
+                "decision", "preempt",
+                key=f"{api_version}/{kind}/{namespace}/{name}",
+                trace_id=ann.get(ANNOTATION_TRACE_ID),
+                reason="TPUSlicePreempted",
+                prior_devices=prior, lost_devices=lost_devices,
+                surviving_devices=surviving,
+            )
         restart = (ann.get(ANNOTATION_RESTART_ON_PREEMPTION, "").lower()
                    in ("1", "true", "yes"))
         # Distinct Preempted condition first (never the LAST entry — the

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import hmac
+import inspect
 import json
 import logging
 import os as _os
@@ -22,6 +23,7 @@ import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
+from urllib.parse import parse_qs
 
 from cron_operator_tpu import __version__
 
@@ -68,6 +70,16 @@ def _watched_tls(cert_path, cert_name, cert_key, enable_http2, log, what):
     return ctx, watcher
 
 
+def _takes_params(fn) -> bool:
+    """True iff a route callable declares a (query-params) parameter.
+    Resolved once per request, not per route registration, so plain
+    zero-arg lambdas keep working unchanged."""
+    try:
+        return bool(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):  # builtins without a signature
+        return False
+
+
 def _serve(
     port: int,
     routes,
@@ -83,7 +95,13 @@ def _serve(
     ``Authorization: Bearer <token>`` (embedded mode); ``authn`` is a
     callable(authorization_header) -> bool for kube-delegated
     TokenReview/SubjectAccessReview (cluster mode,
-    runtime.authfilter.ScrapeAuthenticator). 401 otherwise."""
+    runtime.authfilter.ScrapeAuthenticator). 401 otherwise.
+
+    Routes map an exact path to a zero-arg callable returning
+    ``(body, content_type)``; a callable declaring a parameter instead
+    receives the parsed query string (``urllib.parse.parse_qs`` shape) —
+    how the filterable debug routes (``/debug/audit``) take their
+    ``?kind=&trace=&limit=`` params."""
 
     def _denied(headers) -> bool:
         if token is not None:
@@ -108,12 +126,16 @@ def _serve(
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            fn = routes.get(self.path)
+            path, _, query = self.path.partition("?")
+            fn = routes.get(path)
             if fn is None:
                 self.send_response(404)
                 self.end_headers()
                 return
-            body, ctype = fn()
+            if _takes_params(fn):
+                body, ctype = fn(parse_qs(query))
+            else:
+                body, ctype = fn()
             data = body.encode()
             self.send_response(200)
             self.send_header("Content-Type", ctype)
@@ -270,6 +292,12 @@ def build_parser() -> argparse.ArgumentParser:
                             "replay the shard's WAL byte stream "
                             "continuously and are promotable on leader "
                             "failure; requires --data-dir")
+    start.add_argument("--audit-log", default=None, metavar="FILE",
+                       help="append every audit record (committed store "
+                            "verbs, controller decisions, cluster events) "
+                            "as one JSON line to FILE — the durable "
+                            "flight-recorder tape. Unset = in-memory ring "
+                            "only (always on; served at /debug/audit)")
 
     # kubectl-style inspection for standalone mode: the reference relies
     # on kubectl + CRD printcolumns (cron_types.go:33-36); with no
@@ -409,12 +437,15 @@ def cmd_start(args: argparse.Namespace) -> int:
         log.error("--shards must be >= 1, got %d", args.shards)
         return 2
 
-    # One tracer per process: the cron tick's trace id links reconcile/
-    # submit spans (controller) to compile/first-step spans (backend) on
-    # /debug/traces.
-    from cron_operator_tpu.telemetry import Tracer
+    # One tracer + one audit journal per process: the cron tick's trace
+    # id links reconcile/submit spans (controller) to compile/first-step
+    # spans (backend) on /debug/traces, and the journal records every
+    # committed store verb / controller decision / cluster event for
+    # /debug/audit (optionally tee'd to --audit-log as JSONL).
+    from cron_operator_tpu.telemetry import AuditJournal, Tracer
 
     tracer = Tracer()
+    journal = AuditJournal(sink_path=args.audit_log or None)
 
     persistence = None
     recovered = None
@@ -433,10 +464,13 @@ def cmd_start(args: argparse.Namespace) -> int:
         )
 
         shared_metrics = Metrics()
+        tracer.instrument(shared_metrics)
+        journal.instrument(shared_metrics)
         try:
             plane = ShardedControlPlane(
                 n_shards=args.shards, replicas=args.replicas,
                 data_dir=args.data_dir, metrics=shared_metrics,
+                audit=journal, tracer=tracer,
             )
         except ValueError as err:
             log.error("%s", err)
@@ -479,11 +513,16 @@ def cmd_start(args: argparse.Namespace) -> int:
                 leader_elect=args.leader_elect,
                 recovering=s.recovered is not None and not s.recovered.empty,
                 metrics=ShardMetrics(shared_metrics, i),
+                audit=journal.shard_view(i),
             )
+            # The shard's audit view stamps every record with the shard
+            # index; /debug/shards names this manager as the leader.
+            s.leader = m.identity
             # Each shard's reconciler talks DIRECTLY to its shard's
             # backend: workloads land on their owner's shard, keeping
             # ownerReferences and cascade delete intra-shard.
-            rec = CronReconciler(backend, metrics=m.metrics, tracer=tracer)
+            rec = CronReconciler(backend, metrics=m.metrics, tracer=tracer,
+                                 audit=journal.shard_view(i))
             m.add_controller(
                 "cron",
                 rec.reconcile,
@@ -501,8 +540,11 @@ def cmd_start(args: argparse.Namespace) -> int:
             from cron_operator_tpu.runtime.persistence import Persistence
 
             # Attach to the raw store (before any chaos wrapper): the WAL
-            # hooks live inside APIServer's commit path.
+            # hooks live inside APIServer's commit path. The audit hook
+            # goes on FIRST so recovery itself lands in the journal as a
+            # crash_recovery cluster event.
             persistence = Persistence(args.data_dir)
+            persistence.attach_audit(journal)
             recovered = persistence.start(api)
             if recovered.empty:
                 log.info("durability: empty data dir %s; starting fresh",
@@ -515,6 +557,14 @@ def cmd_start(args: argparse.Namespace) -> int:
                     recovered.had_snapshot, recovered.wal_records_replayed,
                     recovered.torn_records_dropped,
                 )
+
+        # The raw (unwrapped) store backs /debug/shards in single-shard
+        # mode; the audit hook rides the commit path, so it too attaches
+        # before any chaos wrapper. Cluster mode has no embedded commit
+        # path — the journal still records controller decisions there.
+        raw_store = api
+        if args.api_server != "cluster":
+            api.attach_audit(journal)
 
         if args.chaos_seed is not None:
             if args.api_server == "cluster":
@@ -541,9 +591,12 @@ def cmd_start(args: argparse.Namespace) -> int:
             # After recovering real state, hold readyz until the catch-up
             # enqueue sweep drains once (missed ticks fired/skipped).
             recovering=recovered is not None and not recovered.empty,
+            audit=journal,
         )
+        tracer.instrument(manager.metrics)
+        journal.instrument(manager.metrics)
         reconciler = CronReconciler(api, metrics=manager.metrics,
-                                    tracer=tracer)
+                                    tracer=tracer, audit=journal)
         manager.add_controller(
             "cron",
             reconciler.reconcile,
@@ -592,8 +645,40 @@ def cmd_start(args: argparse.Namespace) -> int:
         executor_metrics = (
             shared_metrics if sharded else manager.metrics  # noqa: F821
         )
-        executor = LocalExecutor(api, metrics=executor_metrics, tracer=tracer)
+        executor = LocalExecutor(api, metrics=executor_metrics, tracer=tracer,
+                                 audit=journal)
         executor.start()
+
+    def _debug_shards_json() -> str:
+        # Sharded: the plane owns the authoritative per-shard view
+        # (WAL stats, follower lag, failover counts). Single store:
+        # synthesize the same shape so dashboards/scripts need not
+        # branch on topology.
+        if plane is not None:
+            return plane.render_debug_json()
+        store = raw_store
+        entry = {
+            "shard": 0,
+            "objects": len(store) if hasattr(store, "__len__") else None,
+            "rv": int(getattr(store, "_rv", 0)),
+            "failovers": 0,
+            "leader": manager.identity,
+            "data_dir": args.data_dir or None,
+        }
+        if persistence is not None:
+            entry["wal"] = persistence.stats()
+            entry["wal_buffered_bytes"] = persistence.buffered_bytes()
+        return json.dumps(
+            {
+                "n_shards": 1,
+                "replicas": 0,
+                "composite_rv": entry["rv"],
+                "objects": entry["objects"],
+                "shards": [entry],
+            },
+            indent=2,
+            default=str,
+        )
 
     servers: List[ThreadingHTTPServer] = []
     health_port = _parse_bind(args.health_probe_bind_address)
@@ -706,6 +791,16 @@ def cmd_start(args: argparse.Namespace) -> int:
                     # quantities (same TLS/token gate as /metrics).
                     "/debug/traces": lambda: (
                         tracer.render_json(), "application/json"
+                    ),
+                    # Flight recorder: typed audit records with filter
+                    # params (?kind=&event=&trace=&shard=&key=&limit=).
+                    "/debug/audit": lambda params: (
+                        journal.render_json(params), "application/json"
+                    ),
+                    # Per-shard durability view: rv, WAL stats, follower
+                    # replication lag, leader identity.
+                    "/debug/shards": lambda: (
+                        _debug_shards_json(), "application/json"
                     ),
                 },
                 "metrics",

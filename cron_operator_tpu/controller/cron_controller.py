@@ -121,7 +121,8 @@ class CronReconciler:
 
     def __init__(self, api: APIServer, clock: Optional[Clock] = None,
                  metrics: Optional[Any] = None,
-                 tracer: Optional[Any] = None):
+                 tracer: Optional[Any] = None,
+                 audit: Optional[Any] = None):
         self.api = api
         self.clock = clock or api.clock
         # Domain metrics (runtime.manager.Metrics-compatible). The reference
@@ -132,6 +133,11 @@ class CronReconciler:
         # tick records "reconcile" and "submit" spans under the trace id
         # stamped on the created workload.
         self.tracer = tracer
+        # Audit journal (telemetry.AuditJournal-compatible). Every
+        # controller *decision* — tick fired/skipped(+reason), submit
+        # retry exhaustion, resume, replace/GC deletes — lands as one
+        # "decision" record next to the store verbs it caused.
+        self.audit = audit
         # De-dup state for per-tick (not per-reconcile) metric counting: the
         # same missed tick is re-observed by every reconcile until it fires
         # or is superseded.
@@ -146,10 +152,18 @@ class CronReconciler:
         # Logical runs whose resume budget ran out — the Warning event
         # fires once per run, not once per reconcile of a terminal state.
         self._resume_exhausted: set = set()
+        # Resume-attempt UIDs whose lineage span has been recorded (the
+        # span waits for the attempt's trainingProgress to show where it
+        # actually resumed, so it's recorded lazily, exactly once).
+        self._resume_span_recorded: set = set()
 
     def _count(self, name: str, value: float = 1.0) -> None:
         if self.metrics is not None:
             self.metrics.inc(name, value)
+
+    def _audit(self, event: str, **kw: Any) -> None:
+        if self.audit is not None:
+            self.audit.record("decision", event, **kw)
 
     def _note_skipped_tick(self, ns: str, name: str,
                            missed_run: datetime) -> bool:
@@ -281,6 +295,7 @@ class CronReconciler:
         )
 
         self._observe_first_step_latency((ns, name), workloads)
+        self._record_resume_spans(workloads)
 
         # Elastic resume (reshard-on-preemption): a preempted attempt is a
         # *continuation* of its logical run, not a new tick — so it is
@@ -347,6 +362,11 @@ class CronReconciler:
                 self._count(
                     'cron_ticks_skipped_total{policy="StartingDeadline"}'
                 )
+                self._audit(
+                    "tick_skipped", reason="StartingDeadline",
+                    key=f"{API_VERSION}/{KIND_CRON}/{ns}/{name}",
+                    tick=str(missed_run),
+                )
                 self.api.record_event(
                     cron.to_dict(),
                     "Warning",
@@ -367,6 +387,11 @@ class CronReconciler:
             # (the same pending tick is re-seen until it fires/expires).
             if self._note_skipped_tick(ns, name, missed_run):
                 self._count('cron_ticks_skipped_total{policy="Forbid"}')
+                self._audit(
+                    "tick_skipped", reason="Forbid",
+                    key=f"{API_VERSION}/{KIND_CRON}/{ns}/{name}",
+                    tick=str(missed_run), active=len(active),
+                )
             return scheduled
 
         if cron.spec.concurrency_policy == ConcurrencyPolicy.REPLACE:
@@ -400,6 +425,14 @@ class CronReconciler:
                         propagation="Background",
                     )
                     self._count("cron_workloads_replaced_total")
+                    self._audit(
+                        "replace_delete", reason="Replace",
+                        key=(f"{w.get('apiVersion', '')}/{w.get('kind', '')}"
+                             f"/{meta.get('namespace', ns)}"
+                             f"/{meta.get('name', '')}"),
+                        trace_id=(meta.get("annotations") or {}).get(
+                            ANNOTATION_TRACE_ID),
+                    )
                 except NotFoundError:
                     pass  # already gone is fine
 
@@ -439,6 +472,13 @@ class CronReconciler:
         try:
             self._submit_workload(cron, gvk, workload, log)
             self._count("cron_ticks_fired_total")
+            self._audit(
+                "tick_fired", trace_id=trace_id,
+                key=(f"{workload.get('apiVersion', '')}"
+                     f"/{workload.get('kind', '')}/{ns}"
+                     f"/{workload['metadata']['name']}"),
+                cron=f"{ns}/{name}", tick=str(missed_run),
+            )
             if missed_count > 1:
                 # Ticks the catch-up loop passed over; counted only when the
                 # latest one actually fires (lastScheduleTime advances), so
@@ -480,9 +520,16 @@ class CronReconciler:
         the rate-limited-requeue path). AlreadyExists propagates on the
         first attempt — it is a semantic answer, not a transient."""
         wl_name = (workload.get("metadata") or {}).get("name", "")
+        wl_meta = workload.get("metadata") or {}
+        wl_key = (f"{workload.get('apiVersion', '')}/"
+                  f"{workload.get('kind', '')}/"
+                  f"{wl_meta.get('namespace', '')}/{wl_name}")
+        wl_trace = (wl_meta.get("annotations") or {}).get(ANNOTATION_TRACE_ID)
         for attempt in range(SUBMIT_ATTEMPTS):
             try:
                 self.api.create(workload)
+                self._audit("submit", key=wl_key, trace_id=wl_trace,
+                            attempt=attempt + 1)
                 return
             except ServerTimeoutError as err:
                 if attempt == SUBMIT_ATTEMPTS - 1:
@@ -492,6 +539,11 @@ class CronReconciler:
                         "SubmitRetriesExhausted",
                         f"giving up creating {gvk.kind} {wl_name} after "
                         f"{SUBMIT_ATTEMPTS} attempts: {err}",
+                    )
+                    self._audit(
+                        "submit_retries_exhausted", key=wl_key,
+                        trace_id=wl_trace, reason=str(err),
+                        attempts=SUBMIT_ATTEMPTS,
                     )
                     raise
                 self._count("cron_submit_retries_total")
@@ -585,6 +637,80 @@ class CronReconciler:
             # list — they can never be re-listed, so no double count).
             for uid in [u for u in observed if u not in live]:
                 del observed[uid]
+
+    def _record_resume_spans(self, workloads: List[Unstructured]) -> None:
+        """Record one ``resume`` span per resume attempt, under the trace
+        id the attempt inherited from its root (lineage propagation in
+        ``_new_resume_attempt``), so ``/debug/traces`` renders the whole
+        preempt→resume chain as a single tree.
+
+        Recorded lazily: the span's ``resumed_from_step`` is only known
+        once the successor's ``status.trainingProgress`` appears, so each
+        reconcile sweep records whichever attempts have started since —
+        exactly once per workload UID. ``pre_steps`` (the preempted
+        predecessor's last step) comes from the predecessor object when
+        it still exists, making ``wasted_steps = pre_steps -
+        resumed_from_step`` — training the predecessor did past its last
+        durable checkpoint — fall straight out."""
+        if self.tracer is None:
+            return
+        by_name: Dict[str, Unstructured] = {}
+        for w in workloads:
+            by_name[(w.get("metadata") or {}).get("name", "")] = w
+        for w in workloads:
+            meta = w.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            attempt = self._attempt_number(w)
+            uid = meta.get("uid")
+            if attempt < 1 or not uid \
+                    or uid in self._resume_span_recorded:
+                continue
+            trace_id = ann.get(ANNOTATION_TRACE_ID)
+            if not trace_id:
+                continue
+            progress = (w.get("status") or {}).get("trainingProgress") or {}
+            if "resumed_from_step" not in progress \
+                    and "steps_done" not in progress:
+                continue  # not started yet; next reconcile retries
+            try:
+                start_step = int(progress.get("resumed_from_step") or 0)
+            except (TypeError, ValueError):
+                start_step = 0
+            root = ann.get(ANNOTATION_RESUME_OF) or logical_run_root(
+                meta.get("name", ""), ann
+            )
+            pred_name = root if attempt == 1 else f"{root}-r{attempt - 1}"
+            pre_steps = start_step
+            pred = by_name.get(pred_name)
+            if pred is not None:
+                pprog = (pred.get("status") or {}).get(
+                    "trainingProgress") or {}
+                try:
+                    pre_steps = int(pprog.get("steps_done") or start_step)
+                except (TypeError, ValueError):
+                    pass
+            created = parse_time(meta.get("creationTimestamp"))
+            start_s = created.timestamp() if created is not None \
+                else time.time()
+            end_s = progress.get("first_step_at") \
+                or progress.get("started_at") or start_s
+            self.tracer.record(
+                "resume", trace_id, start_s, float(end_s),
+                attrs={
+                    "attempt": attempt,
+                    "workload": meta.get("name", ""),
+                    "resumed_from_step": start_step,
+                    "pre_steps": pre_steps,
+                    "wasted_steps": max(0, pre_steps - start_step),
+                },
+            )
+            self._resume_span_recorded.add(uid)
+        if len(self._resume_span_recorded) > 4096:
+            # Deleted workloads can never be re-listed; drop their UIDs.
+            live = {
+                (w.get("metadata") or {}).get("uid") for w in workloads
+            }
+            self._resume_span_recorded &= live
 
     # -- elastic resume (reshard-on-preemption) -----------------------------
 
@@ -722,6 +848,18 @@ class CronReconciler:
                 log.info("resume attempt %s already exists", rname)
                 continue
             self._count("cron_workload_resumes_total")
+            self._audit(
+                "resume",
+                key=(f"{resume.get('apiVersion', gvk.api_version)}"
+                     f"/{resume.get('kind', gvk.kind)}"
+                     f"/{cron.metadata.namespace}/{rname}"),
+                trace_id=(resume.get("metadata", {}).get("annotations")
+                          or {}).get(ANNOTATION_TRACE_ID),
+                reason="TPUSlicePreempted",
+                root=root, attempt=next_no,
+                surviving_devices=record.get("survivingDevices"),
+                lost_devices=record.get("lostDevices"),
+            )
             surviving = record.get("survivingDevices")
             self.api.record_event(
                 cron.to_dict(),
@@ -784,8 +922,13 @@ class CronReconciler:
         # attempt's checkpoint lineage — this is the resume-from-checkpoint
         # contract the runner env inherits as TPU_PARAM_CHECKPOINT_JOB.
         ann.setdefault(PARAM_ANNOTATION_PREFIX + "checkpoint_job", root)
-        # Fresh trace id: the resume is a new submission, telemetry-wise.
-        ann[ANNOTATION_TRACE_ID] = new_trace_id()
+        # Lineage propagation: the resume CONTINUES the root attempt's
+        # trace — the deepcopy above already carries the predecessor's id
+        # (itself propagated from the root), so /debug/traces renders one
+        # preempt→resume chain as a single tree. Mint fresh only when the
+        # lineage has no id (workload created outside the controller).
+        if not ann.get(ANNOTATION_TRACE_ID):
+            ann[ANNOTATION_TRACE_ID] = new_trace_id()
 
         try:
             surviving = int(record.get("survivingDevices") or 0)
@@ -1000,6 +1143,16 @@ class CronReconciler:
                             propagation="Background",
                         )
                         self._count("cron_history_gc_deleted_total")
+                        self._audit(
+                            "gc_delete", reason="HistoryLimit",
+                            key=(f"{w.get('apiVersion', '')}"
+                                 f"/{w.get('kind', '')}"
+                                 f"/{meta.get('namespace', '')}"
+                                 f"/{meta.get('name', '')}"),
+                            trace_id=(meta.get("annotations") or {}).get(
+                                ANNOTATION_TRACE_ID),
+                            run=root,
+                        )
                     except NotFoundError:
                         pass
                 continue

@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from cron_operator_tpu.api.v1alpha1 import rfc3339
 from cron_operator_tpu.runtime.frozen import freeze, freeze_delta, thaw
+from cron_operator_tpu.telemetry.trace import ANNOTATION_TRACE_ID
 from cron_operator_tpu.utils.clock import Clock, RealClock
 
 Unstructured = Dict[str, Any]
@@ -247,6 +248,11 @@ class APIServer:
         # BEFORE the in-memory commit — see _persist_put for the ordering
         # contract — and snapshot rotation piggybacks on the write path.
         self._wal = None
+        # Optional flight recorder (telemetry.audit.AuditJournal). When
+        # attached, every committed verb is audited right after its WAL
+        # append, under the same store lock — audit order == WAL order
+        # == commit order, which is what makes audit ≡ WAL checkable.
+        self._audit = None
 
     # ---- metrics ----------------------------------------------------------
 
@@ -346,6 +352,43 @@ class APIServer:
         wal = self._wal
         if wal is not None:
             wal.append_delete(key, self._rv)
+
+    # ---- audit ------------------------------------------------------------
+
+    def attach_audit(self, audit) -> None:
+        """Attach a :class:`telemetry.audit.AuditJournal` (or a shard
+        view of one). Every committed verb is then recorded as a typed
+        audit record carrying the object's trace id, this store's shard
+        index (from the view), the committed resourceVersion, and the
+        WAL position of the verb's durable record. Semantic no-op status
+        patches return before the WAL *and* before this hook, so a
+        steady-state sweep audits nothing."""
+        with self._lock:
+            self._audit = audit
+
+    def _audit_commit(self, verb: str, committed: Unstructured) -> None:
+        """Audit hook for every verb. Called with the store lock held,
+        AFTER the WAL append succeeded and the in-memory commit applied:
+        a kill-point mid-append raises before this line, so the journal
+        only ever records verbs that actually committed (the WAL may
+        carry at most the one in-flight crash record the audit lacks —
+        wal_check's ``crash_tail`` tolerance)."""
+        audit = self._audit
+        if audit is None:
+            return
+        meta = committed.get("metadata") or {}
+        wal = self._wal
+        audit.record(
+            "store", verb,
+            key=(f"{committed.get('apiVersion', '')}/"
+                 f"{committed.get('kind', '')}/"
+                 f"{meta.get('namespace', '')}/{meta.get('name', '')}"),
+            trace_id=(meta.get("annotations") or {}).get(
+                ANNOTATION_TRACE_ID
+            ),
+            wal_pos=wal.records_appended if wal is not None else None,
+            rv=int(meta.get("resourceVersion") or 0),
+        )
 
     def _maybe_rotate(self) -> None:
         """Compact when the WAL passes its rotation threshold. Called with
@@ -667,6 +710,7 @@ class APIServer:
             self._persist_put("create", committed)
             self._commit(key, committed)
             self._count_commit("create")
+            self._audit_commit("create", committed)
             self._notify("ADDED", committed)
             self._maybe_rotate()
             # `obj` carries the server-set metadata (uid/rv/timestamp) in
@@ -832,6 +876,7 @@ class APIServer:
             self._persist_put("update", committed)
             self._commit(key, committed)
             self._count_commit("update")
+            self._audit_commit("update", committed)
             self._notify("MODIFIED", committed)
             self._maybe_rotate()
             return obj
@@ -877,6 +922,7 @@ class APIServer:
             self._persist_put("patch_status", committed)
             self._commit(key, committed)
             self._count_commit("patch_status")
+            self._audit_commit("patch_status", committed)
             self._notify("MODIFIED", committed)
             self._maybe_rotate()
             return committed
@@ -903,6 +949,7 @@ class APIServer:
             self._persist_delete(key)
             self._evict(key)
             self._count_commit("delete")
+            self._audit_commit("delete", final)
             self._notify("DELETED", final)
             self._maybe_rotate()
             if propagation in ("Background", "Foreground"):
@@ -924,6 +971,7 @@ class APIServer:
             final = self._bump_rv_version(dep)
             self._persist_delete(k)
             self._evict(k)
+            self._audit_commit("cascade_delete", final)
             self._notify("DELETED", final)
             self._maybe_rotate()
             self._cascade_delete(dep["metadata"].get("uid"), namespace)

@@ -158,6 +158,44 @@ _FAMILY_META: Dict[str, tuple] = {
         "counter", "Shard leader failovers: a WAL-shipping follower "
                    "promoted to serve its partition after the leader "
                    "died (label shard=N)"),
+    "audit_records_total": (
+        "counter", "Audit-journal records appended (label kind: store, "
+                   "decision, cluster) — the control-plane flight "
+                   "recorder (telemetry/audit.py)"),
+    "audit_records_dropped_total": (
+        "counter", "Audit records evicted from the bounded in-process "
+                   "ring (oldest-first; the optional JSONL sink keeps "
+                   "everything)"),
+    "trace_spans_dropped_total": (
+        "counter", "Finished trace spans evicted from the bounded span "
+                   "store (oldest-first FIFO)"),
+    "wal_append_seconds": (
+        "histogram", "WAL record serialize+append latency (buffer write; "
+                     "group-commit fsync is wal_fsync_seconds; sharded "
+                     "deployments add a shard=N label)"),
+    "wal_fsync_seconds": (
+        "histogram", "WAL group-commit fsync latency (sharded "
+                     "deployments add a shard=N label)"),
+    "wal_snapshot_seconds": (
+        "histogram", "Snapshot compaction duration: serialize + fsync + "
+                     "atomic rename + WAL truncation (sharded "
+                     "deployments add a shard=N label)"),
+    "shard_follower_lag_records": (
+        "gauge", "WAL records the hot-standby follower is behind its "
+                 "shard leader (durable appends not yet applied; label "
+                 "shard=N)"),
+    "shard_follower_lag_bytes": (
+        "gauge", "Bytes of shipped-but-unparsed WAL buffered at the "
+                 "follower plus leader bytes not yet shipped (label "
+                 "shard=N)"),
+    "shard_follower_lag_seconds": (
+        "gauge", "Seconds since the oldest leader append the follower "
+                 "has not applied (0 when caught up; label shard=N)"),
+    "shard_failover_duration_seconds": (
+        "histogram", "End-to-end failover timeline: leader death "
+                     "detected -> follower promoted -> catch-up "
+                     "verified -> serving (label shard=N); the phase "
+                     "breakdown is recorded as failover trace spans"),
 }
 
 
@@ -332,6 +370,7 @@ class Manager:
         lease_duration_s: float = 15.0,
         recovering: bool = False,
         metrics: Optional[Metrics] = None,
+        audit=None,
     ):
         self.api = api
         self.max_concurrent_reconciles = max_concurrent_reconciles
@@ -343,6 +382,9 @@ class Manager:
         # control plane: each shard's manager records into the process
         # registry through a shard-labeling view, runtime/shard.py).
         self.metrics = metrics if metrics is not None else Metrics()
+        # Flight recorder (telemetry/audit.py): lease transitions and
+        # watch resyncs are audited as cluster events when attached.
+        self.audit = audit
         self._controllers: List[_Controller] = []
         # GenerationChangedPredicate state: last seen metadata.generation
         # per For-kind object. A MODIFIED event whose generation did not
@@ -542,6 +584,11 @@ class Manager:
         if from_watch_error:
             self.metrics.inc("watch_resyncs_total")
             self._watch_healthy = True
+            if self.audit is not None:
+                self.audit.record(
+                    "cluster", "watch_resync", reason="watch_error",
+                    identity=self.identity,
+                )
             logger.info("watch stream resynced; readyz restored")
 
     def healthz(self) -> bool:
@@ -565,7 +612,21 @@ class Manager:
                 self._is_leader.set()
                 with self._leader_cv:
                     self._leader_cv.notify_all()
+                if self.audit is not None:
+                    self.audit.record(
+                        "cluster", "lease_acquired",
+                        key=f"{LEASE_API_VERSION}/{LEASE_KIND}/"
+                            f"kube-system/{LEADER_LEASE_NAME}",
+                        identity=self.identity,
+                    )
         else:
+            if self._is_leader.is_set() and self.audit is not None:
+                self.audit.record(
+                    "cluster", "lease_revoked",
+                    key=f"{LEASE_API_VERSION}/{LEASE_KIND}/"
+                        f"kube-system/{LEADER_LEASE_NAME}",
+                    identity=self.identity,
+                )
             self._is_leader.clear()
 
     def _await_leadership(self) -> bool:

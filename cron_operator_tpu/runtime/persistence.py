@@ -62,6 +62,7 @@ import json
 import logging
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -87,6 +88,14 @@ DEFAULT_SNAPSHOT_EVERY = 4096
 #: kill -9. 0 disables the flusher (the chaos soak does, so its flush
 #: points stay seed-deterministic).
 DEFAULT_FLUSH_INTERVAL_S = 0.25
+
+#: Bucket ladder for WAL write-path latencies (append is tens of µs,
+#: fsync tens of µs to tens of ms depending on the device).
+WAL_LATENCY_BUCKETS = (0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                       0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
+#: Bucket ladder for snapshot compaction (serialize + fsync + rename).
+SNAPSHOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 class SimulatedCrash(ApiError):
@@ -161,10 +170,19 @@ class Persistence:
         self._dead = False
         self._die_mid_snapshot = False
         self._metrics = None
+        # Optional flight recorder: start() audits recovery as a
+        # cluster event when a journal is attached.
+        self.audit = None
         # Forensics (also surfaced as metrics when instrumented).
         self.records_appended = 0
         self.fsyncs = 0
         self.snapshots_written = 0
+        # Shipping/lag bookkeeping for hot-standby followers: total
+        # serialized bytes accepted, and the monotonic instant of the
+        # newest append — a follower's lag in records/bytes/seconds is
+        # computed against these (runtime/shard.py).
+        self.bytes_appended = 0
+        self.last_append_monotonic: Optional[float] = None
         os.makedirs(data_dir, exist_ok=True)
 
     # ---- lifecycle --------------------------------------------------------
@@ -173,9 +191,19 @@ class Persistence:
         """Attach a ``Metrics`` registry (wal_records_total etc.)."""
         self._metrics = metrics
 
+    def attach_audit(self, audit) -> None:
+        """Attach a :class:`telemetry.audit.AuditJournal`: boot recovery
+        is then audited as a ``cluster`` event (the store-verb auditing
+        itself hooks in at the APIServer, not here)."""
+        self.audit = audit
+
     def _count(self, name: str, value: float = 1.0) -> None:
         if self._metrics is not None:
             self._metrics.inc(name, value)
+
+    def _observe(self, series: str, value: float, buckets: tuple) -> None:
+        if self._metrics is not None:
+            self._metrics.observe(series, value, buckets=buckets)
 
     @property
     def dead(self) -> bool:
@@ -255,6 +283,7 @@ class Persistence:
         self._append({"op": "del", "rv": int(rv), "key": list(key)})
 
     def _append(self, rec: Dict[str, Any]) -> None:
+        t0 = time.monotonic()
         line = (
             json.dumps(rec, separators=(",", ":"), default=str) + "\n"
         ).encode("utf-8")
@@ -287,8 +316,14 @@ class Persistence:
                 raise SimulatedCrash("kill-point: torn final WAL record")
             self._buf.append(line)
             self.records_appended += 1
+            self.bytes_appended += len(line)
+            self.last_append_monotonic = time.monotonic()
             self._since_snapshot += 1
             self._count(f'wal_records_total{{op="{rec["op"]}"}}')
+            # Serialize+buffer latency only; the group-commit fsync has
+            # its own histogram in _flush_locked.
+            self._observe("wal_append_seconds", time.monotonic() - t0,
+                          WAL_LATENCY_BUCKETS)
             if action == "after_append":
                 # Record made durable, then death — the client never saw
                 # the response ("fsynced, 200 lost" window).
@@ -320,7 +355,10 @@ class Persistence:
         self._buf.clear()
         self._f.flush()
         if fsync:
+            t0 = time.monotonic()
             os.fsync(self._f.fileno())
+            self._observe("wal_fsync_seconds", time.monotonic() - t0,
+                          WAL_LATENCY_BUCKETS)
             self.fsyncs += 1
             self._count("wal_fsync_total")
         self._ship(data)
@@ -373,6 +411,7 @@ class Persistence:
         with self._lock:
             if self._dead:
                 return  # a dead process compacts nothing
+            t0 = time.monotonic()
             # WAL first: the snapshot claims to cover everything <= rv.
             self._flush_locked(fsync=True)
             payload = {
@@ -402,6 +441,8 @@ class Persistence:
             self._since_snapshot = 0
             self.snapshots_written += 1
             self._count("wal_snapshots_total")
+            self._observe("wal_snapshot_seconds", time.monotonic() - t0,
+                          SNAPSHOT_BUCKETS)
 
     def _fsync_dir(self) -> None:
         try:
@@ -505,16 +546,33 @@ class Persistence:
         self.open()
         self.write_snapshot(api.all_objects(), int(getattr(api, "_rv", state.rv)))
         api.attach_persistence(self)
+        if self.audit is not None:
+            self.audit.record(
+                "cluster", "crash_recovery",
+                reason="recovered" if not state.empty else "cold_start",
+                rv=state.rv,
+                objects=len(state.objects),
+                had_snapshot=state.had_snapshot,
+                wal_records_replayed=state.wal_records_replayed,
+                torn_records_dropped=state.torn_records_dropped,
+            )
         return state
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {
                 "records_appended": self.records_appended,
+                "bytes_appended": self.bytes_appended,
                 "fsyncs": self.fsyncs,
                 "snapshots_written": self.snapshots_written,
                 "buffered": len(self._buf),
             }
+
+    def buffered_bytes(self) -> int:
+        """Bytes committed but not yet flushed (and therefore not yet
+        shipped to followers) — the leader-side share of follower lag."""
+        with self._lock:
+            return sum(len(line) for line in self._buf)
 
 
 __all__ = [

@@ -41,6 +41,7 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from cron_operator_tpu.runtime.kube import (
@@ -51,6 +52,7 @@ from cron_operator_tpu.runtime.kube import (
     object_key,
 )
 from cron_operator_tpu.runtime.persistence import Persistence, RecoveredState
+from cron_operator_tpu.telemetry.trace import new_trace_id
 from cron_operator_tpu.utils.clock import Clock, RealClock
 
 logger = logging.getLogger(__name__)
@@ -62,6 +64,14 @@ SHARD_DIR_FMT = "shard-{}"
 # other blake2b use of the same input; the key is part of the on-disk
 # format (see module docstring) and must never change.
 _HASH_KEY = b"cron-operator-shard-v1"
+
+#: Bucket ladder for ``shard_failover_duration_seconds`` — failovers are
+#: dominated by the independent WAL replay (I6 check) plus one snapshot
+#: write, so the ladder spans sub-millisecond through tens of seconds.
+FAILOVER_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
 
 
 def shard_index(namespace: str, name: str, n_shards: int) -> int:
@@ -184,6 +194,13 @@ class FollowerReplica:
         self.records_applied = 0
         self.records_dropped = 0  # unparseable lines (corrupt mid-stream)
         self.bootstrap_rv = 0
+        #: Total shipped bytes received (applied + torn tail) — compared
+        #: against the leader's ``bytes_appended`` for byte-domain lag.
+        self.bytes_received = 0
+        #: ``time.monotonic()`` of the last byte run consumed; paired
+        #: with the leader's ``last_append_monotonic`` for time-domain
+        #: lag (how long the follower has been behind, not how far).
+        self.last_apply_monotonic: Optional[float] = None
         #: Keys whose last shipped record was a ``del`` — the follower's
         #: running equivalent of ``RecoveredState.wal_deleted_keys``.
         self.deleted_keys: Dict[tuple, int] = {}
@@ -198,6 +215,7 @@ class FollowerReplica:
     def apply_bytes(self, data: bytes) -> None:
         """Consume a shipped byte run; applies every COMPLETE line."""
         with self._lock:
+            self.bytes_received += len(data)
             buf = self._tail + data
             while True:
                 nl = buf.find(b"\n")
@@ -207,6 +225,7 @@ class FollowerReplica:
                 if line:
                     self._apply_line(line)
             self._tail = buf
+            self.last_apply_monotonic = time.monotonic()
 
     def _apply_line(self, line: bytes) -> None:
         try:
@@ -235,6 +254,12 @@ class FollowerReplica:
         """Bytes buffered but not yet applied (a torn/partial record)."""
         with self._lock:
             return len(self._tail)
+
+    @property
+    def bytes_applied(self) -> int:
+        """Shipped bytes fully applied (received minus the torn tail)."""
+        with self._lock:
+            return self.bytes_received - len(self._tail)
 
     def state(self) -> str:
         """Canonical state string (see :func:`canonical_state`)."""
@@ -270,6 +295,32 @@ class Shard:
         self.data_dir = data_dir
         self.recovered = recovered
         self.failovers = 0
+        #: Identity of the manager currently leading this shard, set by
+        #: whoever owns the managers (the CLI, the chaos soak). Purely
+        #: informational — surfaced in ``/debug/shards``.
+        self.leader: Optional[str] = None
+
+    def lag(self) -> Dict[str, Any]:
+        """Follower replication lag: records / bytes / seconds behind
+        the leader's WAL. All three are leader-minus-follower deltas —
+        ``records`` counts durable records not yet applied, ``bytes``
+        additionally includes bytes the leader has committed but not yet
+        flushed (unshipped), and ``seconds`` is how long the follower's
+        last apply trails the leader's last append."""
+        pers, follower = self.persistence, self.follower
+        if pers is None or follower is None:
+            return {"records": 0, "bytes": 0, "seconds": 0.0}
+        records = max(0, pers.records_appended - follower.records_applied)
+        lag_bytes = max(0, pers.bytes_appended - follower.bytes_applied)
+        seconds = 0.0
+        if records or lag_bytes:
+            appended = pers.last_append_monotonic
+            applied = follower.last_apply_monotonic
+            if appended is not None and (applied is None or applied < appended):
+                # Behind at least since the leader's newest append; grows
+                # with wall time until the next flush ships + drains it.
+                seconds = max(0.0, time.monotonic() - appended)
+        return {"records": records, "bytes": lag_bytes, "seconds": seconds}
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (f"Shard(index={self.index}, objects={len(self.store)}, "
@@ -536,6 +587,8 @@ class ShardedControlPlane:
         fsync_every: Optional[int] = None,
         snapshot_every: Optional[int] = None,
         flush_interval_s: Optional[float] = None,
+        audit: Optional[Any] = None,
+        tracer: Optional[Any] = None,
     ):
         if n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, got {n_shards}")
@@ -552,6 +605,8 @@ class ShardedControlPlane:
         self.data_dir = data_dir
         self.clock = clock if clock is not None else RealClock()
         self.metrics = metrics
+        self.audit = audit
+        self.tracer = tracer
         self._pers_kwargs: Dict[str, Any] = {}
         if fsync_every is not None:
             self._pers_kwargs["fsync_every"] = fsync_every
@@ -563,6 +618,7 @@ class ShardedControlPlane:
         self.shards: List[Shard] = []
         for i in range(n_shards):
             store = APIServer(self.clock)
+            shard_audit = audit.shard_view(i) if audit is not None else None
             pers: Optional[Persistence] = None
             follower: Optional[FollowerReplica] = None
             sdir: Optional[str] = None
@@ -572,12 +628,18 @@ class ShardedControlPlane:
                 pers = Persistence(sdir, **self._pers_kwargs)
                 if metrics is not None:
                     pers.instrument(ShardMetrics(metrics, i))
+                if shard_audit is not None:
+                    # Before start(): recovery itself is an audited
+                    # cluster event (crash_recovery, stamped per shard).
+                    pers.attach_audit(shard_audit)
                 recovered = pers.start(store)
                 if replicas:
                     follower = FollowerReplica(self.clock)
                     pers.attach_follower(follower)
             if metrics is not None:
                 store.instrument(ShardMetrics(metrics, i))
+            if shard_audit is not None:
+                store.attach_audit(shard_audit)
             self.shards.append(
                 Shard(i, store, pers, follower, sdir, recovered)
             )
@@ -592,23 +654,37 @@ class ShardedControlPlane:
 
     # -- failover ------------------------------------------------------------
 
-    def promote_follower(self, index: int) -> Dict[str, Any]:
+    def promote_follower(
+        self, index: int, detected_at_s: Optional[float] = None
+    ) -> Dict[str, Any]:
         """Promote shard ``index``'s hot standby to leader.
 
         Returns a report dict; ``report["i6_ok"]`` is the per-shard I6
         verdict (follower state == independent replay of the on-disk
         WAL), checked BEFORE the promoted store writes a new snapshot.
         Raises RuntimeError if the shard has no follower attached.
+
+        The failover timeline — detect → catch_up → promote → serving —
+        is recorded as one trace (``detected_at_s``, wall clock, lets the
+        caller account the gap between noticing the dead leader and
+        calling here) and its total duration lands in the per-shard
+        ``shard_failover_duration_seconds`` histogram.
         """
         shard = self.shards[index]
         follower = shard.follower
         if follower is None:
             raise RuntimeError(f"shard {index} has no follower to promote")
+        t0_mono = time.monotonic()
+        t_start = time.time()
+        if detected_at_s is None:
+            detected_at_s = t_start
+
         old_pers = shard.persistence
         if old_pers is not None and not old_pers.dead:
             # Clean handover (e.g. rolling restart): flush + stop the old
             # durability layer first so the follower has every byte.
             old_pers.close()
+        t_caught_up = time.time()
 
         # I6, per shard: the follower must equal an independent replay of
         # exactly the bytes on disk — before the new leader rewrites them.
@@ -618,9 +694,19 @@ class ShardedControlPlane:
         i6_ok = follower_state == replay_state
 
         store = follower.store
+        if self.audit is not None:
+            # The promoted leader's WAL restarts empty, so its position
+            # counter restarts at 1 — continuity is judged against the
+            # NEW WAL from here (the old WAL's verdict is the caller's
+            # to take BEFORE promoting; the chaos soak does).
+            reset = getattr(self.audit, "reset_wal", None)
+            if reset is not None:
+                reset(index)
         new_pers = Persistence(shard.data_dir, **self._pers_kwargs)
         if self.metrics is not None:
             new_pers.instrument(ShardMetrics(self.metrics, index))
+        if self.audit is not None:
+            new_pers.attach_audit(self.audit.shard_view(index))
         new_pers.open()
         # Snapshot-first: the promoted store's state becomes the new
         # snapshot and the WAL restarts empty — the promoted leader's
@@ -633,6 +719,9 @@ class ShardedControlPlane:
         store.attach_persistence(new_pers)
         if self.metrics is not None:
             store.instrument(ShardMetrics(self.metrics, index))
+        if self.audit is not None:
+            store.attach_audit(self.audit.shard_view(index))
+        t_promoted = time.time()
 
         new_follower: Optional[FollowerReplica] = None
         if self.replicas:
@@ -643,9 +732,38 @@ class ShardedControlPlane:
         shard.persistence = new_pers
         shard.follower = new_follower
         shard.failovers += 1
+        shard.leader = None  # the caller starts (and registers) a manager
         self.router.replace(index, store)
+        t_serving = time.time()
+        duration = time.monotonic() - t0_mono
         if self.metrics is not None:
             self.metrics.inc(f'shard_failovers_total{{shard="{index}"}}')
+            self.metrics.observe(
+                f'shard_failover_duration_seconds{{shard="{index}"}}',
+                duration, buckets=FAILOVER_BUCKETS,
+            )
+            self._refresh_lag_gauges(shard)
+        if self.tracer is not None:
+            tid = new_trace_id()
+            attrs = {"shard": index, "i6_ok": i6_ok}
+            root = self.tracer.record(
+                "shard_failover", tid, detected_at_s, t_serving, attrs=attrs)
+            for name, a, b in (
+                ("detect", detected_at_s, t_start),
+                ("catch_up", t_start, t_caught_up),
+                ("promote", t_caught_up, t_promoted),
+                ("serving", t_promoted, t_serving),
+            ):
+                self.tracer.record(name, tid, a, b,
+                                   parent_id=root.span_id, attrs=attrs)
+        if self.audit is not None:
+            self.audit.record(
+                "cluster", "shard_failover", shard=index,
+                reason="leader_lost",
+                i6_ok=i6_ok, duration_s=round(duration, 6),
+                objects=len(store), rv=int(getattr(store, "_rv", 0)),
+                follower_records_applied=follower.records_applied,
+            )
         logger.info(
             "shard %d: follower promoted (i6_ok=%s, objects=%d, rv=%d)",
             index, i6_ok, len(store), int(getattr(store, "_rv", 0)),
@@ -658,7 +776,66 @@ class ShardedControlPlane:
             "replayed_records": replay.wal_records_replayed,
             "follower_records_applied": follower.records_applied,
             "wal_deleted_keys": sorted(follower.deleted_keys),
+            "duration_s": duration,
         }
+
+    # -- observability -------------------------------------------------------
+
+    def _refresh_lag_gauges(self, shard: Shard) -> None:
+        if self.metrics is None:
+            return
+        lag = shard.lag()
+        sm = ShardMetrics(self.metrics, shard.index)
+        sm.set("shard_follower_lag_records", lag["records"])
+        sm.set("shard_follower_lag_bytes", lag["bytes"])
+        sm.set("shard_follower_lag_seconds", lag["seconds"])
+
+    def refresh_lag_gauges(self) -> None:
+        """Publish every shard's current follower lag as gauges
+        (``shard_follower_lag_{records,bytes,seconds}``). Called by the
+        ``/debug/shards`` data source and after failovers; cheap enough
+        to call from any health/scrape path."""
+        for shard in self.shards:
+            self._refresh_lag_gauges(shard)
+
+    def debug_shards(self) -> Dict[str, Any]:
+        """Data source for ``/debug/shards``: per-shard resourceVersion,
+        WAL stats, follower lag, and leader identity, plus the composite
+        router view."""
+        shards = []
+        for s in self.shards:
+            entry: Dict[str, Any] = {
+                "shard": s.index,
+                "objects": len(s.store),
+                "rv": int(getattr(s.store, "_rv", 0)),
+                "failovers": s.failovers,
+                "leader": s.leader,
+                "data_dir": s.data_dir,
+            }
+            if s.persistence is not None:
+                entry["wal"] = s.persistence.stats()
+                entry["wal_buffered_bytes"] = s.persistence.buffered_bytes()
+            if s.follower is not None:
+                entry["follower"] = {
+                    "records_applied": s.follower.records_applied,
+                    "records_dropped": s.follower.records_dropped,
+                    "bytes_applied": s.follower.bytes_applied,
+                    "torn_tail_bytes": s.follower.lag_bytes,
+                    "lag": s.lag(),
+                }
+            shards.append(entry)
+        self.refresh_lag_gauges()
+        return {
+            "n_shards": self.n_shards,
+            "replicas": self.replicas,
+            "composite_rv": int(self.router._rv),
+            "objects": len(self.router),
+            "shards": shards,
+        }
+
+    def render_debug_json(self) -> str:
+        """JSON body for the ``/debug/shards`` route."""
+        return json.dumps(self.debug_shards(), indent=2, default=str)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -688,6 +865,7 @@ __all__ = [
     "shard_index",
     "shard_dir",
     "canonical_state",
+    "FAILOVER_BUCKETS",
     "ShardMetrics",
     "FollowerReplica",
     "Shard",

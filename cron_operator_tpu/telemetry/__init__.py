@@ -1,12 +1,25 @@
-"""End-to-end tracing for the tick→first-step path.
+"""End-to-end tracing + flight recording for the control plane.
 
 One trace id is minted when the cron controller fires a tick and rides
 the workload object (annotation) and the runner env (``TPU_TRACE_ID``)
 through every layer, so the operator can decompose the BASELINE north
 star — ``cron_tick_to_first_step_seconds`` — into reconcile / submit /
-queue / compile / first-step spans on ``/debug/traces``.
+queue / compile / first-step spans on ``/debug/traces``. Elastic resume
+attempts inherit the ROOT attempt's trace id, so one preempt→resume
+chain renders as a single tree with per-attempt productive vs. wasted
+steps.
+
+The :mod:`~cron_operator_tpu.telemetry.audit` journal is the discrete
+counterpart: every committed store verb, controller decision, and
+cluster event as one typed record, cross-checkable against the WAL
+(invariant I9) and served from ``/debug/audit``.
 """
 
+from cron_operator_tpu.telemetry.audit import (
+    AUDIT_KINDS,
+    AuditJournal,
+    AuditRecord,
+)
 from cron_operator_tpu.telemetry.trace import (
     ANNOTATION_TRACE_ID,
     ENV_TRACE_ID,
@@ -18,6 +31,9 @@ from cron_operator_tpu.telemetry.trace import (
 
 __all__ = [
     "ANNOTATION_TRACE_ID",
+    "AUDIT_KINDS",
+    "AuditJournal",
+    "AuditRecord",
     "ENV_TRACE_ID",
     "Span",
     "Tracer",

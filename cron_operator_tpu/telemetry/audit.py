@@ -1,0 +1,370 @@
+"""Structured audit journal — the control-plane flight recorder.
+
+Every *committed* store verb, controller decision, and cluster event is
+appended as one typed :class:`AuditRecord` carrying the trace id, shard
+index, and WAL position it happened under. Records land in a bounded
+in-process ring (evictions are counted, never silent) plus an optional
+JSONL sink, and are served from ``/debug/audit`` with filter params.
+
+The journal is *cross-checkable against the WAL*: store-verb records are
+emitted immediately after the WAL append, under the same store lock, so
+their ``wal_pos`` sequence per shard must be exactly ``1..N`` with
+``N == Persistence.records_appended`` — a gap means a durable write the
+audit missed, a duplicate or overshoot means an audited write that never
+reached the WAL. :meth:`AuditJournal.wal_check` asserts both directions
+from O(1) aggregates (maintained outside the ring, so eviction cannot
+blind the check); the chaos soak promotes it to invariant I9.
+
+Record kinds:
+
+- ``store``    — a committed API-server verb (create, update,
+  patch_status, delete, cascade_delete). Semantic no-op status patches
+  are elided by the store *before* the WAL and before this journal, so
+  a steady-state sweep audits nothing — by design.
+- ``decision`` — a controller choice: tick_fired, tick_skipped (+reason),
+  submit, submit_retries_exhausted, resume, replace_delete, gc_delete,
+  preempt.
+- ``cluster``  — control-plane lifecycle: lease_acquired, lease_revoked,
+  watch_resync, shard_failover, crash_recovery.
+
+Everything is stdlib-only and thread-safe; :meth:`AuditJournal.record`
+is a few dict ops under a lock (gated ≤ 5 µs/verb by
+``hack/controlplane_bench.py``) so it can sit on the commit hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+#: Record kinds the journal accepts (see module docstring).
+AUDIT_KINDS = ("store", "decision", "cluster")
+
+#: Default bound on the in-process ring. 4096 records ≈ several hundred
+#: ticks of history; older records are evicted FIFO (and counted).
+DEFAULT_MAX_RECORDS = 4096
+
+# Pre-formatted metric series per kind: record() sits on the store
+# commit path, so it must not pay an f-string per call.
+_KIND_SERIES = {
+    k: f'audit_records_total{{kind="{k}"}}' for k in AUDIT_KINDS
+}
+
+
+@dataclass
+class AuditRecord:
+    """One audited fact. ``ts`` is wall-clock epoch seconds
+    (``time.time`` domain, same as trace spans, so audit records and
+    spans from different components line up on one timeline)."""
+
+    seq: int
+    ts: float
+    kind: str                     # store | decision | cluster
+    event: str                    # verb / decision / lifecycle event
+    key: str = ""                 # "apiVersion/Kind/ns/name" or ""
+    trace_id: Optional[str] = None
+    shard: Optional[int] = None
+    wal_pos: Optional[int] = None  # records_appended after the append
+    rv: Optional[int] = None       # committed resourceVersion
+    reason: Optional[str] = None
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "seq": self.seq,
+            "ts": self.ts,
+            "kind": self.kind,
+            "event": self.event,
+            "key": self.key,
+            "trace_id": self.trace_id,
+            "shard": self.shard,
+            "wal_pos": self.wal_pos,
+            "rv": self.rv,
+            "reason": self.reason,
+        }
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        return d
+
+
+def object_key(obj: Dict[str, Any]) -> str:
+    """Canonical audit key for a store object."""
+    meta = obj.get("metadata") or {}
+    return (
+        f"{obj.get('apiVersion', '')}/{obj.get('kind', '')}/"
+        f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+    )
+
+
+class AuditJournal:
+    """Thread-safe bounded audit ring with WAL cross-check aggregates.
+
+    ``sink_path`` (optional) appends every record as one JSON line — the
+    durable flight-recorder tape for post-mortems; the ring alone serves
+    ``/debug/audit``. ``shard`` is a default stamped on records that do
+    not carry their own (a sharded plane passes per-store views via
+    :meth:`shard_view`).
+    """
+
+    def __init__(
+        self,
+        max_records: int = DEFAULT_MAX_RECORDS,
+        sink_path: Optional[str] = None,
+        shard: Optional[int] = None,
+        metrics=None,
+    ):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max_records)
+        self.max_records = max_records
+        self.shard = shard
+        self._seq = 0
+        self.records_dropped = 0
+        self._metrics = metrics
+        # Per-(shard, kind) totals survive ring eviction — counts stay
+        # exact however small the ring is.
+        self._kind_totals: Dict[str, int] = {}
+        # Per-shard WAL continuity aggregate: first/last position seen,
+        # count, and whether every step was +1 (see wal_check).
+        self._wal: Dict[Optional[int], Dict[str, Any]] = {}
+        self._sink = open(sink_path, "a", encoding="utf-8") \
+            if sink_path else None
+        self.sink_path = sink_path
+
+    # ---- recording --------------------------------------------------------
+
+    def instrument(self, metrics) -> None:
+        """Count records (and ring evictions) into a metrics registry."""
+        self._metrics = metrics
+
+    def _count(self, series: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(series)
+
+    def record(
+        self,
+        kind: str,
+        event: str,
+        *,
+        key: str = "",
+        trace_id: Optional[str] = None,
+        shard: Optional[int] = None,
+        wal_pos: Optional[int] = None,
+        rv: Optional[int] = None,
+        reason: Optional[str] = None,
+        **attrs: Any,
+    ) -> AuditRecord:
+        """Append one record. Hot path: called under the store lock for
+        every committed verb, so it stays allocation-light."""
+        if shard is None:
+            shard = self.shard
+        with self._lock:
+            self._seq += 1
+            rec = AuditRecord(
+                seq=self._seq, ts=time.time(), kind=kind, event=event,
+                key=key, trace_id=trace_id, shard=shard, wal_pos=wal_pos,
+                rv=rv, reason=reason, attrs=attrs,
+            )
+            if len(self._ring) == self.max_records:
+                self.records_dropped += 1
+                self._count("audit_records_dropped_total")
+            self._ring.append(rec)
+            self._kind_totals[kind] = self._kind_totals.get(kind, 0) + 1
+            if wal_pos is not None:
+                w = self._wal.get(shard)
+                if w is None:
+                    self._wal[shard] = {
+                        "first_pos": wal_pos, "last_pos": wal_pos,
+                        "count": 1, "contiguous": True,
+                    }
+                else:
+                    if wal_pos != w["last_pos"] + 1:
+                        w["contiguous"] = False
+                    w["last_pos"] = wal_pos
+                    w["count"] += 1
+            if self._sink is not None:
+                self._sink.write(
+                    json.dumps(rec.to_dict(), default=str) + "\n"
+                )
+        if self._metrics is not None:
+            series = _KIND_SERIES.get(kind)
+            if series is not None:
+                self._metrics.inc(series)
+            else:  # unknown kind — format off the hot path
+                self._metrics.inc(f'audit_records_total{{kind="{kind}"}}')
+        return rec
+
+    def shard_view(self, shard: int) -> "_ShardAuditView":
+        """A view stamping ``shard`` on every record (one journal shared
+        by a sharded plane, mirroring the ``ShardMetrics`` idiom)."""
+        return _ShardAuditView(self, shard)
+
+    # ---- reading ----------------------------------------------------------
+
+    @property
+    def total(self) -> int:
+        """Records ever written (ring + evicted)."""
+        with self._lock:
+            return self._seq
+
+    def kind_totals(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._kind_totals)
+
+    def records(
+        self,
+        kind: Optional[str] = None,
+        event: Optional[str] = None,
+        trace_id: Optional[str] = None,
+        shard: Optional[int] = None,
+        key_contains: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Filtered view of the ring, oldest first. ``limit`` keeps the
+        NEWEST matches (the useful tail of a flight recorder)."""
+        with self._lock:
+            out = [r.to_dict() for r in self._ring]
+        if kind is not None:
+            out = [r for r in out if r["kind"] == kind]
+        if event is not None:
+            out = [r for r in out if r["event"] == event]
+        if trace_id is not None:
+            out = [r for r in out if r["trace_id"] == trace_id]
+        if shard is not None:
+            out = [r for r in out if r["shard"] == shard]
+        if key_contains is not None:
+            out = [r for r in out if key_contains in r["key"]]
+        if limit is not None and limit >= 0:
+            out = out[-limit:]
+        return out
+
+    def render_json(self, params: Optional[Dict[str, List[str]]] = None) -> str:
+        """JSON body for ``/debug/audit``. ``params`` is a parsed query
+        string (``urllib.parse.parse_qs`` shape): ``kind``, ``event``,
+        ``trace``, ``shard``, ``key``, ``limit`` (default 256, bounding
+        the response body)."""
+        params = params or {}
+
+        def one(name: str) -> Optional[str]:
+            vals = params.get(name)
+            return vals[0] if vals else None
+
+        shard: Optional[int] = None
+        raw_shard = one("shard")
+        if raw_shard is not None:
+            try:
+                shard = int(raw_shard)
+            except ValueError:
+                shard = None
+        try:
+            limit = int(one("limit") or 256)
+        except ValueError:
+            limit = 256
+        recs = self.records(
+            kind=one("kind"), event=one("event"), trace_id=one("trace"),
+            shard=shard, key_contains=one("key"), limit=limit,
+        )
+        return json.dumps(
+            {
+                "total": self.total,
+                "dropped": self.records_dropped,
+                "kind_totals": self.kind_totals(),
+                "matched": len(recs),
+                "records": recs,
+            },
+            indent=2, default=str,
+        )
+
+    # ---- WAL cross-check (invariant I9's store leg) ------------------------
+
+    def wal_summary(self, shard: Optional[int] = None) -> Dict[str, Any]:
+        with self._lock:
+            w = self._wal.get(shard if shard is not None else self.shard)
+            if w is None and shard is None and len(self._wal) == 1:
+                w = next(iter(self._wal.values()))
+            return dict(w) if w else {
+                "first_pos": None, "last_pos": None,
+                "count": 0, "contiguous": True,
+            }
+
+    def reset_wal(self, shard: Optional[int] = None) -> None:
+        """Forget the WAL-continuity aggregate for ``shard``.
+
+        A failover (or crash-restart with a fresh journal-less restart)
+        replaces the shard's ``Persistence``, whose position counter
+        restarts at 1 — judge continuity against the NEW WAL from here.
+        Callers wanting the old WAL's verdict take :meth:`wal_check`
+        first; the chaos soak does exactly that at every promotion.
+        """
+        with self._lock:
+            self._wal.pop(shard if shard is not None else self.shard, None)
+
+    def wal_check(
+        self,
+        records_appended: int,
+        shard: Optional[int] = None,
+        crash_tail: int = 0,
+    ) -> Dict[str, Any]:
+        """Audit ≡ WAL, record for record, for one store's WAL.
+
+        Passes iff the audited ``wal_pos`` stream for ``shard`` is
+        exactly contiguous ``1..K`` and ``K == records_appended`` — every
+        durable record was audited and every audited verb was durable.
+        ``crash_tail`` tolerates up to that many WAL records *beyond* the
+        audit (a kill fired between the WAL append and the commit: the
+        record is on disk but the verb never committed, so the journal —
+        which audits only *committed* verbs — rightly lacks it).
+        """
+        w = self.wal_summary(shard)
+        count = w["count"]
+        gap = records_appended - (w["last_pos"] or 0)
+        ok = (
+            w["contiguous"]
+            and (count == 0 or w["first_pos"] == 1)
+            and (count == 0 or w["last_pos"] == count)
+            and 0 <= gap <= crash_tail
+        )
+        return {
+            "ok": ok,
+            "audited_records": count,
+            "wal_records_appended": records_appended,
+            "contiguous": w["contiguous"],
+            "first_pos": w["first_pos"],
+            "last_pos": w["last_pos"],
+            "unaudited_tail": max(gap, 0),
+            "crash_tail_allowed": crash_tail,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+
+
+class _ShardAuditView:
+    """Stamps a shard index on every record routed through it (the
+    audit analog of ``ShardMetrics``); everything else delegates."""
+
+    def __init__(self, journal: AuditJournal, shard: int):
+        self._journal = journal
+        self.shard = shard
+
+    def record(self, kind: str, event: str, **kw: Any) -> AuditRecord:
+        kw.setdefault("shard", self.shard)
+        return self._journal.record(kind, event, **kw)
+
+    def __getattr__(self, name: str):
+        return getattr(self._journal, name)
+
+
+__all__ = [
+    "AUDIT_KINDS",
+    "AuditJournal",
+    "AuditRecord",
+    "DEFAULT_MAX_RECORDS",
+    "object_key",
+]

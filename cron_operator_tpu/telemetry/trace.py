@@ -97,9 +97,20 @@ class Tracer:
     progress stream already carries (``started_at``, ``first_step_at``).
     """
 
-    def __init__(self, max_spans: int = DEFAULT_MAX_SPANS):
+    def __init__(self, max_spans: int = DEFAULT_MAX_SPANS, metrics=None):
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=max_spans)
+        self.max_spans = max_spans
+        # FIFO eviction is visible, never silent: the counter (and the
+        # trace_spans_dropped_total family when instrumented) says how
+        # much history the bounded store has already shed.
+        self.spans_dropped = 0
+        self._metrics = metrics
+
+    def instrument(self, metrics) -> None:
+        """Count evictions into a metrics registry
+        (``trace_spans_dropped_total``)."""
+        self._metrics = metrics
 
     def start_span(
         self,
@@ -119,8 +130,14 @@ class Tracer:
 
     def finish(self, span: Span, end_s: float) -> Span:
         span.end_s = end_s
+        dropped = False
         with self._lock:
+            if len(self._spans) == self.max_spans:
+                self.spans_dropped += 1
+                dropped = True
             self._spans.append(span)
+        if dropped and self._metrics is not None:
+            self._metrics.inc("trace_spans_dropped_total")
         return span
 
     def record(
@@ -166,15 +183,64 @@ class Tracer:
         return out
 
     def traces(self) -> List[Dict[str, Any]]:
-        """Finished spans grouped by trace id, oldest trace first."""
+        """Finished spans grouped by trace id, oldest trace first. A
+        trace whose spans carry resume lineage (``attempt`` attrs from
+        the elastic-resume path — the root attempt's trace id is
+        propagated through every ``-rN`` successor, so one preempt→
+        resume chain is one trace) additionally gets a ``lineage``
+        summary with per-attempt productive vs. wasted steps."""
         grouped: Dict[str, List[Dict[str, Any]]] = {}
         for s in self.spans():
             grouped.setdefault(s["trace_id"], []).append(s)
-        return [
-            {"trace_id": tid, "spans": sorted(spans, key=lambda s: s["start_s"])}
-            for tid, spans in grouped.items()
-        ]
+        out = []
+        for tid, spans in grouped.items():
+            entry: Dict[str, Any] = {
+                "trace_id": tid,
+                "spans": sorted(spans, key=lambda s: s["start_s"]),
+            }
+            lineage = _lineage(spans)
+            if lineage is not None:
+                entry["lineage"] = lineage
+            out.append(entry)
+        return out
 
     def render_json(self) -> str:
         """JSON body for the ``/debug/traces`` route."""
-        return json.dumps({"traces": self.traces()}, indent=2, sort_keys=False)
+        return json.dumps(
+            {"traces": self.traces(), "spans_dropped": self.spans_dropped},
+            indent=2, sort_keys=False,
+        )
+
+
+def _lineage(spans: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Attempt-chain summary for one trace, built from ``resume`` spans.
+
+    Each resume span is stamped by the controller with the successor's
+    ``attempt`` number, the checkpoint step it resumed from, and the
+    preempted predecessor's last step — so ``wasted_steps`` (steps the
+    predecessor trained past its last durable checkpoint) falls straight
+    out, and the goodput report can read the whole chain from one trace.
+    """
+    resumes = [s for s in spans if s["name"] == "resume"]
+    if not resumes:
+        return None
+    chain = []
+    for s in sorted(resumes, key=lambda s: s["attrs"].get("attempt", 0)):
+        a = s["attrs"]
+        try:
+            pre = int(a.get("pre_steps") or 0)
+            start = int(a.get("resumed_from_step") or 0)
+        except (TypeError, ValueError):
+            pre = start = 0
+        chain.append({
+            "attempt": a.get("attempt"),
+            "workload": a.get("workload"),
+            "resumed_from_step": start,
+            "pre_steps": pre,
+            "wasted_steps": max(0, pre - start),
+        })
+    return {
+        "attempts": len(resumes) + 1,
+        "resumes": chain,
+        "wasted_steps": sum(c["wasted_steps"] for c in chain),
+    }

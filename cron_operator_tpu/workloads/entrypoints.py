@@ -199,6 +199,11 @@ def _run(
     ``workload_tokens_per_s`` gauge.
     """
     ctx.progress["started_at"] = time.time()
+    # Monotonic anchor for same-process latency deltas: the wall-clock
+    # started_at/first_step_at pair stays for cross-process alignment,
+    # but a wall jump (NTP slew) between them must not distort the
+    # first_step phase histogram.
+    started_mono = time.monotonic()
     if trainer.steps_done:
         ctx.progress["resumed_from_step"] = trainer.steps_done
         # The restored steps are DONE (they travel in state.step), so
@@ -228,6 +233,9 @@ def _run(
             # The north-star timestamp: first optimizer step finished
             # (device-synced — Trainer.step blocks on the loss).
             ctx.progress["first_step_at"] = time.time()
+            ctx.progress["first_step_latency_s"] = round(
+                time.monotonic() - started_mono, 6
+            )
             if trainer.first_dispatch_time_s is not None:
                 # The compile component of tick→first-step (the first
                 # dispatch traces + XLA-compiles before executing).
@@ -701,6 +709,7 @@ def generate_job(ctx: JobContext) -> None:
         )
         key = jax.random.PRNGKey(int(ctx.params.get("seed", 0)))
         ctx.progress["started_at"] = time.time()
+        started_mono = time.monotonic()
         total_tokens = 0
         steady_t0 = None
         for r in range(rounds):
@@ -722,6 +731,9 @@ def generate_job(ctx: JobContext) -> None:
                 # Round 0 carries the compile; steady throughput starts
                 # after it (mirrors the trainers' first-step convention).
                 ctx.progress["first_step_at"] = now
+                ctx.progress["first_step_latency_s"] = round(
+                    time.monotonic() - started_mono, 6
+                )
                 steady_t0 = now
             else:
                 total_tokens += batch_size * max_new

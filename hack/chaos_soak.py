@@ -43,6 +43,17 @@ end-state invariants:
   right ``resumes`` count.  ``--no-elastic`` is the counter-proof: the
   same storms against restart-on-preemption jobs (no checkpoint) must
   violate I8 — restarted runs start over at step 0.
+- **I9 flight_recorder** (crash mode) — the audit journal
+  (:mod:`cron_operator_tpu.telemetry.audit`) is cross-checkable against
+  the WAL, record for record: per generation (single store) / per shard
+  (sharded), the audited ``wal_pos`` stream is exactly contiguous
+  ``1..N`` with ``N == records_appended``, tolerating at most ONE
+  kill-stranded tail record (appended but never committed).  The
+  sharded soak adds the lag-telemetry leg — follower replication lag is
+  observed >0 before round-boundary flushes and drains to exactly zero
+  after each — and ``--preempt-storm`` adds the goodput leg: productive
+  steps over total steps trained across every attempt chain must clear
+  ``GOODPUT_FLOOR``.
 
 Determinism model: every fault decision, kill-point, and simulated
 workload outcome is a pure function of ``(seed, injection point)`` (see
@@ -359,6 +370,7 @@ def run_soak(
         SimulatedCrash,
     )
     from cron_operator_tpu.runtime.retry import with_conflict_retry
+    from cron_operator_tpu.telemetry.audit import AuditJournal
     from cron_operator_tpu.utils.clock import FakeClock
 
     storm_plan = FaultPlan.default_chaos(seed)
@@ -378,6 +390,13 @@ def run_soak(
     start_epoch = int(clock.now().timestamp())
     store = APIServer(clock=clock)
     pers = None
+    # Flight recorder (I9): one journal per PROCESS GENERATION — a
+    # restart's fresh Persistence restarts the WAL position counter, so
+    # the audit≡WAL continuity check is per generation too. The check
+    # itself is taken at every kill (crash_tail=1: the kill can land
+    # between the WAL append and the commit) and once at the clean end.
+    journal = None
+    audit_checks: list = []
     if crash and chaotic:
         # Durable mode recovers from this dir across kills; no-durability
         # mode still runs a persistence layer (the kill-points live in
@@ -388,7 +407,10 @@ def run_soak(
         # not of wall-clock flusher timing.
         pers = Persistence(os.path.join(data_dir, "gen-0"),
                            flush_interval_s=0)
+        journal = AuditJournal()
+        pers.attach_audit(journal)
         pers.start(store)
+        store.attach_audit(journal)
     api = FaultInjector(store, plan)
 
     forbid = {
@@ -412,9 +434,10 @@ def run_soak(
             identity="chaos-soak",
             lease_duration_s=1.0,
             recovering=recovering,
+            audit=journal,
         )
         m.resync_on_watch_error = not unhardened
-        r = CronReconciler(api, metrics=m.metrics)
+        r = CronReconciler(api, metrics=m.metrics, audit=journal)
         m.add_controller(
             "cron", r.reconcile, for_gvk=GVK_CRON,
             owns=default_scheme().workload_kinds(),
@@ -573,13 +596,23 @@ def run_soak(
         Zero fake time passes — the restarted process resumes in the same
         fake minute, so recovery catch-up re-fires the crashed round's
         ticks under the same deterministic names."""
-        nonlocal store, pers, api, mgr, rec, quiesce_timeouts
+        nonlocal store, pers, api, mgr, rec, quiesce_timeouts, journal
         mgr.stop()
         metric_gens.append(_collect_metrics(mgr))
         fault_gens.append(
             (api.fault_counts(), api.dropped_events())
         )
         store.close()  # drains the dispatcher into the watchlog
+        if journal is not None:
+            # I9, dying generation's verdict: every durable WAL record
+            # was audited and vice versa — tolerating ONE record the
+            # kill stranded between WAL append and commit.
+            audit_checks.append({
+                "round": r,
+                "generation": watchlog.generation,
+                **journal.wal_check(pers.records_appended, crash_tail=1),
+            })
+            journal.close()
         kill_info = (
             dict(pers.kill_switch.describe()) if pers.kill_switch else
             {"round": r, "point": "end_of_round", "fired": True}
@@ -595,7 +628,10 @@ def run_soak(
             # Unset --data-dir semantics: nothing survives the process.
             new_dir = os.path.join(data_dir, f"gen-{gen}")
         pers = Persistence(new_dir, flush_interval_s=0)
+        journal = AuditJournal()
+        pers.attach_audit(journal)
         store = APIServer(clock=clock)
+        store.attach_audit(journal)
         recovered = pers.recover()
         # I6: recovery is a pure function of the on-disk bytes — an
         # independent second replay must be byte-identical.
@@ -742,6 +778,16 @@ def run_soak(
         rec.reconcile(NAMESPACE, f"chaos-{i}")
     final_sweep_writes = int(getattr(store, "_rv")) - rv_before
 
+    # ---- I9: audit ≡ WAL for the surviving generation --------------------
+    # Clean end, no kill in flight: zero crash tail tolerated.
+    if journal is not None:
+        audit_checks.append({
+            "round": rounds,
+            "generation": watchlog.generation,
+            **journal.wal_check(pers.records_appended, crash_tail=0),
+        })
+        journal.close()
+
     # ---- I7b: nothing permanently lost across restarts -------------------
     final_names = {
         (w.get("metadata") or {}).get("name", "")
@@ -784,6 +830,7 @@ def run_soak(
         "dup_violations": list(watchlog.dup_violations),
         "permanently_lost": permanently_lost,
         "wal": pers.stats() if pers is not None else None,
+        "audit_checks": audit_checks,
         "metrics": metrics,
         "surface": surface,
         "created_count": watchlog.created_count,
@@ -839,6 +886,7 @@ def run_sharded_soak(
         ShardRouter,
         shard_index,
     )
+    from cron_operator_tpu.telemetry.audit import AuditJournal
     from cron_operator_tpu.utils.clock import FakeClock
 
     storm_plan = FaultPlan.default_chaos(seed)
@@ -862,9 +910,16 @@ def run_sharded_soak(
     # flush_interval_s=0: like the single-store soak, the harness owns
     # every flush point, so WAL suffix loss (and therefore follower lag
     # at the kill instant) is a pure function of the seed.
+    # One shared journal; the plane hands each shard's store a shard
+    # view, so every record carries its shard index and the audit≡WAL
+    # continuity check (I9) runs per shard.
+    journal = AuditJournal()
+    audit_checks: list = []
+    lag_samples = {"total": 0, "with_lag": 0, "max_records": 0,
+                   "max_bytes": 0, "not_drained": 0}
     plane = ShardedControlPlane(
         n_shards=shards, replicas=1, data_dir=data_dir,
-        clock=clock, flush_interval_s=0,
+        clock=clock, flush_interval_s=0, audit=journal,
     )
     injectors = [
         FaultInjector(s.store, _plan_for(s.index)) for s in plane.shards
@@ -895,8 +950,11 @@ def run_sharded_soak(
             identity=f"chaos-soak-shard-{si}",
             lease_duration_s=1.0,
             recovering=recovering,
+            audit=journal.shard_view(si),
         )
-        r = CronReconciler(injectors[si], metrics=m.metrics)
+        plane.shards[si].leader = m.identity
+        r = CronReconciler(injectors[si], metrics=m.metrics,
+                           audit=journal.shard_view(si))
         m.add_controller(
             "cron", r.reconcile, for_gvk=GVK_CRON,
             owns=default_scheme().workload_kinds(),
@@ -1070,6 +1128,22 @@ def run_sharded_soak(
         )
         if not kill_info.get("fired"):
             kill_info["point"] = "end_of_round"
+        # I9, dead leader's verdict BEFORE promotion resets the shard's
+        # WAL position aggregate (crash_tail=1: the kill can land
+        # between the WAL append and the commit).
+        audit_checks.append({
+            "round": r,
+            "shard": si,
+            **journal.wal_check(
+                shard.persistence.records_appended, shard=si, crash_tail=1
+            ),
+        })
+        # Follower lag at the kill instant — the catch-up the promotion
+        # must drain (records the dead leader appended but never flushed
+        # to the shipping sink are LOST with the process, exactly like
+        # the single-store suffix loss; the follower serves what was
+        # durable).
+        lag_at_kill = shard.lag()
         # Promote: I6 (follower == independent WAL replay) is checked
         # inside, before the promoted store rewrites the snapshot.
         report = plane.promote_follower(si)
@@ -1088,6 +1162,9 @@ def run_sharded_soak(
             "promoted_rv": report["rv"],
             "follower_records_applied": report["follower_records_applied"],
             "i6_recovery_equals_replay": report["i6_ok"],
+            "failover_duration_s": report["duration_s"],
+            "lag_at_kill": lag_at_kill,
+            "lag_after_promotion": shard.lag(),
         })
         failovers.append(si)
         watchlog.begin_generation(
@@ -1174,7 +1251,23 @@ def run_sharded_soak(
                 _failover(r, victim)
             for s in plane.shards:
                 if s.persistence is not None and not s.persistence.dead:
+                    # Lag telemetry evidence (I9): before the round
+                    # boundary flush a busy shard's follower trails the
+                    # leader (appends buffer up to fsync_every); the
+                    # flush ships the bytes and the lag must drain to
+                    # exactly zero records.
+                    pre = s.lag()
                     s.persistence.flush()
+                    post = s.lag()
+                    lag_samples["total"] += 1
+                    if pre["records"] or pre["bytes"]:
+                        lag_samples["with_lag"] += 1
+                    lag_samples["max_records"] = max(
+                        lag_samples["max_records"], pre["records"])
+                    lag_samples["max_bytes"] = max(
+                        lag_samples["max_bytes"], pre["bytes"])
+                    if post["records"] or post["bytes"]:
+                        lag_samples["not_drained"] += 1
 
         # ---- faults stop: convergence phase ------------------------------
         for inj in injectors:
@@ -1225,6 +1318,18 @@ def run_sharded_soak(
         s.persistence.stats() for s in plane.shards
         if s.persistence is not None
     ]
+    # I9, clean end: every surviving shard's WAL, record for record.
+    for s in plane.shards:
+        if s.persistence is not None:
+            audit_checks.append({
+                "round": rounds,
+                "shard": s.index,
+                **journal.wal_check(
+                    s.persistence.records_appended, shard=s.index,
+                    crash_tail=0,
+                ),
+            })
+    debug_shards = plane.debug_shards()
     plane.close()
     shutil.rmtree(data_dir, ignore_errors=True)
     permanently_lost = sorted(
@@ -1258,6 +1363,9 @@ def run_sharded_soak(
         "dup_violations": list(watchlog.dup_violations),
         "permanently_lost": permanently_lost,
         "wal": wal_stats,
+        "audit_checks": audit_checks,
+        "follower_lag": lag_samples,
+        "debug_shards": debug_shards,
         "metrics": metrics,
         "surface": surface,
         "created_count": watchlog.created_count,
@@ -1815,7 +1923,89 @@ def check_invariants(chaotic: dict, replay: dict, history_limit: int) -> dict:
                 f"delete(s) across {len(chaotic['kills'])} kill(s))"
             ),
         }
+
+        # I9, flight recorder: the audit journal is cross-checkable
+        # against the WAL — every durable record audited, every audited
+        # verb durable, per generation (single store) / per shard
+        # (sharded), with at most one kill-stranded tail record. The
+        # sharded soak adds the lag-telemetry leg: follower lag is
+        # OBSERVED (>0 records before a round-boundary flush) and drains
+        # to exactly zero after every flush.
+        checks = chaotic.get("audit_checks") or []
+        bad_checks = [c for c in checks if not c.get("ok")]
+        i9 = {
+            "ok": bool(checks) and not bad_checks,
+            "detail": bad_checks[:3] or (
+                f"{len(checks)} audit≡WAL check(s) across "
+                f"{chaotic['generations']} generation(s), record for "
+                "record (≤1 kill-stranded WAL tail record each)"
+            ),
+        }
+        lag = chaotic.get("follower_lag")
+        if lag is not None and lag.get("total"):
+            drained = lag["not_drained"] == 0
+            seen = lag["with_lag"] > 0
+            i9["follower_lag"] = lag
+            i9["ok"] = i9["ok"] and drained and seen
+            if drained and seen and not bad_checks:
+                i9["detail"] += (
+                    f"; follower lag >0 on {lag['with_lag']}/"
+                    f"{lag['total']} flush point(s) (max "
+                    f"{lag['max_records']} records / {lag['max_bytes']} "
+                    "bytes) and drained to zero after every flush"
+                )
+            else:
+                i9["detail"] = {
+                    "audit": i9["detail"],
+                    "follower_lag": lag,
+                }
+        inv["I9_flight_recorder"] = i9
     return inv
+
+
+#: Minimum training goodput (productive / total steps trained across the
+#: attempt chains) the preempt-storm leg must clear — the I9 goodput leg.
+GOODPUT_FLOOR = 0.5
+
+
+def compute_goodput(ev: dict, floor: float = GOODPUT_FLOOR) -> dict:
+    """Training goodput per attempt chain from the elastic-leg evidence:
+    productive steps (the target, trained exactly once end to end) over
+    TOTAL steps trained across the chain — every step re-trained between
+    a resume point and the preempted attempt's last step is waste."""
+    per_chain: dict = {}
+    sum_productive = 0
+    sum_trained = 0
+    for cron, run in (ev.get("runs") or {}).items():
+        chain = run.get("chain") or []
+        if not chain:
+            continue
+        trained = sum(
+            max(
+                0,
+                int(a.get("steps_done") or 0)
+                - int(a.get("resumed_from_step") or 0),
+            )
+            for a in chain
+        )
+        target = int(ev["steps_target"])
+        productive = min(target, int(chain[-1].get("steps_done") or 0))
+        per_chain[cron] = {
+            "attempts": len(chain),
+            "productive_steps": productive,
+            "total_steps_trained": trained,
+            "wasted_steps": max(0, trained - productive),
+            "goodput": round(productive / trained, 4) if trained else 0.0,
+        }
+        sum_productive += productive
+        sum_trained += trained
+    overall = sum_productive / sum_trained if sum_trained else 0.0
+    return {
+        "per_chain": per_chain,
+        "overall": round(overall, 4),
+        "floor": floor,
+        "ok": bool(per_chain) and overall >= floor,
+    }
 
 
 def main(argv=None) -> int:
@@ -1988,6 +2178,9 @@ def main(argv=None) -> int:
             "resurrections": chaotic["resurrections"],
             "phantom_deletes": chaotic.get("phantom_deletes", []),
             "wal": chaotic["wal"],
+            "audit_checks": chaotic.get("audit_checks", []),
+            "follower_lag": chaotic.get("follower_lag"),
+            "debug_shards": chaotic.get("debug_shards"),
             "metrics": chaotic["metrics"],
             "elapsed_s": {
                 "chaotic": chaotic["elapsed_s"],
@@ -2057,6 +2250,31 @@ def main(argv=None) -> int:
         )
         invariants["I8_elastic_resume"] = check_i8(elastic_ev)
 
+        # I9's goodput leg: under the storm, productive steps must
+        # dominate re-trained waste across every attempt chain.
+        goodput = compute_goodput(elastic_ev)
+        gp_detail = (
+            f"goodput {goodput['overall']} >= floor {GOODPUT_FLOOR} "
+            f"across {len(goodput['per_chain'])} attempt chain(s) under "
+            "the preempt storm"
+        )
+        i9 = invariants.get("I9_flight_recorder")
+        if i9 is None:
+            invariants["I9_flight_recorder"] = {
+                "ok": goodput["ok"],
+                "detail": gp_detail if goodput["ok"] else {
+                    "goodput": goodput,
+                },
+                "goodput": goodput,
+            }
+        else:
+            i9["ok"] = i9["ok"] and goodput["ok"]
+            i9["goodput"] = goodput
+            if goodput["ok"] and isinstance(i9["detail"], str):
+                i9["detail"] += "; " + gp_detail
+            elif not goodput["ok"]:
+                i9["detail"] = {"audit": i9["detail"], "goodput": goodput}
+
     ok = all(v["ok"] for v in invariants.values()) and deterministic
 
     report = {
@@ -2083,6 +2301,7 @@ def main(argv=None) -> int:
         "resurrections": chaotic["resurrections"],
         "phantom_deletes": chaotic.get("phantom_deletes", []),
         "wal": chaotic["wal"],
+        "audit_checks": chaotic.get("audit_checks", []),
         "metrics": chaotic["metrics"],
         "elapsed_s": {
             "chaotic": chaotic["elapsed_s"],

@@ -116,6 +116,22 @@ echo "==> durability counter-proof (same kills, no durability -> I7 must break)"
 python hack/chaos_soak.py --seed 7 --crons 40 --rounds 3 \
     --no-durability --expect-violation --out /dev/null
 
+echo "==> observability report smoke (flight recorder + SLO verdict, fast legs)"
+# Fast legs of the goodput/SLO report (hack/obs_report.py): a simulated
+# fire+resume scenario whose audit journal must reconcile exactly against
+# the WAL (I9's audit ≡ WAL check), plus the scheduling-SLO leg; --check
+# skips the real-training goodput leg and fails the gate on any
+# REGRESSION verdict. Full report: make obs-report (writes BENCH_OBS.json).
+python hack/obs_report.py --check --out /dev/null >/dev/null
+
+echo "==> metric registry drift (every emitted family declared + typed)"
+# Explicit run of the registry drift guard: scans every metrics.inc/
+# observe/set call site AND interned-series assignment in the package,
+# and fails if a family is emitted that _FAMILY_META does not declare
+# (or vice versa). Runs again inside the full suite below, but a drifted
+# registry should name itself, not hide in a wall of test output.
+python -m pytest tests/test_registry_drift.py -q
+
 echo "==> unit + integration tests"
 # With pytest-cov installed (CI always; optional locally) the suite runs
 # under coverage and hack/ci_gate enforces the pyproject fail_under

@@ -1,0 +1,301 @@
+"""Observability verdict layer (``make obs-report`` → ``BENCH_OBS.json``).
+
+Drives the REAL stack — ``APIServer`` + ``Persistence`` + the flight
+recorder (``telemetry/audit.py``) + ``CronReconciler`` +
+``LocalExecutor`` — and computes the goodput/SLO verdicts the
+observability layer exists to answer:
+
+- **flight_recorder** — audit ≡ WAL record for record
+  (:meth:`AuditJournal.wal_check`), every fired tick present as a
+  ``decision`` record matching ``cron_ticks_fired_total``, and the
+  ``/debug/audit`` / ``/debug/traces`` bodies parse as bounded JSON.
+- **scheduling_slo** — tick fired (the ``tick_fired`` audit record's
+  wall-clock ``ts``) → the workload's first training step
+  (``trainingProgress.first_step_at``, same clock domain): p95 must be
+  under ``SCHED_SLO_P95_S``.
+- **goodput** (full mode only) — the chaos soak's preempt-storm leg:
+  real CPU-mesh training under preemption storms, productive ÷ total
+  steps across every attempt chain, must clear
+  ``chaos_soak.GOODPUT_FLOOR``.
+
+``--check`` runs the fast legs only (simulated workloads, no real
+training) — the CI smoke ``hack/ci_gate.sh`` runs on every gate.
+
+Verdict: ``OK`` iff every leg passes, else ``REGRESSION`` (exit 1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from datetime import timedelta
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CRON_API_VERSION = "apps.kubedl.io/v1alpha1"
+WORKLOAD_API_VERSION = "kubeflow.org/v1"
+WORKLOAD_KIND = "JAXJob"
+NAMESPACE = "default"
+
+#: Scheduling-latency SLO: p95 of (tick fired → first training step).
+#: Simulated workloads complete their first "step" at executor pickup,
+#: so this bounds the control plane + executor dispatch path itself.
+SCHED_SLO_P95_S = 2.0
+
+#: Sizes of the fast scenario (kept small: the CI gate runs --check).
+OBS_CRONS = 6
+OBS_ROUNDS = 4
+
+
+def _cron(i: int) -> dict:
+    return {
+        "apiVersion": CRON_API_VERSION,
+        "kind": "Cron",
+        "metadata": {"name": f"obs-{i}", "namespace": NAMESPACE},
+        "spec": {
+            "schedule": "*/1 * * * *",
+            "concurrencyPolicy": "Allow",
+            "historyLimit": 2,
+            "template": {"workload": {
+                "apiVersion": WORKLOAD_API_VERSION,
+                "kind": WORKLOAD_KIND,
+                "metadata": {"annotations": {
+                    # Simulated 10ms run: reports started_at/first_step_at
+                    # like a real trainer, without JAX in the loop.
+                    "tpu.kubedl.io/simulate-duration": "10ms",
+                }},
+                "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+            }},
+        },
+    }
+
+
+def _is_terminal(obj: dict) -> bool:
+    for c in ((obj.get("status") or {}).get("conditions") or []):
+        if c.get("type") in ("Succeeded", "Failed") and \
+                c.get("status") == "True":
+            return True
+    return False
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def run_fast_legs(rounds: int = OBS_ROUNDS, crons: int = OBS_CRONS) -> dict:
+    """The flight-recorder + scheduling-SLO legs: fake-clock ticks over
+    simulated workloads, real wall-clock dispatch underneath."""
+    from cron_operator_tpu.backends.local import LocalExecutor
+    from cron_operator_tpu.controller.cron_controller import CronReconciler
+    from cron_operator_tpu.runtime.kube import APIServer
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.runtime.persistence import Persistence
+    from cron_operator_tpu.telemetry import AuditJournal, Tracer
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    tmp = tempfile.mkdtemp(prefix="obs-report-")
+    clock = FakeClock()
+    store = APIServer(clock=clock)
+    metrics = Metrics()
+    journal = AuditJournal()
+    tracer = Tracer()
+    journal.instrument(metrics)
+    tracer.instrument(metrics)
+    pers = Persistence(tmp, flush_interval_s=0)
+    pers.instrument(metrics)
+    pers.attach_audit(journal)
+    pers.start(store)
+    store.instrument(metrics)
+    store.attach_audit(journal)
+    ex = LocalExecutor(store, metrics=metrics, tracer=tracer, audit=journal)
+    ex.start()
+    rec = CronReconciler(store, metrics=metrics, tracer=tracer,
+                         audit=journal)
+
+    for i in range(crons):
+        store.create(_cron(i))
+
+    first_step_at: dict = {}
+
+    def _sweep() -> None:
+        for i in range(crons):
+            rec.reconcile(NAMESPACE, f"obs-{i}")
+
+    def _wait_terminal(deadline_s: float = 30.0) -> None:
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            workloads = store.list(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+            )
+            for w in workloads:
+                meta = w.get("metadata") or {}
+                prog = (w.get("status") or {}).get("trainingProgress") or {}
+                if prog.get("first_step_at") is not None:
+                    first_step_at.setdefault(
+                        meta.get("name", ""),
+                        float(prog["first_step_at"]),
+                    )
+            if all(_is_terminal(w) for w in workloads):
+                return
+            time.sleep(0.02)
+
+    for _ in range(rounds):
+        clock.advance(timedelta(seconds=61))
+        _sweep()
+        _wait_terminal()
+        _sweep()  # fold the settled round into history / GC
+        pers.flush()
+
+    # ---- flight recorder leg ---------------------------------------------
+    wal = journal.wal_check(pers.records_appended)
+    ticks_fired = int(metrics.get("cron_ticks_fired_total") or 0)
+    tick_records = journal.records(kind="decision", event="tick_fired")
+    audit_body = json.loads(
+        journal.render_json({"kind": ["decision"], "limit": ["10"]})
+    )
+    traces_body = json.loads(tracer.render_json())
+    endpoint_ok = (
+        audit_body["matched"] <= 10
+        and all(r["kind"] == "decision" for r in audit_body["records"])
+        and isinstance(traces_body.get("traces"), list)
+    )
+    recorder = {
+        "wal_check": wal,
+        "ticks_fired_metric": ticks_fired,
+        "tick_fired_audit_records": len(tick_records),
+        "kind_totals": journal.kind_totals(),
+        "audit_total": journal.total,
+        "audit_dropped": journal.records_dropped,
+        "debug_endpoints_ok": endpoint_ok,
+        "ok": (
+            wal["ok"]
+            and ticks_fired > 0
+            and len(tick_records) == ticks_fired
+            and endpoint_ok
+        ),
+    }
+
+    # ---- scheduling-latency SLO leg --------------------------------------
+    lat = []
+    for r in tick_records:
+        name = r["key"].rsplit("/", 1)[-1]
+        fs = first_step_at.get(name)
+        if fs is not None:
+            lat.append(max(0.0, fs - r["ts"]))
+    lat.sort()
+    slo = {
+        "samples": len(lat),
+        "p50_s": round(_percentile(lat, 0.50), 4),
+        "p95_s": round(_percentile(lat, 0.95), 4),
+        "max_s": round(lat[-1], 4) if lat else 0.0,
+        "slo_p95_s": SCHED_SLO_P95_S,
+        "ok": bool(lat) and _percentile(lat, 0.95) <= SCHED_SLO_P95_S,
+    }
+
+    ex.stop()
+    store.close()
+    pers.close()
+    journal.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+    return {"flight_recorder": recorder, "scheduling_slo": slo}
+
+
+def run_goodput_leg(seed: int, jobs: int, rounds: int) -> dict:
+    """Real CPU-mesh training under preemption storms (the chaos soak's
+    elastic leg), reduced to the goodput verdict."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # Must be set before ANY jax import in this process.
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import chaos_soak
+
+    ev = chaos_soak.run_preempt_soak(seed, jobs, rounds, elastic=True)
+    goodput = chaos_soak.compute_goodput(ev)
+    goodput["preempt_events"] = len(ev["preempt_events"])
+    goodput["resumes"] = int(ev["metrics"]["resumes"])
+    return goodput
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--check", action="store_true", default=False,
+                    help="fast legs only (no real training) — the CI "
+                         "smoke; verdict still OK/REGRESSION")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--goodput-jobs", type=int, default=2,
+                    help="logical training runs in the goodput leg")
+    ap.add_argument("--goodput-rounds", type=int, default=2,
+                    help="preemption-storm rounds in the goodput leg")
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT,
+                                                  "BENCH_OBS.json"))
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    mode = "check" if args.check else "full"
+    print(f"obs report ({mode}): crons={OBS_CRONS} rounds={OBS_ROUNDS}",
+          flush=True)
+    report = {"mode": mode, **run_fast_legs()}
+
+    if not args.check:
+        print(
+            f"  goodput leg: jobs={args.goodput_jobs} "
+            f"rounds={args.goodput_rounds} (real CPU-mesh training)",
+            flush=True,
+        )
+        report["goodput"] = run_goodput_leg(
+            args.seed, args.goodput_jobs, args.goodput_rounds
+        )
+
+    legs = [("flight_recorder", report["flight_recorder"]),
+            ("scheduling_slo", report["scheduling_slo"])]
+    if "goodput" in report:
+        legs.append(("goodput", report["goodput"]))
+    ok = all(leg["ok"] for _, leg in legs)
+    report["verdict"] = "OK" if ok else "REGRESSION"
+    report["elapsed_s"] = round(time.time() - t0, 2)
+
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+        f.write("\n")
+
+    for name, leg in legs:
+        mark = "PASS" if leg["ok"] else "FAIL"
+        if name == "flight_recorder":
+            detail = (
+                f"audit≡WAL={leg['wal_check']['ok']} "
+                f"({leg['wal_check']['audited_records']} records), "
+                f"tick_fired audit {leg['tick_fired_audit_records']} == "
+                f"metric {leg['ticks_fired_metric']}, "
+                f"endpoints_ok={leg['debug_endpoints_ok']}"
+            )
+        elif name == "scheduling_slo":
+            detail = (
+                f"p95={leg['p95_s']}s <= {leg['slo_p95_s']}s "
+                f"over {leg['samples']} tick(s)"
+            )
+        else:
+            detail = (
+                f"goodput {leg['overall']} >= floor {leg['floor']} "
+                f"({leg['preempt_events']} preempt(s), "
+                f"{leg['resumes']} resume(s))"
+            )
+        print(f"  [{mark}] {name}: {detail}")
+    print(f"wrote {args.out} (verdict={report['verdict']})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

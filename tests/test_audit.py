@@ -1,0 +1,442 @@
+"""Flight recorder (telemetry/audit.py): unit behavior of the bounded
+ring + WAL cross-check aggregates, the store/controller integration that
+makes audit ≡ WAL hold record for record (invariant I9's store leg), and
+the ``/debug/audit`` / ``/debug/shards`` HTTP surface."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from datetime import timedelta
+
+import pytest
+
+from cron_operator_tpu.api.v1alpha1 import LABEL_CRON_NAME
+from cron_operator_tpu.backends.tpu import ANNOTATION_ELASTIC_RESUME
+from cron_operator_tpu.controller import CronReconciler
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.runtime.persistence import Persistence
+from cron_operator_tpu.telemetry import ANNOTATION_TRACE_ID, AuditJournal
+from cron_operator_tpu.telemetry.audit import object_key
+
+CRON_API = "apps.kubedl.io/v1alpha1"
+WL_API = "kubeflow.org/v1"
+WL_KIND = "JAXJob"
+
+
+def _cron(name="demo", schedule="*/5 * * * *", policy=None):
+    spec = {
+        "schedule": schedule,
+        "template": {"workload": {
+            "apiVersion": WL_API, "kind": WL_KIND,
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+        }},
+    }
+    if policy:
+        spec["concurrencyPolicy"] = policy
+    return {
+        "apiVersion": CRON_API, "kind": "Cron",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+class TestJournalUnit:
+    def test_record_seq_kind_totals_and_total(self):
+        j = AuditJournal()
+        j.record("store", "create", key="a/b/ns/x", wal_pos=1, rv=1)
+        j.record("decision", "tick_fired", key="a/b/ns/x", trace_id="t-1")
+        j.record("cluster", "lease_acquired", reason="op-1")
+        assert j.total == 3
+        assert j.kind_totals() == {"store": 1, "decision": 1, "cluster": 1}
+        recs = j.records()
+        assert [r["seq"] for r in recs] == [1, 2, 3]
+        assert recs[1]["trace_id"] == "t-1"
+        assert recs[2]["reason"] == "op-1"
+
+    def test_filters_and_limit_keeps_newest(self):
+        j = AuditJournal()
+        for i in range(10):
+            j.record("store", "update", key=f"a/b/ns/obj-{i}",
+                     shard=i % 2, trace_id=f"t-{i % 3}")
+        assert len(j.records(kind="store")) == 10
+        assert len(j.records(kind="decision")) == 0
+        assert len(j.records(shard=1)) == 5
+        assert len(j.records(trace_id="t-0")) == 4
+        assert [r["key"] for r in j.records(key_contains="obj-7")] \
+            == ["a/b/ns/obj-7"]
+        # limit keeps the NEWEST matches — the tail of a flight recorder
+        tail = j.records(limit=3)
+        assert [r["seq"] for r in tail] == [8, 9, 10]
+
+    def test_ring_bounded_eviction_counted_totals_exact(self):
+        m = Metrics()
+        j = AuditJournal(max_records=4, metrics=m)
+        for i in range(10):
+            j.record("decision", "tick_fired", key=f"k-{i}")
+        assert len(j.records()) == 4
+        assert j.records_dropped == 6
+        assert m.get("audit_records_dropped_total") == 6
+        # per-kind totals and total survive eviction
+        assert j.total == 10
+        assert j.kind_totals() == {"decision": 10}
+        assert m.get('audit_records_total{kind="decision"}') == 10
+
+    def test_jsonl_sink_outlives_ring(self, tmp_path):
+        path = str(tmp_path / "audit.jsonl")
+        j = AuditJournal(max_records=2, sink_path=path)
+        for i in range(5):
+            j.record("store", "create", key=f"k-{i}", wal_pos=i + 1)
+        j.close()
+        lines = [json.loads(line)
+                 for line in open(path) if line.strip()]
+        assert [r["seq"] for r in lines] == [1, 2, 3, 4, 5]
+        assert lines[0]["key"] == "k-0"  # evicted from ring, on tape
+        assert len(j.records()) == 2
+
+    def test_render_json_filters_and_default_bound(self):
+        j = AuditJournal()
+        for i in range(300):
+            j.record("store", "update", key=f"k-{i}")
+        j.record("decision", "tick_fired", key="k-x", trace_id="t-z")
+        doc = json.loads(j.render_json({}))
+        assert doc["total"] == 301
+        assert doc["matched"] == 256  # default limit bounds the body
+        assert len(doc["records"]) == 256
+        doc = json.loads(j.render_json(
+            {"kind": ["decision"], "trace": ["t-z"], "limit": ["5"]}
+        ))
+        assert doc["matched"] == 1
+        assert doc["records"][0]["event"] == "tick_fired"
+        # malformed params degrade, never raise
+        doc = json.loads(j.render_json(
+            {"shard": ["bogus"], "limit": ["many"]}
+        ))
+        assert doc["matched"] == 256
+
+    def test_shard_view_stamps_and_delegates(self):
+        j = AuditJournal()
+        v = j.shard_view(3)
+        v.record("store", "create", key="k", wal_pos=1)
+        (rec,) = j.records()
+        assert rec["shard"] == 3
+        # explicit shard wins over the view's stamp
+        v.record("cluster", "shard_failover", shard=7)
+        assert j.records()[-1]["shard"] == 7
+        # delegation: the view answers the whole journal surface
+        assert v.total == 2
+        assert v.wal_check(1, shard=3)["ok"]
+
+    def test_object_key(self):
+        assert object_key({
+            "apiVersion": CRON_API, "kind": "Cron",
+            "metadata": {"namespace": "ns", "name": "x"},
+        }) == f"{CRON_API}/Cron/ns/x"
+        assert object_key({}) == "///"
+
+
+class TestWalCrossCheck:
+    def test_contiguous_stream_passes(self):
+        j = AuditJournal()
+        for i in range(1, 6):
+            j.record("store", "update", key="k", wal_pos=i)
+        check = j.wal_check(5)
+        assert check["ok"]
+        assert check["audited_records"] == 5
+        assert check["unaudited_tail"] == 0
+
+    def test_gap_in_positions_fails(self):
+        j = AuditJournal()
+        j.record("store", "update", key="k", wal_pos=1)
+        j.record("store", "update", key="k", wal_pos=3)  # 2 missing
+        check = j.wal_check(3)
+        assert not check["ok"]
+        assert not check["contiguous"]
+
+    def test_wal_ahead_of_audit_fails_without_crash_tail(self):
+        j = AuditJournal()
+        j.record("store", "update", key="k", wal_pos=1)
+        assert not j.wal_check(2)["ok"]          # durable but unaudited
+        assert j.wal_check(2, crash_tail=1)["ok"]  # kill mid-commit
+        assert not j.wal_check(3, crash_tail=1)["ok"]  # only ONE in flight
+
+    def test_audit_ahead_of_wal_fails(self):
+        j = AuditJournal()
+        j.record("store", "update", key="k", wal_pos=1)
+        j.record("store", "update", key="k", wal_pos=2)
+        assert not j.wal_check(1)["ok"]  # audited verb never durable
+
+    def test_stream_must_start_at_one(self):
+        j = AuditJournal()
+        j.record("store", "update", key="k", wal_pos=2)
+        assert not j.wal_check(2)["ok"]
+
+    def test_empty_journal_matches_empty_wal_only(self):
+        j = AuditJournal()
+        assert j.wal_check(0)["ok"]
+        assert not j.wal_check(4)["ok"]
+
+    def test_reset_wal_judges_the_new_wal(self):
+        j = AuditJournal()
+        v = j.shard_view(0)
+        for i in range(1, 4):
+            v.record("store", "update", key="k", wal_pos=i)
+        assert j.wal_check(3, shard=0)["ok"]
+        # failover: fresh Persistence restarts the position counter
+        j.reset_wal(0)
+        v.record("store", "update", key="k", wal_pos=1)
+        check = j.wal_check(1, shard=0)
+        assert check["ok"]
+        assert check["audited_records"] == 1
+
+    def test_per_shard_streams_are_independent(self):
+        j = AuditJournal()
+        a, b = j.shard_view(0), j.shard_view(1)
+        a.record("store", "update", key="k", wal_pos=1)
+        b.record("store", "update", key="k", wal_pos=1)
+        b.record("store", "update", key="k", wal_pos=2)
+        assert j.wal_check(1, shard=0)["ok"]
+        assert j.wal_check(2, shard=1)["ok"]
+        assert not j.wal_check(2, shard=0)["ok"]
+
+
+class TestStoreIntegration:
+    """Every committed verb audited, under the same lock as its WAL
+    append — the property wal_check certifies."""
+
+    @pytest.fixture
+    def stack(self, api, tmp_path):
+        journal = AuditJournal()
+        pers = Persistence(str(tmp_path), flush_interval_s=0)
+        pers.attach_audit(journal)
+        pers.start(api)
+        api.attach_audit(journal)
+        yield api, pers, journal
+        pers.close()
+
+    def test_verbs_audited_contiguously_and_match_wal(self, stack):
+        api, pers, journal = stack
+        api.create(_cron("a"))
+        api.create(_cron("b"))
+        obj = api.get(CRON_API, "Cron", "default", "a")
+        obj = dict(obj)
+        obj["metadata"] = dict(obj["metadata"],
+                               labels={"touched": "yes"})
+        api.update(obj)
+        api.patch_status(CRON_API, "Cron", "default", "b",
+                         {"lastScheduleTime": "2026-01-01T00:00:00Z"})
+        api.delete(CRON_API, "Cron", "default", "a")
+
+        events = [r["event"] for r in journal.records(kind="store")]
+        assert events == ["create", "create", "update", "patch_status",
+                          "delete"]
+        check = journal.wal_check(pers.records_appended)
+        assert check["ok"], check
+        # each record carries the committed rv and its WAL position
+        recs = journal.records(kind="store")
+        assert [r["wal_pos"] for r in recs] == [1, 2, 3, 4, 5]
+        assert all(r["rv"] is not None for r in recs)
+
+    def test_noop_status_patch_not_audited(self, stack):
+        api, pers, journal = stack
+        api.create(_cron("a"))
+        api.patch_status(CRON_API, "Cron", "default", "a",
+                         {"benchSeq": "steady"})
+        before = journal.total
+        wal_before = pers.records_appended
+        for _ in range(10):
+            api.patch_status(CRON_API, "Cron", "default", "a",
+                             {"benchSeq": "steady"})
+        assert journal.total == before       # elided before the journal
+        assert pers.records_appended == wal_before  # and before the WAL
+        assert journal.wal_check(pers.records_appended)["ok"]
+
+    def test_trace_id_from_annotation_lands_on_record(self, stack):
+        api, pers, journal = stack
+        wl = {
+            "apiVersion": WL_API, "kind": WL_KIND,
+            "metadata": {
+                "name": "j", "namespace": "default",
+                "annotations": {ANNOTATION_TRACE_ID: "cafe0123deadbeef"},
+            },
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+        }
+        api.create(wl)
+        (rec,) = journal.records(kind="store", event="create")
+        assert rec["trace_id"] == "cafe0123deadbeef"
+        assert rec["key"] == f"{WL_API}/{WL_KIND}/default/j"
+
+
+class TestControllerDecisions:
+    def test_tick_fired_audited_with_workload_trace_id(
+        self, api, fake_clock
+    ):
+        journal = AuditJournal()
+        api.attach_audit(journal)
+        rec = CronReconciler(api, audit=journal)
+        api.create(_cron())
+        fake_clock.advance(timedelta(minutes=10))
+        rec.reconcile("default", "demo")
+
+        (fired,) = journal.records(kind="decision", event="tick_fired")
+        (job,) = api.list(WL_API, WL_KIND, namespace="default")
+        assert fired["trace_id"] \
+            == job["metadata"]["annotations"][ANNOTATION_TRACE_ID]
+        assert fired["key"].endswith(job["metadata"]["name"])
+        # the submit decision shares the tick's trace id
+        (submit,) = journal.records(kind="decision", event="submit")
+        assert submit["trace_id"] == fired["trace_id"]
+
+    def test_tick_skipped_forbid_audited_with_reason(
+        self, api, fake_clock
+    ):
+        journal = AuditJournal()
+        rec = CronReconciler(api, audit=journal)
+        api.create(_cron(policy="Forbid"))
+        fake_clock.advance(timedelta(minutes=5))
+        rec.reconcile("default", "demo")  # fires; workload stays active
+        fake_clock.advance(timedelta(minutes=5))
+        rec.reconcile("default", "demo")  # Forbid: active run blocks it
+
+        (skip,) = journal.records(kind="decision", event="tick_skipped")
+        assert skip["reason"] == "Forbid"
+        assert len(journal.records(event="tick_fired")) == 1
+
+    def test_resume_decision_audited_with_lineage(self, api, fake_clock):
+        journal = AuditJournal()
+        rec = CronReconciler(api, audit=journal)
+        api.create(_cron(schedule="0 0 1 1 *"))  # no tick due today
+        api.create({
+            "apiVersion": WL_API, "kind": WL_KIND,
+            "metadata": {
+                "name": "demo-run", "namespace": "default",
+                "labels": {LABEL_CRON_NAME: "demo"},
+                "annotations": {
+                    ANNOTATION_ELASTIC_RESUME: "true",
+                    ANNOTATION_TRACE_ID: "feed0123deadbeef",
+                },
+            },
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 8}}},
+        })
+        api.patch_status(WL_API, WL_KIND, "default", "demo-run", {
+            "conditions": [
+                {"type": "Preempted", "status": "True",
+                 "reason": "TPUSlicePreempted"},
+                {"type": "Failed", "status": "True",
+                 "reason": "TPUSlicePreempted"},
+            ],
+            "preemption": {"survivingDevices": 4, "priorDevices": 8},
+        })
+        rec.reconcile("default", "demo")
+
+        (resume,) = journal.records(kind="decision", event="resume")
+        assert resume["reason"] == "TPUSlicePreempted"
+        assert resume["key"].endswith("demo-run-r1")
+        # lineage: the successor carries (and the record names) the
+        # ROOT attempt's trace id
+        assert resume["trace_id"] == "feed0123deadbeef"
+        assert resume["attrs"]["root"] == "demo-run"
+        assert resume["attrs"]["attempt"] == 1
+
+
+class TestDebugEndpoints:
+    """The HTTP surface: filter params, bounded bodies, content types,
+    and the empty-store shape."""
+
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5
+        ) as resp:
+            return resp.headers["Content-Type"], resp.read().decode()
+
+    def test_debug_audit_params_bound_and_content_type(self):
+        from cron_operator_tpu.cli.main import _serve
+
+        journal = AuditJournal()
+        for i in range(300):
+            journal.record("store", "update", key=f"k-{i}", shard=0)
+        journal.record("decision", "tick_fired", key="cron/x",
+                       trace_id="t-q", shard=1)
+        server = _serve(
+            0,
+            {"/debug/audit": lambda params: (
+                journal.render_json(params), "application/json")},
+            "test-audit",
+        )
+        try:
+            port = server.server_address[1]
+            ctype, body = self._get(port, "/debug/audit")
+            assert ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["total"] == 301
+            assert doc["matched"] == 256  # default limit bounds the body
+
+            _, body = self._get(
+                port, "/debug/audit?kind=decision&trace=t-q&limit=10"
+            )
+            doc = json.loads(body)
+            assert doc["matched"] == 1
+            assert doc["records"][0]["event"] == "tick_fired"
+
+            _, body = self._get(port, "/debug/audit?shard=1")
+            assert json.loads(body)["matched"] == 1
+
+            _, body = self._get(port, "/debug/audit?limit=7")
+            doc = json.loads(body)
+            assert len(doc["records"]) == 7
+            # newest tail: the decision record is the last one
+            assert doc["records"][-1]["kind"] == "decision"
+        finally:
+            server.shutdown()
+
+    def test_debug_audit_empty_store(self):
+        from cron_operator_tpu.cli.main import _serve
+
+        journal = AuditJournal()
+        server = _serve(
+            0,
+            {"/debug/audit": lambda params: (
+                journal.render_json(params), "application/json")},
+            "test-audit-empty",
+        )
+        try:
+            port = server.server_address[1]
+            ctype, body = self._get(port, "/debug/audit?kind=store")
+            assert ctype == "application/json"
+            doc = json.loads(body)
+            assert doc == {"total": 0, "dropped": 0, "kind_totals": {},
+                           "matched": 0, "records": []}
+        finally:
+            server.shutdown()
+
+    def test_debug_shards_shape(self, tmp_path):
+        from cron_operator_tpu.cli.main import _serve
+        from cron_operator_tpu.runtime.shard import ShardedControlPlane
+
+        plane = ShardedControlPlane(
+            n_shards=2, data_dir=str(tmp_path), flush_interval_s=0
+        )
+        try:
+            plane.router.create(_cron("alpha"))
+            plane.router.create(_cron("beta"))
+            server = _serve(
+                0,
+                {"/debug/shards": lambda: (
+                    plane.render_debug_json(), "application/json")},
+                "test-shards",
+            )
+            try:
+                port = server.server_address[1]
+                ctype, body = self._get(port, "/debug/shards")
+                assert ctype == "application/json"
+                doc = json.loads(body)
+                assert doc["n_shards"] == 2
+                assert len(doc["shards"]) == 2
+                for entry in doc["shards"]:
+                    assert {"shard", "objects", "rv", "failovers",
+                            "leader", "data_dir", "wal"} <= set(entry)
+                assert sum(s["objects"] for s in doc["shards"]) == 2
+            finally:
+                server.shutdown()
+        finally:
+            plane.close()

@@ -15,13 +15,22 @@ from cron_operator_tpu.runtime.manager import _FAMILY_META
 PKG_ROOT = pathlib.Path(cron_operator_tpu.__file__).parent
 
 # Family = the leading identifier of the first string literal passed to a
-# metrics sink call. Receiver-restricted (`metrics.` / the reconciler's
-# `self._count` shim) so unrelated `.set()` calls (threading.Event etc.)
-# never match; `\s*` spans newlines, catching the multi-line
-# 'family' f'{{labels}}' concatenation idiom.
+# metrics sink call. Receiver-restricted (`metrics.` / the `self._count`
+# shim in the reconciler and audit journal / persistence's
+# `self._observe` histogram shim) so unrelated `.set()` calls
+# (threading.Event etc.) never match; `\s*` spans newlines, catching the
+# multi-line 'family' f'{{labels}}' concatenation idiom.
 _CALL_RE = re.compile(
-    r"(?:metrics\.(?:inc|observe|set)|self\._count)\(\s*"
+    r"(?:metrics\.(?:inc|observe|set)|self\._(?:count|observe))\(\s*"
     r"f?['\"]([A-Za-z_][A-Za-z0-9_]*)"
+)
+
+# Interned-series idiom: hot loops pre-format the series once into an
+# `s_*` local (manager worker) or `self._s_*` attribute (workqueue) and
+# pass the variable to the sink, so the literal never appears inside the
+# call parens. The assignment itself carries the family name.
+_INTERN_RE = re.compile(
+    r"(?:\b|\.)_?s_[a-z_]+\s*=\s*\(?\s*f?['\"]([A-Za-z_][A-Za-z0-9_]*)"
 )
 
 
@@ -29,11 +38,12 @@ def _emitted_families():
     found = {}
     for path in sorted(PKG_ROOT.rglob("*.py")):
         text = path.read_text()
-        for m in _CALL_RE.finditer(text):
-            found.setdefault(m.group(1), []).append(
-                f"{path.relative_to(PKG_ROOT.parent)}:"
-                f"{text.count(chr(10), 0, m.start()) + 1}"
-            )
+        for regex in (_CALL_RE, _INTERN_RE):
+            for m in regex.finditer(text):
+                found.setdefault(m.group(1), []).append(
+                    f"{path.relative_to(PKG_ROOT.parent)}:"
+                    f"{text.count(chr(10), 0, m.start()) + 1}"
+                )
     return found
 
 

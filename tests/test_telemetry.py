@@ -93,6 +93,120 @@ class TestTracerUnit:
         assert len(ids) == 64
         assert all(len(i) == 16 for i in ids)
 
+    def test_spans_dropped_counted_and_metered(self):
+        from cron_operator_tpu.runtime.manager import Metrics
+
+        m = Metrics()
+        tr = Tracer(max_spans=2)
+        tr.instrument(m)
+        for i in range(5):
+            tr.record(f"s{i}", "t-ffff", start_s=float(i), end_s=float(i))
+        assert tr.spans_dropped == 3
+        assert m.get("trace_spans_dropped_total") == 3
+        # eviction is visible on the served body, never silent
+        assert json.loads(tr.render_json())["spans_dropped"] == 3
+
+
+class TestLineage:
+    """Elastic-resume lineage: one trace id spans the whole preempt→
+    resume chain, and /debug/traces summarizes productive vs. wasted
+    steps per attempt."""
+
+    def test_resume_spans_render_lineage_summary(self):
+        tr = Tracer()
+        tid = "t-chain"
+        tr.record("first_step", tid, start_s=1.0, end_s=2.0)
+        tr.record("resume", tid, start_s=10.0, end_s=11.0, attrs={
+            "attempt": 1, "workload": "run-r1",
+            "resumed_from_step": 100, "pre_steps": 130,
+        })
+        tr.record("resume", tid, start_s=20.0, end_s=21.0, attrs={
+            "attempt": 2, "workload": "run-r2",
+            "resumed_from_step": 200, "pre_steps": 220,
+        })
+        (trace,) = [t for t in tr.traces() if t["trace_id"] == tid]
+        lin = trace["lineage"]
+        assert lin["attempts"] == 3
+        assert [c["attempt"] for c in lin["resumes"]] == [1, 2]
+        assert [c["wasted_steps"] for c in lin["resumes"]] == [30, 20]
+        assert lin["wasted_steps"] == 50
+        # lineage appears on the served JSON too
+        served = json.loads(tr.render_json())
+        (entry,) = [t for t in served["traces"] if t["trace_id"] == tid]
+        assert entry["lineage"]["attempts"] == 3
+
+    def test_trace_without_resumes_has_no_lineage(self):
+        tr = Tracer()
+        tr.record("reconcile", "t-plain", start_s=1.0, end_s=2.0)
+        (trace,) = tr.traces()
+        assert "lineage" not in trace
+
+    def test_controller_propagates_root_trace_through_resume(
+        self, api, fake_clock
+    ):
+        """The -r1 successor inherits the ROOT attempt's trace id (no
+        fresh id minted), and the reconciler records a resume span with
+        the chain's productive/wasted step attrs under that id."""
+        from cron_operator_tpu.api.v1alpha1 import LABEL_CRON_NAME
+        from cron_operator_tpu.backends.tpu import (
+            ANNOTATION_ELASTIC_RESUME,
+        )
+
+        tracer = Tracer()
+        rec = CronReconciler(api, tracer=tracer)
+        cron = _cron(schedule="0 0 1 1 *")  # no tick due
+        api.create(cron)
+        api.create({
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {
+                "name": "demo-run", "namespace": "default",
+                "labels": {LABEL_CRON_NAME: "demo"},
+                "annotations": {
+                    ANNOTATION_ELASTIC_RESUME: "true",
+                    ANNOTATION_TRACE_ID: "feed0123deadbeef",
+                },
+            },
+            "spec": {"replicaSpecs": {"Worker": {"replicas": 8}}},
+        })
+        api.patch_status("kubeflow.org/v1", "JAXJob", "default",
+                         "demo-run", {
+                             "conditions": [
+                                 {"type": "Preempted", "status": "True",
+                                  "reason": "TPUSlicePreempted"},
+                                 {"type": "Failed", "status": "True",
+                                  "reason": "TPUSlicePreempted"},
+                             ],
+                             "preemption": {"survivingDevices": 4,
+                                            "priorDevices": 8},
+                             "trainingProgress": {"steps_done": 130},
+                         })
+        rec.reconcile("default", "demo")
+
+        successor = api.get("kubeflow.org/v1", "JAXJob", "default",
+                            "demo-run-r1")
+        ann = successor["metadata"]["annotations"]
+        assert ann[ANNOTATION_TRACE_ID] == "feed0123deadbeef"
+
+        # successor starts training from its checkpoint; the next sweep
+        # records the resume span under the inherited trace id
+        api.patch_status("kubeflow.org/v1", "JAXJob", "default",
+                         "demo-run-r1", {"trainingProgress": {
+                             "resumed_from_step": 100,
+                             "steps_done": 105,
+                         }})
+        rec.reconcile("default", "demo")
+
+        spans = tracer.spans("feed0123deadbeef")
+        (resume,) = [s for s in spans if s["name"] == "resume"]
+        assert resume["attrs"]["attempt"] == 1
+        assert resume["attrs"]["workload"] == "demo-run-r1"
+        assert resume["attrs"]["resumed_from_step"] == 100
+        assert resume["attrs"]["pre_steps"] == 130
+        assert resume["attrs"]["wasted_steps"] == 30
+        (trace,) = [t for t in tracer.traces()
+                    if t["trace_id"] == "feed0123deadbeef"]
+        assert trace["lineage"]["wasted_steps"] == 30
+
 
 def _cron(name="demo", schedule="*/5 * * * *"):
     return {
