@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards chaos-soak chaos-soak-preempt obs-report
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http chaos-soak chaos-soak-preempt obs-report
 
 all: gate
 
@@ -42,6 +42,19 @@ bench:
 # regression fail the target.
 bench-controlplane:
 	python hack/controlplane_bench.py $(if $(BASELINE),--baseline-ref $(BASELINE)) $(if $(CHECK),--check)
+
+# HTTP front-door benchmark (hack/http_bench.py): watch fan-out
+# events/s at 1k watchers with the encode-once invariant asserted,
+# group-commit durable-write p99 from 1 -> 64 concurrent HTTP writers
+# (plus a closed-loop burst that must share fsyncs), APF fairness for a
+# quiet tenant under a 50x+ noisy flood (with a single-flow FIFO
+# control run), and a zero-steady-state-writes check. Writes
+# BENCH_HTTP.json with per-scenario OK/REGRESSION verdicts.
+# BASELINE=<git-ref> replays the fan-out scenario against that ref's
+# thread-per-connection server and gates the >= 5x speedup; CHECK=1
+# runs small sizes and fails the target on any REGRESSION.
+bench-http:
+	python hack/http_bench.py $(if $(BASELINE),--baseline-ref $(BASELINE)) $(if $(CHECK),--check)
 
 # Sharded control-plane sweep (runtime/shard.py): the same steady-state
 # list+reconcile sweep at TOTAL Crons, run per shard count in COUNTS

@@ -256,6 +256,21 @@ def build_parser() -> argparse.ArgumentParser:
                             "rotation, like --metrics-cert-path)")
     start.add_argument("--serve-api-cert-name", default="tls.crt")
     start.add_argument("--serve-api-cert-key", default="tls.key")
+    start.add_argument("--serve-api-tenant-token", action="append",
+                       default=[], metavar="TOKEN=TENANT",
+                       help="additional --serve-api bearer token mapped to a "
+                            "named tenant identity (repeatable); tenants get "
+                            "separate APF fair-queue flows, so one tenant's "
+                            "burst cannot starve another's requests")
+    start.add_argument("--serve-api-seats", type=int, default=None,
+                       metavar="N",
+                       help="concurrency seats for the front door's "
+                            "'workload' priority level (system/batch levels "
+                            "scale to N/2; default: APF built-in budgets)")
+    start.add_argument("--serve-api-queue-depth", type=int, default=None,
+                       metavar="N",
+                       help="per-tenant admission queue depth before 429 "
+                            "(default: APF built-in budgets)")
     start.add_argument("--run-for", type=float, default=None,
                        metavar="SECONDS",
                        help="exit after N seconds (default: run until signal)")
@@ -628,10 +643,37 @@ def cmd_start(args: argparse.Namespace) -> int:
             )
             if api_tls_ctx is None:
                 return 2
+        tenant_tokens = {}
+        for spec in args.serve_api_tenant_token:
+            tok, _, tenant = spec.partition("=")
+            if not tok or not tenant:
+                log.error("--serve-api-tenant-token expects TOKEN=TENANT, "
+                          "got %r", spec)
+                return 2
+            tenant_tokens[tok] = tenant
+        admission = None
+        if args.serve_api_seats or args.serve_api_queue_depth:
+            from cron_operator_tpu.runtime.apf import (
+                DEFAULT_LEVELS, FairQueueAdmission, LevelConfig,
+            )
+
+            seats = args.serve_api_seats or DEFAULT_LEVELS["workload"].seats
+            depth = (args.serve_api_queue_depth
+                     or DEFAULT_LEVELS["workload"].queue_depth)
+            admission = FairQueueAdmission(levels={
+                "system": LevelConfig(seats=max(1, seats // 2),
+                                      queue_depth=depth * 2),
+                "workload": LevelConfig(seats=seats, queue_depth=depth),
+                "batch": LevelConfig(seats=max(1, seats // 2),
+                                     queue_depth=max(1, depth // 2),
+                                     max_queued=max(4, depth * 4)),
+            })
+        front_metrics = shared_metrics if sharded else manager.metrics
         api_http = HTTPAPIServer(
             api=api, scheme=scheme, host=host or "127.0.0.1",
             port=int(port), token=args.serve_api_token,
-            tls_ctx=api_tls_ctx,
+            tls_ctx=api_tls_ctx, tokens=tenant_tokens or None,
+            admission=admission, metrics=front_metrics,
         )
         api_http.start()
         log.info("embedded API serving on %s", api_http.url)

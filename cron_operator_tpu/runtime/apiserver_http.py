@@ -1,10 +1,11 @@
-"""HTTP facade for the embedded control plane — a kube-apiserver dialect.
+"""HTTP front door for the embedded control plane — a kube-apiserver dialect.
 
-Serves a :class:`runtime.kube.APIServer` store over the Kubernetes REST
-protocol: typed collection/object paths, label-selector LIST, the status
-subresource (merge-patch), DeleteOptions propagation, bearer-token auth,
-and streaming WATCH with resourceVersion replay, bookmarks and real
-410-Gone expiry.
+Serves a :class:`runtime.kube.APIServer` store (or a
+:class:`runtime.shard.ShardRouter` over many) over the Kubernetes REST
+protocol: typed collection/object paths, label-selector LIST **and
+WATCH**, the status subresource (merge-patch), DeleteOptions
+propagation, bearer-token auth, and streaming WATCH with
+resourceVersion replay, bookmarks and real 410-Gone expiry.
 
 Two jobs:
 
@@ -21,6 +22,39 @@ Two jobs:
    protocol over real sockets (tests/test_e2e_http.py), not against
    hand-built request fakes.
 
+Production shape (the front-door rebuild):
+
+* **Shared-encode watch fan-out.** Every published event is JSON-encoded
+  exactly once into a chunked-transfer frame; the byte buffer is shared
+  by every matching connection (events carry frozen immutable snapshots,
+  so sharing is safe — the old per-connection ``deepcopy`` + ``dumps``
+  made fan-out cost O(watchers × events) in encodes for no reason).
+  Connections subscribe at the hub by (apiVersion, kind) with
+  namespace/label pre-filtering at publish time, so an event only visits
+  connections that could want it. Each connection gets per-object
+  latest-wins coalescing of MODIFIED frames (the store dispatcher's
+  contract, applied at the wire), a bounded frame queue (a consumer too
+  slow to drain it is dropped and must re-watch), periodic BOOKMARKs
+  while idle, and a live 410 when the ring has evicted past its horizon.
+  Plain-HTTP watch connections are **adopted into a selector loop** after
+  the replay: the per-connection handler thread exits and one event-driven
+  thread services every stream, so 10k watchers cost 10k sockets, not 10k
+  parked threads. (TLS streams keep their handler thread — non-blocking
+  SSL writes are not worth the renegotiation edge cases.)
+
+* **APF-style admission** (:mod:`runtime.apf`). Requests are classified
+  into priority levels (system / workload / batch) and per-tenant flows
+  (auth identity, else namespace); each level runs bounded fair queues
+  with round-robin dispatch, and overflow answers 429 + ``Retry-After``
+  instead of queueing without bound. Watch streams give their seat back
+  once established — a long-lived stream must not pin admission capacity.
+
+* **Durable writes via group commit.** When the store has a persistence
+  layer attached, every write verb blocks on
+  ``Persistence.wait_durable()`` before its 2xx: concurrent HTTP writers
+  batch into one fsync per group, so the 200 means "on disk" and write
+  p99 stays flat as fan-in grows.
+
 Watch semantics mirror the apiserver: events are held in a bounded ring
 buffer indexed by resourceVersion; a watch from an rv that has been
 evicted gets a 410-style ``ERROR`` event (clients must re-list — exactly
@@ -31,17 +65,32 @@ get periodic BOOKMARK events so clients can resume without replay.
 from __future__ import annotations
 
 import copy
+import heapq
 import json
 import logging
+import selectors
+import socket
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from cron_operator_tpu.api.scheme import GVK, Scheme, default_scheme
+from cron_operator_tpu.runtime.apf import (
+    FairQueueAdmission,
+    TooManyRequests,
+    classify,
+    flow_for,
+)
+from cron_operator_tpu.runtime.authfilter import (
+    ScrapeAuthenticator,
+    StaticTokenReviewer,
+)
 from cron_operator_tpu.runtime.kube import (
     AlreadyExistsError,
+    ApiError,
     APIServer,
     ConflictError,
     InvalidError,
@@ -64,6 +113,22 @@ _CORE_KINDS = [
 
 WATCH_BUFFER = 2048  # ring size; older events → 410 on replay
 BOOKMARK_INTERVAL_S = 5.0
+#: Frames a connection may have queued before it is dropped as too slow
+#: (frames are shared bytes, so this bounds references, not copies —
+#: but an unbounded queue lets one dead-slow peer pin the whole ring's
+#: history forever).
+MAX_PENDING_FRAMES = 4096
+#: Per-connection outbound buffer high-water mark (selector loop): stop
+#: concatenating pending frames past this; backpressure then accrues in
+#: the frame queue where the overflow policy can see it.
+OUTBUF_HIGH_WATER = 256 * 1024
+
+#: Request-latency bucket ladder (reads are µs–ms; durable writes add an
+#: fsync; queued requests add their APF wait).
+REQUEST_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 10.0)
+
+_TERMINAL_CHUNK = b"0\r\n\r\n"
 
 
 def _singularize(plural: str) -> str:
@@ -76,37 +141,571 @@ def _singularize(plural: str) -> str:
     return plural
 
 
-class _WatchHub:
-    """Bounded, rv-ordered event log with condition-variable fan-out."""
+def _parse_selector(raw: Optional[str]) -> Optional[Dict[str, str]]:
+    """``labelSelector`` query value → equality map (``k=v,k2=v2``)."""
+    if not raw:
+        return None
+    return dict(kv.split("=", 1) for kv in raw.split(",") if "=" in kv)
 
-    def __init__(self, size: int = WATCH_BUFFER):
-        self._cond = threading.Condition()
+
+def _selector_matches(selector: Dict[str, str], labels: Dict[str, Any]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class _Entry:
+    """One published event in the hub ring. ``frame`` is the lazily
+    encoded chunked-transfer frame — encoded at most once, shared by
+    every connection and replay that delivers this event."""
+
+    __slots__ = ("rv", "av", "kind", "ns", "name", "labels", "ev_type",
+                 "obj", "frame")
+
+    def __init__(self, rv: int, av: str, kind: str, ns: str, name: str,
+                 labels: Dict[str, Any], ev_type: str, obj: Unstructured):
+        self.rv = rv
+        self.av = av
+        self.kind = kind
+        self.ns = ns
+        self.name = name
+        self.labels = labels
+        self.ev_type = ev_type
+        self.obj = obj
+        self.frame: Optional[bytes] = None
+
+
+def _frame_for(payload: Dict[str, Any]) -> bytes:
+    """JSON payload → one chunked-transfer frame (hex length, line, CRLF)."""
+    line = (json.dumps(payload) + "\n").encode()
+    return b"%x\r\n" % len(line) + line + b"\r\n"
+
+
+_EXPIRED_FRAME = _frame_for({"type": "ERROR", "object": {
+    "kind": "Status", "code": 410, "reason": "Expired",
+    "message": "too old resource version",
+}})
+
+
+class _WatchConn:
+    """One watch stream's hub-side state. All fields are guarded by the
+    hub lock; ``cv`` (thread mode) shares that lock so a publish can
+    wake exactly this stream's handler."""
+
+    __slots__ = ("av", "kind", "ns", "selector", "mode", "pending",
+                 "mod_idx", "cv", "sock", "outbuf", "mask", "horizon",
+                 "last_sent_rv", "next_bookmark", "overflowed", "closed",
+                 "dirty", "max_pending")
+
+    def __init__(self, av: str, kind: str, ns: Optional[str],
+                 selector: Optional[Dict[str, str]], mode: str,
+                 cv: Optional[threading.Condition],
+                 max_pending: int = MAX_PENDING_FRAMES):
+        self.av = av
+        self.kind = kind
+        self.ns = ns or None
+        self.selector = selector
+        self.mode = mode  # "thread" | "selector"
+        # Queued frames as mutable [frame, key, ev_type, rv] slots so a
+        # newer MODIFIED of the same object can overwrite in place
+        # (latest-wins coalescing without reordering).
+        self.pending: deque = deque()
+        self.mod_idx: Dict[Tuple, List] = {}
+        self.cv = cv
+        self.sock: Optional[socket.socket] = None
+        self.outbuf = b""
+        self.mask = 0
+        self.horizon = 0        # rv this stream is known caught up past
+        self.last_sent_rv = 0
+        self.next_bookmark = 0.0
+        self.overflowed = False
+        self.closed = False
+        self.dirty = False      # queued for selector-loop service
+        self.max_pending = max_pending
+
+
+class _WatchHub:
+    """Shared-encode watch fan-out hub.
+
+    A bounded, rv-ordered ring of published events (for replay + 410
+    horizon tracking) plus a (apiVersion, kind)-keyed subscription index.
+    ``publish`` encodes a matching event's frame once and pushes the
+    shared bytes to every matching connection; connections are serviced
+    either by their own handler thread (TLS) or by the hub's selector
+    loop (plain HTTP, after socket adoption)."""
+
+    def __init__(self, size: int = WATCH_BUFFER, metrics=None):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
         self._events: deque = deque(maxlen=size)
         self._oldest_evicted_rv = 0  # highest rv ever dropped from the ring
+        # Per-(apiVersion, kind) eviction horizon: mid-stream expiry must
+        # only fire for streams whose OWN kind lost history — ring churn
+        # on other kinds is irrelevant to a quiet watcher (live streams
+        # receive matching events at publish time; the ring only matters
+        # for replay and for this poke-able expiry signal).
+        self._evicted_by_kind: Dict[Tuple[str, str], int] = {}
+        self._last_rv = 0
+        self._subs: Dict[Tuple[str, str], set] = {}
+        self._nconns = 0
+        self._metrics = metrics
+        # Shared-encode forensics (asserted by the encode-count test and
+        # the fan-out bench): encodes counts json.dumps calls, frames_sent
+        # counts deliveries — fan-out efficiency is the ratio.
+        self.encodes = 0
+        self.frames_sent = 0
+        self.coalesced = 0
+        self.dropped = 0
+        # Selector loop state.
+        self._loop_thread: Optional[threading.Thread] = None
+        self._loop_stop = threading.Event()
+        self._loop_add: deque = deque()
+        self._loop_dirty: deque = deque()
+        self._wake_r: Optional[socket.socket] = None
+        self._wake_w: Optional[socket.socket] = None
+
+    def instrument(self, metrics) -> None:
+        self._metrics = metrics
+
+    # ---- publish / subscribe (store dispatcher + handler threads) --------
 
     def publish(self, ev: WatchEvent) -> None:
-        rv = int((ev.object.get("metadata") or {}).get("resourceVersion", 0))
+        obj = ev.object
+        meta = obj.get("metadata") or {}
+        try:
+            rv = int(meta.get("resourceVersion", 0) or 0)
+        except (TypeError, ValueError):
+            rv = 0
+        entry = _Entry(rv, obj.get("apiVersion") or "", obj.get("kind") or "",
+                       meta.get("namespace") or "", meta.get("name") or "",
+                       meta.get("labels") or {}, ev.type, obj)
+        wake = False
         with self._cond:
-            if len(self._events) == self._events.maxlen and self._events:
+            ring = self._events
+            if ring.maxlen is not None and len(ring) == ring.maxlen and ring:
+                evicted = ring[0]
                 self._oldest_evicted_rv = max(
-                    self._oldest_evicted_rv, self._events[0][0]
+                    self._oldest_evicted_rv, evicted.rv
                 )
-            self._events.append((rv, ev))
+                ek = (evicted.av, evicted.kind)
+                if evicted.rv > self._evicted_by_kind.get(ek, 0):
+                    self._evicted_by_kind[ek] = evicted.rv
+            ring.append(entry)
+            self._last_rv = max(self._last_rv, rv)
+            # Kind pre-filter at the hub: only same-(av, kind) streams are
+            # visited at all; namespace/selector checks run per candidate.
+            subs = self._subs.get((entry.av, entry.kind))
+            if subs:
+                frame = None
+                key = (entry.av, entry.kind, entry.ns, entry.name)
+                for conn in subs:
+                    if conn.ns and entry.ns != conn.ns:
+                        continue
+                    if conn.selector and not _selector_matches(
+                            conn.selector, entry.labels):
+                        continue
+                    if frame is None:
+                        frame = self._encode_locked(entry)
+                    wake |= self._push_locked(conn, key, entry.ev_type,
+                                              frame, rv)
+            self._cond.notify_all()
+        if wake:
+            self._wake_loop()
+
+    def attach(self, conn: _WatchConn, after_rv: int) -> bool:
+        """Replay events past ``after_rv`` into ``conn`` and subscribe it,
+        atomically (no gap between replay and live pushes). Returns True
+        when the requested horizon has been evicted (caller answers 410
+        and must NOT stream)."""
+        with self._cond:
+            if after_rv < self._oldest_evicted_rv:
+                return True
+            for entry in self._events:
+                if entry.rv <= after_rv:
+                    continue
+                if entry.av != conn.av or entry.kind != conn.kind:
+                    continue
+                if conn.ns and entry.ns != conn.ns:
+                    continue
+                if conn.selector and not _selector_matches(
+                        conn.selector, entry.labels):
+                    continue
+                self._push_locked(
+                    conn, (entry.av, entry.kind, entry.ns, entry.name),
+                    entry.ev_type, self._encode_locked(entry), entry.rv,
+                )
+            conn.horizon = max(after_rv, 0)
+            conn.next_bookmark = time.monotonic() + BOOKMARK_INTERVAL_S
+            self._subs.setdefault((conn.av, conn.kind), set()).add(conn)
+            self._nconns += 1
+            self._set_conn_gauge_locked()
+        return False
+
+    def detach(self, conn: _WatchConn) -> None:
+        with self._cond:
+            if conn.closed:
+                return
+            conn.closed = True
+            subs = self._subs.get((conn.av, conn.kind))
+            if subs is not None:
+                subs.discard(conn)
+                if not subs:
+                    del self._subs[(conn.av, conn.kind)]
+            self._nconns -= 1
+            self._set_conn_gauge_locked()
+
+    def _set_conn_gauge_locked(self) -> None:
+        metrics = self._metrics
+        if metrics is not None:
+            metrics.set("http_watch_connections", float(self._nconns))
+
+    def _encode_locked(self, entry: _Entry) -> bytes:
+        frame = entry.frame
+        if frame is None:
+            frame = _frame_for({"type": entry.ev_type, "object": entry.obj})
+            entry.frame = frame
+            self.encodes += 1
+            metrics = self._metrics
+            if metrics is not None:
+                metrics.inc("http_watch_event_encodes_total")
+        return frame
+
+    def _push_locked(self, conn: _WatchConn, key: Tuple, ev_type: str,
+                     frame: bytes, rv: int) -> bool:
+        """Queue a shared frame on one connection. Returns True when the
+        selector loop needs a wakeup for this connection."""
+        if conn.closed or conn.overflowed:
+            return False
+        if ev_type == "MODIFIED":
+            slot = conn.mod_idx.get(key)
+            if slot is not None:
+                # Latest-wins: a newer version of an object whose older
+                # MODIFIED is still queued replaces it in place.
+                slot[0] = frame
+                slot[3] = rv
+                self.coalesced += 1
+                metrics = self._metrics
+                if metrics is not None:
+                    metrics.inc("http_watch_coalesced_total")
+                return False
+        if len(conn.pending) >= conn.max_pending:
+            # Too slow to drain: drop the stream (the client re-watches;
+            # if its rv has aged out by then, the 410 path re-lists).
+            conn.overflowed = True
+            self.dropped += 1
+            metrics = self._metrics
+            if metrics is not None:
+                metrics.inc("http_watch_dropped_total")
+        else:
+            slot = [frame, key, ev_type, rv]
+            conn.pending.append(slot)
+            if ev_type == "MODIFIED":
+                conn.mod_idx[key] = slot
+        if conn.mode == "thread":
+            if conn.cv is not None:
+                conn.cv.notify_all()
+            return False
+        if not conn.dirty:
+            conn.dirty = True
+            self._loop_dirty.append(conn)
+        return True
+
+    def _pop_frames_locked(self, conn: _WatchConn,
+                           max_bytes: int = OUTBUF_HIGH_WATER) -> bytes:
+        bufs: List[bytes] = []
+        total = 0
+        sent = 0
+        while conn.pending and total < max_bytes:
+            slot = conn.pending.popleft()
+            frame, key, ev_type, rv = slot
+            if ev_type == "MODIFIED" and conn.mod_idx.get(key) is slot:
+                del conn.mod_idx[key]
+            bufs.append(frame)
+            total += len(frame)
+            conn.last_sent_rv = max(conn.last_sent_rv, rv)
+            if ev_type != "BOOKMARK":
+                sent += 1
+        if sent:
+            self.frames_sent += sent
+            metrics = self._metrics
+            if metrics is not None:
+                metrics.inc("http_watch_events_sent_total", float(sent))
+        return b"".join(bufs)
+
+    def _tick_locked(self, conn: _WatchConn, now: float) -> str:
+        """Per-stream housekeeping: overflow/expiry verdicts, horizon
+        advancement, bookmark scheduling. Returns "ok" | "expired" |
+        "overflow"."""
+        if conn.overflowed:
+            return "overflow"
+        if conn.pending:
+            # Traffic is flowing; it keeps the stream alive by itself.
+            conn.next_bookmark = now + BOOKMARK_INTERVAL_S
+            return "ok"
+        if conn.horizon < self._evicted_by_kind.get((conn.av, conn.kind), 0):
+            # This stream's OWN kind evicted history past what it has
+            # seen while it was idle: it can no longer be resumed
+            # consistently. Churn on other kinds is irrelevant — live
+            # streams receive matching events at publish time, so a
+            # quiet watcher misses nothing when unrelated kinds cycle
+            # through the ring.
+            return "expired"
+        conn.horizon = max(conn.horizon, self._last_rv)
+        if now >= conn.next_bookmark:
+            rv = max(conn.horizon, conn.last_sent_rv)
+            conn.pending.append([
+                _frame_for({"type": "BOOKMARK", "object": {
+                    "apiVersion": conn.av, "kind": conn.kind,
+                    "metadata": {"resourceVersion": str(rv)},
+                }}),
+                None, "BOOKMARK", rv,
+            ])
+            conn.next_bookmark = now + BOOKMARK_INTERVAL_S
+        return "ok"
+
+    # ---- selector loop (plain-HTTP adopted streams) -----------------------
+
+    def adopt(self, conn: _WatchConn, sock: socket.socket) -> None:
+        """Hand an established plain-HTTP watch socket to the selector
+        loop; the calling handler thread returns and is reclaimed."""
+        sock.setblocking(False)
+        conn.sock = sock
+        with self._cond:
+            self._ensure_loop_locked()
+            self._loop_add.append(conn)
+        self._wake_loop()
+
+    def _ensure_loop_locked(self) -> None:
+        if self._loop_thread is not None:
+            return
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._loop_stop.clear()
+        self._loop_thread = threading.Thread(
+            target=self._loop_run, name="apiserver-watch-fanout", daemon=True,
+        )
+        self._loop_thread.start()
+
+    def _wake_loop(self) -> None:
+        w = self._wake_w
+        if w is None:
+            return
+        try:
+            w.send(b"x")
+        except (BlockingIOError, OSError):
+            pass  # wake byte already pending / loop gone
+
+    def _loop_run(self) -> None:
+        sel = selectors.DefaultSelector()
+        assert self._wake_r is not None
+        sel.register(self._wake_r, selectors.EVENT_READ, None)
+        conns: set = set()
+        bookmarks: List[Tuple[float, int, _WatchConn]] = []  # heap
+        seq = 0
+        try:
+            while not self._loop_stop.is_set():
+                now = time.monotonic()
+                to_close: List[Tuple[_WatchConn, str]] = []
+                with self._cond:
+                    while self._loop_add:
+                        conn = self._loop_add.popleft()
+                        conns.add(conn)
+                        conn.mask = selectors.EVENT_READ
+                        try:
+                            sel.register(conn.sock, conn.mask, conn)
+                        except (ValueError, KeyError, OSError):
+                            to_close.append((conn, "error"))
+                            continue
+                        seq += 1
+                        heapq.heappush(
+                            bookmarks, (conn.next_bookmark, seq, conn))
+                    service = []
+                    while self._loop_dirty:
+                        c = self._loop_dirty.popleft()
+                        c.dirty = False
+                        service.append(c)
+                    while bookmarks and bookmarks[0][0] <= now:
+                        _, _, c = heapq.heappop(bookmarks)
+                        if c.closed or c not in conns:
+                            continue
+                        service.append(c)
+                        seq += 1
+                        heapq.heappush(
+                            bookmarks,
+                            (now + BOOKMARK_INTERVAL_S, seq, c))
+                    for conn in service:
+                        if conn not in conns or conn.closed:
+                            continue
+                        state = self._tick_locked(conn, now)
+                        if state != "ok":
+                            to_close.append((conn, state))
+                            continue
+                        if conn.pending and len(conn.outbuf) < OUTBUF_HIGH_WATER:
+                            conn.outbuf += self._pop_frames_locked(conn)
+                    flushable = [c for c in service
+                                 if c.outbuf and (c, "expired") not in to_close]
+                for conn, state in to_close:
+                    self._loop_close(sel, conns, conn, state)
+                for conn in flushable:
+                    if conn in conns:
+                        self._loop_write(sel, conns, conn)
+                timeout = 0.5
+                if bookmarks:
+                    timeout = min(timeout, max(0.01, bookmarks[0][0] - now))
+                for key, mask in sel.select(timeout):
+                    if key.data is None:
+                        try:
+                            while self._wake_r.recv(4096):
+                                pass
+                        except (BlockingIOError, OSError):
+                            pass
+                        continue
+                    conn = key.data
+                    if conn not in conns:
+                        continue
+                    if mask & selectors.EVENT_READ:
+                        if self._loop_peer_closed(conn):
+                            self._loop_close(sel, conns, conn, "peer")
+                            continue
+                    if mask & selectors.EVENT_WRITE:
+                        with self._cond:
+                            if conn.pending and \
+                                    len(conn.outbuf) < OUTBUF_HIGH_WATER:
+                                conn.outbuf += self._pop_frames_locked(conn)
+                        self._loop_write(sel, conns, conn)
+        except Exception:  # pragma: no cover — must never die silently
+            logger.exception("watch fan-out loop crashed")
+        finally:
+            for conn in list(conns):
+                self._loop_close(sel, conns, conn, "shutdown",
+                                 final_chunk=True)
+            sel.close()
+
+    @staticmethod
+    def _loop_peer_closed(conn: _WatchConn) -> bool:
+        try:
+            data = conn.sock.recv(4096)
+        except (BlockingIOError, InterruptedError):
+            return False
+        except OSError:
+            return True
+        # Watch clients never send after the request; EOF means hangup
+        # and anything else is ignorable junk on a one-way stream.
+        return data == b""
+
+    def _loop_write(self, sel, conns: set, conn: _WatchConn) -> None:
+        try:
+            if conn.outbuf:
+                n = conn.sock.send(conn.outbuf)
+                conn.outbuf = conn.outbuf[n:]
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._loop_close(sel, conns, conn, "error")
+            return
+        want = selectors.EVENT_READ | (
+            selectors.EVENT_WRITE if conn.outbuf or conn.pending else 0
+        )
+        if want != conn.mask:
+            try:
+                sel.modify(conn.sock, want, conn)
+                conn.mask = want
+            except (ValueError, KeyError, OSError):
+                self._loop_close(sel, conns, conn, "error")
+
+    def _loop_close(self, sel, conns: set, conn: _WatchConn, why: str,
+                    final_chunk: bool = False) -> None:
+        conns.discard(conn)
+        self.detach(conn)
+        try:
+            sel.unregister(conn.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        try:
+            tail = conn.outbuf
+            if why == "expired":
+                tail += _EXPIRED_FRAME + _TERMINAL_CHUNK
+            elif final_chunk:
+                tail += _TERMINAL_CHUNK
+            if tail:
+                conn.sock.send(tail)
+        except OSError:
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        """Stop the selector loop (flushing terminal chunks) and wake
+        every thread-mode stream so its handler can exit."""
+        self._loop_stop.set()
+        self._wake_loop()
+        t = self._loop_thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._loop_thread = None
+        for s in (self._wake_r, self._wake_w):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._wake_r = self._wake_w = None
+        with self._cond:
+            for subs in self._subs.values():
+                for conn in subs:
+                    if conn.cv is not None:
+                        conn.cv.notify_all()
             self._cond.notify_all()
 
+    # ---- legacy replay surface (kept for tests/back-compat) ---------------
+
     def replay_and_wait(self, after_rv: int, timeout: float):
-        """(events with rv > after_rv, expired?) — blocks up to timeout when
-        nothing is pending."""
+        """(events with rv > after_rv, expired?) — blocks up to timeout
+        when nothing is pending. Pre-fan-out surface, kept because it is
+        a convenient polling view of the ring."""
         with self._cond:
             if after_rv < self._oldest_evicted_rv:
                 return None, True  # 410: requested horizon evicted
-            out = [ev for rv, ev in self._events if rv > after_rv]
+            out = [WatchEvent(type=e.ev_type, object=e.obj)
+                   for e in self._events if e.rv > after_rv]
             if out:
                 return out, False
             self._cond.wait(timeout)
             if after_rv < self._oldest_evicted_rv:
                 return None, True
-            return [ev for rv, ev in self._events if rv > after_rv], False
+            return [WatchEvent(type=e.ev_type, object=e.obj)
+                    for e in self._events if e.rv > after_rv], False
+
+
+class _FrontDoorServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can hand a connection's socket to the
+    watch fan-out loop: a handler marks its request adopted, and
+    ``shutdown_request`` then leaves the socket alone instead of
+    closing it when the handler thread returns."""
+
+    daemon_threads = True
+    # socketserver's default listen backlog is 5; a connection burst
+    # (watch re-establishment after a 410, a writer fleet reconnecting)
+    # overflows it and the overflowed peers see RSTs on their first
+    # request. Admission control belongs to APF, not the accept queue.
+    request_queue_size = 128
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._adopted_ids: set = set()
+        self._adopted_lock = threading.Lock()
+
+    def adopt_request(self, request) -> None:
+        with self._adopted_lock:
+            self._adopted_ids.add(id(request))
+
+    def shutdown_request(self, request):  # noqa: D102
+        with self._adopted_lock:
+            if id(request) in self._adopted_ids:
+                self._adopted_ids.discard(id(request))
+                return  # the watch hub owns this socket now
+        super().shutdown_request(request)
 
 
 class HTTPAPIServer:
@@ -120,6 +719,13 @@ class HTTPAPIServer:
         port: int = 0,
         token: Optional[str] = None,
         tls_ctx=None,
+        *,
+        tokens: Optional[Dict[str, str]] = None,
+        authn: Optional[ScrapeAuthenticator] = None,
+        admission: Optional[FairQueueAdmission] = None,
+        metrics=None,
+        durable_writes: bool = True,
+        selector_watch: Optional[bool] = None,
     ):
         """``tls_ctx`` (an ``ssl.SSLContext``, e.g. from
         ``utils.tlsutil.server_context``) serves the API over HTTPS — the
@@ -127,27 +733,63 @@ class HTTPAPIServer:
         (start.go:100-119: same TLS options stack as metrics, cert dir
         watched for rotation via utils.tlsutil.CertWatcher). The
         handshake is deferred to the per-connection handler thread so a
-        stalled peer cannot wedge the accept loop."""
+        stalled peer cannot wedge the accept loop.
+
+        Auth: ``authn`` (a :class:`ScrapeAuthenticator`, typically over
+        a real cluster client) is the delegated-auth path shared with
+        ``/metrics``. ``token`` / ``tokens`` (token → tenant identity)
+        instead build the same authenticator over a
+        :class:`StaticTokenReviewer`, so embedded deployments get the
+        identical cache/fail-closed/counter behavior.
+
+        ``admission`` is the APF-style fair-queue scheduler; pass
+        ``False`` to disable admission entirely. ``durable_writes``
+        makes write verbs block on the store's group-commit barrier
+        (``wait_durable``) before answering, when a WAL is attached.
+
+        ``selector_watch`` controls watch-socket adoption into the
+        event-driven fan-out loop; default: on for plain HTTP, off for
+        TLS (those streams keep a handler thread)."""
         # Identity check, not truthiness: APIServer defines __len__, and
         # an empty-but-live store must not be swapped for a fresh one.
         self.api = api if api is not None else APIServer()
         self.scheme = scheme or default_scheme()
         self.token = token
         self.tls = tls_ctx is not None
+        self.metrics = metrics
+        if authn is None and (token is not None or tokens):
+            table = dict(tokens or {})
+            if token is not None:
+                table.setdefault(token, "default")
+            authn = ScrapeAuthenticator(
+                StaticTokenReviewer(table), path="/apis", ttl_s=300.0,
+            )
+        self.authn = authn
+        if authn is not None and metrics is not None:
+            authn.instrument(metrics)
+        if admission is False:
+            self.apf: Optional[FairQueueAdmission] = None
+        elif admission is None:
+            self.apf = FairQueueAdmission(metrics=metrics)
+        else:
+            self.apf = admission
+            if metrics is not None:
+                admission.instrument(metrics)
+        self.durable_writes = durable_writes
+        self.selector_watch = (
+            (not self.tls) if selector_watch is None else selector_watch
+        )
         self._kinds: Dict[Tuple[str, str, str], str] = {}
         for gvk, plural in list(self.scheme.items()) + _CORE_KINDS:
             self._kinds[(gvk.group, gvk.version, plural)] = gvk.kind
-        self.hub = _WatchHub()
+        self.hub = _WatchHub(metrics=metrics)
         self.api.add_watcher(self.hub.publish)
-        self._server = ThreadingHTTPServer(
-            (host, port), self._make_handler()
-        )
+        self._server = _FrontDoorServer((host, port), self._make_handler())
         if tls_ctx is not None:
             self._server.socket = tls_ctx.wrap_socket(
                 self._server.socket, server_side=True,
                 do_handshake_on_connect=False,
             )
-        self._server.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
 
@@ -172,9 +814,52 @@ class HTTPAPIServer:
 
     def stop(self) -> None:
         self._stopping.set()
-        self._server.shutdown()
-        if self._thread:
+        self.hub.close()
+        if self._thread is not None:
+            # shutdown() blocks on a flag that only serve_forever() sets;
+            # calling it on a never-started server would hang forever.
+            self._server.shutdown()
             self._thread.join(timeout=5.0)
+            self._thread = None
+        self._server.server_close()
+
+    # ---- instrumentation --------------------------------------------------
+
+    def instrument(self, metrics) -> None:
+        """Attach a ``Metrics`` registry (request/queue/watch families)."""
+        self.metrics = metrics
+        self.hub.instrument(metrics)
+        if self.apf is not None:
+            self.apf.instrument(metrics)
+        if self.authn is not None:
+            self.authn.instrument(metrics)
+
+    def _observe_request(self, verb: str, code: int, seconds: float) -> None:
+        metrics = self.metrics
+        if metrics is None:
+            return
+        metrics.inc(f'http_requests_total{{code="{code}",verb="{verb}"}}')
+        metrics.observe(f'http_request_seconds{{verb="{verb}"}}', seconds,
+                        buckets=REQUEST_BUCKETS)
+
+    # ---- auth / durability ------------------------------------------------
+
+    def _authenticate(self, header: Optional[str]):
+        """→ (identity, authorized). No auth configured → anonymous OK."""
+        if self.authn is not None:
+            ident = self.authn.identify(header)
+            return ident, ident is not None
+        return None, True
+
+    def _barrier_durable(self) -> None:
+        """Group-commit barrier: a write verb's 2xx must mean 'durable'
+        when the store has a WAL. Concurrent callers batch into one
+        fsync (Persistence.wait_durable)."""
+        if not self.durable_writes:
+            return
+        fn = getattr(self.api, "wait_durable", None)
+        if fn is not None and not fn():
+            raise ApiError("write committed but not durable within timeout")
 
     # ---- path mapping -----------------------------------------------------
 
@@ -228,7 +913,8 @@ class HTTPAPIServer:
             # Under TLS the handshake runs lazily in this handler's
             # thread (see __init__); the socket timeout bounds it — and
             # every read — so a stalled peer's thread is reclaimed. Watch
-            # streams are unaffected: they write at least every 0.5 s.
+            # streams are unaffected: they write at least every bookmark
+            # interval.
             timeout = 60 if outer.tls else None
 
             def log_message(self, *a):  # noqa: D102
@@ -236,32 +922,54 @@ class HTTPAPIServer:
 
             # -- plumbing --------------------------------------------------
 
-            def _send_json(self, code: int, payload: Any) -> None:
+            def _send_json(self, code: int, payload: Any,
+                           extra_headers: Optional[Dict[str, str]] = None
+                           ) -> None:
                 data = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
+                self._code = code
 
-            def _send_status(self, code: int, reason: str, message: str) -> None:
+            def _send_status(self, code: int, reason: str, message: str,
+                             extra_headers: Optional[Dict[str, str]] = None
+                             ) -> None:
                 self._send_json(code, {
                     "kind": "Status", "apiVersion": "v1", "status": "Failure",
                     "reason": reason, "message": message, "code": code,
-                })
+                }, extra_headers)
 
             def _body(self) -> Any:
                 n = int(self.headers.get("Content-Length") or 0)
                 return json.loads(self.rfile.read(n)) if n else None
 
-            def _authorized(self) -> bool:
-                if outer.token is None:
-                    return True
-                return (self.headers.get("Authorization")
-                        == f"Bearer {outer.token}")
+            def _release_seat(self) -> None:
+                ticket = getattr(self, "_ticket", None)
+                if ticket is not None:
+                    self._ticket = None
+                    ticket.release()
 
             def _dispatch(self, method: str) -> None:
-                if not self._authorized():
+                t0 = time.monotonic()
+                self._code = 0
+                try:
+                    self._dispatch_admitted(method)
+                finally:
+                    self._release_seat()
+                    if self._code:
+                        outer._observe_request(
+                            method, self._code, time.monotonic() - t0
+                        )
+
+            def _dispatch_admitted(self, method: str) -> None:
+                identity, ok = outer._authenticate(
+                    self.headers.get("Authorization")
+                )
+                if not ok:
                     self._send_status(401, "Unauthorized", "bad bearer token")
                     return
                 parsed = urlparse(self.path)
@@ -270,9 +978,26 @@ class HTTPAPIServer:
                 except NotFoundError as err:
                     self._send_status(404, "NotFound", str(err))
                     return
+                q = parse_qs(parsed.query)
+                watch = q.get("watch") == ["true"]
+                if outer.apf is not None:
+                    level = classify(method, name=name, kind=kind,
+                                     namespace=ns, identity=identity,
+                                     watch=watch)
+                    try:
+                        self._ticket = outer.apf.acquire(
+                            level, flow_for(identity, ns)
+                        )
+                    except TooManyRequests as exc:
+                        self._send_status(
+                            429, "TooManyRequests", str(exc),
+                            {"Retry-After":
+                             str(max(1, int(exc.retry_after)))},
+                        )
+                        return
                 try:
                     fn = getattr(self, f"_do_{method}")
-                    fn(parsed, av, kind, ns, name, sub)
+                    fn(parsed, av, kind, ns, name, sub, q)
                 except NotFoundError as err:
                     self._send_status(404, "NotFound", str(err))
                 except AlreadyExistsError as err:
@@ -308,19 +1033,16 @@ class HTTPAPIServer:
 
             # -- verbs -----------------------------------------------------
 
-            def _do_GET(self, parsed, av, kind, ns, name, sub) -> None:
-                q = parse_qs(parsed.query)
+            def _do_GET(self, parsed, av, kind, ns, name, sub, q) -> None:
                 if name is not None:
                     self._send_json(200, outer.api.get(av, kind, ns or "", name))
                     return
+                sel = _parse_selector(q.get("labelSelector", [None])[0])
                 if q.get("watch") == ["true"]:
-                    self._serve_watch(av, kind, ns, q)
+                    self._serve_watch(av, kind, ns, sel, q)
                     return
-                sel = None
-                raw_sel = q.get("labelSelector", [None])[0]
-                if raw_sel:
-                    sel = dict(kv.split("=", 1)
-                               for kv in raw_sel.split(",") if "=" in kv)
+                # Label-selector LISTs route to the store's label indexes
+                # (list_with_rv narrowest-index routing), not post-filter.
                 items, rv = outer.api.list_with_rv(
                     av, kind, namespace=ns, label_selector=sel
                 )
@@ -331,15 +1053,17 @@ class HTTPAPIServer:
                     "items": items,
                 })
 
-            def _do_POST(self, parsed, av, kind, ns, name, sub) -> None:
+            def _do_POST(self, parsed, av, kind, ns, name, sub, q) -> None:
                 obj = self._body() or {}
                 obj.setdefault("apiVersion", av)
                 obj.setdefault("kind", kind)
                 if ns:
                     obj.setdefault("metadata", {}).setdefault("namespace", ns)
-                self._send_json(201, outer.api.create(obj))
+                created = outer.api.create(obj)
+                outer._barrier_durable()
+                self._send_json(201, created)
 
-            def _do_PUT(self, parsed, av, kind, ns, name, sub) -> None:
+            def _do_PUT(self, parsed, av, kind, ns, name, sub, q) -> None:
                 if name is None:
                     raise InvalidError("PUT requires an object path")
                 obj = self._body() or {}
@@ -347,92 +1071,108 @@ class HTTPAPIServer:
                 obj.setdefault("kind", kind)
                 obj.setdefault("metadata", {}).setdefault("namespace", ns)
                 obj["metadata"].setdefault("name", name)
-                self._send_json(200, outer.api.update(obj))
+                updated = outer.api.update(obj)
+                outer._barrier_durable()
+                self._send_json(200, updated)
 
-            def _do_PATCH(self, parsed, av, kind, ns, name, sub) -> None:
+            def _do_PATCH(self, parsed, av, kind, ns, name, sub, q) -> None:
                 if name is None:
                     raise InvalidError("PATCH requires an object path")
                 patch = self._body() or {}
                 if sub == "status":
-                    self._send_json(200, outer.api.patch_status(
+                    patched = outer.api.patch_status(
                         av, kind, ns or "", name, patch.get("status") or {}
-                    ))
+                    )
+                    outer._barrier_durable()
+                    self._send_json(200, patched)
                     return
                 # strategic-merge-lite: shallow merge of top-level fields,
                 # deep merge of metadata/spec maps
                 current = outer.api.get(av, kind, ns or "", name)
                 merged = _merge_patch(current, patch)
-                self._send_json(200, outer.api.update(merged))
+                updated = outer.api.update(merged)
+                outer._barrier_durable()
+                self._send_json(200, updated)
 
-            def _do_DELETE(self, parsed, av, kind, ns, name, sub) -> None:
+            def _do_DELETE(self, parsed, av, kind, ns, name, sub, q) -> None:
                 if name is None:
                     raise InvalidError("DELETE requires an object path")
                 opts = self._body() or {}
                 propagation = opts.get("propagationPolicy", "Background")
                 outer.api.delete(av, kind, ns or "", name,
                                  propagation=propagation)
+                outer._barrier_durable()
                 self._send_json(200, {"kind": "Status", "status": "Success"})
 
             # -- watch -----------------------------------------------------
 
-            def _serve_watch(self, av, kind, ns, q) -> None:
+            def _serve_watch(self, av, kind, ns, sel, q) -> None:
                 after_rv = int(q.get("resourceVersion", ["0"])[0] or 0)
+                adopt = outer.selector_watch and not outer.tls
+                conn = _WatchConn(
+                    av, kind, ns, sel,
+                    mode="selector" if adopt else "thread",
+                    cv=None if adopt else threading.Condition(
+                        outer.hub._lock),
+                )
+                expired = outer.hub.attach(conn, after_rv)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
-
-                def emit(payload: Dict[str, Any]) -> None:
-                    line = (json.dumps(payload) + "\n").encode()
-                    self.wfile.write(
-                        f"{len(line):x}\r\n".encode() + line + b"\r\n"
-                    )
+                self._code = 200
+                if expired:
+                    # 410: requested horizon evicted — stream one ERROR
+                    # frame; the client must re-list and re-watch.
+                    try:
+                        self.wfile.write(_EXPIRED_FRAME + _TERMINAL_CHUNK)
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+                    return
+                # Stream established: give the admission seat back — a
+                # long-lived watch must not pin front-door concurrency.
+                self._release_seat()
+                if adopt:
                     self.wfile.flush()
+                    self.close_connection = True
+                    self.server.adopt_request(self.connection)
+                    outer.hub.adopt(conn, self.connection)
+                    return
+                self._serve_watch_thread(conn)
 
-                import time as _time
-
-                last_rv = after_rv
-                last_bookmark = _time.monotonic()
+            def _serve_watch_thread(self, conn) -> None:
+                """Thread-mode stream (TLS, or selector mode disabled):
+                this handler thread parks on the stream's condition and
+                wakes per publish — waits are event-driven, the 0.5 s
+                timeout only bounds shutdown latency."""
+                hub = outer.hub
                 try:
                     while not outer._stopping.is_set():
-                        # replay_and_wait blocks on the hub's condition, so
-                        # a publish wakes this loop immediately — no idle
-                        # sleep may sit between an event and its delivery.
-                        events, expired = outer.hub.replay_and_wait(
-                            last_rv, timeout=0.5
-                        )
-                        if expired:
-                            emit({"type": "ERROR", "object": {
-                                "kind": "Status", "code": 410,
-                                "reason": "Expired",
-                                "message": "too old resource version",
-                            }})
-                            break
-                        for ev in events or []:
-                            obj = ev.object
-                            rv = int((obj.get("metadata") or {})
-                                     .get("resourceVersion", 0))
-                            last_rv = max(last_rv, rv)
-                            if obj.get("apiVersion") != av \
-                                    or obj.get("kind") != kind:
-                                continue
-                            if ns and (obj.get("metadata") or {}).get(
-                                    "namespace") != ns:
-                                continue
-                            emit({"type": ev.type,
-                                  "object": copy.deepcopy(obj)})
-                        now = _time.monotonic()
-                        if now - last_bookmark >= BOOKMARK_INTERVAL_S:
-                            # Periodic bookmark so clients advance their rv
-                            # past events filtered out of this stream.
-                            emit({"type": "BOOKMARK", "object": {
-                                "apiVersion": av, "kind": kind,
-                                "metadata": {"resourceVersion": str(last_rv)},
-                            }})
-                            last_bookmark = now
-                    self.wfile.write(b"0\r\n\r\n")
-                except (BrokenPipeError, ConnectionResetError):
+                        with hub._cond:
+                            state = hub._tick_locked(conn, time.monotonic())
+                            if state == "ok" and not conn.pending:
+                                conn.cv.wait(0.5)
+                                state = hub._tick_locked(
+                                    conn, time.monotonic())
+                            data = hub._pop_frames_locked(conn)
+                        if data:
+                            self.wfile.write(data)
+                            self.wfile.flush()
+                        if state == "expired":
+                            self.wfile.write(
+                                _EXPIRED_FRAME + _TERMINAL_CHUNK)
+                            self.wfile.flush()
+                            return
+                        if state == "overflow":
+                            return  # too slow; client re-watches
+                    self.wfile.write(_TERMINAL_CHUNK)
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError,
+                        socket.timeout, OSError):
                     pass
+                finally:
+                    hub.detach(conn)
 
         return Handler
 

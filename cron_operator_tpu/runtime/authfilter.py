@@ -16,6 +16,16 @@ with the same ServiceAccount token, and two apiserver round trips per
 scrape would put the kube API on the metrics hot path. Failures are
 closed (deny): an unreachable apiserver means no anonymous metrics, not
 an open endpoint.
+
+The HTTP front door (:mod:`runtime.apiserver_http`) reuses this exact
+filter for API bearer auth — one delegated-auth path for scrapes and API
+traffic. Front-door callers use :meth:`ScrapeAuthenticator.identify`,
+which additionally returns *who* authenticated (the reviewed username),
+feeding APF per-tenant flow keys. Embedded deployments without a real
+apiserver plug a :class:`StaticTokenReviewer` in as the client: a
+token → username table speaking the TokenReview/SubjectAccessReview
+dialect, so the cache, fail-closed and counter behavior are identical in
+both modes.
 """
 
 from __future__ import annotations
@@ -24,7 +34,7 @@ import logging
 import threading
 import time
 from collections import OrderedDict
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 logger = logging.getLogger("runtime.authfilter")
 
@@ -44,56 +54,117 @@ class ScrapeAuthenticator:
         self._ttl = ttl_s
         self._clock = clock
         self._lock = threading.Lock()
-        # token -> (expires_at, allowed). STRICTLY bounded LRU: an
-        # attacker spraying unique forged tokens must not grow memory —
+        # token -> (expires_at, identity-or-None). None = authoritative
+        # deny (negative entries are cached too: a forged token must not
+        # buy an apiserver round trip per request). STRICTLY bounded LRU:
+        # an attacker spraying unique forged tokens must not grow memory —
         # expiry-only sweeping would evict nothing inside the TTL window.
         # (The per-unique-token apiserver round trip itself is inherent
         # to delegated auth and throttled by the client's QPS limiter.)
-        self._cache: "OrderedDict" = OrderedDict()
+        self._cache: "OrderedDict[str, Tuple[float, Optional[str]]]" = \
+            OrderedDict()
         self._cache_cap = 1024
+        self._metrics = None
+
+    def instrument(self, metrics) -> None:
+        """Attach a ``Metrics`` registry for cache hit/miss/denial
+        counters (scrape_auth_* families)."""
+        self._metrics = metrics
 
     def allow(self, authorization: Optional[str]) -> bool:
+        return self.identify(authorization) is not None
+
+    def identify(self, authorization: Optional[str]) -> Optional[str]:
+        """Authenticated+authorized identity for the header, else None.
+
+        The identity is the TokenReview username (``"authenticated"``
+        when the review authenticates without naming one) — the APF flow
+        key for per-tenant fairness at the front door.
+        """
         if not authorization or not authorization.startswith("Bearer "):
-            return False
+            self._count("scrape_auth_denials_total")
+            return None
         token = authorization[len("Bearer "):].strip()
         if not token:
-            return False
+            self._count("scrape_auth_denials_total")
+            return None
         now = self._clock()
         with self._lock:
             hit = self._cache.get(token)
             if hit is not None and hit[0] > now:
                 self._cache.move_to_end(token)
+                self._count("scrape_auth_cache_hits_total")
+                if hit[1] is None:
+                    self._count("scrape_auth_denials_total")
                 return hit[1]
-        allowed = self._review(token)
-        if allowed is None:
+        self._count("scrape_auth_cache_misses_total")
+        outcome = self._review(token)
+        if outcome is None:
             # Transient review failure: deny THIS request (fail closed)
             # but don't poison the cache — a one-scrape apiserver blip
             # must not lock a legitimate scraper out for a full TTL.
-            return False
+            self._count("scrape_auth_denials_total")
+            return None
+        allowed, identity = outcome
         with self._lock:
-            self._cache[token] = (now + self._ttl, allowed)
+            self._cache[token] = (now + self._ttl,
+                                  identity if allowed else None)
             self._cache.move_to_end(token)
             while len(self._cache) > self._cache_cap:
                 self._cache.popitem(last=False)
-        return allowed
+        if not allowed:
+            self._count("scrape_auth_denials_total")
+            return None
+        return identity
 
-    def _review(self, token: str) -> Optional[bool]:
-        """True/False = authoritative review outcome (cacheable); None =
-        transient failure (deny, never cache)."""
+    def _review(self, token: str) -> Optional[Tuple[bool, str]]:
+        """(allowed, identity) = authoritative review outcome
+        (cacheable); None = transient failure (deny, never cache)."""
         try:
             status = self._client.token_review(token)
             if not status.get("authenticated"):
-                return False
+                return (False, "")
             user = (status.get("user") or {}).get("username") or ""
             groups = (status.get("user") or {}).get("groups") or []
-            return self._client.subject_access_review(
+            allowed = bool(self._client.subject_access_review(
                 user, groups, self._verb, self._path
-            )
+            ))
+            return (allowed, user or "authenticated")
         except Exception as exc:  # noqa: BLE001 — fail CLOSED
             logger.warning(
                 "scrape authn/z review failed (denying): %s", exc
             )
             return None
 
+    def _count(self, name: str) -> None:
+        metrics = self._metrics
+        if metrics is not None:
+            metrics.inc(name)
 
-__all__ = ["ScrapeAuthenticator"]
+
+class StaticTokenReviewer:
+    """TokenReview/SubjectAccessReview dialect over a static token table.
+
+    The embedded front door has no apiserver to delegate to; this is its
+    review backend (token → username), so ``--serve-api-token`` style
+    static auth still flows through the one shared
+    :class:`ScrapeAuthenticator` path (TTL cache, fail-closed, denial
+    counters) instead of a second bespoke string-compare branch.
+    """
+
+    def __init__(self, tokens: Optional[Dict[str, str]] = None):
+        self._tokens = dict(tokens or {})
+
+    def token_review(self, token: str) -> Dict:
+        name = self._tokens.get(token)
+        if name is None:
+            return {"authenticated": False}
+        return {"authenticated": True, "user": {"username": name}}
+
+    def subject_access_review(self, user, groups, verb, path) -> bool:
+        # Possession of a configured token IS the authorization grant in
+        # static mode; there is no finer-grained policy to consult.
+        return True
+
+
+__all__ = ["ScrapeAuthenticator", "StaticTokenReviewer"]

@@ -279,6 +279,18 @@ class APIServer:
         with self._lock:
             self._wal = wal
 
+    def wait_durable(self, timeout: float = 5.0) -> bool:
+        """Group-commit barrier: block until every write committed before
+        this call is fsynced in the attached WAL (the HTTP front door
+        calls this before answering a write verb's 2xx — see
+        ``Persistence.wait_durable``). Trivially True without a WAL:
+        an in-memory store's commit IS its strongest durability."""
+        wal = self._wal
+        fn = getattr(wal, "wait_durable", None) if wal is not None else None
+        if fn is None:
+            return True
+        return bool(fn(timeout))
+
     def restore_state(self, objects: List[Unstructured], rv: int) -> None:
         """Seed an EMPTY store from recovered state: install every object
         (frozen, fully indexed) and restore the resourceVersion counter so

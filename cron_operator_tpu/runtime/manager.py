@@ -196,6 +196,59 @@ _FAMILY_META: Dict[str, tuple] = {
                      "detected -> follower promoted -> catch-up "
                      "verified -> serving (label shard=N); the phase "
                      "breakdown is recorded as failover trace spans"),
+    "wal_group_commit_total": (
+        "counter", "Group-commit leader flushes: one fsync covering "
+                   "every concurrent writer waiting in wait_durable "
+                   "(HTTP write fan-in batches into these)"),
+    "http_requests_total": (
+        "counter", "HTTP front-door requests served (label verb: "
+                   "GET/POST/PUT/PATCH/DELETE, label code: status)"),
+    "http_request_seconds": (
+        "histogram", "HTTP front-door request latency, admission queue "
+                     "wait included (label verb)"),
+    "apf_requests_total": (
+        "counter", "Requests admitted by the APF-style fair-queue "
+                   "scheduler (label level: system/workload/batch)"),
+    "apf_rejected_total": (
+        "counter", "Requests rejected 429 by admission: queue overflow "
+                   "or queue-wait timeout (label level)"),
+    "apf_queue_wait_seconds": (
+        "histogram", "Seconds a request waited in its fair queue before "
+                     "getting a seat (label level)"),
+    "apf_inflight": (
+        "gauge", "Requests currently holding an admission seat (label "
+                 "level)"),
+    "apf_queued": (
+        "gauge", "Requests currently waiting in fair queues (label "
+                 "level)"),
+    "http_watch_connections": (
+        "gauge", "Open HTTP watch streams registered at the fan-out hub"),
+    "http_watch_events_sent_total": (
+        "counter", "Watch event frames delivered to HTTP streams "
+                   "(BOOKMARKs excluded)"),
+    "http_watch_event_encodes_total": (
+        "counter", "Watch events JSON-encoded at the hub — once per "
+                   "published event regardless of watcher count "
+                   "(shared-encode fan-out; the sent/encodes ratio is "
+                   "the fan-out factor)"),
+    "http_watch_coalesced_total": (
+        "counter", "Queued MODIFIED frames replaced in place by a newer "
+                   "version of the same object (per-connection "
+                   "latest-wins coalescing)"),
+    "http_watch_dropped_total": (
+        "counter", "Watch streams dropped for not draining their frame "
+                   "queue (client must re-watch; 410 re-list applies if "
+                   "its horizon has aged out)"),
+    "scrape_auth_cache_hits_total": (
+        "counter", "Delegated-auth decisions served from the token "
+                   "TTL cache (scrape + HTTP front-door bearer auth)"),
+    "scrape_auth_cache_misses_total": (
+        "counter", "Delegated-auth decisions that required a "
+                   "TokenReview/SubjectAccessReview round trip"),
+    "scrape_auth_denials_total": (
+        "counter", "Bearer-auth denials: malformed header, failed "
+                   "review, unauthorized subject, or fail-closed "
+                   "transient review error"),
 }
 
 

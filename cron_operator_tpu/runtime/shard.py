@@ -534,6 +534,22 @@ class ShardRouter:
             ok = s.flush(timeout=remaining) and ok
         return ok
 
+    def wait_durable(self, timeout: float = 5.0) -> bool:
+        """Group-commit barrier over every shard (see
+        ``APIServer.wait_durable``): the front door serves the router as
+        one store, so its durable-write guarantee spans all shards."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        ok = True
+        for s in self._stores:
+            fn = getattr(s, "wait_durable", None)
+            if fn is None:
+                continue
+            remaining = max(0.05, deadline - _time.monotonic())
+            ok = bool(fn(remaining)) and ok
+        return ok
+
     def close(self) -> None:
         for s in self._stores:
             s.close()

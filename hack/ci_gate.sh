@@ -124,6 +124,17 @@ echo "==> observability report smoke (flight recorder + SLO verdict, fast legs)"
 # REGRESSION verdict. Full report: make obs-report (writes BENCH_OBS.json).
 python hack/obs_report.py --check --out /dev/null >/dev/null
 
+echo "==> HTTP front-door smoke (fan-out encode-once, group-commit, APF fairness)"
+# Small-size run of the real front-door bench against the in-process
+# HTTPAPIServer: 100 watchers must each receive every event from ONE
+# encode per event, durable-write p99 must hold from 1 -> 16 concurrent
+# writers with a closed-loop burst sharing fsyncs, a quiet tenant's p99
+# must survive a 50x+ noisy flood (vs a single-flow FIFO control), and
+# the read-only phase must commit zero store/WAL writes. --check fails
+# the gate on any REGRESSION verdict. Full run: make bench-http
+# (updates BENCH_HTTP.json; BASELINE=<ref> adds the >= 5x fan-out A/B).
+python hack/http_bench.py --check --stdout >/dev/null
+
 echo "==> metric registry drift (every emitted family declared + typed)"
 # Explicit run of the registry drift guard: scans every metrics.inc/
 # observe/set call site AND interned-series assignment in the package,

@@ -1,0 +1,214 @@
+"""APF-style fair-queue admission (runtime/apf.py): seats, per-flow
+round-robin dispatch, bounded queues with 429 semantics, classification."""
+
+import threading
+import time
+
+import pytest
+
+from cron_operator_tpu.runtime.apf import (
+    DEFAULT_LEVELS,
+    FairQueueAdmission,
+    LevelConfig,
+    TooManyRequests,
+    classify,
+    flow_for,
+)
+from cron_operator_tpu.runtime.manager import Metrics
+
+
+def make_apf(seats=1, queue_depth=4, max_queued=8, timeout_s=5.0, **kw):
+    return FairQueueAdmission(levels={
+        "workload": LevelConfig(seats=seats, queue_depth=queue_depth,
+                                max_queued=max_queued,
+                                queue_timeout_s=timeout_s),
+    }, **kw)
+
+
+class TestSeats:
+    def test_fast_path_acquire_release(self):
+        apf = make_apf(seats=2)
+        t1 = apf.acquire("workload", "a")
+        t2 = apf.acquire("workload", "b")
+        snap = apf.snapshot()["workload"]
+        assert snap["in_flight"] == 2 and snap["queued"] == 0
+        t1.release()
+        t2.release()
+        assert apf.snapshot()["workload"]["in_flight"] == 0
+
+    def test_release_is_idempotent(self):
+        apf = make_apf(seats=1)
+        t = apf.acquire("workload", "a")
+        t.release()
+        t.release()
+        assert apf.snapshot()["workload"]["in_flight"] == 0
+        # the freed seat is reusable
+        with apf.acquire("workload", "a"):
+            assert apf.snapshot()["workload"]["in_flight"] == 1
+        assert apf.snapshot()["workload"]["in_flight"] == 0
+
+    def test_unknown_level_falls_back_to_workload(self):
+        apf = make_apf(seats=1)
+        t = apf.acquire("no-such-level", "a")
+        assert apf.snapshot()["workload"]["in_flight"] == 1
+        t.release()
+
+    def test_levels_are_isolated(self):
+        apf = FairQueueAdmission(levels={
+            "system": LevelConfig(seats=1, queue_depth=1, max_queued=1,
+                                  queue_timeout_s=0.05),
+            "workload": LevelConfig(seats=1, queue_depth=1, max_queued=1,
+                                    queue_timeout_s=0.05),
+        })
+        hold = apf.acquire("workload", "noisy")
+        # workload exhausted; system must still admit instantly.
+        t = apf.acquire("system", "controller")
+        t.release()
+        hold.release()
+
+    def test_requires_workload_level(self):
+        with pytest.raises(ValueError):
+            FairQueueAdmission(levels={"batch": LevelConfig()})
+
+
+class TestQueueing:
+    def test_queue_overflow_rejects_429(self):
+        apf = make_apf(seats=1, queue_depth=2, max_queued=8)
+        hold = apf.acquire("workload", "x")
+        filler = []
+
+        def queue_one():
+            try:
+                filler.append(apf.acquire("workload", "x"))
+            except TooManyRequests:
+                filler.append(None)
+
+        threads = [threading.Thread(target=queue_one) for _ in range(2)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 2.0
+        while (apf.snapshot()["workload"]["queued"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert apf.snapshot()["workload"]["queued"] == 2
+        with pytest.raises(TooManyRequests) as exc:
+            apf.acquire("workload", "x")
+        assert exc.value.retry_after >= 1.0
+        hold.release()
+        for t in threads:
+            t.join(timeout=2.0)
+
+    def test_queue_wait_timeout_rejects_429(self):
+        apf = make_apf(seats=1, timeout_s=0.05)
+        hold = apf.acquire("workload", "x")
+        t0 = time.monotonic()
+        with pytest.raises(TooManyRequests):
+            apf.acquire("workload", "y")
+        assert time.monotonic() - t0 < 2.0
+        # the abandoned waiter must not leak queue accounting
+        assert apf.snapshot()["workload"]["queued"] == 0
+        hold.release()
+        # and the seat is still grantable afterwards
+        apf.acquire("workload", "y").release()
+
+    def test_round_robin_across_flows(self):
+        """One noisy flow (3 queued) + one quiet flow (1 queued): the
+        quiet request is dispatched second, not fourth."""
+        apf = make_apf(seats=1)
+        hold = apf.acquire("workload", "seed")
+        order = []
+        lock = threading.Lock()
+
+        def worker(tag, flow):
+            ticket = apf.acquire("workload", flow)
+            with lock:
+                order.append(tag)
+            ticket.release()
+
+        threads = []
+        for tag, flow in [("n1", "noisy"), ("n2", "noisy"),
+                          ("n3", "noisy"), ("q1", "quiet")]:
+            th = threading.Thread(target=worker, args=(tag, flow))
+            th.start()
+            threads.append(th)
+            # serialize enqueue order so FIFO position is deterministic
+            deadline = time.monotonic() + 2.0
+            want = len(threads)
+            while (apf.snapshot()["workload"]["queued"] < want
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+        hold.release()
+        for th in threads:
+            th.join(timeout=5.0)
+        assert order[0] == "n1"
+        # round-robin: quiet's single request preempts noisy's backlog
+        assert order[1] == "q1"
+        assert sorted(order[2:]) == ["n2", "n3"]
+
+    def test_free_seat_never_idles_while_requests_queue(self):
+        """Regression guard: a drained-but-undeleted flow entry must not
+        force new arrivals to queue behind an idle seat."""
+        apf = make_apf(seats=1, timeout_s=1.0)
+        # Exercise queue → grant → release so flow bookkeeping has churn.
+        t = apf.acquire("workload", "a")
+        res = []
+        th = threading.Thread(
+            target=lambda: res.append(apf.acquire("workload", "a")))
+        th.start()
+        deadline = time.monotonic() + 2.0
+        while (apf.snapshot()["workload"]["queued"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        t.release()
+        th.join(timeout=2.0)
+        assert res and res[0] is not None
+        res[0].release()
+        # Seat free again: the next acquire must not block or 429.
+        t0 = time.monotonic()
+        apf.acquire("workload", "b").release()
+        assert time.monotonic() - t0 < 0.5
+
+
+class TestTelemetry:
+    def test_counters_and_gauges_emitted(self):
+        m = Metrics()
+        apf = make_apf(seats=1, timeout_s=0.05, metrics=m)
+        t = apf.acquire("workload", "a")
+        with pytest.raises(TooManyRequests):
+            apf.acquire("workload", "b")
+        t.release()
+        assert m.get('apf_requests_total{level="workload"}') == 1
+        assert m.get('apf_rejected_total{level="workload"}') == 1
+        assert m.gauge('apf_inflight{level="workload"}') == 0
+        hist = m.histogram('apf_queue_wait_seconds{level="workload"}')
+        assert hist is not None and hist["count"] == 1
+
+
+class TestClassify:
+    def test_system_traffic(self):
+        assert classify("PUT", name="lease-1", kind="Lease",
+                        namespace="default", identity=None) == "system"
+        assert classify("GET", name=None, kind="Cron",
+                        namespace="kube-system", identity=None) == "system"
+        assert classify("POST", name=None, kind="Cron", namespace="default",
+                        identity="system:operator") == "system"
+
+    def test_bulk_lists_are_batch(self):
+        assert classify("GET", name=None, kind="Cron",
+                        namespace="default", identity="alice") == "batch"
+
+    def test_watch_and_object_verbs_are_workload(self):
+        assert classify("GET", name=None, kind="Cron", namespace="default",
+                        identity="alice", watch=True) == "workload"
+        assert classify("GET", name="a", kind="Cron", namespace="default",
+                        identity="alice") == "workload"
+        assert classify("POST", name=None, kind="Cron", namespace="default",
+                        identity="alice") == "workload"
+
+    def test_flow_key_prefers_identity(self):
+        assert flow_for("alice", "ns1") == "alice"
+        assert flow_for(None, "ns1") == "ns1"
+        assert flow_for(None, None) == "cluster-scope"
+
+    def test_default_levels_cover_mandatory_names(self):
+        assert set(DEFAULT_LEVELS) == {"system", "workload", "batch"}

@@ -1,0 +1,645 @@
+"""Production HTTP front door: shared-encode watch fan-out, APF
+admission at the wire, group-commit durable writes, delegated bearer
+auth, and RFC 7386 merge-patch conformance.
+
+The fan-out assertions here are the dedicated encode-count guard for the
+one-encode-per-event contract (the bench measures the speedup; this
+pins the mechanism): N watchers receiving E events must cost exactly E
+JSON encodes at the hub, never N×E.
+"""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from cron_operator_tpu.runtime import apiserver_http as front
+from cron_operator_tpu.runtime.apf import FairQueueAdmission, LevelConfig
+from cron_operator_tpu.runtime.apiserver_http import (
+    HTTPAPIServer,
+    _merge_patch,
+    _WatchConn,
+)
+from cron_operator_tpu.runtime.authfilter import (
+    ScrapeAuthenticator,
+    StaticTokenReviewer,
+)
+from cron_operator_tpu.runtime.kube import APIServer, WatchEvent
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.runtime.persistence import Persistence
+
+TOKEN = "front-door-token"
+CRON_AV = "apps.kubedl.io/v1alpha1"
+WATCH_PATH = (f"/apis/{CRON_AV}/namespaces/default/crons"
+              "?watch=true&resourceVersion=0")
+
+
+def make_cron(name, namespace="default", labels=None):
+    meta = {"name": name, "namespace": namespace}
+    if labels:
+        meta["labels"] = labels
+    return {"apiVersion": CRON_AV, "kind": "Cron", "metadata": meta,
+            "spec": {"schedule": "@every 1h", "template": {"workload": {
+                "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+                "spec": {}}}}}
+
+
+def wait_for(fn, timeout=10.0, message="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = fn()
+        if got:
+            return got
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {message}")
+
+
+@pytest.fixture
+def server():
+    srv = HTTPAPIServer(token=TOKEN)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+class WatchStream:
+    """Raw chunked-watch consumer (http.client decodes the chunking;
+    each frame is one JSON line)."""
+
+    def __init__(self, srv, path=WATCH_PATH, token=TOKEN):
+        host, port = srv._server.server_address[0], srv.port
+        self.conn = http.client.HTTPConnection(host, port, timeout=30)
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        self.conn.request("GET", path, headers=headers)
+        self.resp = self.conn.getresponse()
+        self.status = self.resp.status
+        self.events = []
+        self.done = threading.Event()
+        self._t = threading.Thread(target=self._pump, daemon=True)
+        self._t.start()
+
+    def _pump(self):
+        try:
+            for raw in self.resp:
+                if raw.strip():
+                    self.events.append(json.loads(raw))
+        except Exception:
+            pass
+        finally:
+            self.done.set()
+
+    def of_type(self, ev_type):
+        return [e for e in self.events if e.get("type") == ev_type]
+
+    def close(self):
+        # Shut the socket down first: the pump thread sits blocked in a
+        # buffered readline holding the reader lock, and a plain
+        # conn.close() would block on that lock until the next bookmark
+        # frame releases it. EOF unblocks the pump immediately.
+        try:
+            sock = self.conn.sock
+            if sock is not None:
+                sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.done.wait(5.0)
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class TestSharedEncodeFanOut:
+    def test_encode_once_across_watchers(self, server):
+        """8 watchers × 5 events = 40 frames delivered but exactly 5
+        JSON encodes — the old path paid deepcopy+dumps per watcher."""
+        streams = [WatchStream(server) for _ in range(8)]
+        try:
+            wait_for(lambda: server.hub._nconns == 8, message="8 streams")
+            for i in range(5):
+                server.api.create(make_cron(f"fan-{i}"))
+            wait_for(
+                lambda: all(len(s.of_type("ADDED")) == 5 for s in streams),
+                message="all watchers saw all events",
+            )
+            assert server.hub.encodes == 5
+            assert server.hub.frames_sent == 40
+            # every stream saw identical payloads, in order
+            names = [[e["object"]["metadata"]["name"]
+                      for e in s.of_type("ADDED")] for s in streams]
+            assert all(n == [f"fan-{i}" for i in range(5)] for n in names)
+        finally:
+            for s in streams:
+                s.close()
+
+    def test_plain_http_streams_run_on_selector_loop(self, server):
+        streams = [WatchStream(server) for _ in range(3)]
+        try:
+            wait_for(lambda: server.hub._nconns == 3, message="3 streams")
+            assert server.hub._loop_thread is not None
+            assert server.hub._loop_thread.is_alive()
+        finally:
+            for s in streams:
+                s.close()
+
+    def test_watch_connection_gauge(self):
+        m = Metrics()
+        srv = HTTPAPIServer(token=TOKEN, metrics=m)
+        srv.start()
+        try:
+            s = WatchStream(srv)
+            wait_for(lambda: m.gauge("http_watch_connections") == 1,
+                     message="gauge up")
+            s.close()
+            wait_for(lambda: m.gauge("http_watch_connections") == 0,
+                     message="gauge back down")
+        finally:
+            srv.stop()
+
+    def test_bookmarks_flow_on_idle_streams(self, server, monkeypatch):
+        monkeypatch.setattr(front, "BOOKMARK_INTERVAL_S", 0.2)
+        s = WatchStream(server)
+        try:
+            wait_for(lambda: s.of_type("BOOKMARK"), timeout=5.0,
+                     message="bookmark on idle stream")
+            bm = s.of_type("BOOKMARK")[0]
+            assert bm["object"]["kind"] == "Cron"
+            assert "resourceVersion" in bm["object"]["metadata"]
+        finally:
+            s.close()
+
+
+class TestWatchFiltering:
+    def test_label_selector_on_watch(self, server):
+        path = (f"/apis/{CRON_AV}/namespaces/default/crons"
+                "?watch=true&labelSelector=team%3Dml")
+        s = WatchStream(server, path=path)
+        try:
+            wait_for(lambda: server.hub._nconns == 1, message="stream up")
+            server.api.create(make_cron("ml-cron", labels={"team": "ml"}))
+            server.api.create(make_cron("infra-cron",
+                                        labels={"team": "infra"}))
+            server.api.create(make_cron("bare-cron"))
+            wait_for(lambda: s.of_type("ADDED"), message="selected event")
+            time.sleep(0.3)  # would-be leak window for the other two
+            names = [e["object"]["metadata"]["name"]
+                     for e in s.of_type("ADDED")]
+            assert names == ["ml-cron"]
+        finally:
+            s.close()
+
+    def test_namespace_prefilter_on_watch(self, server):
+        s = WatchStream(server)  # namespace=default
+        try:
+            wait_for(lambda: server.hub._nconns == 1, message="stream up")
+            server.api.create(make_cron("other-ns", namespace="prod"))
+            server.api.create(make_cron("mine"))
+            wait_for(lambda: s.of_type("ADDED"), message="event")
+            time.sleep(0.2)
+            names = [e["object"]["metadata"]["name"]
+                     for e in s.of_type("ADDED")]
+            assert names == ["mine"]
+        finally:
+            s.close()
+
+    def test_label_selector_list_routed_to_index(self, server):
+        server.api.create(make_cron("a", labels={"team": "ml"}))
+        server.api.create(make_cron("b", labels={"team": "infra"}))
+        conn = http.client.HTTPConnection(
+            server._server.server_address[0], server.port, timeout=10)
+        conn.request(
+            "GET",
+            f"/apis/{CRON_AV}/namespaces/default/crons"
+            "?labelSelector=team%3Dml",
+            headers={"Authorization": f"Bearer {TOKEN}"},
+        )
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert [c["metadata"]["name"] for c in body["items"]] == ["a"]
+
+
+class TestHubMechanics:
+    """Hub-level behavior that real sockets can't force deterministically:
+    latest-wins coalescing, overflow drop, mid-stream expiry."""
+
+    def _conn(self, server, **kw):
+        conn = _WatchConn(
+            CRON_AV, "Cron", "default", None, mode="thread",
+            cv=threading.Condition(server.hub._lock), **kw)
+        assert server.hub.attach(conn, 0) is False
+        return conn
+
+    def _publish(self, server, ev_type, name, rv):
+        server.hub.publish(WatchEvent(type=ev_type, object={
+            "apiVersion": CRON_AV, "kind": "Cron",
+            "metadata": {"name": name, "namespace": "default",
+                         "resourceVersion": str(rv)},
+        }))
+
+    def test_latest_wins_coalescing(self, server):
+        conn = self._conn(server)
+        try:
+            self._publish(server, "ADDED", "obj", 1)
+            self._publish(server, "MODIFIED", "obj", 2)
+            self._publish(server, "MODIFIED", "obj", 3)
+            self._publish(server, "MODIFIED", "obj", 4)
+            with server.hub._lock:
+                assert len(conn.pending) == 2  # ADDED + one MODIFIED slot
+                data = server.hub._pop_frames_locked(conn)
+            frames = [json.loads(line) for line in data.split(b"\r\n")
+                      if line.startswith(b"{")]
+            assert [f["type"] for f in frames] == ["ADDED", "MODIFIED"]
+            # the queued MODIFIED was overwritten in place with the newest
+            assert frames[1]["object"]["metadata"]["resourceVersion"] == "4"
+            assert server.hub.coalesced == 2
+        finally:
+            server.hub.detach(conn)
+
+    def test_slow_consumer_overflows_and_drops(self, server):
+        conn = self._conn(server, max_pending=2)
+        try:
+            for i in range(4):
+                self._publish(server, "ADDED", f"o{i}", i + 1)
+            assert conn.overflowed
+            assert server.hub.dropped == 1
+            with server.hub._lock:
+                state = server.hub._tick_locked(conn, time.monotonic())
+            assert state == "overflow"
+        finally:
+            server.hub.detach(conn)
+
+    def test_idle_stream_expires_when_ring_evicts_past_horizon(self, server):
+        conn = self._conn(server)
+        try:
+            with server.hub._cond:
+                server.hub._events.clear()
+                server.hub._oldest_evicted_rv = 10_000_000
+                server.hub._evicted_by_kind[(CRON_AV, "Cron")] = 10_000_000
+            with server.hub._lock:
+                state = server.hub._tick_locked(conn, time.monotonic())
+            assert state == "expired"
+        finally:
+            server.hub.detach(conn)
+
+    def test_quiet_kind_watcher_survives_ring_churn(self, server):
+        """The horizon advances while a stream is idle, so heavy traffic
+        on OTHER kinds must not 410 a quiet kind's watcher."""
+        conn = self._conn(server)
+        try:
+            with server.hub._lock:
+                server.hub._tick_locked(conn, time.monotonic())
+            for i in range(front.WATCH_BUFFER + 50):
+                server.hub.publish(WatchEvent(type="ADDED", object={
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"p{i}", "namespace": "default",
+                                 "resourceVersion": str(i + 1)},
+                }))
+            with server.hub._lock:
+                server.hub._pop_frames_locked(conn)  # nothing pending
+                state = server.hub._tick_locked(conn, time.monotonic())
+            assert state == "ok"
+        finally:
+            server.hub.detach(conn)
+
+
+class TestWatch410:
+    def test_watch_from_evicted_rv_gets_410_and_stream_ends(self, server):
+        server.api.create(make_cron("seed"))
+        with server.hub._cond:
+            server.hub._events.clear()
+            server.hub._oldest_evicted_rv = 10_000_000
+        path = (f"/apis/{CRON_AV}/namespaces/default/crons"
+                "?watch=true&resourceVersion=5")
+        s = WatchStream(server, path=path)
+        try:
+            assert s.done.wait(5.0), "410 stream must terminate"
+            assert len(s.events) == 1
+            err = s.events[0]
+            assert err["type"] == "ERROR"
+            assert err["object"]["code"] == 410
+            assert err["object"]["reason"] == "Expired"
+        finally:
+            s.close()
+
+    def test_client_relists_after_410(self, server):
+        """The production client path: ExpiredWatchError → re-list →
+        objects created after recovery still arrive (tests/test_e2e_http
+        drives the same loop through the reconciler)."""
+        from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
+        from cron_operator_tpu.runtime.cluster import (
+            ClusterAPIServer,
+            ClusterConfig,
+        )
+
+        capi = ClusterAPIServer(
+            ClusterConfig(server.url, token=TOKEN), scheme=default_scheme())
+        seen = []
+        capi.add_watcher(lambda ev: seen.append(ev.object["metadata"]["name"]))
+        try:
+            capi.start_watches([GVK_CRON])
+            time.sleep(0.3)
+            capi.create(make_cron("pre-410"))
+            wait_for(lambda: "pre-410" in seen, message="pre-410 event")
+            with server.hub._cond:
+                server.hub._events.clear()
+                server.hub._oldest_evicted_rv = 10_000_000
+                server.hub._cond.notify_all()
+            time.sleep(0.3)
+            capi.create(make_cron("post-410"))
+            wait_for(lambda: "post-410" in seen, timeout=15.0,
+                     message="post-recovery event")
+        finally:
+            capi.stop()
+
+
+class TestMergePatchRFC7386:
+    def test_top_level_null_deletes_key(self):
+        assert _merge_patch({"a": 1, "b": 2}, {"a": None}) == {"b": 2}
+
+    def test_null_for_absent_key_is_noop(self):
+        assert _merge_patch({"b": 2}, {"a": None}) == {"b": 2}
+
+    def test_arrays_replaced_wholesale(self):
+        out = _merge_patch({"l": [1, 2, 3], "keep": True}, {"l": [9]})
+        assert out == {"l": [9], "keep": True}
+
+    def test_nested_null_deletes_nested_key(self):
+        out = _merge_patch({"m": {"x": 1, "y": 2}}, {"m": {"x": None}})
+        assert out == {"m": {"y": 2}}
+
+    def test_scalar_replaces_object_and_vice_versa(self):
+        assert _merge_patch({"m": {"x": 1}}, {"m": 7}) == {"m": 7}
+        assert _merge_patch({"m": 7}, {"m": {"x": 1}}) == {"m": {"x": 1}}
+
+    def test_rfc_appendix_example(self):
+        # RFC 7386 §3 example, abridged
+        target = {"title": "Goodbye!",
+                  "author": {"givenName": "John", "familyName": "Doe"},
+                  "tags": ["example", "sample"], "content": "This will be unchanged"}
+        patch = {"title": "Hello!", "phoneNumber": "+01-123-456-7890",
+                 "author": {"familyName": None}, "tags": ["example"]}
+        assert _merge_patch(target, patch) == {
+            "title": "Hello!", "author": {"givenName": "John"},
+            "tags": ["example"], "content": "This will be unchanged",
+            "phoneNumber": "+01-123-456-7890",
+        }
+
+    def test_null_deletion_over_http(self, server):
+        cron = make_cron("patch-me", labels={"drop": "me", "keep": "yes"})
+        server.api.create(cron)
+        conn = http.client.HTTPConnection(
+            server._server.server_address[0], server.port, timeout=10)
+        conn.request(
+            "PATCH",
+            f"/apis/{CRON_AV}/namespaces/default/crons/patch-me",
+            body=json.dumps(
+                {"metadata": {"labels": {"drop": None}}}).encode(),
+            headers={"Authorization": f"Bearer {TOKEN}",
+                     "Content-Type": "application/merge-patch+json"},
+        )
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert body["metadata"]["labels"] == {"keep": "yes"}
+
+
+class TestAdmissionAtTheWire:
+    def _get(self, srv, path, token=TOKEN):
+        conn = http.client.HTTPConnection(
+            srv._server.server_address[0], srv.port, timeout=10)
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        conn.request("GET", path, headers=headers)
+        resp = conn.getresponse()
+        body = resp.read()
+        headers_out = dict(resp.getheaders())
+        conn.close()
+        return resp.status, body, headers_out
+
+    def test_saturated_level_answers_429_with_retry_after(self):
+        admission = FairQueueAdmission(levels={"workload": LevelConfig(
+            seats=1, queue_depth=1, max_queued=1, queue_timeout_s=0.05)})
+        srv = HTTPAPIServer(token=TOKEN, admission=admission)
+        srv.start()
+        hold = admission.acquire("workload", "hog")
+        try:
+            status, body, headers = self._get(
+                srv, f"/apis/{CRON_AV}/namespaces/default/crons/missing")
+            assert status == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert json.loads(body)["reason"] == "TooManyRequests"
+        finally:
+            hold.release()
+            srv.stop()
+
+    def test_seat_released_after_normal_request(self):
+        admission = FairQueueAdmission(levels={"workload": LevelConfig(
+            seats=1, queue_depth=1, max_queued=1, queue_timeout_s=0.05)})
+        srv = HTTPAPIServer(token=TOKEN, admission=admission)
+        srv.start()
+        try:
+            for _ in range(3):  # would deadlock if seats leaked
+                status, _, _ = self._get(
+                    srv, f"/apis/{CRON_AV}/namespaces/default/crons/nope")
+                assert status == 404
+            assert admission.snapshot()["workload"]["in_flight"] == 0
+        finally:
+            srv.stop()
+
+    def test_established_watch_gives_seat_back(self, monkeypatch):
+        admission = FairQueueAdmission(levels={"workload": LevelConfig(
+            seats=1, queue_depth=4, max_queued=8, queue_timeout_s=0.5)})
+        srv = HTTPAPIServer(token=TOKEN, admission=admission)
+        srv.start()
+        s = None
+        try:
+            s = WatchStream(srv)
+            wait_for(lambda: srv.hub._nconns == 1, message="stream up")
+            # the long-lived stream must not pin the only seat
+            wait_for(lambda: admission.snapshot()["workload"]["in_flight"] == 0,
+                     message="watch seat returned")
+            status, _, _ = self._get(
+                srv, f"/apis/{CRON_AV}/namespaces/default/crons/nope")
+            assert status == 404
+        finally:
+            if s is not None:
+                s.close()
+            srv.stop()
+
+    def test_request_metrics_emitted(self):
+        m = Metrics()
+        srv = HTTPAPIServer(token=TOKEN, metrics=m)
+        srv.start()
+        try:
+            status, _, _ = self._get(
+                srv, f"/apis/{CRON_AV}/namespaces/default/crons")
+            assert status == 200
+            # the handler observes the request AFTER flushing the
+            # response, so the counter can trail the client by a moment
+            wait_for(
+                lambda: m.get(
+                    'http_requests_total{code="200",verb="GET"}') == 1,
+                timeout=5.0, message="request counter")
+            hist = m.histogram('http_request_seconds{verb="GET"}')
+            assert hist is not None and hist["count"] == 1
+        finally:
+            srv.stop()
+
+    def test_admission_disabled_with_false(self):
+        srv = HTTPAPIServer(token=TOKEN, admission=False)
+        srv.start()
+        try:
+            assert srv.apf is None
+            status, _, _ = self._get(
+                srv, f"/apis/{CRON_AV}/namespaces/default/crons")
+            assert status == 200
+        finally:
+            srv.stop()
+
+
+class TestDelegatedAuth:
+    def test_identify_and_counters(self):
+        m = Metrics()
+        auth = ScrapeAuthenticator(
+            StaticTokenReviewer({"tok": "alice"}), path="/apis")
+        auth.instrument(m)
+        assert auth.identify("Bearer tok") == "alice"
+        assert m.get("scrape_auth_cache_misses_total") == 1
+        assert auth.identify("Bearer tok") == "alice"
+        assert m.get("scrape_auth_cache_hits_total") == 1
+        assert m.get("scrape_auth_cache_misses_total") == 1
+        # allow() keeps its strict-bool contract on the shared path
+        assert auth.allow("Bearer tok") is True
+        assert auth.allow("Bearer forged") is False
+        assert m.get("scrape_auth_denials_total") == 1
+        # negative outcome is cached: the repeat deny is a hit, no review
+        assert auth.allow("Bearer forged") is False
+        assert m.get("scrape_auth_cache_hits_total") >= 3
+        assert m.get("scrape_auth_denials_total") == 2
+        # malformed headers deny without burning a cache miss
+        misses = m.get("scrape_auth_cache_misses_total")
+        assert auth.allow(None) is False
+        assert auth.allow("Basic Zm9v") is False
+        assert m.get("scrape_auth_cache_misses_total") == misses
+        assert m.get("scrape_auth_denials_total") == 4
+
+    def test_front_door_401_for_bad_token(self, server):
+        conn = http.client.HTTPConnection(
+            server._server.server_address[0], server.port, timeout=10)
+        conn.request("GET", f"/apis/{CRON_AV}/namespaces/default/crons",
+                     headers={"Authorization": "Bearer wrong"})
+        resp = conn.getresponse()
+        resp.read()
+        conn.close()
+        assert resp.status == 401
+
+    def test_tenant_tokens_map_to_identities(self):
+        srv = HTTPAPIServer(tokens={"t-a": "tenant-a", "t-b": "tenant-b"})
+        try:
+            assert srv.authn.identify("Bearer t-a") == "tenant-a"
+            assert srv.authn.identify("Bearer t-b") == "tenant-b"
+            assert srv.authn.identify("Bearer nope") is None
+        finally:
+            srv.stop()
+
+
+class TestGroupCommitDurability:
+    def test_concurrent_waiters_share_fsyncs(self, tmp_path):
+        wal = Persistence(str(tmp_path), fsync_every=10_000,
+                          flush_interval_s=0)
+        wal.open()
+        errors = []
+
+        def writer(i):
+            try:
+                for j in range(5):
+                    wal.append_put("create", {
+                        "apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": f"w{i}-{j}",
+                                     "namespace": "default",
+                                     # rv 0 would be skipped on replay as
+                                     # <= the empty snapshot's rv
+                                     "resourceVersion": str(i * 100 + j + 1)},
+                    })
+                    assert wal.wait_durable(timeout=10.0)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors
+        assert wal.records_appended == 80
+        assert wal.durable_seq == 80
+        # group commit: 80 durability barriers, far fewer fsyncs
+        assert wal.fsyncs < 80
+        wal.close()
+        state = Persistence(str(tmp_path)).recover()
+        assert state.wal_records_replayed == 80
+
+    def test_wait_durable_trivial_when_caught_up(self, tmp_path):
+        wal = Persistence(str(tmp_path), flush_interval_s=0)
+        wal.open()
+        assert wal.wait_durable() is True  # nothing appended
+        wal.append_put("create", {"metadata": {"resourceVersion": "1"}})
+        assert wal.wait_durable() is True
+        before = wal.fsyncs
+        assert wal.wait_durable() is True  # already durable: no new fsync
+        assert wal.fsyncs == before
+        wal.close()
+
+    def test_wait_durable_false_on_dead_layer(self, tmp_path):
+        wal = Persistence(str(tmp_path), flush_interval_s=0)
+        wal.open()
+        wal.append_put("create", {"metadata": {"resourceVersion": "1"}})
+        wal.kill()
+        assert wal.wait_durable(timeout=0.2) is False
+
+    def test_store_barrier_without_wal_is_trivially_durable(self):
+        api = APIServer()
+        assert api.wait_durable() is True
+
+    def test_store_barrier_with_wal(self, tmp_path):
+        api = APIServer()
+        wal = Persistence(str(tmp_path), fsync_every=10_000,
+                          flush_interval_s=0)
+        wal.open()
+        api.attach_persistence(wal)
+        api.create(make_cron("durable"))
+        assert api.wait_durable() is True
+        assert wal.durable_seq == wal.records_appended == 1
+        wal.close()
+
+    def test_http_write_blocks_on_group_commit(self, tmp_path):
+        api = APIServer()
+        wal = Persistence(str(tmp_path), fsync_every=10_000,
+                          flush_interval_s=0)
+        wal.open()
+        api.attach_persistence(wal)
+        srv = HTTPAPIServer(api=api, token=TOKEN)
+        srv.start()
+        try:
+            conn = http.client.HTTPConnection(
+                srv._server.server_address[0], srv.port, timeout=10)
+            conn.request(
+                "POST", f"/apis/{CRON_AV}/namespaces/default/crons",
+                body=json.dumps(make_cron("over-http")).encode(),
+                headers={"Authorization": f"Bearer {TOKEN}"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            assert resp.status == 201
+            # the 201 means ON DISK, not just committed in memory
+            assert wal.durable_seq == wal.records_appended >= 1
+        finally:
+            srv.stop()
+            wal.close()
