@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http chaos-soak chaos-soak-preempt obs-report
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet chaos-soak chaos-soak-preempt obs-report
 
 all: gate
 
@@ -74,6 +74,18 @@ bench-shards:
 	    --shard-counts $(COUNTS) \
 	    --shards-min-scaleup $(MIN_SCALEUP) \
 	    $(if $(CHECK),--check)
+
+# Fleet scheduler benchmark (hack/fleet_bench.py -> BENCH_FLEET.json):
+# a 10k-job fired storm over a mixed v5e/v4/cpu pool, placed by the
+# heterogeneity-aware policy vs the FIFO/first-fit baseline under
+# identical job physics. Gates: >= 1.5x makespan speedup at
+# equal-or-better Jain fairness over per-tenant goodput, placement
+# decision p50 <= 1 ms on the tick path, and a wired zero-write
+# steady-state leg (repeated scheduler pumps against a real store must
+# freeze resourceVersion). CHECK=1 runs a 600-job smoke and fails the
+# target on REGRESSION (the CI-gate leg).
+bench-fleet:
+	python hack/fleet_bench.py $(if $(CHECK),--check --stdout)
 
 # Seeded chaos soak: N Crons reconciled under a deterministic fault
 # schedule (conflicts, transient server errors, latency, submit

@@ -19,6 +19,12 @@ ANNOTATION_ENTRYPOINT = "tpu.kubedl.io/entrypoint"
 
 _REGISTRY: Dict[str, Callable[["JobContext"], Any]] = {}
 
+# The standard-workloads import is retried on every resolve (the package
+# may become importable later), but the failure warning fires once per
+# process — a control-plane box without jax resolves entrypoints on every
+# tick, and a full traceback per tick is pure log spam.
+_WORKLOADS_IMPORT_WARNED = False
+
 
 @dataclass
 class JobContext:
@@ -68,13 +74,17 @@ def resolve_entrypoint(ref: str) -> Callable[["JobContext"], Any]:
         try:
             importlib.import_module("cron_operator_tpu.workloads.entrypoints")
         except ImportError:
-            import logging
+            global _WORKLOADS_IMPORT_WARNED
+            if not _WORKLOADS_IMPORT_WARNED:
+                _WORKLOADS_IMPORT_WARNED = True
+                import logging
 
-            logging.getLogger("backends.registry").warning(
-                "standard workload entrypoints unavailable "
-                "(cron_operator_tpu.workloads failed to import)",
-                exc_info=True,
-            )
+                logging.getLogger("backends.registry").warning(
+                    "standard workload entrypoints unavailable "
+                    "(cron_operator_tpu.workloads failed to import); "
+                    "warning once, not per resolve",
+                    exc_info=True,
+                )
     if ref in _REGISTRY:
         return _REGISTRY[ref]
     if ":" in ref:

@@ -178,11 +178,16 @@ _SHORTHAND = {
     "v6e-4": ("v6e", "2x2"),
     "v6e-8": ("v6e", "2x4"),
     "v6e-16": ("v6e", "4x4"),
+    "v6e-32": ("v6e", "4x8"),
     "v6e-64": ("v6e", "8x8"),
     "v6e-256": ("v6e", "16x16"),
+    "v5p-4": ("v5p", "2x2x1"),
     "v5p-8": ("v5p", "2x2x2"),
     "v5p-16": ("v5p", "2x2x4"),
+    "v5p-32": ("v5p", "2x4x4"),
     "v4-8": ("v4", "2x2x2"),
+    "v4-16": ("v4", "2x2x4"),
+    "v4-32": ("v4", "2x4x4"),
 }
 
 

@@ -307,6 +307,27 @@ def build_parser() -> argparse.ArgumentParser:
                             "replay the shard's WAL byte stream "
                             "continuously and are promotable on leader "
                             "failure; requires --data-dir")
+    start.add_argument("--fleet-pool", default=None, metavar="POOL",
+                       help="enable the heterogeneity-aware fleet "
+                            "scheduler over a pool of named slice types, "
+                            "e.g. 'v5e-16=2,v4-8=4,cpu=8' (shorthand=count;"
+                            " names that are not TPU slice shorthands "
+                            "model 1-chip host-local capacity). Fired "
+                            "workloads are placed on the slice type "
+                            "maximizing aggregate throughput, queued when "
+                            "saturated, and may preempt lower-priority "
+                            "gangs. See README 'Fleet scheduling'")
+    start.add_argument("--fleet-quota", action="append", default=[],
+                       metavar="TENANT=CHIPS",
+                       help="per-tenant concurrent chip quota for the "
+                            "fleet scheduler (repeatable). Tenant = the "
+                            "tpu.kubedl.io/tenant annotation, defaulting "
+                            "to the workload's namespace")
+    start.add_argument("--fleet-queue-depth", type=int, default=256,
+                       metavar="N",
+                       help="bounded fleet admission queue: fired "
+                            "workloads beyond N waiting are shed with a "
+                            "FleetRejected event (default 256)")
     start.add_argument("--audit-log", default=None, metavar="FILE",
                        help="append every audit record (committed store "
                             "verbs, controller decisions, cluster events) "
@@ -450,6 +471,13 @@ def cmd_start(args: argparse.Namespace) -> int:
         return 2
     if args.shards < 1:
         log.error("--shards must be >= 1, got %d", args.shards)
+        return 2
+    fleet = None
+    if args.fleet_pool and (args.api_server == "cluster" or sharded):
+        # The fleet's capacity books are process-local and its creates
+        # must see the same store the watch pump releases against.
+        log.error("--fleet-pool applies to the single-shard embedded "
+                  "control plane only")
         return 2
 
     # One tracer + one audit journal per process: the cron tick's trace
@@ -610,8 +638,42 @@ def cmd_start(args: argparse.Namespace) -> int:
         )
         tracer.instrument(manager.metrics)
         journal.instrument(manager.metrics)
+        if args.fleet_pool:
+            from cron_operator_tpu.runtime.fleet import (
+                FleetScheduler,
+                parse_pool,
+                parse_quotas,
+            )
+
+            try:
+                fleet_types = parse_pool(args.fleet_pool)
+                fleet_quotas = parse_quotas(args.fleet_quota)
+            except ValueError as err:
+                log.error("--fleet-pool/--fleet-quota: %s", err)
+                return 2
+            # The fleet submits through the (possibly chaos-wrapped) api
+            # so placement creates share the store path every other
+            # write takes; its watch pump releases slices on terminal
+            # workloads and refines the throughput matrix from the
+            # tokens/s the executor publishes.
+            fleet = FleetScheduler(
+                fleet_types,
+                api=api,
+                metrics=manager.metrics,
+                audit=journal,
+                quotas=fleet_quotas,
+                max_queue=args.fleet_queue_depth,
+                backend_name=args.backend,
+            )
+            log.info(
+                "fleet scheduler: pool %s, %d tenant quota(s), queue "
+                "depth %d",
+                ", ".join(f"{t.name}x{t.count}" for t in fleet_types),
+                len(fleet_quotas), args.fleet_queue_depth,
+            )
         reconciler = CronReconciler(api, metrics=manager.metrics,
-                                    tracer=tracer, audit=journal)
+                                    tracer=tracer, audit=journal,
+                                    fleet=fleet)
         manager.add_controller(
             "cron",
             reconciler.reconcile,
@@ -690,6 +752,11 @@ def cmd_start(args: argparse.Namespace) -> int:
         executor = LocalExecutor(api, metrics=executor_metrics, tracer=tracer,
                                  audit=journal)
         executor.start()
+    if fleet is not None:
+        # Priority preemptions route through the executor so the elastic
+        # chain resumes the victim (no executor → books-only preemption).
+        fleet.backend = executor
+        fleet.start()
 
     def _debug_shards_json() -> str:
         # Sharded: the plane owns the authoritative per-shard view
@@ -902,6 +969,8 @@ def cmd_start(args: argparse.Namespace) -> int:
         m.stop()
     if api_http is not None:
         api_http.stop()
+    if fleet is not None:
+        fleet.stop()
     if executor is not None:
         executor.stop()
     if plane is not None:

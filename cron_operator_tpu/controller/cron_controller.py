@@ -122,9 +122,17 @@ class CronReconciler:
     def __init__(self, api: APIServer, clock: Optional[Clock] = None,
                  metrics: Optional[Any] = None,
                  tracer: Optional[Any] = None,
-                 audit: Optional[Any] = None):
+                 audit: Optional[Any] = None,
+                 fleet: Optional[Any] = None):
         self.api = api
         self.clock = clock or api.clock
+        # Fleet scheduler (runtime.fleet.FleetScheduler-compatible). When
+        # set, fired workloads route through fleet.submit() — placement /
+        # bounded queueing / load shedding — instead of straight to
+        # api.create. The resume path shares _submit_workload, so resumed
+        # attempts are fleet-placed too (possibly on a different slice
+        # type than the preempted original).
+        self.fleet = fleet
         # Domain metrics (runtime.manager.Metrics-compatible). The reference
         # exposes only controller-runtime built-ins (SURVEY.md §5 "No custom
         # metrics are registered — build should add domain metrics").
@@ -511,14 +519,20 @@ class CronReconciler:
 
     def _submit_workload(
         self, cron: Cron, gvk: GVK, workload: Unstructured, log
-    ) -> None:
+    ) -> Optional[Any]:
         """Create the tick's workload with a bounded retry budget for
         transient API failures. Retries are counted
         (``cron_submit_retries_total``); exhaustion records a terminal
         Warning event naming the workload, then re-raises (the caller's
         generic handler adds FailedCreate and the reconcile error takes
         the rate-limited-requeue path). AlreadyExists propagates on the
-        first attempt — it is a semantic answer, not a transient."""
+        first attempt — it is a semantic answer, not a transient.
+
+        With a fleet scheduler wired, the create routes through
+        ``fleet.submit`` and the PlacementDecision is returned (a queued
+        workload exists only in the fleet's books until dispatch, so
+        callers can distinguish a fresh submit from a duplicate of a
+        still-queued one). Returns None on the direct-create path."""
         wl_name = (workload.get("metadata") or {}).get("name", "")
         wl_meta = workload.get("metadata") or {}
         wl_key = (f"{workload.get('apiVersion', '')}/"
@@ -527,10 +541,38 @@ class CronReconciler:
         wl_trace = (wl_meta.get("annotations") or {}).get(ANNOTATION_TRACE_ID)
         for attempt in range(SUBMIT_ATTEMPTS):
             try:
+                if self.fleet is not None:
+                    decision = self.fleet.submit(workload)
+                    if decision.action == "rejected":
+                        # Bounded queue shed the tick: surface it on the
+                        # Cron and stop — re-raising would burn the retry
+                        # budget against a full queue.
+                        self.api.record_event(
+                            cron.to_dict(),
+                            "Warning",
+                            "FleetRejected",
+                            f"fleet queue full "
+                            f"(depth {decision.queue_depth}): shed "
+                            f"{gvk.kind} {wl_name}",
+                        )
+                        self._audit(
+                            "submit_rejected", key=wl_key,
+                            trace_id=wl_trace, reason=decision.reason,
+                            queue_depth=decision.queue_depth,
+                        )
+                        return decision
+                    if decision.reason not in ("already-tracked",
+                                               "already-queued"):
+                        self._audit(
+                            "submit", key=wl_key, trace_id=wl_trace,
+                            attempt=attempt + 1, placement=decision.action,
+                            slice_type=decision.slice_type,
+                        )
+                    return decision
                 self.api.create(workload)
                 self._audit("submit", key=wl_key, trace_id=wl_trace,
                             attempt=attempt + 1)
-                return
+                return None
             except ServerTimeoutError as err:
                 if attempt == SUBMIT_ATTEMPTS - 1:
                     self.api.record_event(
@@ -841,11 +883,20 @@ class CronReconciler:
             )
             rname = resume["metadata"]["name"]
             try:
-                self._submit_workload(cron, gvk, resume, log)
+                decision = self._submit_workload(cron, gvk, resume, log)
             except AlreadyExistsError:
                 # Fail-over replay of a resubmit whose status update was
                 # lost; the successor is (or was) already running.
                 log.info("resume attempt %s already exists", rname)
+                continue
+            if decision is not None and (
+                decision.action == "rejected"
+                or decision.reason in ("already-tracked", "already-queued")
+            ):
+                # Shed (retried next sweep) or a duplicate of a resume
+                # still waiting in the fleet queue — the store doesn't
+                # have it yet, but the fleet's books do. Either way this
+                # sweep did not start a new resume.
                 continue
             self._count("cron_workload_resumes_total")
             self._audit(

@@ -1,0 +1,1098 @@
+"""Heterogeneity-aware fleet scheduler (ROADMAP item 3).
+
+The policy layer between a fired Cron tick and the backend: a capacity
+model over a pool of *named slice types* (``v5e-16``, ``v4-8``,
+``cpu`` …), each a :class:`~cron_operator_tpu.backends.tpu.SliceSpec`
+plus a count, with a per-(workload-class, slice-type) throughput matrix
+seeded from bench history and refined online from the ``tokens/s``
+progress the executor publishes. Placement follows Gavel
+(arXiv 2008.09213): each gang goes to the slice type maximizing
+*aggregate* weighted throughput — batch dispatch runs a max-regret
+greedy assignment over the queue window, not first-fit. On top of the
+placement core: per-tenant chip quotas, priority classes, bounded
+queueing when saturated, preemption of lower-priority gangs through
+``LocalExecutor.preempt()`` (so the PR 7 elastic-resume chain resumes
+the victim instead of restarting it — VirtualFlow, arXiv 2009.09523),
+and backfill of short jobs past a blocked queue head.
+
+Decision discipline: ``submit()`` reads only the workload dict it was
+handed plus the scheduler's own in-memory books — never the store — so
+a placement decision performs zero store reads/writes and the control
+plane's steady-state zero-write invariant is untouched. The only store
+interaction is the ``create`` of a placed workload (the write the tick
+was going to make anyway, just routed and possibly delayed).
+
+Watch events are *enqueued* by the subscriber callback and drained by
+:meth:`FleetScheduler.pump` — either from the background dispatcher
+thread (:meth:`start`) or synchronously from tests/benches/soaks, which
+keeps every decision deterministically replayable from a fixed seed.
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cron_operator_tpu.backends.tpu import (
+    ANNOTATION_ACCELERATOR,
+    ANNOTATION_TOPOLOGY,
+    SliceSpec,
+    slice_for_shorthand,
+)
+from cron_operator_tpu.runtime.kube import AlreadyExistsError, WatchEvent
+from cron_operator_tpu.runtime.manager import PHASE_BUCKETS
+
+logger = logging.getLogger("runtime.fleet")
+
+# ---------------------------------------------------------------------------
+# annotations / priority classes
+
+# Stamped by the scheduler on every workload it places (records the
+# decision on the object itself; /debug/audit carries the full record).
+ANNOTATION_SLICE_TYPE = "tpu.kubedl.io/fleet-slice-type"
+# Marker that the accelerator/topology annotations were written by the
+# SCHEDULER, not the user: a resumed attempt inherits its predecessor's
+# stamp via deepcopy, and this marker is what lets the scheduler re-place
+# the resume on a *different* slice type instead of treating the stale
+# stamp as a user pin.
+ANNOTATION_FLEET_PLACED = "tpu.kubedl.io/fleet-placed"
+ANNOTATION_TENANT = "tpu.kubedl.io/tenant"
+ANNOTATION_PRIORITY = "tpu.kubedl.io/priority"
+ANNOTATION_WORKLOAD_CLASS = "tpu.kubedl.io/workload-class"
+# Abstract work units (tokens) remaining for the run — the backfill
+# short-job estimate: est. duration on type t = work / rate(class, t).
+ANNOTATION_EST_WORK = "tpu.kubedl.io/estimated-work"
+ANNOTATION_GANG_SIZE = "tpu.kubedl.io/gang-size"
+
+PRIORITY_CLASSES = {
+    "system": 100,
+    "high": 50,
+    "normal": 0,
+    "batch": -50,
+    "low": -50,
+}
+DEFAULT_PRIORITY = 0
+
+# Env names inject_tpu_topology renders; must be dropped before a
+# re-stamp so re-injection writes values for the NEW slice shape
+# (inject only appends names that are absent).
+_COORDINATOR_ENV = {
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+    "TPU_WORKER_ID",
+}
+
+_TERMINAL_CONDITIONS = ("Succeeded", "Failed")
+
+
+def _is_terminal(obj: Dict[str, Any]) -> bool:
+    for c in (obj.get("status") or {}).get("conditions") or []:
+        if (
+            c.get("type") in _TERMINAL_CONDITIONS
+            and str(c.get("status", "")).lower() == "true"
+        ):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pool / matrix
+
+
+@dataclass(frozen=True)
+class SliceType:
+    """One pool entry: a named slice shape with a count of instances."""
+
+    name: str
+    count: int
+    spec: Optional[SliceSpec] = None  # None = host-local (CPU) capacity
+
+    @property
+    def chips(self) -> int:
+        return self.spec.chips if self.spec is not None else 1
+
+
+def parse_pool(text: str) -> List[SliceType]:
+    """``"v5e-16=2,v4-8=4,cpu=8"`` → pool entries. Names that resolve via
+    ``slice_for_shorthand`` model real slice shapes; anything else is a
+    1-chip host-local type (``cpu``)."""
+    pool: List[SliceType] = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, count_s = part.partition("=")
+        name = name.strip()
+        try:
+            count = int(count_s) if count_s else 1
+        except ValueError:
+            raise ValueError(
+                f"fleet pool entry {part!r}: count must be an integer"
+            ) from None
+        if count < 1:
+            raise ValueError(f"fleet pool entry {part!r}: count must be >= 1")
+        try:
+            spec: Optional[SliceSpec] = slice_for_shorthand(name)
+        except Exception:
+            spec = None
+        pool.append(SliceType(name, count, spec))
+    if not pool:
+        raise ValueError(f"fleet pool {text!r} names no slice types")
+    return pool
+
+
+def parse_quotas(entries: List[str]) -> Dict[str, int]:
+    """``["team-a=32", "team-b=16"]`` → {tenant: chip quota}."""
+    quotas: Dict[str, int] = {}
+    for entry in entries:
+        tenant, _, chips_s = entry.partition("=")
+        if not tenant or not chips_s:
+            raise ValueError(
+                f"fleet quota {entry!r}: expected TENANT=CHIPS"
+            )
+        quotas[tenant.strip()] = int(chips_s)
+    return quotas
+
+
+class ThroughputMatrix:
+    """(workload-class, slice-type) → tokens/s.
+
+    Seeded from bench history (``seed``), refined online with an EMA of
+    the ``tokens_per_s`` the executor publishes into workload status.
+    Unknown pairs fall back to a ``"*"`` wildcard row, then to a
+    chips-proportional prior (more chips, more throughput — the neutral
+    assumption until a real observation lands)."""
+
+    def __init__(
+        self,
+        seed: Optional[Dict[Tuple[str, str], float]] = None,
+        alpha: float = 0.25,
+    ):
+        self._rates: Dict[Tuple[str, str], float] = dict(seed or {})
+        self._alpha = alpha
+        self._lock = threading.Lock()
+
+    def rate(self, wclass: str, slice_type: str, chips: int = 1) -> float:
+        with self._lock:
+            r = self._rates.get((wclass, slice_type))
+            if r is None:
+                r = self._rates.get(("*", slice_type))
+        return float(r) if r is not None else float(max(chips, 1))
+
+    def observe(self, wclass: str, slice_type: str, tokens_per_s: Any) -> None:
+        try:
+            v = float(tokens_per_s)
+        except (TypeError, ValueError):
+            return
+        if v <= 0:
+            return
+        with self._lock:
+            cur = self._rates.get((wclass, slice_type))
+            self._rates[(wclass, slice_type)] = (
+                v if cur is None else cur + self._alpha * (v - cur)
+            )
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {f"{w}/{t}": r for (w, t), r in sorted(self._rates.items())}
+
+
+# ---------------------------------------------------------------------------
+# decisions / tracking
+
+
+@dataclass
+class PlacementDecision:
+    action: str  # "placed" | "queued" | "rejected"
+    slice_type: Optional[str] = None
+    reason: Optional[str] = None
+    preempted: Optional[str] = None  # "ns/name" of the evicted gang
+    queue_depth: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "action": self.action,
+            "slice_type": self.slice_type,
+            "reason": self.reason,
+            "preempted": self.preempted,
+            "queue_depth": self.queue_depth,
+        }
+
+
+@dataclass
+class _Tracked:
+    key: Tuple[str, str]  # (namespace, name)
+    workload: Dict[str, Any]
+    api_version: str
+    kind: str
+    wclass: str
+    tenant: str
+    priority: int
+    pinned: Optional[str]  # pool type name the user pinned, or None
+    est_work: float
+    seq: int
+    enqueued_mono: float = field(default_factory=time.monotonic)
+    slice_type: Optional[str] = None
+    state: str = "queued"
+    attempts: int = 0
+
+
+def plan_assignments(
+    jobs: List[Tuple[str, Any, float]],
+    free: Dict[str, int],
+    rate: Callable[[str, str], float],
+) -> List[Optional[str]]:
+    """Max-regret greedy assignment (the Gavel-flavored core, pure and
+    testable): ``jobs`` are ``(workload_class, allowed, est_work)``
+    tuples — ``allowed`` a single pinned type name, a list of candidate
+    type names, or None for the whole pool — and ``free`` maps
+    slice-type name → free instance count. Returns one chosen type (or
+    None) per job, maximizing the sum of ``rate(class, type)`` — jobs
+    that would lose the most by missing their best type are assigned
+    first."""
+    free = dict(free)
+    n = len(jobs)
+    chosen: List[Optional[str]] = [None] * n
+    unassigned = set(range(n))
+    while unassigned:
+        best_pick: Optional[Tuple[float, float, int, int, str]] = None
+        for i in unassigned:
+            wclass, allowed, _work = jobs[i]
+            if allowed is None:
+                types = sorted(free)
+            elif isinstance(allowed, str):
+                types = [allowed]
+            else:
+                types = list(allowed)
+            avail = [t for t in types if free.get(t, 0) > 0]
+            if not avail:
+                continue
+            rates = sorted(
+                ((rate(wclass, t), t) for t in avail), reverse=True
+            )
+            top_rate, top_type = rates[0]
+            regret = top_rate - (rates[1][0] if len(rates) > 1 else 0.0)
+            # Highest regret wins the next slot; deterministic tie-break
+            # on (rate, -index, type name).
+            pick = (regret, top_rate, -i, i, top_type)
+            if best_pick is None or pick > best_pick:
+                best_pick = pick
+        if best_pick is None:
+            break
+        _, _, _, i, t = best_pick
+        chosen[i] = t
+        free[t] -= 1
+        unassigned.discard(i)
+    return chosen
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+
+
+class FleetScheduler:
+    """Admission + placement layer in front of ``api.create``.
+
+    ``policy="hetero"`` (default) is the heterogeneity-aware scheduler;
+    ``policy="fifo"`` is the naive FIFO/first-fit baseline the bench
+    compares against (declaration-order first fit, strict head-of-line
+    queue, no preemption, no backfill).
+
+    ``api=None`` runs the scheduler in pure simulation: placements call
+    ``on_create(workload, slice_type)`` instead of a store create, and
+    completions arrive via :meth:`release` — how ``hack/fleet_bench.py``
+    drives 10k virtual Crons without a control plane."""
+
+    def __init__(
+        self,
+        pool: List[SliceType],
+        *,
+        api: Optional[Any] = None,
+        backend: Optional[Any] = None,
+        matrix: Optional[ThroughputMatrix] = None,
+        quotas: Optional[Dict[str, int]] = None,
+        max_queue: int = 256,
+        backfill_window: int = 64,
+        policy: str = "hetero",
+        min_efficiency: float = 0.0,
+        metrics: Optional[Any] = None,
+        audit: Optional[Any] = None,
+        on_create: Optional[Callable[[Dict[str, Any], str], None]] = None,
+        backend_name: str = "local",
+    ):
+        if not pool:
+            raise ValueError("fleet pool must name at least one slice type")
+        if policy not in ("hetero", "fifo"):
+            raise ValueError(f"unknown fleet policy {policy!r}")
+        self.pool: Dict[str, SliceType] = {}
+        for t in pool:
+            if t.name in self.pool:
+                raise ValueError(f"duplicate slice type {t.name!r} in pool")
+            self.pool[t.name] = t
+        self.api = api
+        self.backend = backend
+        self.matrix = matrix or ThroughputMatrix()
+        self.quotas = dict(quotas or {})
+        self.max_queue = max_queue
+        self.backfill_window = backfill_window
+        self.policy = policy
+        # Bounded-slowdown knob (hetero policy only): never place an
+        # unpinned job on a slice type slower than min_efficiency x its
+        # best-in-pool rate — waiting for the right hardware beats a
+        # 40x-slower run that wrecks the makespan tail. 0.0 = any port
+        # in a storm.
+        self.min_efficiency = min_efficiency
+        self.metrics = metrics
+        self.audit = audit
+        self.on_create = on_create
+        self.backend_name = backend_name
+
+        self._lock = threading.RLock()
+        self._free: Dict[str, int] = {t.name: t.count for t in pool}
+        self._lost: Dict[str, int] = {t.name: 0 for t in pool}
+        self._queue: List[_Tracked] = []  # sorted by (-priority, seq)
+        self._running: Dict[Tuple[str, str], _Tracked] = {}
+        self._seq = 0
+        self._tenant_used: Dict[str, int] = {}
+        # High-water mark of concurrent chip usage per tenant — the chaos
+        # soak's "quotas never exceeded" invariant reads this.
+        self.tenant_peak: Dict[str, int] = {}
+        self.rejected_total = 0
+        self.preempted_total = 0
+        self.backfilled_total = 0
+        # Bounded, append-only decision trail (determinism tests replay
+        # it; /debug/audit carries the full records).
+        self.decision_log: deque = deque(maxlen=65536)
+
+        self._events: deque = deque()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def start(self) -> "FleetScheduler":
+        """Subscribe to workload watch events and start the background
+        pump (release-on-terminal, queue dispatch, matrix refinement)."""
+        if self.api is not None and hasattr(self.api, "add_watcher"):
+            self.api.add_watcher(self._on_event, coalesce=True)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+            try:
+                self.pump()
+            except Exception:  # noqa: BLE001 — the pump must survive
+                logger.exception("fleet pump failed; continuing")
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        # Watch callback: enqueue only (delivery happens on the store's
+        # dispatcher thread; all real work runs in pump()).
+        self._events.append(ev)
+        self._wake.set()
+
+    # ---- metrics / audit shims -------------------------------------------
+
+    def _count(self, series: str, value: float = 1.0) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(series, value)
+
+    def _record(self, event: str, **kw: Any) -> None:
+        if self.audit is not None:
+            self.audit.record("decision", event, **kw)
+
+    def _update_pending_gauge_locked(self) -> None:
+        if self.metrics is None:
+            return
+        counts = {name: 0 for name in self.pool}
+        for tr in self._queue:
+            counts[self._preferred_type(tr)] += 1
+        for name, n in counts.items():
+            self.metrics.set(
+                f'cron_jobs_pending{{backend="{self.backend_name}"'
+                f',slice_type="{name}"}}',
+                float(n),
+            )
+
+    # ---- job parsing ------------------------------------------------------
+
+    def _track(self, workload: Dict[str, Any]) -> _Tracked:
+        meta = workload.get("metadata") or {}
+        ann = meta.get("annotations") or {}
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        prio_raw = ann.get(ANNOTATION_PRIORITY, "")
+        if prio_raw in PRIORITY_CLASSES:
+            priority = PRIORITY_CLASSES[prio_raw]
+        else:
+            try:
+                priority = int(prio_raw)
+            except (TypeError, ValueError):
+                priority = DEFAULT_PRIORITY
+        try:
+            est_work = float(ann.get(ANNOTATION_EST_WORK, 0) or 0)
+        except (TypeError, ValueError):
+            est_work = 0.0
+        pinned = self._pinned_type(ann)
+        self._seq += 1
+        return _Tracked(
+            key=(ns, name),
+            workload=workload,
+            api_version=workload.get("apiVersion", "kubeflow.org/v1"),
+            kind=workload.get("kind", "JAXJob"),
+            wclass=ann.get(ANNOTATION_WORKLOAD_CLASS)
+            or workload.get("kind", "default"),
+            tenant=ann.get(ANNOTATION_TENANT) or ns,
+            priority=priority,
+            pinned=pinned,
+            est_work=est_work,
+            seq=self._seq,
+        )
+
+    def _pinned_type(self, ann: Dict[str, str]) -> Optional[str]:
+        """A USER-written accelerator/topology (or explicit slice-type)
+        annotation pins the job to the matching pool type. Scheduler
+        stamps (marked ``fleet-placed``) never pin — a resumed attempt
+        must be free to land on a different shape."""
+        if str(ann.get(ANNOTATION_FLEET_PLACED, "")).lower() in ("1", "true"):
+            return None
+        explicit = ann.get(ANNOTATION_SLICE_TYPE)
+        if explicit and explicit in self.pool:
+            return explicit
+        accel = ann.get(ANNOTATION_ACCELERATOR)
+        if not accel:
+            return None
+        topo = ann.get(ANNOTATION_TOPOLOGY)
+        for t in self.pool.values():
+            if t.spec is None:
+                continue
+            if topo:
+                if (t.spec.accelerator, t.spec.topology) == (accel, topo):
+                    return t.name
+            elif t.name == accel:  # shorthand pin ("v5e-16", no topology)
+                return t.name
+        return "__unpooled__"  # pinned to hardware the pool doesn't model
+
+    def _preferred_type(self, tr: _Tracked) -> str:
+        if tr.pinned is not None and tr.pinned in self.pool:
+            return tr.pinned
+        best = max(
+            self.pool.values(),
+            key=lambda t: (self.matrix.rate(tr.wclass, t.name, t.chips),
+                           t.name),
+        )
+        return best.name
+
+    # ---- capacity model ---------------------------------------------------
+
+    def capacity(self, slice_type: Optional[str] = None) -> int:
+        """Slices currently in service (free + busy), fleet-wide or for
+        one type — the ``LocalExecutor.capacity()`` analog one level up."""
+        with self._lock:
+            if slice_type is not None:
+                t = self.pool[slice_type]
+                return t.count - self._lost[slice_type]
+            return sum(
+                t.count - self._lost[t.name] for t in self.pool.values()
+            )
+
+    def shrink_capacity(self, slice_type: str, n: int = 1) -> int:
+        """Remove up to ``n`` slices of ``slice_type`` from service
+        (maintenance / spot reclamation / chaos flap). Free slices go
+        first; beyond that, the lowest-priority running gangs on the type
+        are preempted through the backend so the elastic-resume chain
+        picks them up. Returns the number of slices actually removed."""
+        victims: List[_Tracked] = []
+        removed = 0
+        with self._lock:
+            if slice_type not in self.pool:
+                raise KeyError(f"unknown slice type {slice_type!r}")
+            in_service = self.pool[slice_type].count - self._lost[slice_type]
+            n = min(n, in_service)
+            while removed < n and self._free[slice_type] > 0:
+                self._free[slice_type] -= 1
+                self._lost[slice_type] += 1
+                removed += 1
+            while removed < n:
+                victim = self._victim_on_locked(slice_type)
+                if victim is None:
+                    break
+                self._release_locked(victim.key)  # frees the slot…
+                self._free[slice_type] -= 1  # …which the flap then takes
+                self._lost[slice_type] += 1
+                removed += 1
+                victims.append(victim)
+        for v in victims:
+            self._do_preempt(v, reason="capacity-flap")
+        if removed:
+            self._record(
+                "fleet_flap", key=slice_type, removed=removed,
+                preempted=[f"{v.key[0]}/{v.key[1]}" for v in victims],
+            )
+        return removed
+
+    def restore_capacity(
+        self, slice_type: Optional[str] = None, n: Optional[int] = None
+    ) -> int:
+        """Return flapped-away slices to service (all types / all slices
+        by default) and dispatch the queue into the recovered capacity."""
+        restored = 0
+        with self._lock:
+            names = [slice_type] if slice_type is not None else list(self.pool)
+            for name in names:
+                k = self._lost[name] if n is None else min(n, self._lost[name])
+                self._lost[name] -= k
+                self._free[name] += k
+                restored += k
+        if restored:
+            self._record("fleet_restore", key=slice_type or "*",
+                         restored=restored)
+            self._dispatch()
+        return restored
+
+    # ---- submit (the tick path) ------------------------------------------
+
+    def submit(self, workload: Dict[str, Any]) -> PlacementDecision:
+        """Admit one fired workload: place it now, queue it, or shed it.
+
+        Reads only the workload dict and in-memory books (no store I/O):
+        the decision itself adds microseconds to the tick path and zero
+        writes. Transient create failures undo the reservation and
+        re-raise, so the controller's bounded submit-retry loop re-enters
+        cleanly; AlreadyExists propagates untouched (the deterministic-
+        name fail-over guard is a semantic answer, not a transient)."""
+        meta = workload.get("metadata") or {}
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        victim: Optional[_Tracked] = None
+        with self._lock:
+            cur = self._running.get(key)
+            if cur is not None:  # idempotent re-submit
+                return PlacementDecision(
+                    "placed", cur.slice_type, reason="already-tracked"
+                )
+            for q in self._queue:
+                if q.key == key:
+                    return PlacementDecision(
+                        "queued", None, reason="already-queued",
+                        queue_depth=len(self._queue),
+                    )
+            tr = self._track(workload)
+            if tr.pinned == "__unpooled__":
+                # Hardware the pool doesn't model: pass through untouched
+                # (never brick a workload because the fleet map is stale).
+                decision = PlacementDecision(
+                    "placed", None, reason="unpooled-pin"
+                )
+                self.decision_log.append((f"{key[0]}/{key[1]}",
+                                          decision.to_dict()))
+                self._create_passthrough(workload)
+                return decision
+            placement = self._place_locked(tr)
+            if placement is None:
+                if len(self._queue) >= self.max_queue:
+                    self.rejected_total += 1
+                    self._count("fleet_rejections_total")
+                    decision = PlacementDecision(
+                        "rejected", None, reason="queue-full",
+                        queue_depth=len(self._queue),
+                    )
+                    self.decision_log.append((f"{key[0]}/{key[1]}",
+                                              decision.to_dict()))
+                    self._record(
+                        "fleet_reject", key=f"{key[0]}/{key[1]}",
+                        reason="queue-full", queue_depth=len(self._queue),
+                    )
+                    return decision
+                bisect.insort(
+                    self._queue, tr, key=lambda x: (-x.priority, x.seq)
+                )
+                self._update_pending_gauge_locked()
+                decision = PlacementDecision(
+                    "queued", None, reason="saturated",
+                    queue_depth=len(self._queue),
+                )
+                self.decision_log.append((f"{key[0]}/{key[1]}",
+                                          decision.to_dict()))
+                self._record(
+                    "fleet_queue", key=f"{key[0]}/{key[1]}",
+                    tenant=tr.tenant, priority=tr.priority,
+                    queue_depth=len(self._queue),
+                )
+                return decision
+            slice_type, victim = placement
+            self._commit_placement_locked(tr, slice_type)
+        if victim is not None:
+            self._do_preempt(victim, reason="priority",
+                             for_key=f"{key[0]}/{key[1]}")
+        try:
+            self._create(tr)
+        except Exception:
+            with self._lock:
+                self._undo_placement_locked(tr)
+            raise
+        decision = PlacementDecision(
+            "placed", tr.slice_type,
+            preempted=f"{victim.key[0]}/{victim.key[1]}" if victim else None,
+        )
+        self.decision_log.append((f"{key[0]}/{key[1]}", decision.to_dict()))
+        self._count(
+            f'fleet_placements_total{{slice_type="{tr.slice_type}"}}'
+        )
+        self._record(
+            "fleet_place", key=f"{key[0]}/{key[1]}",
+            slice_type=tr.slice_type, tenant=tr.tenant,
+            priority=tr.priority, wclass=tr.wclass,
+            preempted=decision.preempted,
+        )
+        return decision
+
+    # ---- placement core (locked) -----------------------------------------
+
+    def _quota_headroom_locked(self, tenant: str, exclude: int = 0) -> float:
+        quota = self.quotas.get(tenant)
+        if quota is None:
+            return float("inf")
+        return quota - (self._tenant_used.get(tenant, 0) - exclude)
+
+    def _allowed_types_locked(self, tr: _Tracked) -> List[str]:
+        """Types this job may EVER run on: its pin, or the pool filtered
+        by the bounded-slowdown floor (free slots and quota are the
+        caller's concern)."""
+        if tr.pinned:
+            return [tr.pinned]
+        names = list(self.pool)
+        if self.min_efficiency <= 0.0 or self.policy != "hetero":
+            return names
+        best = max(
+            self.matrix.rate(tr.wclass, n, self.pool[n].chips)
+            for n in names
+        )
+        floor = best * self.min_efficiency
+        return [
+            n for n in names
+            if self.matrix.rate(tr.wclass, n, self.pool[n].chips) >= floor
+        ]
+
+    def _candidates_locked(self, tr: _Tracked) -> List[str]:
+        headroom = self._quota_headroom_locked(tr.tenant)
+        return [
+            name
+            for name in self._allowed_types_locked(tr)
+            if self._free.get(name, 0) > 0
+            and self.pool[name].chips <= headroom
+        ]
+
+    def _best_type_locked(self, tr: _Tracked,
+                          avail: List[str]) -> Optional[str]:
+        if not avail:
+            return None
+        if self.policy == "fifo":
+            for name in self.pool:  # declaration-order first fit
+                if name in avail:
+                    return name
+            return None
+        return max(
+            avail,
+            key=lambda name: (
+                self.matrix.rate(tr.wclass, name, self.pool[name].chips),
+                name,
+            ),
+        )
+
+    def _place_locked(
+        self, tr: _Tracked
+    ) -> Optional[Tuple[str, Optional[_Tracked]]]:
+        avail = self._candidates_locked(tr)
+        best = self._best_type_locked(tr, avail)
+        if best is not None:
+            return best, None
+        if self.policy != "hetero":
+            return None
+        victim = self._find_victim_locked(tr)
+        if victim is None:
+            return None
+        self._release_locked(victim.key)
+        return victim.slice_type, victim
+
+    def _victim_on_locked(self, slice_type: str) -> Optional[_Tracked]:
+        candidates = [
+            r for r in self._running.values() if r.slice_type == slice_type
+        ]
+        if not candidates:
+            return None
+        # Lowest priority first; among equals the most recently placed
+        # (least sunk work) goes.
+        return min(candidates, key=lambda r: (r.priority, -r.seq))
+
+    def _find_victim_locked(self, tr: _Tracked) -> Optional[_Tracked]:
+        names = self._allowed_types_locked(tr)
+        headroom = self._quota_headroom_locked(tr.tenant)
+        best: Optional[_Tracked] = None
+        for r in self._running.values():
+            if r.priority >= tr.priority or r.slice_type not in names:
+                continue
+            # Quota still binds across a preemption: evicting a same-
+            # tenant gang returns its chips to the tenant's budget.
+            chips = self.pool[r.slice_type].chips
+            back = chips if r.tenant == tr.tenant else 0
+            if chips > headroom + back:
+                continue
+            if best is None or (r.priority, -r.seq) < (best.priority,
+                                                       -best.seq):
+                best = r
+        return best
+
+    def _commit_placement_locked(self, tr: _Tracked, slice_type: str) -> None:
+        self._free[slice_type] -= 1
+        assert self._free[slice_type] >= 0
+        tr.slice_type = slice_type
+        tr.state = "running"
+        self._running[tr.key] = tr
+        chips = self.pool[slice_type].chips
+        used = self._tenant_used.get(tr.tenant, 0) + chips
+        self._tenant_used[tr.tenant] = used
+        if used > self.tenant_peak.get(tr.tenant, 0):
+            self.tenant_peak[tr.tenant] = used
+        if tr in self._queue:
+            self._queue.remove(tr)
+            self._update_pending_gauge_locked()
+
+    def _undo_placement_locked(
+        self, tr: _Tracked, requeue: bool = False
+    ) -> None:
+        if self._running.pop(tr.key, None) is None:
+            return
+        self._free[tr.slice_type] += 1
+        chips = self.pool[tr.slice_type].chips
+        self._tenant_used[tr.tenant] = max(
+            0, self._tenant_used.get(tr.tenant, 0) - chips
+        )
+        tr.slice_type = None
+        tr.state = "queued"
+        if requeue:
+            bisect.insort(self._queue, tr, key=lambda x: (-x.priority, x.seq))
+            self._update_pending_gauge_locked()
+
+    def _release_locked(self, key: Tuple[str, str]) -> bool:
+        tr = self._running.pop(key, None)
+        if tr is None:
+            return False
+        self._free[tr.slice_type] += 1
+        chips = self.pool[tr.slice_type].chips
+        self._tenant_used[tr.tenant] = max(
+            0, self._tenant_used.get(tr.tenant, 0) - chips
+        )
+        return True
+
+    # ---- create / stamp ---------------------------------------------------
+
+    def _stamp(self, tr: _Tracked) -> None:
+        """Record the placement on the workload and (re-)inject topology
+        for the chosen shape. Previous fleet stamps (a resumed attempt
+        inherits its predecessor's) are cleared first so injection
+        renders coordinator env / gang size for the NEW slice."""
+        from cron_operator_tpu.backends.tpu import inject_tpu_topology
+
+        t = self.pool[tr.slice_type]
+        meta = tr.workload.setdefault("metadata", {})
+        ann = meta.setdefault("annotations", {})
+        ann[ANNOTATION_SLICE_TYPE] = t.name
+        if tr.pinned is not None:
+            return  # user-pinned: the template's own annotations stand
+        was_stamped = str(ann.get(ANNOTATION_FLEET_PLACED, "")).lower() in (
+            "1", "true",
+        )
+        ann[ANNOTATION_FLEET_PLACED] = "true"
+        if t.spec is None:
+            # Host-local type: a re-placed job may carry a stale TPU
+            # stamp from its previous slice — drop it.
+            if was_stamped:
+                ann.pop(ANNOTATION_ACCELERATOR, None)
+                ann.pop(ANNOTATION_TOPOLOGY, None)
+                ann.pop(ANNOTATION_GANG_SIZE, None)
+                self._strip_injected_env(tr.workload)
+            return
+        if was_stamped:
+            ann.pop(ANNOTATION_GANG_SIZE, None)
+            self._strip_injected_env(tr.workload)
+        ann[ANNOTATION_ACCELERATOR] = t.spec.accelerator
+        ann[ANNOTATION_TOPOLOGY] = t.spec.topology
+        inject_tpu_topology(tr.workload)
+
+    @staticmethod
+    def _strip_injected_env(workload: Dict[str, Any]) -> None:
+        worker = ((workload.get("spec") or {}).get("replicaSpecs") or {}).get(
+            "Worker") or {}
+        pod_spec = ((worker.get("template") or {}).get("spec")) or {}
+        for c in pod_spec.get("containers") or []:
+            env = c.get("env")
+            if env:
+                c["env"] = [
+                    e for e in env if e.get("name") not in _COORDINATOR_ENV
+                ]
+
+    def _create(self, tr: _Tracked) -> None:
+        self._stamp(tr)
+        if self.api is not None:
+            self.api.create(tr.workload)
+        elif self.on_create is not None:
+            self.on_create(tr.workload, tr.slice_type)
+
+    def _create_passthrough(self, workload: Dict[str, Any]) -> None:
+        if self.api is not None:
+            self.api.create(workload)
+        elif self.on_create is not None:
+            self.on_create(workload, None)
+
+    # ---- preemption -------------------------------------------------------
+
+    def _do_preempt(self, victim: _Tracked, reason: str,
+                    for_key: Optional[str] = None) -> None:
+        self.preempted_total += 1
+        self._count("fleet_preemptions_total")
+        self._record(
+            "fleet_preempt", key=f"{victim.key[0]}/{victim.key[1]}",
+            reason=reason, for_key=for_key, slice_type=victim.slice_type,
+            priority=victim.priority,
+        )
+        backend = self.backend
+        if backend is None or not hasattr(backend, "preempt"):
+            return
+        ns, name = victim.key
+        try:
+            record = backend.preempt(
+                ns, name, kind=victim.kind, api_version=victim.api_version
+            )
+        except Exception:  # noqa: BLE001 — victim may be finishing/deleted
+            logger.exception("fleet preempt of %s/%s failed", ns, name)
+            return
+        # The fleet slice is reassigned, not destroyed: give the backend
+        # its devices back so executor capacity models only REAL loss
+        # (chaos flaps model that at the fleet layer via shrink_capacity).
+        if isinstance(record, dict) and not record.get("jobFinished"):
+            lost = record.get("lostDevices")
+            n = lost if isinstance(lost, int) else (
+                len(lost) if isinstance(lost, (list, tuple)) else None
+            )
+            try:
+                backend.restore_capacity(n)
+            except Exception:  # noqa: BLE001
+                logger.exception("fleet restore after preempt failed")
+
+    # ---- event pump / dispatch -------------------------------------------
+
+    def pump(self) -> int:
+        """Drain the watch inbox (releases, matrix refinement) and then
+        dispatch the queue into any free capacity. Returns the number of
+        events processed. Synchronous seam for tests/benches/soaks; the
+        background loop calls it continuously."""
+        processed = 0
+        released = False
+        while True:
+            try:
+                ev = self._events.popleft()
+            except IndexError:
+                break
+            processed += 1
+            obj = ev.object
+            meta = obj.get("metadata") or {}
+            key = (meta.get("namespace", "default"), meta.get("name", ""))
+            with self._lock:
+                tr = self._running.get(key)
+                if tr is None:
+                    continue
+                if ev.type == "DELETED" or _is_terminal(obj):
+                    released |= self._release_locked(key)
+                    continue
+            progress = (obj.get("status") or {}).get("trainingProgress") or {}
+            tps = progress.get("tokens_per_s")
+            if tps is not None:
+                self.matrix.observe(tr.wclass, tr.slice_type, tps)
+        self._dispatch()
+        return processed
+
+    def release(self, namespace: str, name: str) -> bool:
+        """Explicitly free the slice held by a finished job (simulation
+        mode; the watch pump does this automatically against a store)."""
+        with self._lock:
+            ok = self._release_locked((namespace, name))
+        if ok:
+            self._dispatch()
+        return ok
+
+    def _pick_batch_locked(self) -> List[Tuple[_Tracked, str, bool]]:
+        """Choose the next dispatch batch: the queue window planned
+        jointly (max-regret greedy over actual free capacity), priority
+        band by priority band. FIFO policy degrades to strict
+        head-of-line first-fit."""
+        if not self._queue or sum(self._free.values()) <= 0:
+            return []
+        if self.policy == "fifo":
+            head = self._queue[0]
+            t = self._best_type_locked(head, self._candidates_locked(head))
+            return [(head, t, False)] if t is not None else []
+        picks: List[Tuple[_Tracked, str, bool]] = []
+        free = dict(self._free)
+        used_delta: Dict[str, int] = {}
+        head_seq = self._queue[0].seq
+        window = self._queue[: self.backfill_window]
+        i = 0
+        while i < len(window):
+            prio = window[i].priority
+            band = [tr for tr in window[i:] if tr.priority == prio]
+            i += len(band)
+            jobs = []
+            for tr in band:
+                headroom = self._quota_headroom_locked(
+                    tr.tenant
+                ) - used_delta.get(tr.tenant, 0)
+                ok = [
+                    n for n in self._allowed_types_locked(tr)
+                    if self.pool[n].chips <= headroom
+                ]
+                jobs.append((tr, ok))
+            plan = plan_assignments(
+                [(tr.wclass, ok, tr.est_work) for tr, ok in jobs],
+                free,
+                lambda w, t: self.matrix.rate(w, t, self.pool[t].chips),
+            )
+            for (tr, ok), t in zip(jobs, plan):
+                if t is None or t not in ok or free.get(t, 0) <= 0:
+                    continue
+                # Re-check quota against picks already taken THIS band:
+                # the per-job headroom above predates them, so without
+                # this N same-tenant jobs could each claim the same
+                # remaining budget. Skipped jobs stay queued; the next
+                # dispatch round re-plans them against settled books.
+                if self.pool[t].chips > (
+                    self._quota_headroom_locked(tr.tenant)
+                    - used_delta.get(tr.tenant, 0)
+                ):
+                    continue
+                free[t] -= 1
+                used_delta[tr.tenant] = (
+                    used_delta.get(tr.tenant, 0) + self.pool[t].chips
+                )
+                picks.append((tr, t, tr.seq != head_seq))
+            if picks:
+                break  # dispatch the highest band that produced work
+        if not picks:
+            return []
+        # Backfill flag: a pick is a backfill iff the queue head stays
+        # queued while a later job jumps it.
+        placed_seqs = {tr.seq for tr, _t, _b in picks}
+        head_placed = head_seq in placed_seqs
+        return [
+            (tr, t, (not head_placed) and tr.seq != head_seq)
+            for tr, t, _ in picks
+        ]
+
+    def _dispatch(self) -> List[Dict[str, Any]]:
+        created: List[Dict[str, Any]] = []
+        while True:
+            with self._lock:
+                batch = self._pick_batch_locked()
+                if not batch:
+                    break
+                for tr, t, _bf in batch:
+                    self._commit_placement_locked(tr, t)
+            ok = True
+            for tr, t, backfill in batch:
+                try:
+                    self._create(tr)
+                except AlreadyExistsError:
+                    pass  # fail-over replay: it already runs; keep books
+                except Exception:  # noqa: BLE001 — transient store fault
+                    with self._lock:
+                        tr.attempts += 1
+                        self._undo_placement_locked(tr, requeue=True)
+                    logger.warning(
+                        "deferred create of %s/%s failed (attempt %d); "
+                        "requeued", tr.key[0], tr.key[1], tr.attempts,
+                        exc_info=True,
+                    )
+                    ok = False
+                    continue
+                created.append(tr.workload)
+                wait_s = max(0.0, time.monotonic() - tr.enqueued_mono)
+                if self.metrics is not None:
+                    self.metrics.observe(
+                        'cron_tick_phase_seconds{phase="queue"}',
+                        wait_s, buckets=PHASE_BUCKETS,
+                    )
+                self._count(
+                    f'fleet_placements_total{{slice_type="{t}"}}'
+                )
+                if backfill:
+                    self.backfilled_total += 1
+                    self._count("fleet_backfills_total")
+                self.decision_log.append((
+                    f"{tr.key[0]}/{tr.key[1]}",
+                    PlacementDecision(
+                        "placed", t,
+                        reason="backfill" if backfill else "dispatch",
+                    ).to_dict(),
+                ))
+                self._record(
+                    "fleet_dispatch", key=f"{tr.key[0]}/{tr.key[1]}",
+                    slice_type=t, backfill=backfill,
+                    queue_wait_s=round(wait_s, 6), tenant=tr.tenant,
+                )
+            if not ok:
+                break
+        return created
+
+    # ---- introspection ----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "free": dict(self._free),
+                "lost": dict(self._lost),
+                "running": len(self._running),
+                "queued": len(self._queue),
+                "tenant_used": dict(self._tenant_used),
+                "tenant_peak": dict(self.tenant_peak),
+                "rejected_total": self.rejected_total,
+                "preempted_total": self.preempted_total,
+                "backfilled_total": self.backfilled_total,
+            }
+
+
+__all__ = [
+    "ANNOTATION_SLICE_TYPE",
+    "ANNOTATION_FLEET_PLACED",
+    "ANNOTATION_TENANT",
+    "ANNOTATION_PRIORITY",
+    "ANNOTATION_WORKLOAD_CLASS",
+    "ANNOTATION_EST_WORK",
+    "PRIORITY_CLASSES",
+    "SliceType",
+    "ThroughputMatrix",
+    "PlacementDecision",
+    "FleetScheduler",
+    "parse_pool",
+    "parse_quotas",
+    "plan_assignments",
+]

@@ -121,6 +121,22 @@ _FAMILY_META: Dict[str, tuple] = {
     "workload_tokens_per_s": (
         "gauge", "Most recently reported training throughput in tokens "
                  "per second across running workloads"),
+    "cron_jobs_pending": (
+        "gauge", "Fired workloads waiting in the fleet scheduler queue "
+                 "(labels backend, slice_type: attributed to each job's "
+                 "preferred slice type)"),
+    "fleet_placements_total": (
+        "counter", "Workloads placed onto a fleet slice (label "
+                   "slice_type), immediate and queued-then-dispatched"),
+    "fleet_preemptions_total": (
+        "counter", "Lower-priority gangs preempted by the fleet "
+                   "scheduler (priority placement or capacity flap)"),
+    "fleet_backfills_total": (
+        "counter", "Queued workloads dispatched past a still-blocked "
+                   "queue head (backfill)"),
+    "fleet_rejections_total": (
+        "counter", "Fired workloads shed because the fleet queue was at "
+                   "max depth"),
     "watch_resyncs_total": (
         "counter", "Full re-list + enqueue-all resyncs performed after a "
                    "watch stream signalled a break (ERROR then BOOKMARK "

@@ -2008,6 +2008,330 @@ def compute_goodput(ev: dict, floor: float = GOODPUT_FLOOR) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Fleet capacity-flap leg (ISSUE 10): the heterogeneity-aware scheduler
+# under a shrinking/growing slice pool.
+# ---------------------------------------------------------------------------
+
+FLEET_POOL = "v5e-16=2,v4-8=3,cpu=3"
+FLEET_QUOTAS = {"team-a": 40, "team-b": 24}
+
+
+def _fleet_cron(i: int, duration_s: float, priority: str, tenant: str,
+                wclass: str) -> dict:
+    return {
+        "apiVersion": CRON_API_VERSION,
+        "kind": "Cron",
+        "metadata": {"name": f"fleet-{i}", "namespace": NAMESPACE},
+        "spec": {
+            "schedule": "*/1 * * * *",
+            "concurrencyPolicy": "Forbid",
+            "historyLimit": 3,
+            "template": {"workload": {
+                "apiVersion": WORKLOAD_API_VERSION,
+                "kind": WORKLOAD_KIND,
+                "metadata": {"annotations": {
+                    # Simulated run: cheap, but flows through the full
+                    # condition/preemption machinery in the executor.
+                    "tpu.kubedl.io/simulate-duration": f"{duration_s}s",
+                    "tpu.kubedl.io/elastic-resume": "true",
+                    "tpu.kubedl.io/priority": priority,
+                    "tpu.kubedl.io/tenant": tenant,
+                    "tpu.kubedl.io/workload-class": wclass,
+                }},
+                "spec": {},
+            }},
+        },
+    }
+
+
+def run_fleet_soak(seed: int, n_crons: int, rounds: int,
+                   drain_timeout_s: float = 60.0) -> dict:
+    """Capacity-flap rounds against the fleet scheduler: one fired tick
+    per cron over a 3-type pool with tenant quotas, then per round a
+    PRF-chosen slice type shrinks (free slices first, then preemption of
+    the lowest-priority running gangs through the REAL executor) and
+    grows back. Invariants checked by :func:`check_fleet_invariants`:
+    no admitted job is permanently lost, tenant quotas are never
+    exceeded, and every preempted run resumes via the elastic chain
+    into a single logical history entry."""
+    from cron_operator_tpu.backends.local import LocalExecutor
+    from cron_operator_tpu.controller.cron_controller import CronReconciler
+    from cron_operator_tpu.runtime.faults import seeded_fraction
+    from cron_operator_tpu.runtime.fleet import (
+        FleetScheduler,
+        parse_pool,
+    )
+    from cron_operator_tpu.runtime.kube import APIServer
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.telemetry import AuditJournal
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    t0 = time.time()
+    clock = FakeClock()
+    store = APIServer(clock=clock)
+    metrics = Metrics()
+    journal = AuditJournal()
+    store.attach_audit(journal)
+    ex = LocalExecutor(store, metrics=metrics)
+    ex.start()
+    fs = FleetScheduler(
+        parse_pool(FLEET_POOL),
+        api=store,
+        backend=ex,
+        quotas=dict(FLEET_QUOTAS),
+        max_queue=n_crons * (rounds + 2),  # nothing sheds in this leg
+        metrics=metrics,
+        audit=journal,
+    )
+    store.add_watcher(fs._on_event, coalesce=True)
+    rec = CronReconciler(store, metrics=metrics, audit=journal, fleet=fs)
+
+    crons = []
+    for i in range(n_crons):
+        # PRF-derived mix: long runs span flap rounds (preemption lands
+        # mid-run), short ones churn the queue; priorities make victim
+        # selection meaningful; two tenants exercise the quotas.
+        f = seeded_fraction(seed, "fleet-mix", 0, f"fleet-{i}")
+        duration = 2.5 if f < 0.4 else 0.5
+        priority = ("high", "normal", "batch")[i % 3]
+        tenant = ("team-a", "team-b")[i % 2]
+        wclass = ("train-large", "train-small", "eval")[i % 3]
+        store.create(_fleet_cron(i, duration, priority, tenant, wclass))
+        crons.append(f"fleet-{i}")
+
+    def sweep():
+        for name in crons:
+            rec.reconcile(NAMESPACE, name)
+
+    def churn(seconds: float):
+        deadline = time.time() + seconds
+        while time.time() < deadline:
+            store.flush(1.0)
+            fs.pump()
+            sweep()
+            time.sleep(0.05)
+
+    # One fired tick per cron: one fake minute, one sweep. Some place
+    # immediately, the rest queue against the saturated pool.
+    clock.advance(timedelta(seconds=61))
+    sweep()
+    admitted = {}
+    for w in store.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                        namespace=NAMESPACE):
+        meta = w.get("metadata") or {}
+        cron = (meta.get("labels") or {}).get(LABEL_CRON_NAME, "")
+        if cron:
+            admitted[cron] = meta.get("name", "")
+    # Queued ticks exist only in the fleet's books until dispatch; count
+    # them admitted too (the invariant is about THEM above all).
+    queued_at_fire = fs.stats()["queued"]
+
+    type_names = [t.strip().split("=")[0] for t in FLEET_POOL.split(",")]
+    flaps = []
+    for r in range(rounds):
+        churn(0.6)
+        stype = type_names[
+            int(seeded_fraction(seed, "fleet-flap", r, "type")
+                * len(type_names)) % len(type_names)
+        ]
+        free_before = fs.stats()["free"][stype]
+        preempted_before = fs.preempted_total
+        # Shrink past the free slices so the flap must preempt whenever
+        # anything is running on the chosen type.
+        removed = fs.shrink_capacity(stype, free_before + 1)
+        sweep()  # resume attempts submitted against the degraded pool
+        fs.pump()
+        churn(0.3)
+        restored = fs.restore_capacity(stype)
+        fs.pump()
+        flaps.append({
+            "round": r,
+            "slice_type": stype,
+            "free_before": free_before,
+            "removed": removed,
+            "restored": restored,
+            "preempted": fs.preempted_total - preempted_before,
+        })
+
+    # Drain: every logical run must reach a Succeeded latest attempt.
+    deadline = time.time() + drain_timeout_s
+    def all_done():
+        for cron in crons:
+            root = admitted.get(cron)
+            if root is None:
+                return False
+            latest = None
+            best_no = -1
+            for w in store.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                                namespace=NAMESPACE):
+                meta = w.get("metadata") or {}
+                ann = meta.get("annotations") or {}
+                wroot = ann.get("tpu.kubedl.io/resume-of",
+                                meta.get("name", ""))
+                if wroot != root:
+                    continue
+                try:
+                    no = int(ann.get("tpu.kubedl.io/resume-attempt", 0))
+                except (TypeError, ValueError):
+                    no = 0
+                if no > best_no:
+                    best_no, latest = no, w
+            if latest is None or _is_terminal(latest) != "Succeeded":
+                return False
+        return True
+
+    while time.time() < deadline:
+        churn(0.2)
+        # A fired tick may still be waiting in the fleet queue: it only
+        # appears in the store (and `admitted`) once dispatched.
+        for w in store.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                            namespace=NAMESPACE):
+            meta = w.get("metadata") or {}
+            cron = (meta.get("labels") or {}).get(LABEL_CRON_NAME, "")
+            ann = meta.get("annotations") or {}
+            if cron and "tpu.kubedl.io/resume-of" not in ann:
+                admitted.setdefault(cron, meta.get("name", ""))
+        if len(admitted) == len(crons) and all_done():
+            break
+    ex.wait_idle(timeout=drain_timeout_s)
+    sweep()
+    store.flush(2.0)
+    fs.pump()
+    sweep()
+
+    # ---- end-state evidence ----------------------------------------------
+    runs = {}
+    preempted_roots = set()
+    for cron in crons:
+        root = admitted.get(cron, "")
+        chain = []
+        for w in store.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                            namespace=NAMESPACE):
+            meta = w.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            wroot = ann.get("tpu.kubedl.io/resume-of",
+                            meta.get("name", ""))
+            if wroot != root:
+                continue
+            conds = (w.get("status") or {}).get("conditions") or []
+            was_preempted = any(
+                c.get("type") == "Preempted" for c in conds
+            )
+            if was_preempted:
+                preempted_roots.add(cron)
+            try:
+                no = int(ann.get("tpu.kubedl.io/resume-attempt", 0))
+            except (TypeError, ValueError):
+                no = 0
+            chain.append({
+                "attempt": no,
+                "name": meta.get("name", ""),
+                "terminal": _is_terminal(w),
+                "preempted": was_preempted,
+                "slice_type": ann.get("tpu.kubedl.io/fleet-slice-type"),
+            })
+        chain.sort(key=lambda a: a["attempt"])
+        cron_obj = store.get(CRON_API_VERSION, "Cron", NAMESPACE, cron)
+        hist = (cron_obj.get("status") or {}).get("history") or []
+        runs[cron] = {
+            "root": root,
+            "chain": chain,
+            "history": [
+                {
+                    "name": (h.get("object") or {}).get("name", ""),
+                    "status": h.get("status", ""),
+                    "resumes": int(h.get("resumes") or 0),
+                }
+                for h in hist
+            ],
+        }
+
+    stats = fs.stats()
+    fs.stop()
+    ex.stop()
+    store.close()
+    return {
+        "n_crons": n_crons,
+        "rounds": rounds,
+        "pool": FLEET_POOL,
+        "quotas": dict(FLEET_QUOTAS),
+        "queued_at_fire": queued_at_fire,
+        "flaps": flaps,
+        "runs": runs,
+        "preempted_crons": sorted(preempted_roots),
+        "fleet_stats": stats,
+        "metrics": {
+            "fleet_preemptions": metrics.get("fleet_preemptions_total"),
+            "fleet_rejections": metrics.get("fleet_rejections_total"),
+            "fleet_backfills": metrics.get("fleet_backfills_total"),
+            "resumes": metrics.get("cron_workload_resumes_total"),
+        },
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+def check_fleet_invariants(ev: dict) -> dict:
+    """F1 no admitted job permanently lost, F2 quotas never exceeded,
+    F3 every preempted run resumed via the elastic chain into a single
+    logical history entry (and at least one preemption actually
+    happened — a flap leg that never preempts proves nothing)."""
+    lost = []
+    for cron, run in ev["runs"].items():
+        if not run["root"]:
+            lost.append({"cron": cron, "reason": "tick never dispatched"})
+            continue
+        chain = run["chain"]
+        if not chain or chain[-1]["terminal"] != "Succeeded":
+            lost.append({
+                "cron": cron,
+                "reason": "latest attempt not Succeeded",
+                "chain": chain,
+            })
+    f1 = {
+        "ok": not lost,
+        "detail": (f"all {len(ev['runs'])} admitted runs completed "
+                   f"across {len(ev['flaps'])} capacity flaps"
+                   if not lost else {"lost": lost}),
+    }
+
+    peaks = ev["fleet_stats"]["tenant_peak"]
+    over = {
+        t: {"peak": peaks.get(t, 0), "quota": q}
+        for t, q in ev["quotas"].items()
+        if peaks.get(t, 0) > q
+    }
+    f2 = {
+        "ok": not over,
+        "detail": (f"tenant peaks {peaks} within quotas {ev['quotas']}"
+                   if not over else {"exceeded": over}),
+    }
+
+    bad = []
+    n_preempted = len(ev["preempted_crons"])
+    for cron in ev["preempted_crons"]:
+        run = ev["runs"][cron]
+        hist = run["history"]
+        if len(hist) != 1 or hist[0]["status"] != "Succeeded" \
+                or hist[0]["resumes"] < 1:
+            bad.append({"cron": cron, "history": hist,
+                        "chain": run["chain"]})
+    f3 = {
+        "ok": n_preempted >= 1 and not bad,
+        "detail": (
+            f"{n_preempted} preempted run(s) each collapsed to one "
+            "Succeeded history entry with resumes >= 1"
+            if n_preempted >= 1 and not bad
+            else {"preempted": n_preempted, "bad": bad}
+        ),
+    }
+    return {
+        "F1_no_admitted_job_lost": f1,
+        "F2_quotas_never_exceeded": f2,
+        "F3_preempted_resume_single_history": f3,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -2051,6 +2375,12 @@ def main(argv=None) -> int:
                          "over at step 0")
     ap.add_argument("--elastic-jobs", type=int, default=3,
                     help="logical training runs in the elastic leg")
+    ap.add_argument("--fleet-flap", action="store_true", default=False,
+                    help="run ONLY the fleet capacity-flap leg: a mixed "
+                         "slice pool with tenant quotas shrinks/grows "
+                         "mid-storm; no admitted job may be lost, quotas "
+                         "never exceeded, preempted runs resume into one "
+                         "history entry (invariants F1-F3)")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
 
@@ -2077,6 +2407,36 @@ def main(argv=None) -> int:
         plan_a.schedule(args.rounds) == plan_b.schedule(args.rounds)
         and plan_a.trace_hash(args.rounds) == plan_b.trace_hash(args.rounds)
     )
+
+    if args.fleet_flap:
+        # Standalone fleet leg: the heterogeneity-aware scheduler under
+        # capacity flaps. Simulated workloads (cheap) but the REAL store,
+        # executor, reconciler, fleet books, and elastic-resume chain.
+        print(
+            f"chaos soak (fleet capacity-flap): seed={args.seed} "
+            f"crons={args.crons} rounds={args.rounds}",
+            flush=True,
+        )
+        ev = run_fleet_soak(args.seed, args.crons, args.rounds)
+        invariants = check_fleet_invariants(ev)
+        ok = all(v["ok"] for v in invariants.values())
+        report = {
+            "seed": args.seed,
+            "mode": "fleet-flap",
+            "rounds": args.rounds,
+            "deterministic_trace": deterministic,
+            "fleet_leg": ev,
+            "invariants": invariants,
+            "ok": ok,
+        }
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+        for name, v in invariants.items():
+            mark = "PASS" if v["ok"] else "FAIL"
+            print(f"  [{mark}] {name}: {v['detail']}")
+        print(f"wrote {args.out} (ok={ok})")
+        return 0 if ok else 1
 
     if args.no_elastic:
         # Counter-proof mode: ONLY the elastic leg, with elastic resume

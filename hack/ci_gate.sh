@@ -135,6 +135,25 @@ echo "==> HTTP front-door smoke (fan-out encode-once, group-commit, APF fairness
 # (updates BENCH_HTTP.json; BASELINE=<ref> adds the >= 5x fan-out A/B).
 python hack/http_bench.py --check --stdout >/dev/null
 
+echo "==> fleet scheduler smoke (makespan A/B, fairness, p50, zero-write)"
+# Small-size run of the fleet bench (hack/fleet_bench.py): a 600-job
+# storm over the mixed v5e/v4/cpu pool must beat the FIFO/first-fit
+# baseline >= 1.5x on makespan at equal-or-better Jain fairness, keep
+# the placement decision p50 <= 1 ms, and commit zero store writes
+# across repeated steady-state pumps. --check fails the gate on
+# REGRESSION. Full run: make bench-fleet (updates BENCH_FLEET.json).
+python hack/fleet_bench.py --check --stdout >/dev/null
+
+echo "==> fleet capacity-flap soak (quotas, preemption + elastic resume)"
+# Fixed-seed flap rounds against the fleet scheduler: the slice pool
+# shrinks past its free slices mid-storm (forcing preemptions through
+# the real executor) and grows back. No admitted job may be lost,
+# tenant quotas must never be exceeded (the high-water mark is checked,
+# including joint dispatch batches), and every preempted run must
+# resume via the elastic chain into a single history entry.
+python hack/chaos_soak.py --seed 13 --crons 18 --rounds 3 --fleet-flap \
+    --out /dev/null
+
 echo "==> metric registry drift (every emitted family declared + typed)"
 # Explicit run of the registry drift guard: scans every metrics.inc/
 # observe/set call site AND interned-series assignment in the package,

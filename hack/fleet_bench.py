@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""Fleet scheduler benchmark (ISSUE 10) — BENCH_FLEET.json.
+
+Two legs:
+
+1. **Makespan / fairness A-B** — an event-driven simulation of a fired
+   storm: N virtual Crons (default 10k, ``--check`` shrinks) all fire at
+   t=0 over a 3-type fleet. The same seeded job mix (5 workload classes
+   with strongly type-dependent throughput, 4 tenants) runs under the
+   heterogeneity-aware policy and under the naive FIFO/first-fit
+   baseline; job physics are identical (duration = work / rate(class,
+   placed type)), only placement differs. Gates: hetero makespan beats
+   FIFO by ``--min-speedup`` (default 1.5x) at equal-or-better Jain
+   fairness over per-tenant goodput, and the placement decision itself
+   (the only thing the tick path pays) stays under ``--max-p50-ms``
+   (default 1 ms) at p50.
+
+2. **Wired zero-write steady state** — a real APIServer with placed and
+   queued workloads: repeated scheduler pumps with no watch events must
+   commit zero store writes (resourceVersion frozen). Placement reads
+   the fleet's in-memory books, never the store — the control plane's
+   steady-state zero-write invariant survives the new subsystem.
+
+Output: BENCH_FLEET.json with one OK/REGRESSION verdict over both legs.
+``--check`` runs small sizes and exits non-zero on REGRESSION (the CI
+gate smoke); ``--stdout`` prints the JSON document.
+"""
+
+import argparse
+import heapq
+import json
+import os
+import random
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cron_operator_tpu.runtime.fleet import (  # noqa: E402
+    FleetScheduler,
+    ThroughputMatrix,
+    parse_pool,
+)
+
+POOL = "v5e-16=8,v4-8=12,cpu=16"
+
+# Seeded "bench history": tokens/s per (workload class, slice type).
+# Each class has a strongly preferred type — the structure a mixed
+# training/eval/preprocess fleet actually shows (Gavel, arXiv
+# 2008.09213, Table 1 measures 10x+ spreads across GPU generations).
+RATES = {
+    ("train-large", "v5e-16"): 20.0,
+    ("train-large", "v4-8"): 4.0,
+    ("train-large", "cpu"): 0.5,
+    ("train-small", "v5e-16"): 8.0,
+    ("train-small", "v4-8"): 6.0,
+    ("train-small", "cpu"): 1.0,
+    ("eval", "v5e-16"): 6.0,
+    ("eval", "v4-8"): 5.0,
+    ("eval", "cpu"): 2.0,
+    ("preprocess", "v5e-16"): 2.0,
+    ("preprocess", "v4-8"): 1.8,
+    ("preprocess", "cpu"): 1.5,
+    ("export", "v5e-16"): 3.0,
+    ("export", "v4-8"): 2.8,
+    ("export", "cpu"): 2.5,
+}
+CLASSES = ["train-large", "train-small", "eval", "preprocess", "export"]
+TENANTS = ["team-a", "team-b", "team-c", "team-d"]
+
+
+def _jain(xs):
+    xs = [x for x in xs if x > 0]
+    if not xs:
+        return 1.0
+    return (sum(xs) ** 2) / (len(xs) * sum(x * x for x in xs))
+
+
+def _job_mix(n_jobs, seed):
+    rng = random.Random(seed)
+    jobs = []
+    for i in range(n_jobs):
+        wclass = rng.choice(CLASSES)
+        work = {
+            "train-large": 200.0, "train-small": 60.0, "eval": 30.0,
+            "preprocess": 15.0, "export": 12.0,
+        }[wclass] * rng.uniform(0.5, 1.5)
+        jobs.append({
+            "name": f"job-{i}",
+            "wclass": wclass,
+            "tenant": TENANTS[i % len(TENANTS)],
+            "work": work,
+        })
+    return jobs
+
+
+def run_storm(policy, jobs, backfill_window=48):
+    """Event-heap simulation: submit everything at t=0, then advance the
+    virtual clock finish-by-finish; every release lets the scheduler
+    dispatch queued work at the current sim time."""
+    now = [0.0]
+    finish_at = {}
+    heap = []
+    by_name = {j["name"]: j for j in jobs}
+
+    def on_create(workload, slice_type):
+        name = workload["metadata"]["name"]
+        job = by_name[name]
+        dur = job["work"] / RATES[(job["wclass"], slice_type)]
+        finish_at[name] = now[0] + dur
+        heapq.heappush(heap, (finish_at[name], name))
+
+    fs = FleetScheduler(
+        parse_pool(POOL),
+        policy=policy,
+        matrix=ThroughputMatrix(RATES),
+        max_queue=len(jobs) + 1,
+        backfill_window=backfill_window,
+        # Bounded slowdown: waiting for the right slice beats running a
+        # train-large gang 40x slower on host CPUs (no-op under fifo —
+        # the baseline takes any free slot, as first-fit does).
+        min_efficiency=0.25,
+        on_create=on_create,
+    )
+    submit_lat = []
+    for j in jobs:
+        wl = {
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {
+                "namespace": "bench", "name": j["name"],
+                "annotations": {
+                    "tpu.kubedl.io/workload-class": j["wclass"],
+                    "tpu.kubedl.io/tenant": j["tenant"],
+                    "tpu.kubedl.io/estimated-work": str(j["work"]),
+                },
+            },
+            "spec": {},
+        }
+        t0 = time.perf_counter()
+        d = fs.submit(wl)
+        submit_lat.append(time.perf_counter() - t0)
+        assert d.action != "rejected", d
+    while heap:
+        t, name = heapq.heappop(heap)
+        now[0] = t
+        fs.release("bench", name)
+    assert len(finish_at) == len(jobs), (
+        f"{policy}: {len(jobs) - len(finish_at)} jobs never ran"
+    )
+    tenant_work = {}
+    tenant_turnaround = {}
+    for j in jobs:
+        tenant_work[j["tenant"]] = (
+            tenant_work.get(j["tenant"], 0.0) + j["work"]
+        )
+        tenant_turnaround[j["tenant"]] = (
+            tenant_turnaround.get(j["tenant"], 0.0) + finish_at[j["name"]]
+        )
+    goodput = [
+        tenant_work[t] / tenant_turnaround[t] for t in sorted(tenant_work)
+    ]
+    lat_ms = sorted(x * 1000 for x in submit_lat)
+    return {
+        "policy": policy,
+        "jobs": len(jobs),
+        "makespan_s": round(max(finish_at.values()), 3),
+        "jain_fairness": round(_jain(goodput), 4),
+        "mean_turnaround_s": round(
+            statistics.fmean(finish_at.values()), 3
+        ),
+        "backfills": fs.backfilled_total,
+        "submit_p50_ms": round(lat_ms[len(lat_ms) // 2], 4),
+        "submit_p99_ms": round(lat_ms[int(len(lat_ms) * 0.99) - 1], 4),
+    }
+
+
+def run_zero_write_leg(n_jobs=40, pumps=200):
+    """Wired leg: fleet + real store. After the storm settles, repeated
+    pumps with no watch traffic must not commit a single store write."""
+    from cron_operator_tpu.runtime.kube import APIServer
+
+    api = APIServer()
+    fs = FleetScheduler(
+        parse_pool("v5e-16=2,v4-8=2,cpu=2"),
+        api=api,
+        matrix=ThroughputMatrix(RATES),
+        max_queue=n_jobs + 1,
+    )
+    api.add_watcher(fs._on_event, coalesce=True)
+    rng = random.Random(7)
+    for i in range(n_jobs):
+        fs.submit({
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {
+                "namespace": "bench", "name": f"zw-{i}",
+                "annotations": {
+                    "tpu.kubedl.io/workload-class": rng.choice(CLASSES),
+                },
+            },
+            "spec": {},
+        })
+    api.flush()
+    fs.pump()  # drain the create echoes
+    rv_before = int(getattr(api, "_rv", 0))
+    for _ in range(pumps):
+        fs.pump()
+    rv_after = int(getattr(api, "_rv", 0))
+    stats = fs.stats()
+    api.close()
+    return {
+        "jobs": n_jobs,
+        "pumps": pumps,
+        "running": stats["running"],
+        "queued": stats["queued"],
+        "steady_state_store_writes": rv_after - rv_before,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--jobs", type=int, default=10000,
+                    help="storm size (default 10000)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--min-speedup", type=float, default=1.5,
+                    help="required FIFO/hetero makespan ratio")
+    ap.add_argument("--max-p50-ms", type=float, default=1.0,
+                    help="placement decision p50 budget on the tick path")
+    ap.add_argument("--jain-slack", type=float, default=0.02,
+                    help="allowed Jain-fairness deficit vs the baseline")
+    ap.add_argument("--check", action="store_true",
+                    help="small sizes; exit 1 on REGRESSION (CI smoke)")
+    ap.add_argument("--stdout", action="store_true",
+                    help="print the JSON document")
+    ap.add_argument("--out", default=None,
+                    help="output path (default BENCH_FLEET.json, "
+                         "/dev/null to skip)")
+    args = ap.parse_args(argv)
+
+    n_jobs = 600 if args.check else args.jobs
+    jobs = _job_mix(n_jobs, args.seed)
+    hetero = run_storm("hetero", jobs)
+    fifo = run_storm("fifo", jobs)
+    zero_write = run_zero_write_leg()
+
+    speedup = fifo["makespan_s"] / hetero["makespan_s"]
+    jain_ok = (
+        hetero["jain_fairness"] >= fifo["jain_fairness"] - args.jain_slack
+    )
+    p50_ok = hetero["submit_p50_ms"] <= args.max_p50_ms
+    zw_ok = zero_write["steady_state_store_writes"] == 0
+    ok = speedup >= args.min_speedup and jain_ok and p50_ok and zw_ok
+
+    doc = {
+        "bench": "fleet",
+        "pool": POOL,
+        "seed": args.seed,
+        "check_mode": bool(args.check),
+        "hetero": hetero,
+        "fifo": fifo,
+        "makespan_speedup": round(speedup, 3),
+        "min_speedup": args.min_speedup,
+        "zero_write": zero_write,
+        "gates": {
+            "makespan_speedup_ok": speedup >= args.min_speedup,
+            "jain_ok": jain_ok,
+            "submit_p50_ok": p50_ok,
+            "steady_state_zero_write_ok": zw_ok,
+        },
+        "verdict": "OK" if ok else "REGRESSION",
+    }
+
+    out = args.out or ("/dev/null" if args.check else "BENCH_FLEET.json")
+    if out != "/dev/null":
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+    if args.stdout:
+        print(json.dumps(doc, sort_keys=True))
+    print(
+        f"fleet bench [{doc['verdict']}]: {n_jobs} jobs, makespan "
+        f"hetero {hetero['makespan_s']}s vs fifo {fifo['makespan_s']}s "
+        f"({speedup:.2f}x, need >= {args.min_speedup}x), Jain "
+        f"{hetero['jain_fairness']} vs {fifo['jain_fairness']}, "
+        f"submit p50 {hetero['submit_p50_ms']}ms "
+        f"(<= {args.max_p50_ms}ms), steady-state writes "
+        f"{zero_write['steady_state_store_writes']}",
+        file=sys.stderr,
+    )
+    return 0 if (ok or not args.check) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,640 @@
+"""Fleet scheduler tests (runtime/fleet.py): throughput-optimal batch
+placement on a hand-computable matrix, per-tenant quota enforcement,
+priority preemption feeding the elastic-resume chain (one logical history
+entry), backfill past a blocked queue head, capacity flaps, and
+bit-identical decisions from a fixed seed.
+"""
+
+import itertools
+import random
+import time
+
+import pytest
+
+from cron_operator_tpu.runtime.fleet import (
+    ANNOTATION_EST_WORK,
+    ANNOTATION_FLEET_PLACED,
+    ANNOTATION_PRIORITY,
+    ANNOTATION_SLICE_TYPE,
+    ANNOTATION_TENANT,
+    ANNOTATION_WORKLOAD_CLASS,
+    FleetScheduler,
+    ThroughputMatrix,
+    parse_pool,
+    parse_quotas,
+    plan_assignments,
+)
+from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.runtime.manager import Metrics
+
+JAX_AV, JAX_KIND = "kubeflow.org/v1", "JAXJob"
+CRON_AV = "apps.kubedl.io/v1alpha1"
+
+
+def wait_for(fn, timeout=30.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(interval)
+    raise AssertionError("condition not met in time")
+
+
+def make_job(name, wclass="w", namespace="default", priority=None,
+             tenant=None, pinned_type=None, est_work=None, extra_ann=None):
+    ann = {ANNOTATION_WORKLOAD_CLASS: wclass}
+    if priority is not None:
+        ann[ANNOTATION_PRIORITY] = str(priority)
+    if tenant is not None:
+        ann[ANNOTATION_TENANT] = tenant
+    if pinned_type is not None:
+        ann[ANNOTATION_SLICE_TYPE] = pinned_type
+    if est_work is not None:
+        ann[ANNOTATION_EST_WORK] = str(est_work)
+    if extra_ann:
+        ann.update(extra_ann)
+    return {
+        "apiVersion": JAX_AV,
+        "kind": JAX_KIND,
+        "metadata": {
+            "namespace": namespace, "name": name, "annotations": ann,
+        },
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1, "template": {
+            "spec": {"containers": [{"name": "train", "image": "x"}]},
+        }}}},
+    }
+
+
+# The hand-computable 3-type / 5-job matrix from the issue: tokens/s per
+# (workload class, slice type). The unique optimum places w2,w4 on v5e,
+# w1,w3 on v4 and w5 on cpu for an aggregate 40.5 tokens/s — a greedy
+# highest-rate-first pass would burn the v5e slots on w1 instead.
+POOL3 = "v5e-16=2,v4-8=2,cpu=1"
+RATES = {
+    ("w1", "v5e-16"): 10.0, ("w1", "v4-8"): 9.0, ("w1", "cpu"): 1.0,
+    ("w2", "v5e-16"): 10.0, ("w2", "v4-8"): 2.0, ("w2", "cpu"): 1.0,
+    ("w3", "v5e-16"): 8.0, ("w3", "v4-8"): 7.0, ("w3", "cpu"): 6.0,
+    ("w4", "v5e-16"): 9.0, ("w4", "v4-8"): 3.0, ("w4", "cpu"): 2.0,
+    ("w5", "v5e-16"): 7.0, ("w5", "v4-8"): 6.0, ("w5", "cpu"): 5.5,
+}
+OPTIMAL = {"w1": "v4-8", "w2": "v5e-16", "w3": "v4-8",
+           "w4": "v5e-16", "w5": "cpu"}
+
+
+class TestPool:
+    def test_parse_pool(self):
+        pool = parse_pool(POOL3)
+        by_name = {t.name: t for t in pool}
+        assert by_name["v5e-16"].count == 2
+        assert by_name["v5e-16"].chips == 16
+        assert by_name["v5e-16"].spec.hosts == 4
+        assert by_name["v4-8"].chips == 8
+        assert by_name["cpu"].spec is None  # host-local capacity
+        assert by_name["cpu"].chips == 1
+
+    def test_parse_pool_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_pool("v5e-16=zero")
+        with pytest.raises(ValueError):
+            parse_pool("v5e-16=0")
+        with pytest.raises(ValueError):
+            parse_pool("  ,  ")
+
+    def test_parse_quotas(self):
+        assert parse_quotas(["team-a=32", "team-b=16"]) == {
+            "team-a": 32, "team-b": 16,
+        }
+        with pytest.raises(ValueError):
+            parse_quotas(["team-a"])
+
+    def test_duplicate_type_rejected(self):
+        with pytest.raises(ValueError):
+            FleetScheduler(parse_pool("cpu=1,cpu=2"))
+
+
+class TestThroughputMatrix:
+    def test_seed_fallbacks(self):
+        m = ThroughputMatrix({("w1", "a"): 4.0, ("*", "b"): 2.0})
+        assert m.rate("w1", "a") == 4.0
+        assert m.rate("w9", "b") == 2.0  # wildcard row
+        assert m.rate("w9", "c", chips=16) == 16.0  # chips prior
+
+    def test_observe_refines_online(self):
+        m = ThroughputMatrix({("w1", "a"): 4.0}, alpha=0.5)
+        m.observe("w1", "a", 8.0)
+        assert m.rate("w1", "a") == pytest.approx(6.0)
+        m.observe("w1", "a", "not-a-number")  # ignored, not fatal
+        m.observe("w1", "a", -1)  # ignored
+        assert m.rate("w1", "a") == pytest.approx(6.0)
+        m.observe("w2", "a", 3.0)  # first observation seeds the cell
+        assert m.rate("w2", "a") == pytest.approx(3.0)
+
+
+class TestPlanAssignments:
+    def test_matches_brute_force_optimum(self):
+        """Regret-greedy must hit the exhaustive optimum on the issue's
+        hand-computable matrix (and that optimum must be unique)."""
+        jobs = [(f"w{i}", None, 0.0) for i in range(1, 6)]
+        free = {"v5e-16": 2, "v4-8": 2, "cpu": 1}
+
+        def rate(w, t):
+            return RATES[(w, t)]
+
+        plan = plan_assignments(jobs, free, rate)
+        assert {j[0]: t for j, t in zip(jobs, plan)} == OPTIMAL
+        best, best_count = 0.0, 0
+        types = ["v5e-16"] * 2 + ["v4-8"] * 2 + ["cpu"]
+        for perm in set(itertools.permutations(types)):
+            total = sum(
+                rate(f"w{i + 1}", t) for i, t in enumerate(perm)
+            )
+            if total > best + 1e-9:
+                best, best_count = total, 1
+            elif abs(total - best) <= 1e-9:
+                best_count += 1
+        assert best == pytest.approx(40.5)
+        assert best_count == 1  # the hand-computed optimum is unique
+        assert sum(
+            rate(j[0], t) for j, t in zip(jobs, plan)
+        ) == pytest.approx(best)
+
+    def test_respects_pins_and_capacity(self):
+        plan = plan_assignments(
+            [("w1", "cpu", 0.0), ("w2", None, 0.0), ("w3", None, 0.0)],
+            {"cpu": 1, "v4-8": 1},
+            lambda w, t: {"cpu": 5.0, "v4-8": 1.0}[t],
+        )
+        # w1's pin takes the only cpu slot even though w2/w3 rate it
+        # higher; exactly one of them lands on v4-8.
+        assert plan[0] == "cpu"
+        assert sorted(t for t in plan[1:] if t) == ["v4-8"]
+
+
+class TestBatchDispatchOptimality:
+    def test_queued_batch_lands_on_joint_optimum(self):
+        """End-to-end via the wired path: saturate the pool, queue the
+        five matrix jobs, free every slot at once — the dispatch batch
+        must reproduce the joint optimum, not arrival-order greedy."""
+        api = APIServer()
+        metrics = Metrics()
+        fs = FleetScheduler(
+            parse_pool(POOL3), api=api,
+            matrix=ThroughputMatrix(RATES), metrics=metrics,
+        )
+        api.add_watcher(fs._on_event, coalesce=True)
+        fillers = [make_job(f"fill-{i}") for i in range(5)]
+        for f in fillers:
+            assert fs.submit(f).action == "placed"
+        for i in range(1, 6):
+            d = fs.submit(make_job(f"job-{i}", wclass=f"w{i}"))
+            assert d.action == "queued"
+        assert metrics.get(
+            'cron_jobs_pending{backend="local",slice_type="v5e-16"}'
+        ) is not None
+        for f in fillers:
+            meta = f["metadata"]
+            api.patch_status(JAX_AV, JAX_KIND, meta["namespace"],
+                             meta["name"], {"conditions": [{
+                                 "type": "Succeeded", "status": "True",
+                             }]})
+        api.flush()
+        fs.pump()
+        placed = {
+            key.split("/", 1)[1]: d["slice_type"]
+            for key, d in fs.decision_log
+            if d["action"] == "placed" and key.split("/", 1)[1].startswith(
+                "job-")
+        }
+        assert placed == {
+            f"job-{i}": OPTIMAL[f"w{i}"] for i in range(1, 6)
+        }
+        # Everything dispatched: pending gauge back to zero everywhere.
+        for t in ("v5e-16", "v4-8", "cpu"):
+            assert metrics.get(
+                f'cron_jobs_pending{{backend="local",slice_type="{t}"}}'
+            ) == 0.0
+        api.close()
+
+
+class TestQuotas:
+    def test_tenant_quota_queues_despite_free_capacity(self):
+        created = []
+        fs = FleetScheduler(
+            parse_pool("v5e-16=2"),
+            quotas={"team-a": 16},
+            on_create=lambda w, t: created.append(w),
+        )
+        a1 = fs.submit(make_job("a1", tenant="team-a"))
+        assert a1.action == "placed"
+        a2 = fs.submit(make_job("a2", tenant="team-a"))
+        assert (a2.action, a2.reason) == ("queued", "saturated")
+        # An unquota'd tenant takes the free slice the queued job cannot.
+        assert fs.submit(make_job("b1", tenant="team-b")).action == "placed"
+        assert fs.tenant_peak["team-a"] == 16
+        fs.release("default", "a1")
+        assert fs.stats()["queued"] == 0  # a2 dispatched into a1's slot
+        assert [w["metadata"]["name"] for w in created] == [
+            "a1", "b1", "a2",
+        ]
+        assert fs.tenant_peak["team-a"] == 16  # never exceeded
+
+    def test_quota_binds_within_one_dispatch_batch(self):
+        # Regression (caught by the capacity-flap soak): the batch
+        # planner computed every job's headroom BEFORE any pick in the
+        # band committed, so N same-tenant jobs could each claim the
+        # same remaining budget and the batch overshot the quota.
+        created = []
+        fs = FleetScheduler(
+            parse_pool("v4-8=4"),
+            quotas={"team-a": 16},
+            on_create=lambda w, t: created.append(w),
+        )
+        # Flap the whole pool away so the queue builds up, then restore
+        # it: four slots open in ONE dispatch round, which plans the
+        # three queued 8-chip team-a jobs jointly against a 16-chip
+        # budget.
+        assert fs.shrink_capacity("v4-8", 4) == 4
+        for i in range(3):
+            d = fs.submit(make_job(f"q-{i}", tenant="team-a"))
+            assert d.action == "queued"
+        assert fs.restore_capacity("v4-8") == 4
+        assert fs.tenant_peak["team-a"] == 16  # two placed, never three
+        assert fs.stats()["queued"] == 1
+        assert len(created) == 2
+        # Freed budget lets the straggler run (still within quota).
+        assert fs.release("default", created[0]["metadata"]["name"])
+        assert fs.stats()["queued"] == 0
+        assert fs.tenant_peak["team-a"] == 16
+
+    def test_quota_binds_across_preemption(self):
+        fs = FleetScheduler(
+            parse_pool("v5e-16=1"), quotas={"team-a": 16},
+            on_create=lambda w, t: None,
+        )
+        assert fs.submit(
+            make_job("low", tenant="team-a", priority="batch")
+        ).action == "placed"
+        # Same tenant, higher priority: preempting its own gang keeps the
+        # quota whole, so the placement is allowed.
+        d = fs.submit(make_job("hi", tenant="team-a", priority="high"))
+        assert d.action == "placed"
+        assert d.preempted == "default/low"
+        assert fs.tenant_peak["team-a"] == 16
+
+
+class TestPreemptionAndBackfill:
+    def test_lower_priority_gang_is_preempted(self):
+        preempts = []
+
+        class FakeBackend:
+            def preempt(self, ns, name, kind=None, api_version=None):
+                preempts.append((ns, name))
+                return {"lostDevices": 4, "jobFinished": False}
+
+            def restore_capacity(self, n=None):
+                preempts.append(("restore", n))
+
+        fs = FleetScheduler(
+            parse_pool("v5e-16=1"), backend=FakeBackend(),
+            on_create=lambda w, t: None,
+        )
+        assert fs.submit(make_job("low", priority="batch")).action == "placed"
+        d = fs.submit(make_job("hi", priority="high"))
+        assert (d.action, d.preempted) == ("placed", "default/low")
+        assert preempts == [("default", "low"), ("restore", 4)]
+        assert fs.preempted_total == 1
+        # Equal priority never preempts; it queues.
+        assert fs.submit(
+            make_job("hi2", priority="high")
+        ).action == "queued"
+
+    def test_backfill_past_blocked_head(self):
+        fs = FleetScheduler(
+            parse_pool("v5e-16=1,cpu=1"), on_create=lambda w, t: None,
+        )
+        assert fs.submit(
+            make_job("holder", pinned_type="v5e-16")
+        ).action == "placed"
+        assert fs.submit(make_job("cpu-holder", wclass="wc")).action == \
+            "placed"
+        # Head of queue pinned to the busy v5e slice; the later job can
+        # run anywhere.
+        assert fs.submit(
+            make_job("blocked-head", pinned_type="v5e-16")
+        ).action == "queued"
+        assert fs.submit(make_job("flex", wclass="wc")).action == "queued"
+        fs.release("default", "cpu-holder")
+        stats = fs.stats()
+        assert stats["queued"] == 1  # flex dispatched, head still waiting
+        assert fs.backfilled_total == 1
+        backfills = [
+            key for key, d in fs.decision_log if d["reason"] == "backfill"
+        ]
+        assert backfills == ["default/flex"]
+        # Head dispatches (not backfill) once its pinned slice frees up.
+        fs.release("default", "holder")
+        assert fs.stats()["queued"] == 0
+        assert fs.backfilled_total == 1
+
+    def test_queue_overflow_rejects(self):
+        fs = FleetScheduler(
+            parse_pool("cpu=1"), max_queue=2,
+            on_create=lambda w, t: None,
+        )
+        fs.submit(make_job("r0"))
+        fs.submit(make_job("r1"))
+        fs.submit(make_job("r2"))
+        d = fs.submit(make_job("r3"))
+        assert (d.action, d.reason) == ("rejected", "queue-full")
+        assert fs.rejected_total == 1
+
+
+class TestCapacityFlap:
+    def test_shrink_takes_free_slices_first(self):
+        fs = FleetScheduler(
+            parse_pool("v5e-16=2"), on_create=lambda w, t: None,
+        )
+        fs.submit(make_job("j1"))
+        assert fs.shrink_capacity("v5e-16", 1) == 1
+        assert fs.capacity("v5e-16") == 1
+        assert fs.preempted_total == 0  # the free slice absorbed it
+        # Next job queues against the shrunken pool, dispatches on grow.
+        assert fs.submit(make_job("j2")).action == "queued"
+        assert fs.restore_capacity("v5e-16") == 1
+        assert fs.stats()["queued"] == 0
+
+    def test_shrink_beyond_free_preempts_lowest_priority(self):
+        fs = FleetScheduler(
+            parse_pool("v5e-16=2"), on_create=lambda w, t: None,
+        )
+        fs.submit(make_job("hi", priority="high"))
+        fs.submit(make_job("low", priority="batch"))
+        assert fs.shrink_capacity("v5e-16", 1) == 1
+        assert fs.preempted_total == 1
+        assert ("default", "hi") in fs._running
+        assert ("default", "low") not in fs._running
+        # Flap cannot remove more than exists.
+        assert fs.shrink_capacity("v5e-16", 5) == 1
+        assert fs.capacity("v5e-16") == 0
+
+
+class TestPins:
+    def test_unpooled_pin_passes_through(self):
+        created = []
+        fs = FleetScheduler(
+            parse_pool("cpu=1"),
+            on_create=lambda w, t: created.append((w, t)),
+        )
+        d = fs.submit(make_job("exotic", extra_ann={
+            "tpu.kubedl.io/accelerator": "tpu-v9-podslice",
+            "tpu.kubedl.io/topology": "4x4",
+        }))
+        assert (d.action, d.reason) == ("placed", "unpooled-pin")
+        assert created[0][1] is None  # untouched, untracked
+        assert fs.stats()["running"] == 0
+
+    def test_fleet_stamp_is_not_a_pin(self):
+        """A resumed attempt inherits its predecessor's stamp; the marker
+        makes it re-placeable instead of pinned to the old shape."""
+        fs = FleetScheduler(
+            parse_pool("v5e-16=1,v4-8=1"),
+            matrix=ThroughputMatrix({("w", "v5e-16"): 1.0,
+                                     ("w", "v4-8"): 9.0}),
+            on_create=lambda w, t: None,
+        )
+        job = make_job("resume-r1", extra_ann={
+            ANNOTATION_FLEET_PLACED: "true",
+            "tpu.kubedl.io/accelerator": "tpu-v5-lite-podslice",
+            "tpu.kubedl.io/topology": "4x4",
+        })
+        d = fs.submit(job)
+        assert (d.action, d.slice_type) == ("placed", "v4-8")
+        ann = job["metadata"]["annotations"]
+        assert ann["tpu.kubedl.io/accelerator"] == "tpu-v4-podslice"
+        assert ann[ANNOTATION_SLICE_TYPE] == "v4-8"
+
+    def test_user_pin_placed_on_matching_pool_type(self):
+        fs = FleetScheduler(
+            parse_pool("v5e-16=1,v4-8=1"),
+            matrix=ThroughputMatrix({("w", "v4-8"): 9.0}),
+            on_create=lambda w, t: None,
+        )
+        job = make_job("pinned", extra_ann={
+            "tpu.kubedl.io/accelerator": "tpu-v5-lite-podslice",
+            "tpu.kubedl.io/topology": "4x4",
+        })
+        d = fs.submit(job)
+        assert (d.action, d.slice_type) == ("placed", "v5e-16")
+        # User-pinned: the template's own annotations stand (no marker).
+        ann = job["metadata"]["annotations"]
+        assert ANNOTATION_FLEET_PLACED not in ann
+
+
+class TestDeterminism:
+    def _drive(self, seed):
+        rng = random.Random(seed)
+        fs = FleetScheduler(
+            parse_pool(POOL3), matrix=ThroughputMatrix(RATES),
+            max_queue=64, on_create=lambda w, t: None,
+        )
+        live = []
+        for i in range(60):
+            wclass = f"w{rng.randint(1, 5)}"
+            prio = rng.choice(["high", "normal", "normal", "batch"])
+            d = fs.submit(make_job(f"j{i}", wclass=wclass, priority=prio,
+                                   tenant=rng.choice(["ta", "tb"])))
+            if d.action != "rejected":
+                live.append(f"j{i}")
+            if live and rng.random() < 0.4:
+                fs.release("default", live.pop(rng.randrange(len(live))))
+            if rng.random() < 0.05:
+                fs.shrink_capacity(rng.choice(["v5e-16", "v4-8"]), 1)
+            if rng.random() < 0.05:
+                fs.restore_capacity()
+        return list(fs.decision_log)
+
+    def test_same_seed_same_decisions(self):
+        assert self._drive(42) == self._drive(42)
+
+    def test_decision_log_is_nonempty_and_varied(self):
+        log = self._drive(42)
+        actions = {d["action"] for _k, d in log}
+        assert "placed" in actions and "queued" in actions
+
+
+@pytest.mark.slow
+class TestPreemptElasticResumeEndToEnd:
+    def test_preempted_job_resumes_with_one_history_entry(self):
+        """Priority preemption through the real executor: the victim
+        fails with the Preempted marker, the controller's elastic chain
+        resumes it through the fleet (queued until the aggressor
+        finishes), and history collapses to ONE logical entry."""
+        from cron_operator_tpu.backends.local import LocalExecutor
+        from cron_operator_tpu.controller.cron_controller import (
+            CronReconciler,
+        )
+
+        api = APIServer()
+        metrics = Metrics()
+        ex = LocalExecutor(api, metrics=metrics)
+        ex.start()
+        fs = FleetScheduler(
+            parse_pool("cpu=1"), api=api, backend=ex, metrics=metrics,
+        ).start()
+        rec = CronReconciler(api, metrics=metrics, fleet=fs)
+        try:
+            def mkcron(name, priority, duration, elastic):
+                ann = {
+                    "tpu.kubedl.io/simulate-duration": duration,
+                    ANNOTATION_PRIORITY: priority,
+                }
+                if elastic:
+                    ann["tpu.kubedl.io/elastic-resume"] = "true"
+                api.create({
+                    "apiVersion": CRON_AV, "kind": "Cron",
+                    "metadata": {"name": name, "namespace": "default"},
+                    "spec": {
+                        "schedule": "@every 1s",
+                        "concurrencyPolicy": "Forbid",
+                        "suspend": False,
+                        "template": {"workload": {
+                            "apiVersion": JAX_AV, "kind": JAX_KIND,
+                            "metadata": {"annotations": ann},
+                            "spec": {},
+                        }},
+                    },
+                })
+
+            mkcron("victim", "batch", "6s", elastic=True)
+
+            def fire(name):
+                rec.reconcile("default", name)
+                return [
+                    j for j in api.list(JAX_AV, JAX_KIND,
+                                        namespace="default")
+                    if j["metadata"].get("labels", {}).get(
+                        "tpu.kubedl.io/cron-name") == name
+                    or j["metadata"]["name"].startswith(name)
+                ]
+
+            jobs = wait_for(lambda: fire("victim"), timeout=15.0,
+                            interval=0.3)
+            root = jobs[0]["metadata"]["name"]
+            wait_for(lambda: "Running" in [
+                c["type"] for c in (api.get(
+                    JAX_AV, JAX_KIND, "default", root
+                ).get("status") or {}).get("conditions", [])
+            ])
+
+            mkcron("aggressor", "high", "0.3s", elastic=False)
+            wait_for(lambda: fire("aggressor"), timeout=15.0, interval=0.3)
+            assert fs.preempted_total == 1
+
+            # Park the aggressor so its next ticks don't keep preempting
+            # the batch-priority resume (starvation is WAI under strict
+            # priorities; this test is about the elastic chain).
+            import copy as _copy
+
+            agg = _copy.deepcopy(
+                api.get(CRON_AV, "Cron", "default", "aggressor")
+            )
+            agg["spec"]["suspend"] = True
+            api.update(agg)
+
+            # The victim's resume rides the normal reconcile sweep; it
+            # queues behind the aggressor and dispatches when the slice
+            # frees. Drive the victim until the logical run completes.
+            def resumed_done():
+                rec.reconcile("default", "victim")
+                rname = f"{root}-r1"
+                obj = api.try_get(JAX_AV, JAX_KIND, "default", rname)
+                if obj is None:
+                    return False
+                conds = (obj.get("status") or {}).get("conditions") or []
+                return bool(conds) and conds[-1]["type"] == "Succeeded"
+
+            wait_for(resumed_done, timeout=60.0, interval=0.3)
+            rec.reconcile("default", "victim")
+
+            from cron_operator_tpu.api.v1alpha1 import Cron
+            cron = Cron.from_dict(
+                api.get(CRON_AV, "Cron", "default", "victim")
+            )
+            hist = cron.status.history
+            assert len(hist) == 1  # ONE logical run, not two attempts
+            assert hist[0].status == "Succeeded"
+            assert hist[0].resumes == 1
+            assert hist[0].object.name == root
+            assert metrics.get("cron_workload_resumes_total") == 1.0
+            assert metrics.get("fleet_preemptions_total") == 1.0
+        finally:
+            fs.stop()
+            ex.stop()
+            api.close()
+
+
+class TestControllerWiring:
+    def test_submit_workload_routes_through_fleet(self):
+        from cron_operator_tpu.controller.cron_controller import (
+            CronReconciler,
+        )
+
+        api = APIServer()
+        fs = FleetScheduler(parse_pool("cpu=1"), api=api)
+        rec = CronReconciler(api, fleet=fs)
+        api.create({
+            "apiVersion": CRON_AV, "kind": "Cron",
+            "metadata": {"name": "c", "namespace": "default"},
+            "spec": {
+                "schedule": "@every 1s",
+                "template": {"workload": {
+                    "apiVersion": JAX_AV, "kind": JAX_KIND,
+                    "metadata": {"annotations": {}}, "spec": {},
+                }},
+            },
+        })
+        wait_for(lambda: (
+            rec.reconcile("default", "c"),
+            api.list(JAX_AV, JAX_KIND, namespace="default"),
+        )[1], timeout=15.0, interval=0.3)
+        # The created workload carries the fleet stamp — proof the create
+        # went through fleet.submit, not straight api.create.
+        job = api.list(JAX_AV, JAX_KIND, namespace="default")[0]
+        ann = job["metadata"]["annotations"]
+        assert ann[ANNOTATION_SLICE_TYPE] == "cpu"
+        assert fs.stats()["running"] == 1
+        api.close()
+
+    def test_rejected_tick_records_warning_event(self):
+        from cron_operator_tpu.controller.cron_controller import (
+            CronReconciler,
+        )
+
+        api = APIServer()
+        fs = FleetScheduler(parse_pool("cpu=1"), api=api, max_queue=0)
+        rec = CronReconciler(api, fleet=fs)
+        fs.submit(make_job("holder"))  # saturate: queue depth 0 → shed
+        api.create({
+            "apiVersion": CRON_AV, "kind": "Cron",
+            "metadata": {"name": "shed", "namespace": "default"},
+            "spec": {
+                "schedule": "@every 1s",
+                "template": {"workload": {
+                    "apiVersion": JAX_AV, "kind": JAX_KIND,
+                    "metadata": {"annotations": {}}, "spec": {},
+                }},
+            },
+        })
+
+        def shed_event():
+            rec.reconcile("default", "shed")
+            return [
+                e for e in api.list("v1", "Event", namespace="default")
+                if e.get("reason") == "FleetRejected"
+            ]
+
+        events = wait_for(shed_event, timeout=15.0, interval=0.3)
+        assert events
+        assert fs.rejected_total >= 1
+        api.close()

@@ -50,6 +50,39 @@ class TestSliceResolution:
         s = slice_for_shorthand("v5e-1")
         assert (s.chips, s.hosts) == (1, 1)
 
+    @pytest.mark.parametrize(
+        "name,accel,chips,hosts",
+        [
+            # The fleet-pool shorthands (runtime/fleet.parse_pool feeds
+            # these straight to slice_for_shorthand): every chip count a
+            # mixed v4/v5p/v6e pool spells must resolve, with the 3D
+            # families on 3D topologies and host counts matching the
+            # 4-chips/host multi-host rule.
+            ("v6e-32", "tpu-v6e-slice", 32, 8),
+            ("v5p-4", "tpu-v5p-slice", 4, 1),
+            ("v5p-32", "tpu-v5p-slice", 32, 8),
+            ("v4-16", "tpu-v4-podslice", 16, 4),
+            ("v4-32", "tpu-v4-podslice", 32, 8),
+        ],
+    )
+    def test_fleet_pool_shorthands(self, name, accel, chips, hosts):
+        s = slice_for_shorthand(name)
+        assert s.accelerator == accel
+        assert (s.chips, s.hosts) == (chips, hosts)
+        # Shorthand chip count is the product of its topology dims.
+        fam, topo = name.split("-")[0], s.topology
+        assert s == slice_for(fam, topo)
+
+    def test_shorthand_table_is_self_consistent(self):
+        # Every entry resolves, and the advertised chip count in the
+        # shorthand name ("v5p-32" -> 32) matches the resolved spec.
+        from cron_operator_tpu.backends.tpu import _SHORTHAND
+
+        for name in _SHORTHAND:
+            s = slice_for_shorthand(name)
+            assert s.chips == int(name.rsplit("-", 1)[1]), name
+            assert s.hosts * s.chips_per_host == s.chips, name
+
     def test_errors(self):
         with pytest.raises(TopologyError):
             slice_for("v9x", "4x4")
