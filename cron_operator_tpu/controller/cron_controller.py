@@ -317,6 +317,17 @@ class CronReconciler:
 
         self._sync_status(cron, gvk, active, terminated)
 
+        # Workloads this Cron has admitted into the fleet's bounded queue:
+        # they exist ONLY in the scheduler's books until dispatch, so the
+        # store list above cannot see them. The concurrency gates must —
+        # under Forbid a queued tick is still in flight (tick N queued +
+        # tick N+1 fired would dispatch concurrently once capacity frees),
+        # and under Replace a superseded queued tick must be cancelled or
+        # it still dispatches later.
+        fleet_queued: List[Unstructured] = []
+        if self.fleet is not None and hasattr(self.fleet, "queued_for"):
+            fleet_queued = self.fleet.queued_for(ns, name)
+
         now = self.clock.now()
 
         if cron.metadata.deletion_timestamp is not None:
@@ -385,11 +396,12 @@ class CronReconciler:
 
         if (
             cron.spec.concurrency_policy == ConcurrencyPolicy.FORBID
-            and len(active) > 0
+            and len(active) + len(fleet_queued) > 0
         ):
             log.debug(
-                "skip tick, concurrency policy Forbid with %d active",
-                len(active),
+                "skip tick, concurrency policy Forbid with %d active, "
+                "%d fleet-queued",
+                len(active), len(fleet_queued),
             )
             # Count each distinct skipped tick once, not once per reconcile
             # (the same pending tick is re-seen until it fires/expires).
@@ -399,6 +411,7 @@ class CronReconciler:
                     "tick_skipped", reason="Forbid",
                     key=f"{API_VERSION}/{KIND_CRON}/{ns}/{name}",
                     tick=str(missed_run), active=len(active),
+                    fleet_queued=len(fleet_queued),
                 )
             return scheduled
 
@@ -410,7 +423,7 @@ class CronReconciler:
             # namespace, which cannot affect validity. Non-Replace ticks
             # skip this extra deepcopy+inject: for them a failed
             # admission (caught below) destroys nothing.
-            if active:
+            if active or fleet_queued:
                 try:
                     inject_tpu_topology(copy.deepcopy(workload_tpl))
                 except ValueError as err:
@@ -443,6 +456,24 @@ class CronReconciler:
                     )
                 except NotFoundError:
                     pass  # already gone is fine
+            # Superseded ticks still waiting in the fleet queue: the store
+            # delete above cannot reach them (they were never created), so
+            # cancel them out of the scheduler's books — otherwise a stale
+            # replaced tick dispatches whenever capacity frees.
+            for w in fleet_queued:
+                meta = w.get("metadata") or {}
+                wname = meta.get("name", "")
+                if wname == tick_name:
+                    continue  # same fail-over guard as the delete loop
+                if self.fleet.cancel(meta.get("namespace", ns), wname):
+                    self._count("cron_workloads_replaced_total")
+                    self._audit(
+                        "replace_cancel", reason="Replace",
+                        key=(f"{w.get('apiVersion', '')}/{w.get('kind', '')}"
+                             f"/{meta.get('namespace', ns)}/{wname}"),
+                        trace_id=(meta.get("annotations") or {}).get(
+                            ANNOTATION_TRACE_ID),
+                    )
 
         workload = self._new_workload_from_template(cron, workload_tpl, next_run)
 
@@ -478,23 +509,36 @@ class CronReconciler:
 
         submit_start = time.time()
         try:
-            self._submit_workload(cron, gvk, workload, log)
-            self._count("cron_ticks_fired_total")
-            self._audit(
-                "tick_fired", trace_id=trace_id,
-                key=(f"{workload.get('apiVersion', '')}"
-                     f"/{workload.get('kind', '')}/{ns}"
-                     f"/{workload['metadata']['name']}"),
-                cron=f"{ns}/{name}", tick=str(missed_run),
-            )
+            decision = self._submit_workload(cron, gvk, workload, log)
             if missed_count > 1:
-                # Ticks the catch-up loop passed over; counted only when the
-                # latest one actually fires (lastScheduleTime advances), so
-                # repeated reconciles of one pending tick don't re-count.
+                # Ticks the catch-up loop passed over; counted only when
+                # lastScheduleTime advances past them (the tick fired — or
+                # was shed, which also sweeps them), so repeated reconciles
+                # of one pending tick don't re-count.
                 self._count("cron_missed_runs_total", float(missed_count - 1))
-            log.info(
-                "created %s %s", gvk.kind, workload["metadata"]["name"],
-            )
+            if decision is not None and decision.action == "rejected":
+                # The fleet shed the tick (bounded queue full): no workload
+                # was or ever will be created, so don't report a fire — no
+                # fired counter, no tick_fired audit, no "created" log (the
+                # FleetRejected event + submit_rejected audit record from
+                # _submit_workload carry the story). lastScheduleTime still
+                # advances below: dropping the tick IS the shed semantics.
+                log.info(
+                    "fleet shed tick %s: %s %s not created (queue full)",
+                    missed_run, gvk.kind, workload["metadata"]["name"],
+                )
+            else:
+                self._count("cron_ticks_fired_total")
+                self._audit(
+                    "tick_fired", trace_id=trace_id,
+                    key=(f"{workload.get('apiVersion', '')}"
+                         f"/{workload.get('kind', '')}/{ns}"
+                         f"/{workload['metadata']['name']}"),
+                    cron=f"{ns}/{name}", tick=str(missed_run),
+                )
+                log.info(
+                    "created %s %s", gvk.kind, workload["metadata"]["name"],
+                )
         except AlreadyExistsError:
             log.info(
                 "%s %s already exists",

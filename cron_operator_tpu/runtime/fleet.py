@@ -32,16 +32,20 @@ from __future__ import annotations
 
 import bisect
 import logging
+import re
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from cron_operator_tpu.api.v1alpha1 import LABEL_CRON_NAME
 from cron_operator_tpu.backends.tpu import (
+    _FAMILIES,
     ANNOTATION_ACCELERATOR,
     ANNOTATION_TOPOLOGY,
     SliceSpec,
+    TopologyError,
     slice_for_shorthand,
 )
 from cron_operator_tpu.runtime.kube import AlreadyExistsError, WatchEvent
@@ -121,7 +125,9 @@ class SliceType:
 def parse_pool(text: str) -> List[SliceType]:
     """``"v5e-16=2,v4-8=4,cpu=8"`` → pool entries. Names that resolve via
     ``slice_for_shorthand`` model real slice shapes; anything else is a
-    1-chip host-local type (``cpu``)."""
+    1-chip host-local type (``cpu``) — unless the name leads with a known
+    TPU family (``v5e-12``, ``v4_8``), which is almost certainly a typo'd
+    slice shorthand and must not silently become CPU capacity."""
     pool: List[SliceType] = []
     for part in text.split(","):
         part = part.strip()
@@ -139,8 +145,12 @@ def parse_pool(text: str) -> List[SliceType]:
             raise ValueError(f"fleet pool entry {part!r}: count must be >= 1")
         try:
             spec: Optional[SliceSpec] = slice_for_shorthand(name)
-        except Exception:
-            spec = None
+        except TopologyError as err:
+            if re.split(r"[-_]", name.lower(), maxsplit=1)[0] in _FAMILIES:
+                raise ValueError(
+                    f"fleet pool entry {part!r}: {err}"
+                ) from None
+            spec = None  # host-local capacity
         pool.append(SliceType(name, count, spec))
     if not pool:
         raise ValueError(f"fleet pool {text!r} names no slice types")
@@ -577,10 +587,13 @@ class FleetScheduler:
 
         Reads only the workload dict and in-memory books (no store I/O):
         the decision itself adds microseconds to the tick path and zero
-        writes. Transient create failures undo the reservation and
-        re-raise, so the controller's bounded submit-retry loop re-enters
-        cleanly; AlreadyExists propagates untouched (the deterministic-
-        name fail-over guard is a semantic answer, not a transient)."""
+        writes. Transient create failures undo the reservation (and hand
+        a preemption victim its slot back untouched) and re-raise, so the
+        controller's bounded submit-retry loop re-enters cleanly.
+        AlreadyExists keeps the committed books and re-raises (mirror of
+        the ``_dispatch`` path): a fail-over replay means the workload
+        already RUNS, so undoing the reservation would over-commit the
+        slice type until that run terminates."""
         meta = workload.get("metadata") or {}
         key = (meta.get("namespace", "default"), meta.get("name", ""))
         victim: Optional[_Tracked] = None
@@ -641,15 +654,31 @@ class FleetScheduler:
                 return decision
             slice_type, victim = placement
             self._commit_placement_locked(tr, slice_type)
-        if victim is not None:
-            self._do_preempt(victim, reason="priority",
-                             for_key=f"{key[0]}/{key[1]}")
+        # Preemption is deferred until the create lands: the books above
+        # already reserve the slot, so a transient create failure can hand
+        # it straight back to the victim — no checkpoint/resume cycle for
+        # the sake of a job that never materialized.
         try:
             self._create(tr)
+        except AlreadyExistsError:
+            # Fail-over replay: the workload already runs; keep the
+            # committed books and re-raise the semantic answer. The slot
+            # IS reassigned, so the victim still goes.
+            if victim is not None:
+                self._do_preempt(victim, reason="priority",
+                                 for_key=f"{key[0]}/{key[1]}")
+            raise
         except Exception:
             with self._lock:
                 self._undo_placement_locked(tr)
+                if victim is not None:
+                    # Never actually preempted — restore it onto the slot
+                    # the undo just freed.
+                    self._commit_placement_locked(victim, victim.slice_type)
             raise
+        if victim is not None:
+            self._do_preempt(victim, reason="priority",
+                             for_key=f"{key[0]}/{key[1]}")
         decision = PlacementDecision(
             "placed", tr.slice_type,
             preempted=f"{victim.key[0]}/{victim.key[1]}" if victim else None,
@@ -939,6 +968,41 @@ class FleetScheduler:
         if ok:
             self._dispatch()
         return ok
+
+    def queued_for(self, namespace: str, cron_name: str) -> List[Dict[str, Any]]:
+        """Workloads belonging to one Cron (matched by the
+        ``kubedl.io/cron-name`` label) that exist only in the fleet's
+        books — admitted and queued, not yet created in the store. The
+        reconciler's concurrency gates must see them: under Forbid a
+        queued tick is still in flight, and under Replace it must be
+        cancellable (:meth:`cancel`) before it ever dispatches."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for tr in self._queue:
+                meta = tr.workload.get("metadata") or {}
+                if meta.get("namespace", "default") != namespace:
+                    continue
+                if (meta.get("labels") or {}).get(
+                    LABEL_CRON_NAME
+                ) == cron_name:
+                    out.append(tr.workload)
+        return out
+
+    def cancel(self, namespace: str, name: str) -> bool:
+        """Drop a queued (never-dispatched) workload from the books — the
+        Replace-policy analog of deleting an active workload. Running
+        workloads are untouched (delete those through the store; the
+        watch pump frees their slice). True iff an entry was removed."""
+        with self._lock:
+            for i, tr in enumerate(self._queue):
+                if tr.key == (namespace, name):
+                    del self._queue[i]
+                    self._update_pending_gauge_locked()
+                    break
+            else:
+                return False
+        self._record("fleet_cancel", key=f"{namespace}/{name}")
+        return True
 
     def _pick_batch_locked(self) -> List[Tuple[_Tracked, str, bool]]:
         """Choose the next dispatch batch: the queue window planned

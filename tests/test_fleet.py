@@ -101,6 +101,19 @@ class TestPool:
         with pytest.raises(ValueError):
             parse_pool("  ,  ")
 
+    def test_parse_pool_rejects_typod_tpu_shorthand(self):
+        # A typo'd TPU shorthand must not silently become 1-chip
+        # host-local capacity (the job would run unaccelerated, with no
+        # topology stamping and no warning).
+        with pytest.raises(ValueError):
+            parse_pool("v5e-12=2")  # no such v5e shape
+        with pytest.raises(ValueError):
+            parse_pool("v4_8=4")  # misspelled separator
+        # Names that don't lead with a TPU family still model host-local
+        # capacity.
+        pool = parse_pool("cpu=2,bigmem=1")
+        assert all(t.spec is None for t in pool)
+
     def test_parse_quotas(self):
         assert parse_quotas(["team-a=32", "team-b=16"]) == {
             "team-a": 32, "team-b": 16,
@@ -348,6 +361,86 @@ class TestPreemptionAndBackfill:
         d = fs.submit(make_job("r3"))
         assert (d.action, d.reason) == ("rejected", "queue-full")
         assert fs.rejected_total == 1
+
+
+class TestSubmitFaultPaths:
+    def test_already_exists_keeps_books(self):
+        """Fail-over replay: the workload already runs, so the
+        reservation must stand (mirror of the _dispatch path) — undoing
+        it would over-commit the slice type until the run terminates."""
+        from cron_operator_tpu.runtime.kube import AlreadyExistsError
+
+        api = APIServer()
+        try:
+            first = FleetScheduler(parse_pool("cpu=2"), api=api)
+            assert first.submit(make_job("dup")).action == "placed"
+            # New scheduler incarnation: empty books, same store.
+            replay = FleetScheduler(parse_pool("cpu=2"), api=api)
+            with pytest.raises(AlreadyExistsError):
+                replay.submit(make_job("dup"))
+            stats = replay.stats()
+            assert stats["running"] == 1
+            assert stats["free"]["cpu"] == 1
+        finally:
+            api.close()
+
+    def test_create_failure_hands_slot_back_to_victim(self):
+        """Preemption is deferred until the create lands: a transient
+        create failure must not cost the victim a checkpoint/resume
+        cycle for a displacing job that never materialized."""
+        preempts = []
+
+        class FakeBackend:
+            def preempt(self, ns, name, kind=None, api_version=None):
+                preempts.append((ns, name))
+                return {"lostDevices": 4, "jobFinished": False}
+
+            def restore_capacity(self, n=None):
+                pass
+
+        def creator(w, t):
+            if w["metadata"]["name"] == "hi":
+                raise RuntimeError("store down")
+
+        fs = FleetScheduler(
+            parse_pool("v5e-16=1"), backend=FakeBackend(),
+            on_create=creator,
+        )
+        assert fs.submit(make_job("low", priority="batch")).action == \
+            "placed"
+        with pytest.raises(RuntimeError):
+            fs.submit(make_job("hi", priority="high"))
+        assert preempts == []  # the victim was never evicted
+        assert fs.preempted_total == 0
+        assert ("default", "low") in fs._running
+        assert fs.stats()["free"]["v5e-16"] == 0
+        # A later, healthy high-priority submit preempts as usual.
+        assert fs.submit(
+            make_job("hi2", priority="high")
+        ).action == "placed"
+        assert preempts == [("default", "low")]
+
+
+class TestQueuedVisibility:
+    def test_queued_for_and_cancel(self):
+        fs = FleetScheduler(
+            parse_pool("cpu=1"), on_create=lambda w, t: None,
+        )
+        assert fs.submit(make_job("holder")).action == "placed"
+        tick = make_job("c-100")
+        tick["metadata"]["labels"] = {"kubedl.io/cron-name": "c"}
+        assert fs.submit(tick).action == "queued"
+        assert [
+            w["metadata"]["name"] for w in fs.queued_for("default", "c")
+        ] == ["c-100"]
+        assert fs.queued_for("default", "other") == []
+        assert fs.queued_for("elsewhere", "c") == []
+        assert fs.cancel("default", "c-100")
+        assert not fs.cancel("default", "c-100")  # already gone
+        assert fs.stats()["queued"] == 0
+        # Cancel never touches running workloads.
+        assert not fs.cancel("default", "holder")
+        assert fs.stats()["running"] == 1
 
 
 class TestCapacityFlap:
@@ -606,6 +699,96 @@ class TestControllerWiring:
         assert fs.stats()["running"] == 1
         api.close()
 
+    @staticmethod
+    def _make_fleet_cron(api, name, policy):
+        api.create({
+            "apiVersion": CRON_AV, "kind": "Cron",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "schedule": "*/1 * * * *",
+                "concurrencyPolicy": policy,
+                "template": {"workload": {
+                    "apiVersion": JAX_AV, "kind": JAX_KIND,
+                    "metadata": {"annotations": {}}, "spec": {},
+                }},
+            },
+        })
+
+    def test_forbid_sees_fleet_queued_tick(self):
+        """A tick queued in the fleet's books is invisible to the store
+        list — the Forbid gate must still count it as active, or tick N
+        (queued) and tick N+1 (fired) dispatch concurrently once
+        capacity frees."""
+        from datetime import timedelta
+
+        from cron_operator_tpu.controller.cron_controller import (
+            CronReconciler,
+        )
+        from cron_operator_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        api = APIServer(clock=clock)
+        try:
+            fs = FleetScheduler(parse_pool("cpu=1"), api=api)
+            metrics = Metrics()
+            rec = CronReconciler(api, metrics=metrics, fleet=fs)
+            fs.submit(make_job("holder"))  # saturate the pool
+            self._make_fleet_cron(api, "fb", "Forbid")
+            clock.advance(timedelta(seconds=61))
+            rec.reconcile("default", "fb")
+            assert fs.stats()["queued"] == 1  # tick N admitted, queued
+            clock.advance(timedelta(seconds=60))
+            rec.reconcile("default", "fb")
+            # Tick N+1 must not pass the gate while tick N waits.
+            assert fs.stats()["queued"] == 1
+            assert metrics.get(
+                'cron_ticks_skipped_total{policy="Forbid"}'
+            ) == 1.0
+            assert metrics.get("cron_ticks_fired_total") == 1.0
+        finally:
+            api.close()
+
+    def test_replace_cancels_fleet_queued_tick(self):
+        """Replace's delete-all-active cannot reach a tick that exists
+        only in the fleet's books — it must cancel it there, or the
+        stale replaced tick still dispatches later."""
+        from datetime import timedelta
+
+        from cron_operator_tpu.controller.cron_controller import (
+            CronReconciler,
+        )
+        from cron_operator_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        api = APIServer(clock=clock)
+        try:
+            fs = FleetScheduler(parse_pool("cpu=1"), api=api)
+            metrics = Metrics()
+            rec = CronReconciler(api, metrics=metrics, fleet=fs)
+            fs.submit(make_job("holder"))  # saturate the pool
+            self._make_fleet_cron(api, "rp", "Replace")
+            clock.advance(timedelta(seconds=61))
+            rec.reconcile("default", "rp")
+            q1 = fs.queued_for("default", "rp")
+            assert len(q1) == 1
+            stale = q1[0]["metadata"]["name"]
+            clock.advance(timedelta(seconds=60))
+            rec.reconcile("default", "rp")
+            q2 = [w["metadata"]["name"]
+                  for w in fs.queued_for("default", "rp")]
+            assert len(q2) == 1 and q2 != [stale]  # superseding tick only
+            assert metrics.get("cron_workloads_replaced_total") == 1.0
+            # The cancelled tick can no longer dispatch.
+            fs.release("default", "holder")
+            names = {
+                (w.get("metadata") or {}).get("name")
+                for w in api.list(JAX_AV, JAX_KIND, namespace="default")
+            }
+            assert stale not in names
+            assert q2[0] in names
+        finally:
+            api.close()
+
     def test_rejected_tick_records_warning_event(self):
         from cron_operator_tpu.controller.cron_controller import (
             CronReconciler,
@@ -613,7 +796,8 @@ class TestControllerWiring:
 
         api = APIServer()
         fs = FleetScheduler(parse_pool("cpu=1"), api=api, max_queue=0)
-        rec = CronReconciler(api, fleet=fs)
+        metrics = Metrics()
+        rec = CronReconciler(api, metrics=metrics, fleet=fs)
         fs.submit(make_job("holder"))  # saturate: queue depth 0 → shed
         api.create({
             "apiVersion": CRON_AV, "kind": "Cron",
@@ -637,4 +821,7 @@ class TestControllerWiring:
         events = wait_for(shed_event, timeout=15.0, interval=0.3)
         assert events
         assert fs.rejected_total >= 1
+        # A shed tick is NOT a fired tick: no workload was or will be
+        # created, so the fired counter must not misreport it.
+        assert metrics.get("cron_ticks_fired_total") == 0.0
         api.close()
