@@ -251,6 +251,7 @@ class LocalExecutor:
                 self._threads.pop(key, None)
             if ctx:
                 ctx.cancel.set()
+            self._expire_workload_series(key[2], key[3])
             return
         if ev.type != "ADDED":
             return
@@ -358,6 +359,23 @@ class LocalExecutor:
                 )
             except NotFoundError:
                 pass
+        finally:
+            # However the run ended (success, failure, preemption,
+            # deletion), its labeled gauges are dead series now — drop
+            # them so long soaks don't grow the registry unboundedly.
+            self._expire_workload_series(ns, name)
+
+    def _expire_workload_series(self, ns: str, name: str) -> None:
+        """GC the per-workload labeled gauge series of a terminal run."""
+        if self.metrics is None or not hasattr(self.metrics, "remove_series"):
+            return
+        wl = f'{{workload="{ns}/{name}"}}'
+        for family in (
+            "workload_tokens_per_s",
+            "workload_last_step_seconds",
+            "workload_mfu",
+        ):
+            self.metrics.remove_series(f"{family}{wl}")
 
     def _execute_entrypoint(self, ctx: JobContext) -> None:
         ann = (ctx.job.get("metadata") or {}).get("annotations") or {}
@@ -663,14 +681,21 @@ class LocalExecutor:
             return
         p = ctx.progress
         if self.metrics is not None:
+            # Labeled per-workload series (expired on terminal state by
+            # _expire_workload_series, so long soaks don't grow the
+            # registry unboundedly).
+            wl = f'{{workload="{ctx.namespace}/{ctx.name}"}}'
             if p.get("last_step_time_s") is not None:
                 self.metrics.set(
-                    "workload_last_step_seconds", float(p["last_step_time_s"])
+                    f"workload_last_step_seconds{wl}",
+                    float(p["last_step_time_s"]),
                 )
             if p.get("tokens_per_s") is not None:
                 self.metrics.set(
-                    "workload_tokens_per_s", float(p["tokens_per_s"])
+                    f"workload_tokens_per_s{wl}", float(p["tokens_per_s"])
                 )
+            if p.get("mfu") is not None:
+                self.metrics.set(f"workload_mfu{wl}", float(p["mfu"]))
         first = p.get("first_step_at")
         if not first or key in self._telemetry_done:
             return

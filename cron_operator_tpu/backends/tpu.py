@@ -108,6 +108,13 @@ class SliceSpec:
     def devices(self) -> int:
         return self.chips
 
+    @property
+    def peak_flops(self) -> Optional[float]:
+        """Aggregate peak dense FLOP/s of the slice (bf16), or None for
+        an unknown family — the MFU estimator's denominator."""
+        per_chip = peak_flops_per_chip(self.accelerator)
+        return per_chip * self.chips if per_chip is not None else None
+
 
 # family key → (GKE accelerator label, chips per host for multi-host slices,
 #               max chips on one host, 3D topology?)
@@ -119,6 +126,24 @@ _FAMILIES = {
 }
 
 _ACCEL_TO_FAMILY = {accel: fam for fam, (accel, _, _, _) in _FAMILIES.items()}
+
+# Published peak dense bf16 FLOP/s per chip (Cloud TPU system
+# architecture docs): v4 275 TF, v5e 197 TF, v5p 459 TF, v6e 918 TF.
+PEAK_FLOPS_PER_CHIP = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(family_or_accelerator: str) -> Optional[float]:
+    """Peak bf16 FLOP/s of one chip, by family ("v5e") or GKE
+    accelerator label ("tpu-v5-lite-podslice"). None when unknown —
+    callers skip MFU rather than divide by a guess."""
+    key = (family_or_accelerator or "").lower()
+    fam = key if key in _FAMILIES else _ACCEL_TO_FAMILY.get(key)
+    return PEAK_FLOPS_PER_CHIP.get(fam) if fam is not None else None
 
 
 def _parse_topology(topology: str) -> List[int]:

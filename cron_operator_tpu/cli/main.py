@@ -473,6 +473,7 @@ def cmd_start(args: argparse.Namespace) -> int:
         log.error("--shards must be >= 1, got %d", args.shards)
         return 2
     fleet = None
+    fleet_matrix_path = None
     if args.fleet_pool and (args.api_server == "cluster" or sharded):
         # The fleet's capacity books are process-local and its creates
         # must see the same store the watch pump releases against.
@@ -641,6 +642,7 @@ def cmd_start(args: argparse.Namespace) -> int:
         if args.fleet_pool:
             from cron_operator_tpu.runtime.fleet import (
                 FleetScheduler,
+                ThroughputMatrix,
                 parse_pool,
                 parse_quotas,
             )
@@ -651,6 +653,22 @@ def cmd_start(args: argparse.Namespace) -> int:
             except ValueError as err:
                 log.error("--fleet-pool/--fleet-quota: %s", err)
                 return 2
+            # Throughput-matrix persistence (ROADMAP item 3): seed the
+            # EMA from the previous run's sidecar so a restart plans with
+            # yesterday's learned rates instead of the neutral prior; the
+            # observatory's rollup hook saves it back periodically.
+            matrix = None
+            if args.data_dir:
+                fleet_matrix_path = _os.path.join(
+                    args.data_dir, "fleet_matrix.json"
+                )
+                seed = ThroughputMatrix.load_seed(fleet_matrix_path)
+                matrix = ThroughputMatrix(seed=seed)
+                if seed:
+                    log.info(
+                        "fleet: throughput matrix seeded with %d rate(s) "
+                        "from %s", len(seed), fleet_matrix_path,
+                    )
             # The fleet submits through the (possibly chaos-wrapped) api
             # so placement creates share the store path every other
             # write takes; its watch pump releases slices on terminal
@@ -659,6 +677,7 @@ def cmd_start(args: argparse.Namespace) -> int:
             fleet = FleetScheduler(
                 fleet_types,
                 api=api,
+                matrix=matrix,
                 metrics=manager.metrics,
                 audit=journal,
                 quotas=fleet_quotas,
@@ -681,6 +700,32 @@ def cmd_start(args: argparse.Namespace) -> int:
             owns=scheme.workload_kinds(),
         )
         managers = [manager]
+
+    # Fleet observatory: (a) the opted-in metric families mirror every
+    # sample into a bounded multi-resolution time-series store, served
+    # at /debug/timeline; (b) audit decision records fold into derived
+    # utilization / deadline-SLO / queue-wait / goodput accounting,
+    # served at /debug/fleet and rolled up as JSONL into --data-dir.
+    # Both are pure in-memory folds — zero store/WAL writes added.
+    from cron_operator_tpu.telemetry import (
+        DEFAULT_HISTORY_FAMILIES,
+        FleetObservatory,
+        TimeSeriesStore,
+    )
+
+    registry = shared_metrics if sharded else manager.metrics
+    history = TimeSeriesStore()
+    registry.instrument(history, families=DEFAULT_HISTORY_FAMILIES)
+    observatory = FleetObservatory(
+        metrics=registry, tracer=tracer, data_dir=args.data_dir or None,
+    )
+    journal.attach_observer(observatory.on_record)
+    if fleet is not None:
+        observatory.attach_fleet(fleet)
+        if fleet_matrix_path is not None:
+            observatory.add_rollup_hook(
+                lambda: fleet.matrix.save(fleet_matrix_path)
+            )
 
     api_http = None
     api_cert_watcher = None
@@ -757,6 +802,7 @@ def cmd_start(args: argparse.Namespace) -> int:
         # chain resumes the victim (no executor → books-only preemption).
         fleet.backend = executor
         fleet.start()
+    observatory.start()
 
     def _debug_shards_json() -> str:
         # Sharded: the plane owns the authoritative per-shard view
@@ -911,6 +957,16 @@ def cmd_start(args: argparse.Namespace) -> int:
                     "/debug/shards": lambda: (
                         _debug_shards_json(), "application/json"
                     ),
+                    # Bounded metric history at several bucket widths
+                    # (?family=&series=&res=&limit=).
+                    "/debug/timeline": lambda params: (
+                        history.render_json(params), "application/json"
+                    ),
+                    # Derived fleet accounting: utilization, deadline
+                    # SLO, queue waits, goodput, throughput matrix.
+                    "/debug/fleet": lambda params: (
+                        observatory.render_json(params), "application/json"
+                    ),
                 },
                 "metrics",
                 tls_ctx=tls_ctx,
@@ -969,6 +1025,10 @@ def cmd_start(args: argparse.Namespace) -> int:
         m.stop()
     if api_http is not None:
         api_http.stop()
+    observatory.stop()
+    # Final rollup: flush the accounting line + sidecar hooks (the
+    # throughput matrix save) so a clean shutdown persists the model.
+    observatory.rollup()
     if fleet is not None:
         fleet.stop()
     if executor is not None:

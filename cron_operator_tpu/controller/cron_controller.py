@@ -384,7 +384,9 @@ class CronReconciler:
                 self._audit(
                     "tick_skipped", reason="StartingDeadline",
                     key=f"{API_VERSION}/{KIND_CRON}/{ns}/{name}",
-                    tick=str(missed_run),
+                    tick=str(missed_run), cron=f"{ns}/{name}",
+                    lateness_s=round((now - missed_run).total_seconds(), 3),
+                    deadline_s=cron.spec.starting_deadline_seconds,
                 )
                 self.api.record_event(
                     cron.to_dict(),
@@ -522,7 +524,20 @@ class CronReconciler:
                 # fired counter, no tick_fired audit, no "created" log (the
                 # FleetRejected event + submit_rejected audit record from
                 # _submit_workload carry the story). lastScheduleTime still
-                # advances below: dropping the tick IS the shed semantics.
+                # advances below: dropping the tick IS the shed semantics —
+                # which makes it a *missed run* and a deadline miss, not a
+                # silent sweep (ROADMAP item 3: deadline-aware shedding).
+                self._count("cron_missed_runs_total")
+                self._audit(
+                    "tick_shed", trace_id=trace_id,
+                    reason="FleetQueueFull",
+                    key=(f"{workload.get('apiVersion', '')}"
+                         f"/{workload.get('kind', '')}/{ns}"
+                         f"/{workload['metadata']['name']}"),
+                    cron=f"{ns}/{name}", tick=str(missed_run),
+                    lateness_s=round((now - missed_run).total_seconds(), 3),
+                    deadline_s=cron.spec.starting_deadline_seconds,
+                )
                 log.info(
                     "fleet shed tick %s: %s %s not created (queue full)",
                     missed_run, gvk.kind, workload["metadata"]["name"],
@@ -535,6 +550,8 @@ class CronReconciler:
                          f"/{workload.get('kind', '')}/{ns}"
                          f"/{workload['metadata']['name']}"),
                     cron=f"{ns}/{name}", tick=str(missed_run),
+                    lateness_s=round((now - missed_run).total_seconds(), 3),
+                    deadline_s=cron.spec.starting_deadline_seconds,
                 )
                 log.info(
                     "created %s %s", gvk.kind, workload["metadata"]["name"],

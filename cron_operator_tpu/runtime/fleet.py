@@ -31,7 +31,9 @@ keeps every decision deterministically replayable from a fixed seed.
 from __future__ import annotations
 
 import bisect
+import json
 import logging
+import os
 import re
 import threading
 import time
@@ -211,6 +213,38 @@ class ThroughputMatrix:
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             return {f"{w}/{t}": r for (w, t), r in sorted(self._rates.items())}
+
+    # ---- persistence (JSON sidecar in --data-dir) -------------------------
+
+    def save(self, path: str) -> None:
+        """Write the learned rates as a JSON sidecar (atomic rename), so
+        a restarted operator starts from yesterday's throughput model
+        instead of the chips-proportional prior."""
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(
+                {"alpha": self._alpha, "rates": self.snapshot()},
+                f, indent=2, sort_keys=True,
+            )
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load_seed(path: str) -> Optional[Dict[Tuple[str, str], float]]:
+        """Read a :meth:`save` sidecar back into seed form. Returns None
+        (start cold) on a missing or corrupt file — persistence of the
+        model must never block boot."""
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+            seed: Dict[Tuple[str, str], float] = {}
+            for key, rate in (data.get("rates") or {}).items():
+                wclass, _, slice_type = str(key).partition("/")
+                if not slice_type:
+                    continue
+                seed[(wclass, slice_type)] = float(rate)
+            return seed or None
+        except (OSError, ValueError, TypeError):
+            return None
 
 
 # ---------------------------------------------------------------------------
@@ -1121,6 +1155,7 @@ class FleetScheduler:
                     "fleet_dispatch", key=f"{tr.key[0]}/{tr.key[1]}",
                     slice_type=t, backfill=backfill,
                     queue_wait_s=round(wait_s, 6), tenant=tr.tenant,
+                    priority=tr.priority,
                 )
             if not ok:
                 break

@@ -14,7 +14,7 @@ import threading
 import time
 import traceback
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional
 
 from cron_operator_tpu.api.scheme import GVK, gvk_of
 from cron_operator_tpu.runtime.kube import APIServer, ApiError, WatchEvent
@@ -265,6 +265,25 @@ _FAMILY_META: Dict[str, tuple] = {
         "counter", "Bearer-auth denials: malformed header, failed "
                    "review, unauthorized subject, or fail-closed "
                    "transient review error"),
+    "workload_mfu": (
+        "gauge", "Rolling model-FLOPs-utilization estimate per live "
+                 "workload (XLA-counted flops/step ÷ step time ÷ slice "
+                 "peak FLOP/s); series expire when the run terminates"),
+    "fleet_utilization": (
+        "gauge", "Busy-chip-seconds ÷ capacity-chip-seconds per slice "
+                 "type since observatory start (capacity flaps "
+                 "included)"),
+    "cron_deadline_hits_total": (
+        "counter", "Ticks fired within their Cron's "
+                   "startingDeadlineSeconds (no deadline = any fire "
+                   "counts)"),
+    "cron_deadline_misses_total": (
+        "counter", "Deadline misses: ticks skipped past "
+                   "startingDeadlineSeconds or shed by a full fleet "
+                   "queue"),
+    "observatory_rollups_total": (
+        "counter", "Periodic observatory JSONL rollups persisted into "
+                   "--data-dir"),
 }
 
 
@@ -283,6 +302,14 @@ class Metrics:
         # family must share a bucket ladder.
         self._hists: Dict[str, Dict] = {}
         self._hist_buckets: Dict[str, tuple] = {}  # family → buckets
+        # Optional history mirror (telemetry/timeseries.py): families
+        # that opted in via instrument() get every sample appended to
+        # the bounded time-series store as well. _history_ok memoizes
+        # the per-series family-membership answer so the hot path pays
+        # one dict probe, not a split, per sample.
+        self._history = None
+        self._history_families: Optional[set] = None
+        self._history_ok: Dict[str, bool] = {}
 
     @staticmethod
     def labels(family: str, **kv: object) -> str:
@@ -296,14 +323,57 @@ class Metrics:
         inner = ",".join(f'{k}="{v}"' for k, v in sorted(kv.items()))
         return f"{family}{{{inner}}}"
 
+    def instrument(
+        self, history, families: Optional[Iterable[str]] = None
+    ) -> None:
+        """Mirror samples of ``families`` into a bounded history store
+        (:class:`~cron_operator_tpu.telemetry.timeseries.TimeSeriesStore`).
+
+        Counters record their new cumulative total, gauges the set
+        value, histograms the raw observation — each tagged with the
+        full (labeled) series name. ``families=None`` opts every family
+        in (tests); production callers pass a curated set. Detach with
+        ``history=None``.
+        """
+        with self._lock:
+            self._history = history
+            self._history_families = (
+                set(families) if families is not None else None
+            )
+            self._history_ok = {}
+
+    def _history_append(self, series: str, value: float) -> None:
+        # Called OUTSIDE the registry lock (the store has its own), so a
+        # history append can never deadlock against a concurrent scrape.
+        ok = self._history_ok.get(series)
+        if ok is None:
+            fams = self._history_families
+            ok = fams is None or series.split("{", 1)[0] in fams
+            self._history_ok[series] = ok
+        if ok:
+            self._history.append(series, value)
+
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0.0) + value
+            total = self.counters.get(name, 0.0) + value
+            self.counters[name] = total
+        if self._history is not None:
+            self._history_append(name, total)
 
     def set(self, name: str, value: float) -> None:
         """Set a gauge series to an absolute value (last write wins)."""
         with self._lock:
             self.gauges[name] = float(value)
+        if self._history is not None:
+            self._history_append(name, float(value))
+
+    def remove_series(self, name: str) -> bool:
+        """Drop one gauge series from the registry (GC for labeled
+        per-workload series whose subject reached a terminal state —
+        long soaks must not grow the exposition unboundedly). True iff
+        the series existed."""
+        with self._lock:
+            return self.gauges.pop(name, None) is not None
 
     def observe(
         self, series: str, value: float,
@@ -341,6 +411,8 @@ class Metrics:
                 h["counts"][-1] += 1  # +Inf
             h["sum"] += value
             h["count"] += 1
+        if self._history is not None:
+            self._history_append(series, value)
 
     def get(self, name: str) -> float:
         with self._lock:

@@ -20,6 +20,12 @@ from cron_operator_tpu.telemetry.audit import (
     AuditJournal,
     AuditRecord,
 )
+from cron_operator_tpu.telemetry.observatory import FleetObservatory
+from cron_operator_tpu.telemetry.timeseries import (
+    DEFAULT_HISTORY_FAMILIES,
+    TIMESERIES_APPEND_GATE_US,
+    TimeSeriesStore,
+)
 from cron_operator_tpu.telemetry.trace import (
     ANNOTATION_TRACE_ID,
     ENV_TRACE_ID,
@@ -34,8 +40,12 @@ __all__ = [
     "AUDIT_KINDS",
     "AuditJournal",
     "AuditRecord",
+    "DEFAULT_HISTORY_FAMILIES",
     "ENV_TRACE_ID",
+    "FleetObservatory",
     "Span",
+    "TIMESERIES_APPEND_GATE_US",
+    "TimeSeriesStore",
     "Tracer",
     "new_span_id",
     "new_trace_id",

@@ -133,12 +133,24 @@ class AuditJournal:
         self._sink = open(sink_path, "a", encoding="utf-8") \
             if sink_path else None
         self.sink_path = sink_path
+        # Optional live subscriber (telemetry/observatory.py): called
+        # with every record, OUTSIDE the journal lock. One attribute
+        # check on the hot path when unattached.
+        self._observer = None
 
     # ---- recording --------------------------------------------------------
 
     def instrument(self, metrics) -> None:
         """Count records (and ring evictions) into a metrics registry."""
         self._metrics = metrics
+
+    def attach_observer(self, fn) -> None:
+        """Stream every record to ``fn(record)`` as it lands — the
+        observatory's event intake. The callback runs outside the
+        journal lock on the recording thread, so it must be fast and
+        must never raise (exceptions are swallowed: accounting must not
+        fail the audited operation)."""
+        self._observer = fn
 
     def _count(self, series: str) -> None:
         if self._metrics is not None:
@@ -195,6 +207,11 @@ class AuditJournal:
                 self._metrics.inc(series)
             else:  # unknown kind — format off the hot path
                 self._metrics.inc(f'audit_records_total{{kind="{kind}"}}')
+        if self._observer is not None:
+            try:
+                self._observer(rec)
+            except Exception:  # noqa: BLE001 — see attach_observer
+                pass
         return rec
 
     def shard_view(self, shard: int) -> "_ShardAuditView":

@@ -1,0 +1,452 @@
+"""Fleet observatory — derived accounting over fleet/audit/watch events.
+
+The fleet scheduler, cron controller and executor already *emit*
+everything a capacity review needs (audit decision records, lineage
+traces, pool bookkeeping); this module is the layer that *derives* the
+answers from those streams without touching the store:
+
+- **Utilization** per slice type: busy-chip-seconds ÷
+  capacity-chip-seconds, integrated from periodic fleet samples so
+  capacity flaps (``fleet_flap``/``fleet_restore``) shrink the
+  denominator instead of hiding in it.
+- **Deadline SLO** per Cron: hit-rate of firing within
+  ``startingDeadlineSeconds``, fed by ``tick_fired`` lateness attrs and
+  charged misses for StartingDeadline skips and fleet queue sheds.
+- **Queue-wait distributions** per priority class, from
+  ``fleet_dispatch`` records.
+- **Goodput vs wasted work** per tenant, from the PR 8 lineage spans
+  (``wasted_steps`` of preempted attempts).
+
+Intake is :meth:`FleetObservatory.on_record` registered via
+``AuditJournal.attach_observer`` — a pure in-memory fold over records
+already being written, so the observatory adds **zero store/WAL
+writes** on the steady-state path (rv-bracket asserted by
+``hack/obs_report.py`` and tests). The derived report is served from
+``/debug/fleet`` (:meth:`render_json`) and persisted as periodic JSONL
+rollups into ``--data-dir`` so history survives restarts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+#: Reverse map of runtime/fleet.PRIORITY_CLASSES for display buckets.
+#: "batch" and "low" share a priority value; the first name wins.
+_PRIORITY_NAMES = {100: "system", 50: "high", 0: "normal", -50: "batch"}
+
+#: Audit events the observatory folds; everything else is skipped with
+#: one dict lookup (the intake rides the audit hot path).
+_HANDLED_EVENTS = frozenset((
+    "tick_fired", "tick_skipped", "tick_shed",
+    "fleet_place", "fleet_dispatch",
+))
+
+
+def _priority_name(priority: Any) -> str:
+    try:
+        return _PRIORITY_NAMES.get(int(priority), str(int(priority)))
+    except (TypeError, ValueError):
+        return "normal"
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+class _DeadlineSLO:
+    """Per-Cron hit/miss bookkeeping against startingDeadlineSeconds."""
+
+    __slots__ = ("hits", "misses", "lateness")
+
+    def __init__(self, max_samples: int):
+        self.hits = 0
+        self.misses = 0
+        self.lateness: deque = deque(maxlen=max_samples)
+
+    def to_dict(self) -> Dict[str, Any]:
+        total = self.hits + self.misses
+        late = sorted(self.lateness)
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else 1.0,
+            "lateness_p50_s": round(_quantile(late, 0.50), 3),
+            "lateness_p99_s": round(_quantile(late, 0.99), 3),
+        }
+
+
+class FleetObservatory:
+    """Derived fleet accounting: fold audit records, sample the fleet,
+    read lineage traces — never write the store.
+
+    All intake paths take the observatory's own lock only; rollups and
+    reports are computed from the folded state plus read-only calls
+    into the fleet (``stats()``/``pool``) and tracer (``traces()``).
+    """
+
+    def __init__(
+        self,
+        *,
+        metrics: Optional[Any] = None,
+        tracer: Optional[Any] = None,
+        data_dir: Optional[str] = None,
+        rollup_interval_s: float = 60.0,
+        sample_interval_s: float = 1.0,
+        max_samples: int = 512,
+        max_crons: int = 4096,
+    ):
+        self.metrics = metrics
+        self.tracer = tracer
+        self.data_dir = data_dir
+        self.rollup_interval_s = rollup_interval_s
+        self.sample_interval_s = sample_interval_s
+        self.max_samples = max_samples
+        self.max_crons = max_crons
+
+        self._lock = threading.Lock()
+        self._fleet: Optional[Any] = None
+        # cron "ns/name" → deadline bookkeeping (bounded: max_crons).
+        self._slo: Dict[str, _DeadlineSLO] = {}
+        self._slo_dropped = 0
+        # priority class name → queue-wait reservoir (seconds).
+        self._queue_wait: Dict[str, deque] = {}
+        # workload key → tenant, for attributing lineage waste. Bounded
+        # like the SLO table; dispatch refreshes recency implicitly.
+        self._tenant_of: Dict[str, str] = {}
+        # slice type → integrated chip-seconds since start.
+        self._busy_chip_s: Dict[str, float] = {}
+        self._cap_chip_s: Dict[str, float] = {}
+        self._last_sample_mono: Optional[float] = None
+        self.records_seen = 0
+        self.rollups_total = 0
+
+        self._rollup_hooks: List[Callable[[], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- wiring -----------------------------------------------------------
+
+    def attach_fleet(self, fleet: Any) -> None:
+        """Point utilization sampling at a ``FleetScheduler`` (reads
+        ``pool`` and ``stats()`` only)."""
+        with self._lock:
+            self._fleet = fleet
+            self._last_sample_mono = None
+
+    def add_rollup_hook(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` after each rollup line lands (e.g. the cli's
+        throughput-matrix sidecar save). Exceptions are swallowed —
+        a broken hook must not stop accounting."""
+        self._rollup_hooks.append(fn)
+
+    # ---- audit intake (hot path) ------------------------------------------
+
+    def on_record(self, rec: Any) -> None:
+        """AuditJournal observer: fold one record. Non-decision kinds
+        and unhandled events return after one set lookup."""
+        if rec.kind != "decision" or rec.event not in _HANDLED_EVENTS:
+            return
+        event = rec.event
+        attrs = rec.attrs
+        with self._lock:
+            self.records_seen += 1
+            if event == "tick_fired":
+                self._fold_tick(
+                    attrs.get("cron") or self._cron_from_key(rec.key),
+                    attrs.get("lateness_s"), attrs.get("deadline_s"),
+                )
+            elif event == "tick_skipped":
+                # Only deadline-driven skips are SLO misses; Forbid /
+                # Replace skips are policy working as configured.
+                if rec.reason == "StartingDeadline":
+                    self._fold_miss(
+                        attrs.get("cron") or self._cron_from_key(rec.key),
+                        attrs.get("lateness_s"),
+                    )
+            elif event == "tick_shed":
+                # Fleet queue shed: the tick will never run — a
+                # deadline miss whatever the configured deadline was.
+                self._fold_miss(
+                    attrs.get("cron") or self._cron_from_key(rec.key),
+                    attrs.get("lateness_s"),
+                )
+            elif event == "fleet_place":
+                self._remember_tenant(rec.key, attrs.get("tenant"))
+            elif event == "fleet_dispatch":
+                self._remember_tenant(rec.key, attrs.get("tenant"))
+                wait = attrs.get("queue_wait_s")
+                if wait is not None:
+                    cls = _priority_name(attrs.get("priority", 0))
+                    res = self._queue_wait.get(cls)
+                    if res is None:
+                        res = self._queue_wait[cls] = deque(
+                            maxlen=self.max_samples
+                        )
+                    try:
+                        res.append(float(wait))
+                    except (TypeError, ValueError):
+                        pass
+
+    @staticmethod
+    def _cron_from_key(key: str) -> str:
+        # "apiVersion/Kind/ns/name" → "ns/name"; tolerate bare "ns/name".
+        parts = key.rsplit("/", 2)
+        return "/".join(parts[-2:]) if len(parts) >= 2 else key
+
+    def _slo_for(self, cron: str) -> Optional[_DeadlineSLO]:
+        slo = self._slo.get(cron)
+        if slo is None:
+            if len(self._slo) >= self.max_crons:
+                self._slo_dropped += 1
+                return None
+            slo = self._slo[cron] = _DeadlineSLO(self.max_samples)
+        return slo
+
+    def _fold_tick(
+        self, cron: str, lateness_s: Any, deadline_s: Any
+    ) -> None:
+        slo = self._slo_for(cron)
+        if slo is None:
+            return
+        try:
+            late = max(0.0, float(lateness_s))
+        except (TypeError, ValueError):
+            late = 0.0
+        slo.lateness.append(late)
+        hit = deadline_s is None or late <= float(deadline_s)
+        if hit:
+            slo.hits += 1
+        else:
+            slo.misses += 1
+        self._count(
+            "cron_deadline_hits_total" if hit
+            else "cron_deadline_misses_total"
+        )
+
+    def _fold_miss(self, cron: str, lateness_s: Any) -> None:
+        slo = self._slo_for(cron)
+        if slo is None:
+            return
+        try:
+            slo.lateness.append(max(0.0, float(lateness_s)))
+        except (TypeError, ValueError):
+            pass
+        slo.misses += 1
+        self._count("cron_deadline_misses_total")
+
+    def _remember_tenant(self, key: str, tenant: Any) -> None:
+        if not tenant:
+            return
+        if len(self._tenant_of) >= self.max_crons:
+            # Evict the oldest insertion — dict order is insertion
+            # order, and placement order approximates recency here.
+            self._tenant_of.pop(next(iter(self._tenant_of)))
+        self._tenant_of[self._cron_from_key(key)] = str(tenant)
+
+    def _count(self, series: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(series)
+
+    # ---- utilization sampling ---------------------------------------------
+
+    def sample_fleet(self, now_mono: Optional[float] = None) -> None:
+        """Integrate busy/capacity chip-seconds from the fleet's current
+        bookkeeping. Called ~every second by the observatory thread (or
+        explicitly with a synthetic clock in benches/tests)."""
+        if now_mono is None:
+            now_mono = time.monotonic()
+        with self._lock:
+            fleet = self._fleet
+            if fleet is None:
+                return
+            last = self._last_sample_mono
+            self._last_sample_mono = now_mono
+            stats = fleet.stats()
+            free = stats.get("free", {})
+            lost = stats.get("lost", {})
+            for name, st in fleet.pool.items():
+                cap = max(0, st.count - int(lost.get(name, 0)))
+                busy = max(0, cap - int(free.get(name, 0)))
+                util = busy / cap if cap else 0.0
+                if self.metrics is not None:
+                    self.metrics.set(
+                        f'fleet_utilization{{slice_type="{name}"}}', util
+                    )
+                if last is not None and now_mono > last:
+                    dt = now_mono - last
+                    chips = st.chips
+                    self._busy_chip_s[name] = (
+                        self._busy_chip_s.get(name, 0.0) + busy * chips * dt
+                    )
+                    self._cap_chip_s[name] = (
+                        self._cap_chip_s.get(name, 0.0) + cap * chips * dt
+                    )
+
+    # ---- reporting --------------------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        """The derived accounting snapshot (the ``/debug/fleet`` body's
+        ``observatory`` section and the rollup line's payload)."""
+        with self._lock:
+            util = {
+                name: {
+                    "busy_chip_s": round(self._busy_chip_s.get(name, 0.0), 3),
+                    "capacity_chip_s": round(cap_s, 3),
+                    "utilization": round(
+                        self._busy_chip_s.get(name, 0.0) / cap_s, 4
+                    ) if cap_s else 0.0,
+                }
+                for name, cap_s in sorted(self._cap_chip_s.items())
+            }
+            slo = {c: s.to_dict() for c, s in sorted(self._slo.items())}
+            waits = {
+                cls: self._wait_summary(res)
+                for cls, res in sorted(self._queue_wait.items())
+            }
+            tenants = dict(self._tenant_of)
+            records_seen = self.records_seen
+            rollups = self.rollups_total
+        hits = sum(s["hits"] for s in slo.values())
+        misses = sum(s["misses"] for s in slo.values())
+        return {
+            "utilization": util,
+            "deadline_slo": {
+                "per_cron": slo,
+                "hits": hits,
+                "misses": misses,
+                "hit_rate": round(hits / (hits + misses), 4)
+                if (hits + misses) else 1.0,
+            },
+            "queue_wait_s": waits,
+            "goodput": self._goodput(tenants),
+            "records_seen": records_seen,
+            "rollups_total": rollups,
+        }
+
+    @staticmethod
+    def _wait_summary(res: deque) -> Dict[str, Any]:
+        vals = sorted(res)
+        return {
+            "count": len(vals),
+            "p50_s": round(_quantile(vals, 0.50), 4),
+            "p99_s": round(_quantile(vals, 0.99), 4),
+            "max_s": round(vals[-1], 4) if vals else 0.0,
+        }
+
+    def _goodput(self, tenants: Dict[str, str]) -> Dict[str, Any]:
+        """Per-tenant productive vs wasted steps from lineage traces.
+        A resume chain's workload names carry ``-rN`` suffixes; the
+        tenant map is keyed on placement-time names, so strip the
+        suffix when attributing."""
+        out: Dict[str, Dict[str, float]] = {}
+        total_wasted = 0
+        if self.tracer is None:
+            return {"per_tenant": out, "wasted_steps": 0}
+        for trace in self.tracer.traces():
+            lineage = trace.get("lineage")
+            if not lineage:
+                continue
+            wasted = int(lineage.get("wasted_steps", 0))
+            total_wasted += wasted
+            wl = ""
+            for hop in lineage.get("resumes", []):
+                wl = hop.get("workload") or wl
+                if wl:
+                    break
+            base = wl.split("-r", 1)[0] if wl else ""
+            tenant = tenants.get(base, tenants.get(wl, "unknown"))
+            row = out.setdefault(
+                tenant, {"wasted_steps": 0, "resume_chains": 0}
+            )
+            row["wasted_steps"] += wasted
+            row["resume_chains"] += 1
+        return {"per_tenant": out, "wasted_steps": total_wasted}
+
+    def render_json(
+        self, params: Optional[Dict[str, List[str]]] = None
+    ) -> str:
+        """JSON body for ``/debug/fleet``: the derived report plus the
+        fleet's own live bookkeeping (stats + throughput matrix)."""
+        del params  # reserved; route dispatch is param-aware
+        body: Dict[str, Any] = {"observatory": self.report()}
+        fleet = self._fleet
+        if fleet is not None:
+            body["fleet"] = fleet.stats()
+            body["throughput_matrix"] = fleet.matrix.snapshot()
+            body["pool"] = {
+                name: {"count": st.count, "chips": st.chips}
+                for name, st in sorted(fleet.pool.items())
+            }
+        return json.dumps(body, indent=2, default=str)
+
+    # ---- rollups ----------------------------------------------------------
+
+    @property
+    def rollup_path(self) -> Optional[str]:
+        if not self.data_dir:
+            return None
+        return os.path.join(self.data_dir, "observatory.jsonl")
+
+    def rollup(self, now: Optional[float] = None) -> Optional[str]:
+        """Append one report line to ``--data-dir/observatory.jsonl``
+        (history that survives restarts), bump the counter, run hooks.
+        Returns the path written, or None when no data dir is set."""
+        path = self.rollup_path
+        line = dict(self.report(), ts=now if now is not None else time.time())
+        if path is not None:
+            try:
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(line, default=str) + "\n")
+            except OSError:
+                path = None
+        with self._lock:
+            self.rollups_total += 1
+        self._count("observatory_rollups_total")
+        for hook in self._rollup_hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — see add_rollup_hook
+                pass
+        return path
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        """Own light thread: sample the fleet every
+        ``sample_interval_s``, roll up every ``rollup_interval_s``."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="observatory", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        next_rollup = time.monotonic() + self.rollup_interval_s
+        while not self._stop.wait(self.sample_interval_s):
+            try:
+                self.sample_fleet()
+                if time.monotonic() >= next_rollup:
+                    self.rollup()
+                    next_rollup = time.monotonic() + self.rollup_interval_s
+            except Exception:  # noqa: BLE001 — accounting never crashes
+                pass
+
+
+__all__ = ["FleetObservatory"]

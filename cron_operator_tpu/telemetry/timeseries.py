@@ -1,0 +1,279 @@
+"""Bounded in-process time-series store — the observatory's history layer.
+
+Point-in-time telemetry (gauges, the 4096-record audit ring) answers
+"what is happening"; nothing answered "what was v5e utilization over the
+last hour". This store keeps a *bounded* history per metric series as a
+ring of fixed-width buckets at several resolutions simultaneously
+(multi-resolution rollup): every appended sample lands in the 1 s, 10 s
+and 60 s rings at once, each ring holding (count, sum, min, max) per
+bucket. Memory is O(series × Σ slots) and fixed at construction; a slot
+whose wall-clock bucket has aged past the ring's horizon is overwritten
+in place on the next append that maps to it — eviction IS the append,
+so there is no compaction pass and no allocation on the hot path beyond
+the sample's float box.
+
+``append`` is the hot path: it runs inside :meth:`Metrics.inc` /
+``set`` / ``observe`` for every family that opted into history
+(``Metrics.instrument``), so it is a handful of list index ops under
+one lock — gated ≤ ``TIMESERIES_APPEND_GATE_US`` by
+``hack/controlplane_bench.py`` and the ``timeline`` leg of
+``hack/obs_report.py``, the same discipline as the PR 8 audit-record
+gate.
+
+Snapshots are served from ``/debug/timeline?family=&series=&res=``
+(:meth:`TimeSeriesStore.render_json`, the ``/debug/audit`` param
+idiom). The store performs zero store/WAL I/O by construction — it
+never sees the API server at all.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+#: Hot-path budget for one append across all resolutions, microseconds.
+#: Mirrors hack/controlplane_bench.py's AUDIT_RECORD_GATE_US: history
+#: rides the Metrics hot path, so it must stay this cheap.
+TIMESERIES_APPEND_GATE_US = 5.0
+
+#: (bucket width seconds, slot count) per resolution — finest first.
+#: 1 s × 300 = 5 min of fine detail, 10 s × 360 = 1 h, 60 s × 240 = 4 h.
+DEFAULT_RESOLUTIONS: Tuple[Tuple[float, int], ...] = (
+    (1.0, 300),
+    (10.0, 360),
+    (60.0, 240),
+)
+
+#: Families the embedded operator mirrors into history by default
+#: (cli cmd_start). Curated: history costs one append per sample, so
+#: only the series a fleet dashboard actually plots ride along.
+DEFAULT_HISTORY_FAMILIES: Tuple[str, ...] = (
+    "cron_ticks_fired_total",
+    "cron_missed_runs_total",
+    "cron_jobs_pending",
+    "cron_deadline_hits_total",
+    "cron_deadline_misses_total",
+    "workload_tokens_per_s",
+    "workload_last_step_seconds",
+    "workload_mfu",
+    "fleet_utilization",
+    "fleet_placements_total",
+    "fleet_preemptions_total",
+    "fleet_rejections_total",
+    "fleet_backfills_total",
+)
+
+#: Default cap on distinct series — history memory must stay bounded
+#: even if a caller opts a high-cardinality family in.
+DEFAULT_MAX_SERIES = 256
+
+
+def _res_name(width: float) -> str:
+    return f"{width:g}s"
+
+
+class TimeSeriesStore:
+    """Thread-safe bounded multi-resolution ring store.
+
+    One entry per series; per resolution, five parallel fixed-length
+    lists (bucket index, count, sum, min, max). ``idx[slot] == -1``
+    marks a never-written slot; a written slot whose stored bucket
+    index differs from the incoming sample's is *stale* (its wall-clock
+    window scrolled off the ring) and is reset in place — the rollup /
+    eviction mechanic.
+    """
+
+    def __init__(
+        self,
+        resolutions: Tuple[Tuple[float, int], ...] = DEFAULT_RESOLUTIONS,
+        max_series: int = DEFAULT_MAX_SERIES,
+    ):
+        if not resolutions:
+            raise ValueError("need at least one (width, slots) resolution")
+        for width, slots in resolutions:
+            if width <= 0 or slots <= 0:
+                raise ValueError(
+                    f"invalid resolution ({width}, {slots}): width and "
+                    "slot count must be positive"
+                )
+        self.resolutions = tuple(
+            (float(w), int(n)) for w, n in sorted(resolutions)
+        )
+        self.max_series = max_series
+        self._lock = threading.Lock()
+        # series → list per resolution of [idx, count, sum, min, max]
+        # parallel lists (allocated once, on first sight of the series).
+        self._series: Dict[str, List[List[list]]] = {}
+        self.points_total = 0
+        #: Appends refused because max_series was reached (never silent).
+        self.series_dropped = 0
+
+    # ---- hot path ---------------------------------------------------------
+
+    def append(
+        self, series: str, value: float, ts: Optional[float] = None
+    ) -> bool:
+        """Record one sample into every resolution ring. O(1): a few
+        list index ops per resolution under the lock. Returns False iff
+        the series was refused (max_series cap)."""
+        if ts is None:
+            ts = time.time()
+        v = float(value)
+        with self._lock:
+            rings = self._series.get(series)
+            if rings is None:
+                if len(self._series) >= self.max_series:
+                    self.series_dropped += 1
+                    return False
+                rings = [
+                    [[-1] * n, [0] * n, [0.0] * n, [0.0] * n, [0.0] * n]
+                    for _w, n in self.resolutions
+                ]
+                self._series[series] = rings
+            for (width, slots), (idx, cnt, tot, lo, hi) in zip(
+                self.resolutions, rings
+            ):
+                b = int(ts // width)
+                s = b % slots
+                if idx[s] != b:
+                    # New (or scrolled-past) bucket: overwrite in place.
+                    idx[s] = b
+                    cnt[s] = 1
+                    tot[s] = v
+                    lo[s] = v
+                    hi[s] = v
+                else:
+                    cnt[s] += 1
+                    tot[s] += v
+                    if v < lo[s]:
+                        lo[s] = v
+                    if v > hi[s]:
+                        hi[s] = v
+            self.points_total += 1
+        return True
+
+    # ---- reading ----------------------------------------------------------
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def families(self) -> List[str]:
+        with self._lock:
+            return sorted({s.split("{", 1)[0] for s in self._series})
+
+    def resolution_names(self) -> List[str]:
+        return [_res_name(w) for w, _n in self.resolutions]
+
+    def _resolve_res(self, res: Optional[str]) -> Tuple[float, int]:
+        if res is None:
+            return self.resolutions[0]
+        wanted = res.strip().lower().rstrip("s")
+        for width, slots in self.resolutions:
+            if f"{width:g}" == wanted or _res_name(width) == res:
+                return (width, slots)
+        raise KeyError(
+            f"unknown resolution {res!r}; have "
+            f"{', '.join(self.resolution_names())}"
+        )
+
+    def snapshot(
+        self,
+        series: str,
+        res: Optional[str] = None,
+        *,
+        now: Optional[float] = None,
+        limit: Optional[int] = None,
+    ) -> List[Dict[str, Any]]:
+        """Live buckets of one series at one resolution, oldest first.
+
+        Each point: ``{"t": bucket start epoch s, "count", "sum",
+        "min", "max", "mean"}``. Buckets older than the ring horizon
+        (relative to ``now``) are excluded even if their slot has not
+        been overwritten yet, so a quiet series does not resurface
+        ancient data. ``limit`` keeps the newest points.
+        """
+        width, slots = self._resolve_res(res)
+        ri = self.resolutions.index((width, slots))
+        with self._lock:
+            rings = self._series.get(series)
+            if rings is None:
+                return []
+            idx, cnt, tot, lo, hi = (list(a) for a in rings[ri])
+        if now is None:
+            now = time.time()
+        horizon = int(now // width) - slots + 1
+        pts = [
+            {
+                "t": b * width,
+                "count": cnt[s],
+                "sum": round(tot[s], 6),
+                "min": lo[s],
+                "max": hi[s],
+                "mean": round(tot[s] / cnt[s], 6) if cnt[s] else 0.0,
+            }
+            for s, b in enumerate(idx)
+            if b >= 0 and b >= horizon
+        ]
+        pts.sort(key=lambda p: p["t"])
+        if limit is not None and limit >= 0:
+            pts = pts[-limit:]
+        return pts
+
+    def render_json(
+        self, params: Optional[Dict[str, List[str]]] = None
+    ) -> str:
+        """JSON body for ``/debug/timeline``. ``params`` is a parsed
+        query string (``urllib.parse.parse_qs`` shape): ``family``
+        (every series of the family), ``series`` (one exact series),
+        ``res`` (bucket width, e.g. ``10s`` — default the finest), and
+        ``limit`` (newest points per series, default 256)."""
+        params = params or {}
+
+        def one(name: str) -> Optional[str]:
+            vals = params.get(name)
+            return vals[0] if vals else None
+
+        try:
+            limit = int(one("limit") or 256)
+        except ValueError:
+            limit = 256
+        res = one("res")
+        family = one("family")
+        series = one("series")
+        try:
+            width, _slots = self._resolve_res(res)
+        except KeyError as err:
+            return json.dumps({"error": str(err)}, indent=2)
+        if series is not None:
+            names = [series]
+        elif family is not None:
+            names = [
+                s for s in self.series_names()
+                if s.split("{", 1)[0] == family
+            ]
+        else:
+            names = self.series_names()
+        body = {
+            "resolutions": self.resolution_names(),
+            "res": _res_name(width),
+            "points_total": self.points_total,
+            "series_count": len(self.series_names()),
+            "series_dropped": self.series_dropped,
+            "series": {
+                name: self.snapshot(name, res, limit=limit)
+                for name in names
+            },
+        }
+        return json.dumps(body, indent=2, default=str)
+
+
+__all__ = [
+    "DEFAULT_HISTORY_FAMILIES",
+    "DEFAULT_MAX_SERIES",
+    "DEFAULT_RESOLUTIONS",
+    "TIMESERIES_APPEND_GATE_US",
+    "TimeSeriesStore",
+]

@@ -19,11 +19,16 @@ Model-specific params documented per entrypoint.
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Dict, Iterator, Optional
 
 import jax
 
 from cron_operator_tpu.backends.registry import JobContext, register_entrypoint
+from cron_operator_tpu.backends.tpu import (
+    ANNOTATION_ACCELERATOR,
+    peak_flops_per_chip,
+)
 from cron_operator_tpu.models import (
     GPT,
     GPTConfig,
@@ -224,6 +229,45 @@ def _run(
     profile_dir = ctx.params.get("profile_dir")
     profiling = [False]
     window = [0.0, 0]  # wall time and step count since the last synced step
+    # Bounded per-run profiler timeline: one entry per dispatch with the
+    # phase breakdown Trainer.step measured (data / host dispatch /
+    # device sync / checkpoint stall). The newest param.timeline_steps
+    # (=64) entries ride in trainingProgress; longer history belongs to
+    # the /debug/timeline store.
+    timeline: deque = deque(
+        maxlen=max(1, int(ctx.params.get("timeline_steps", 64) or 64))
+    )
+    # Rolling MFU estimator (ROADMAP item 5). Opt-in via param.mfu=1:
+    # the FLOPs numerator (Trainer.flops_per_step) re-lowers and
+    # re-compiles the step once, at the first synced step. Denominator:
+    # peak per-chip FLOPs from the slice's accelerator family — the
+    # numerator is a per-device post-partitioning count, so the ratio
+    # needs no device-count factor. param.peak_flops_per_chip overrides
+    # for CPU/bench runs where no TPU family applies.
+    mfu_flops = [None]  # type: list
+    mfu_on = str(ctx.params.get("mfu", "0")).lower() in ("1", "true")
+    peak_per_chip: Optional[float] = None
+    if mfu_on:
+        try:
+            if ctx.params.get("peak_flops_per_chip"):
+                peak_per_chip = float(ctx.params["peak_flops_per_chip"])
+            else:
+                spec = getattr(ctx, "slice_spec", None)
+                accel = spec.accelerator if spec is not None else (
+                    (ctx.job.get("metadata") or {}).get("annotations") or {}
+                ).get(ANNOTATION_ACCELERATOR, "")
+                peak_per_chip = peak_flops_per_chip(accel)
+        except (TypeError, ValueError):
+            peak_per_chip = None
+
+    def _mfu(step_avg_s: float) -> Optional[float]:
+        if not (mfu_on and peak_per_chip and step_avg_s > 0):
+            return None
+        if mfu_flops[0] is None:
+            mfu_flops[0] = trainer.flops_per_step() or 0.0
+        if not mfu_flops[0]:
+            return None
+        return round(mfu_flops[0] / (step_avg_s * peak_per_chip), 4)
 
     def on_step(s: StepStats) -> None:
         # Key-presence, not step equality: with steps_per_call > 1 the
@@ -254,6 +298,16 @@ def _run(
                 except Exception as exc:  # noqa: BLE001
                     ctx.progress["profile_error"] = str(exc)
         ctx.progress["steps_done"] = s.step
+        timeline.append({
+            "step": s.step,
+            "t": round(time.monotonic() - started_mono, 4),
+            "step_s": round(s.step_time_s, 6),
+            "data_s": round(s.data_s, 6),
+            "dispatch_s": round(s.dispatch_s, 6),
+            "device_s": round(s.sync_s, 6),
+            "ckpt_s": round(s.ckpt_s, 6),
+            "compile": s.compiled,
+        })
         # Under sync_every > 1, async steps record dispatch-only times and
         # the next synced step absorbs the whole window's device work —
         # neither is a per-step time by itself, so publish the window
@@ -270,6 +324,12 @@ def _run(
                 ctx.progress["tokens_per_s"] = round(
                     tokens_per_step / win_avg, 1
                 )
+            if not s.compiled:
+                # Rolling MFU over the synced window; the compile-laden
+                # first call would report a meaningless near-zero value.
+                mfu = _mfu(win_avg)
+                if mfu is not None:
+                    ctx.progress["mfu"] = mfu
             window[0], window[1] = 0.0, 0
         if step_delay_s:
             time.sleep(step_delay_s)
@@ -278,6 +338,7 @@ def _run(
             first_call or now - last_publish[0] > 1.0
         ):
             last_publish[0] = now
+            ctx.progress["step_timeline"] = list(timeline)
             ctx.publish()
 
     try:
@@ -294,6 +355,8 @@ def _run(
             # Orbax managers own background threads; a long-lived executor
             # runs many ticks, so every store must be released.
             trainer.checkpoint.close()
+    if timeline:
+        ctx.progress["step_timeline"] = list(timeline)
     # Steady-state throughput: drop the compile-laden first call.
     # Chunk-weighted: step_time_s is per-step, chunks can be non-uniform.
     tail = stats[1:] if len(stats) > 1 else stats
@@ -305,6 +368,10 @@ def _run(
         if tokens_per_step and avg > 0:
             # Steady-state throughput (compile-laden first call excluded).
             ctx.progress["tokens_per_s"] = round(tokens_per_step / avg, 1)
+        mfu = _mfu(avg)
+        if mfu is not None:
+            # Final steady-state MFU (same tail average as steps_per_s).
+            ctx.progress["mfu"] = mfu
     # Dispatch-health diagnostic: async (non-synced) calls record pure
     # dispatch wall time (× chunk to undo the per-step normalization —
     # the DISPATCH is what the link taxes, however many steps it

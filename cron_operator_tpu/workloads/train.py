@@ -162,6 +162,16 @@ class StepStats:
     loss: Optional[float]  # None on async (non-synced) steps
     step_time_s: float  # PER-STEP (dispatch wall / chunk)
     chunk: int = 1  # optimizer steps this dispatch carried
+    # Phase breakdown of the dispatch (whole-chunk walls, seconds) —
+    # the profiler-timeline inputs. data = host put_batch, dispatch =
+    # jitted-call return (host work + queueing), sync = device wait for
+    # the loss (0.0 on async steps), ckpt = checkpoint-save stall
+    # (charged after the dispatch, excluded from step_time_s).
+    data_s: float = 0.0
+    dispatch_s: float = 0.0
+    sync_s: float = 0.0
+    ckpt_s: float = 0.0
+    compiled: bool = False  # this dispatch traced+compiled (first call)
 
 
 class Trainer:
@@ -367,15 +377,18 @@ class Trainer:
         TrainConfig.steps_per_call). ``step_time_s`` is normalized PER
         STEP (dispatch wall / chunk) so throughput math is
         chunk-agnostic; ``loss`` is the chunk's last step's."""
+        compiled = self.first_dispatch_time_s is None
         t0 = time.perf_counter()
-        self.state, loss = self._stepper(chunk)(
-            self.state, self.put_batch(batch)
-        )
+        device_batch = self.put_batch(batch)
+        t_data = time.perf_counter()
+        self.state, loss = self._stepper(chunk)(self.state, device_batch)
+        t_disp = time.perf_counter()
         # Blocking keeps the step-time numbers honest; sync=False lets the
         # caller amortize the round trip (see TrainConfig.sync_every).
         loss = float(loss) if sync else None
         wall = time.perf_counter() - t0
-        if self.first_dispatch_time_s is None:
+        sync_s = time.perf_counter() - t_disp if sync else 0.0
+        if compiled:
             # Compile-laden by construction: a fresh process always traces
             # + compiles on its first dispatch (even after checkpoint
             # resume), so this wall time IS the compile measurement —
@@ -384,6 +397,7 @@ class Trainer:
             self.first_dispatch_time_s = wall
         before = self.steps_done
         self.steps_done += chunk
+        ckpt_s = 0.0
         if (
             self.checkpoint is not None
             and self.config.save_every > 0
@@ -391,11 +405,18 @@ class Trainer:
             and self.steps_done // self.config.save_every
             > before // self.config.save_every
         ):
+            t_ckpt = time.perf_counter()
             self.checkpoint.save(self.steps_done, self.state)
+            ckpt_s = time.perf_counter() - t_ckpt
         return StepStats(
             self.steps_done, loss,
             wall / max(1, chunk),
             chunk=max(1, chunk),
+            data_s=t_data - t0,
+            dispatch_s=t_disp - t_data,
+            sync_s=sync_s,
+            ckpt_s=ckpt_s,
+            compiled=compiled,
         )
 
     def run(

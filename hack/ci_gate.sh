@@ -119,9 +119,14 @@ python hack/chaos_soak.py --seed 7 --crons 40 --rounds 3 \
 echo "==> observability report smoke (flight recorder + SLO verdict, fast legs)"
 # Fast legs of the goodput/SLO report (hack/obs_report.py): a simulated
 # fire+resume scenario whose audit journal must reconcile exactly against
-# the WAL (I9's audit ≡ WAL check), plus the scheduling-SLO leg; --check
-# skips the real-training goodput leg and fails the gate on any
-# REGRESSION verdict. Full report: make obs-report (writes BENCH_OBS.json).
+# the WAL (I9's audit ≡ WAL check), the scheduling-SLO leg, and the PR 11
+# observatory legs — timeline (history append gated <= 5µs, counter
+# history == live counter), deadline_slo (hit-rate floor + rv-bracketed
+# zero-store-write proof), utilization (busy <= capacity chip-seconds on
+# a simulated fleet) and mfu_timeline (step-phase timeline + MFU on a
+# real CPU training run); --check skips the real-training goodput leg
+# and fails the gate on any REGRESSION verdict. Full report:
+# make obs-report (writes BENCH_OBS.json).
 python hack/obs_report.py --check --out /dev/null >/dev/null
 
 echo "==> HTTP front-door smoke (fan-out encode-once, group-commit, APF fairness)"

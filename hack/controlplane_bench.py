@@ -407,6 +407,50 @@ def _audit_microbench(repeat: int = 500) -> dict:
         shutil.rmtree(data_dir, ignore_errors=True)
 
 
+def _timeseries_microbench(repeat: int = 500) -> dict:
+    """History-layer overhead: one ``TimeSeriesStore.append`` (which a
+    history-opted ``Metrics.inc``/``set`` pays per sample, across ALL
+    resolution rings) — gated at ``TIMESERIES_APPEND_GATE_US``, the same
+    discipline as the audit-record gate above. Also times the full
+    instrumented ``Metrics.set`` for the end-to-end per-sample cost."""
+    try:
+        from cron_operator_tpu.telemetry.timeseries import (
+            TIMESERIES_APPEND_GATE_US,
+            TimeSeriesStore,
+        )
+    except ImportError:  # baseline trees predate the observatory
+        return {}
+    from cron_operator_tpu.runtime.manager import Metrics
+
+    store = TimeSeriesStore()
+    tick = [0.0]
+
+    def _append_once():
+        tick[0] += 0.01
+        store.append("fleet_utilization", 0.5, ts=tick[0])
+
+    append_us = min(_time_calls(_append_once, repeat) for _ in range(3))
+    assert append_us <= TIMESERIES_APPEND_GATE_US, (
+        f"timeseries append() hot path costs {append_us:.2f}µs/sample "
+        f"(gate: {TIMESERIES_APPEND_GATE_US}µs)"
+    )
+
+    metrics = Metrics()
+    metrics.instrument(TimeSeriesStore(), families=("fleet_utilization",))
+
+    def _set_once():
+        metrics.set("fleet_utilization", 0.5)
+
+    instrumented_set_us = min(
+        _time_calls(_set_once, repeat) for _ in range(3)
+    )
+    return {
+        "timeseries_append_us": round(append_us, 2),
+        "timeseries_append_gate_us": TIMESERIES_APPEND_GATE_US,
+        "instrumented_gauge_set_us": round(instrumented_set_us, 2),
+    }
+
+
 def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
     from datetime import timedelta
     from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
@@ -497,6 +541,7 @@ def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
     write_us = _write_microbench(api)
     write_us.update(_wal_microbench())
     write_us.update(_audit_microbench())
+    write_us.update(_timeseries_microbench())
     api.close()
 
     storm = storm_best_of(n_crons, sweep_timeout_s)

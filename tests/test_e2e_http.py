@@ -697,6 +697,107 @@ class TestStartDebugEndpoints:
         assert any(r["event"] == "tick_fired" for r in lines)
         assert any(r["kind"] == "store" for r in lines)
 
+    def test_start_serves_timeline_and_fleet_observatory(self, tmp_path):
+        """/debug/timeline (bounded metric history) and /debug/fleet
+        (derived utilization/deadline accounting) through the live
+        start path, with a fleet pool so the observatory has capacity
+        books to sample; shutdown persists the observatory rollup and
+        the throughput-matrix sidecar into --data-dir."""
+        import json
+        import threading
+
+        from cron_operator_tpu.cli.main import main as cli_main
+
+        manifest = tmp_path / "cron.yaml"
+        manifest.write_text(json.dumps({
+            "apiVersion": "apps.kubedl.io/v1alpha1", "kind": "Cron",
+            "metadata": {"name": "obs-fleet", "namespace": "default"},
+            "spec": {
+                "schedule": "@every 1s",
+                "template": {"workload": {
+                    "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+                    "metadata": {"annotations": {
+                        "tpu.kubedl.io/simulate-duration": "50ms",
+                    }},
+                    "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+                }},
+            },
+        }))
+        port = self._free_port()
+        rc = []
+        t = threading.Thread(
+            target=lambda: rc.append(cli_main([
+                "start",
+                "--metrics-bind-address", f":{port}",
+                "--metrics-secure=false",
+                "--health-probe-bind-address", "0",
+                "--data-dir", str(tmp_path / "state"),
+                "--fleet-pool", "cpu=2",
+                "--load", str(manifest),
+                "--run-for", "6",
+            ])),
+            daemon=True,
+        )
+        t.start()
+
+        def _history():
+            try:
+                doc = self._get_json(
+                    port,
+                    "/debug/timeline?family=cron_ticks_fired_total&res=1s",
+                )
+            except Exception:
+                return None
+            pts = doc["series"].get("cron_ticks_fired_total") or []
+            return doc if pts else None
+
+        timeline = wait_for(_history, timeout=15.0,
+                            message="tick history on /debug/timeline")
+        assert timeline["res"] == "1s"
+        assert set(timeline["resolutions"]) >= {"1s", "10s", "60s"}
+        pts = timeline["series"]["cron_ticks_fired_total"]
+        # Counters mirror their cumulative total: history max is the
+        # live counter value so far, and never decreases across buckets.
+        assert all(p["count"] >= 1 for p in pts)
+        assert pts[-1]["max"] >= 1.0
+
+        def _fleet():
+            try:
+                doc = self._get_json(port, "/debug/fleet")
+            except Exception:
+                return None
+            # Wait until the fired ticks show up in deadline accounting.
+            if doc["observatory"]["deadline_slo"]["hits"] < 1:
+                return None
+            return doc
+
+        fleet_doc = wait_for(_fleet, timeout=15.0,
+                             message="deadline accounting on /debug/fleet")
+        obs = fleet_doc["observatory"]
+        assert obs["deadline_slo"]["hit_rate"] > 0
+        assert "default/obs-fleet" in obs["deadline_slo"]["per_cron"]
+        assert fleet_doc["pool"]["cpu"]["count"] == 2
+        assert fleet_doc["fleet"]["policy"] == "hetero"
+        util = fleet_doc["observatory"]["utilization"]
+        assert all(
+            0.0 <= row["utilization"] <= 1.0 for row in util.values()
+        )
+
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert rc == [0]
+
+        # Shutdown rolled up accounting history and saved the matrix
+        # sidecar for the next boot.
+        rollup = tmp_path / "state" / "observatory.jsonl"
+        assert rollup.exists()
+        lines = [json.loads(line) for line in
+                 rollup.read_text().splitlines() if line.strip()]
+        assert lines and "deadline_slo" in lines[-1]
+        matrix = tmp_path / "state" / "fleet_matrix.json"
+        assert matrix.exists()
+        assert "rates" in json.loads(matrix.read_text())
+
 
 class TestServedAPITLS:
     """HTTPS on the served API (the reference webhook-server cert

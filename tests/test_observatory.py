@@ -1,0 +1,258 @@
+"""Fleet observatory (telemetry/observatory.py): deadline-SLO accounting
+folded from synthetic audit records, queue-wait distributions per
+priority class, utilization integration over explicit fleet samples, the
+rv-bracketed zero-store-write property, JSONL rollups + hooks, and the
+ThroughputMatrix save/load sidecar round-trip."""
+
+from __future__ import annotations
+
+import json
+
+from cron_operator_tpu.runtime.fleet import (
+    FleetScheduler,
+    ThroughputMatrix,
+    parse_pool,
+)
+from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.telemetry import AuditJournal, FleetObservatory
+
+CRON_KEY = "apps.kubedl.io/v1alpha1/Cron/default/demo"
+
+
+def _wired(**kw):
+    m = Metrics()
+    j = AuditJournal(metrics=m)
+    obs = FleetObservatory(metrics=m, **kw)
+    j.attach_observer(obs.on_record)
+    return m, j, obs
+
+
+def _job(name, wclass="w", tenant=None, priority=None):
+    ann = {"tpu.kubedl.io/workload-class": wclass}
+    if tenant is not None:
+        ann["tpu.kubedl.io/tenant"] = tenant
+    if priority is not None:
+        ann["tpu.kubedl.io/priority"] = str(priority)
+    return {
+        "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+        "metadata": {
+            "namespace": "default", "name": name, "annotations": ann,
+        },
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+    }
+
+
+class TestDeadlineSLO:
+    def test_tick_fired_hit_and_miss_by_lateness(self):
+        m, j, obs = _wired()
+        j.record("decision", "tick_fired", key=CRON_KEY,
+                 cron="default/demo", lateness_s=2.0, deadline_s=30.0)
+        j.record("decision", "tick_fired", key=CRON_KEY,
+                 cron="default/demo", lateness_s=10.0, deadline_s=30.0)
+        j.record("decision", "tick_fired", key=CRON_KEY,
+                 cron="default/demo", lateness_s=45.0, deadline_s=30.0)
+        slo = obs.report()["deadline_slo"]
+        assert slo["hits"] == 2 and slo["misses"] == 1
+        assert slo["hit_rate"] == round(2 / 3, 4)
+        per = slo["per_cron"]["default/demo"]
+        assert per["lateness_p50_s"] == 10.0
+        assert per["lateness_p99_s"] == 45.0
+        assert m.get("cron_deadline_hits_total") == 2
+        assert m.get("cron_deadline_misses_total") == 1
+
+    def test_no_deadline_configured_is_always_a_hit(self):
+        _m, j, obs = _wired()
+        j.record("decision", "tick_fired", key=CRON_KEY,
+                 cron="default/demo", lateness_s=1e6, deadline_s=None)
+        slo = obs.report()["deadline_slo"]
+        assert slo == dict(slo, hits=1, misses=0, hit_rate=1.0)
+
+    def test_starting_deadline_skip_is_a_miss_policy_skips_are_not(self):
+        m, j, obs = _wired()
+        j.record("decision", "tick_skipped", key=CRON_KEY,
+                 reason="StartingDeadline", cron="default/demo",
+                 lateness_s=90.0, deadline_s=30.0)
+        j.record("decision", "tick_skipped", key=CRON_KEY,
+                 reason="Forbid", cron="default/demo")
+        j.record("decision", "tick_skipped", key=CRON_KEY,
+                 reason="Replace", cron="default/demo")
+        slo = obs.report()["deadline_slo"]
+        assert slo["hits"] == 0 and slo["misses"] == 1
+        assert m.get("cron_deadline_misses_total") == 1
+
+    def test_fleet_shed_is_a_miss(self):
+        m, j, obs = _wired()
+        j.record("decision", "tick_shed", key=CRON_KEY,
+                 reason="FleetQueueFull", cron="default/demo",
+                 lateness_s=1.5, deadline_s=None)
+        slo = obs.report()["deadline_slo"]
+        assert slo["misses"] == 1
+        assert m.get("cron_deadline_misses_total") == 1
+
+    def test_cron_identity_falls_back_to_record_key(self):
+        _m, j, obs = _wired()
+        j.record("decision", "tick_fired", key=CRON_KEY, lateness_s=0.1)
+        assert "default/demo" in obs.report()["deadline_slo"]["per_cron"]
+
+    def test_non_decision_kinds_and_other_events_ignored(self):
+        _m, j, obs = _wired()
+        j.record("store", "create", key=CRON_KEY, wal_pos=1, rv=1)
+        j.record("decision", "job_created", key=CRON_KEY)
+        j.record("cluster", "lease_acquired")
+        assert obs.records_seen == 0
+
+    def test_slo_table_is_bounded(self):
+        _m, j, obs = _wired(max_crons=2)
+        for i in range(4):
+            j.record("decision", "tick_fired",
+                     cron=f"default/cron-{i}", lateness_s=0.0)
+        report = obs.report()["deadline_slo"]
+        assert len(report["per_cron"]) == 2
+        assert obs._slo_dropped == 2
+
+
+class TestQueueWait:
+    def test_distributions_bucketed_by_priority_class(self):
+        _m, j, obs = _wired()
+        for wait in (0.1, 0.2, 0.3):
+            j.record("decision", "fleet_dispatch",
+                     key="default/wl", queue_wait_s=wait, priority=50)
+        j.record("decision", "fleet_dispatch",
+                 key="default/wl", queue_wait_s=4.0, priority=-50)
+        j.record("decision", "fleet_dispatch",
+                 key="default/wl", queue_wait_s=1.0)  # no priority → normal
+        waits = obs.report()["queue_wait_s"]
+        assert set(waits) == {"high", "batch", "normal"}
+        assert waits["high"]["count"] == 3
+        assert waits["high"]["max_s"] == 0.3
+        assert waits["batch"]["p50_s"] == 4.0
+        assert waits["normal"]["count"] == 1
+
+    def test_garbage_wait_and_priority_tolerated(self):
+        _m, j, obs = _wired()
+        j.record("decision", "fleet_dispatch",
+                 key="default/wl", queue_wait_s="soon", priority="urgent")
+        j.record("decision", "fleet_dispatch", key="default/wl")
+        assert obs.report()["queue_wait_s"].get("normal", {}) \
+            .get("count", 0) == 0
+
+
+class TestUtilization:
+    def test_integrated_chip_seconds_on_simulated_fleet(self):
+        m = Metrics()
+        obs = FleetObservatory(metrics=m)
+        fs = FleetScheduler(
+            parse_pool("cpu=2"), api=None,
+            on_create=lambda wl, slice_type: None, metrics=m,
+        )
+        obs.attach_fleet(fs)
+        obs.sample_fleet(now_mono=0.0)  # baseline: no interval yet
+        assert fs.submit(_job("busy-0")).action == "placed"
+        obs.sample_fleet(now_mono=10.0)  # 1 of 2 slices busy for 10 s
+        assert fs.release("default", "busy-0")
+        obs.sample_fleet(now_mono=20.0)  # idle for the next 10 s
+        util = obs.report()["utilization"]["cpu"]
+        assert util["busy_chip_s"] == 10.0
+        assert util["capacity_chip_s"] == 40.0
+        assert util["utilization"] == 0.25
+        assert m.get('fleet_utilization{slice_type="cpu"}') == 0.0
+
+    def test_sample_without_fleet_is_a_noop(self):
+        obs = FleetObservatory()
+        obs.sample_fleet(now_mono=1.0)
+        assert obs.report()["utilization"] == {}
+
+
+class TestZeroStoreWrites:
+    def test_report_rollup_render_leave_rv_untouched(self, tmp_path):
+        # The observatory folds records the store already audited; its
+        # whole read/report/rollup surface must add zero store writes.
+        m = Metrics()
+        api = APIServer()
+        j = AuditJournal(metrics=m)
+        api.attach_audit(j)
+        obs = FleetObservatory(metrics=m, data_dir=str(tmp_path))
+        j.attach_observer(obs.on_record)
+        api.create(_job("seed-0"))
+        api.create(_job("seed-1"))
+        j.record("decision", "tick_fired", key=CRON_KEY,
+                 cron="default/demo", lateness_s=0.2, deadline_s=30.0)
+        rv_before = api._rv
+        obs.report()
+        obs.rollup(now=123.0)
+        obs.render_json()
+        assert api._rv == rv_before
+        assert obs.report()["deadline_slo"]["hits"] == 1
+
+
+class TestRollups:
+    def test_jsonl_lines_counter_and_hooks(self, tmp_path):
+        m, j, obs = _wired(data_dir=str(tmp_path))
+        j.record("decision", "tick_fired",
+                 cron="default/demo", lateness_s=0.0)
+        fired = []
+        obs.add_rollup_hook(lambda: fired.append(1))
+        obs.add_rollup_hook(lambda: 1 / 0)  # broken hook is swallowed
+        path = obs.rollup(now=1000.0)
+        assert path == str(tmp_path / "observatory.jsonl")
+        assert obs.rollup(now=2000.0) == path
+        lines = [json.loads(ln) for ln in
+                 open(path, encoding="utf-8").read().splitlines()]
+        assert [ln["ts"] for ln in lines] == [1000.0, 2000.0]
+        assert lines[-1]["deadline_slo"]["hits"] == 1
+        assert lines[-1]["rollups_total"] == 1  # snapshot before bump
+        assert fired == [1, 1]
+        assert obs.rollups_total == 2
+        assert m.get("observatory_rollups_total") == 2
+
+    def test_no_data_dir_still_counts(self):
+        m, _j, obs = _wired()
+        assert obs.rollup() is None
+        assert obs.rollups_total == 1
+        assert m.get("observatory_rollups_total") == 1
+
+
+class TestRenderJson:
+    def test_body_includes_fleet_books_when_attached(self):
+        m, j, obs = _wired()
+        fs = FleetScheduler(
+            parse_pool("cpu=2"), api=None,
+            on_create=lambda wl, slice_type: None, metrics=m,
+        )
+        obs.attach_fleet(fs)
+        j.record("decision", "tick_fired",
+                 cron="default/demo", lateness_s=0.0)
+        body = json.loads(obs.render_json())
+        assert body["observatory"]["deadline_slo"]["hits"] == 1
+        assert body["fleet"]["policy"] == "hetero"
+        assert body["pool"]["cpu"] == {"count": 2, "chips": 1}
+        assert "throughput_matrix" in body
+
+    def test_body_without_fleet_is_observatory_only(self):
+        _m, _j, obs = _wired()
+        body = json.loads(obs.render_json())
+        assert set(body) == {"observatory"}
+
+
+class TestMatrixSidecar:
+    def test_save_load_round_trip(self, tmp_path):
+        path = str(tmp_path / "fleet_matrix.json")
+        matrix = ThroughputMatrix(seed={("w1", "v5e-16"): 10.0})
+        matrix.observe("w2", "cpu", 4.0)
+        matrix.save(path)
+        seed = ThroughputMatrix.load_seed(path)
+        assert seed == {("w1", "v5e-16"): 10.0, ("w2", "cpu"): 4.0}
+        reborn = ThroughputMatrix(seed=seed)
+        assert reborn.snapshot() == matrix.snapshot()
+
+    def test_load_missing_or_corrupt_starts_cold(self, tmp_path):
+        assert ThroughputMatrix.load_seed(
+            str(tmp_path / "nope.json")
+        ) is None
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert ThroughputMatrix.load_seed(str(bad)) is None
+        empty = tmp_path / "empty.json"
+        empty.write_text(json.dumps({"alpha": 0.25, "rates": {}}))
+        assert ThroughputMatrix.load_seed(str(empty)) is None

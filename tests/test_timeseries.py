@@ -1,0 +1,230 @@
+"""Bounded time-series store (telemetry/timeseries.py): append/snapshot
+correctness, multi-resolution rollup, ring eviction (the slot overwrite
+that IS the eviction pass), horizon exclusion for quiet series, the
+max_series bound, the ``/debug/timeline`` JSON surface, and the
+``Metrics.instrument`` history mirror (counters → cumulative totals,
+gauges → set values, histograms → raw observations, family filtering,
+``remove_series`` GC)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.telemetry.timeseries import (
+    DEFAULT_HISTORY_FAMILIES,
+    DEFAULT_RESOLUTIONS,
+    TimeSeriesStore,
+)
+
+
+class TestAppendSnapshot:
+    def test_bucket_aggregates(self):
+        ts = TimeSeriesStore()
+        assert ts.append("m", 2.0, ts=100.2)
+        assert ts.append("m", 6.0, ts=100.7)
+        assert ts.append("m", 1.0, ts=101.1)
+        pts = ts.snapshot("m", "1s", now=102.0)
+        assert [p["t"] for p in pts] == [100.0, 101.0]
+        first = pts[0]
+        assert first["count"] == 2
+        assert first["sum"] == 8.0
+        assert first["min"] == 2.0
+        assert first["max"] == 6.0
+        assert first["mean"] == 4.0
+        assert pts[1]["count"] == 1
+        assert ts.points_total == 3
+
+    def test_unknown_series_is_empty(self):
+        assert TimeSeriesStore().snapshot("nope") == []
+
+    def test_multi_resolution_rollup(self):
+        # One pass of appends lands in every ring at once; the coarse
+        # rings aggregate what the fine ring splits across buckets.
+        ts = TimeSeriesStore()
+        for i in range(60):
+            ts.append("m", float(i + 1), ts=1000.0 + i)
+        fine = ts.snapshot("m", "1s", now=1059.0)
+        assert len(fine) == 60
+        assert all(p["count"] == 1 for p in fine)
+        mid = ts.snapshot("m", "10s", now=1059.0)
+        assert len(mid) == 6
+        assert all(p["count"] == 10 for p in mid)
+        coarse = ts.snapshot("m", "60s", now=1059.0)
+        # 1000..1059 straddles the 960/1020 bucket edge.
+        assert len(coarse) == 2
+        assert sum(p["count"] for p in coarse) == 60
+        assert sum(p["sum"] for p in coarse) == sum(range(1, 61))
+        assert max(p["max"] for p in coarse) == 60.0
+        assert min(p["min"] for p in coarse) == 1.0
+
+    def test_downsample_mean(self):
+        ts = TimeSeriesStore(resolutions=((60.0, 4),))
+        for i in range(60):
+            ts.append("m", float(i + 1), ts=float(i))
+        (pt,) = ts.snapshot("m", "60s", now=59.0)
+        assert pt["count"] == 60
+        assert pt["sum"] == 1830.0
+        assert pt["mean"] == 30.5
+
+    def test_snapshot_limit_keeps_newest(self):
+        ts = TimeSeriesStore()
+        for i in range(10):
+            ts.append("m", 1.0, ts=100.0 + i)
+        pts = ts.snapshot("m", "1s", now=109.0, limit=3)
+        assert [p["t"] for p in pts] == [107.0, 108.0, 109.0]
+
+
+class TestRingEviction:
+    def test_scrolled_slot_overwritten_in_place(self):
+        ts = TimeSeriesStore(resolutions=((1.0, 4),))
+        for i in range(4):
+            ts.append("m", float(i), ts=float(i))
+        assert [p["t"] for p in ts.snapshot("m", now=3.0)] == [
+            0.0, 1.0, 2.0, 3.0,
+        ]
+        # ts=4 maps to slot 0 (4 % 4): bucket 0's aggregates are reset
+        # in place — eviction IS the append, no compaction pass.
+        ts.append("m", 42.0, ts=4.0)
+        pts = ts.snapshot("m", now=4.0)
+        assert [p["t"] for p in pts] == [1.0, 2.0, 3.0, 4.0]
+        assert pts[-1]["max"] == 42.0
+
+    def test_horizon_excludes_stale_quiet_buckets(self):
+        # A series that went quiet must not resurface buckets whose
+        # wall-clock window scrolled past the ring horizon, even though
+        # no later append overwrote their slots.
+        ts = TimeSeriesStore(resolutions=((1.0, 4),))
+        ts.append("m", 1.0, ts=0.0)
+        assert ts.snapshot("m", now=0.0)
+        assert ts.snapshot("m", now=100.0) == []
+
+    def test_max_series_refusal_is_counted(self):
+        ts = TimeSeriesStore(max_series=2)
+        assert ts.append("a", 1.0, ts=0.0)
+        assert ts.append("b", 1.0, ts=0.0)
+        assert not ts.append("c", 1.0, ts=0.0)
+        assert ts.series_dropped == 1
+        # Known series still accept after the cap is hit.
+        assert ts.append("a", 2.0, ts=1.0)
+        assert ts.series_names() == ["a", "b"]
+
+    def test_invalid_resolutions_rejected(self):
+        with pytest.raises(ValueError):
+            TimeSeriesStore(resolutions=())
+        with pytest.raises(ValueError):
+            TimeSeriesStore(resolutions=((0.0, 10),))
+        with pytest.raises(ValueError):
+            TimeSeriesStore(resolutions=((1.0, 0),))
+
+
+class TestResolutionsAndRender:
+    def test_resolution_names_and_resolve(self):
+        ts = TimeSeriesStore()
+        assert ts.resolution_names() == ["1s", "10s", "60s"]
+        assert ts._resolve_res(None) == DEFAULT_RESOLUTIONS[0]
+        assert ts._resolve_res("10s") == (10.0, 360)
+        assert ts._resolve_res("10") == (10.0, 360)
+        with pytest.raises(KeyError):
+            ts._resolve_res("7s")
+
+    def test_render_json_family_and_series_filters(self):
+        ts = TimeSeriesStore()
+        ts.append('cron_ticks_fired_total{shard="0"}', 1.0, ts=100.0)
+        ts.append('cron_ticks_fired_total{shard="1"}', 2.0, ts=100.0)
+        ts.append("cron_jobs_pending", 3.0, ts=100.0)
+        assert ts.families() == [
+            "cron_jobs_pending", "cron_ticks_fired_total",
+        ]
+        body = json.loads(ts.render_json(
+            {"family": ["cron_ticks_fired_total"]}
+        ))
+        assert set(body["series"]) == {
+            'cron_ticks_fired_total{shard="0"}',
+            'cron_ticks_fired_total{shard="1"}',
+        }
+        assert body["res"] == "1s"
+        assert body["resolutions"] == ["1s", "10s", "60s"]
+        assert body["points_total"] == 3
+        assert body["series_dropped"] == 0
+        body = json.loads(ts.render_json(
+            {"series": ["cron_jobs_pending"], "res": ["60s"]}
+        ))
+        assert list(body["series"]) == ["cron_jobs_pending"]
+        assert body["res"] == "60s"
+
+    def test_render_json_bad_res_is_an_error_body(self):
+        body = json.loads(TimeSeriesStore().render_json({"res": ["7s"]}))
+        assert "error" in body
+        assert "7s" in body["error"]
+
+    def test_render_json_bad_limit_falls_back(self):
+        # render_json snapshots against the wall clock, so the sample
+        # must be recent to sit inside the ring horizon.
+        ts = TimeSeriesStore()
+        ts.append("m", 1.0)
+        body = json.loads(ts.render_json({"limit": ["bogus"]}))
+        assert body["series"]["m"]
+
+
+class TestMetricsInstrument:
+    def test_counter_history_is_cumulative_total(self):
+        m, ts = Metrics(), TimeSeriesStore()
+        m.instrument(ts, families=["cron_ticks_fired_total"])
+        m.inc("cron_ticks_fired_total", 2.0)
+        m.inc("cron_ticks_fired_total", 3.0)
+        pts = ts.snapshot("cron_ticks_fired_total")
+        assert sum(p["count"] for p in pts) == 2
+        # History max equals the live counter — the bucket records the
+        # new cumulative total, not the per-call delta.
+        assert max(p["max"] for p in pts) == m.get(
+            "cron_ticks_fired_total"
+        ) == 5.0
+
+    def test_gauge_and_histogram_history(self):
+        m, ts = Metrics(), TimeSeriesStore()
+        m.instrument(ts)  # families=None opts every family in
+        m.set("workload_mfu", 0.41)
+        m.set("workload_mfu", 0.39)
+        pts = ts.snapshot("workload_mfu")
+        assert max(p["max"] for p in pts) == 0.41
+        assert min(p["min"] for p in pts) == 0.39
+        m.observe("cron_schedule_delay_seconds", 1.5)
+        m.observe("cron_schedule_delay_seconds", 0.5)
+        pts = ts.snapshot("cron_schedule_delay_seconds")
+        assert sum(p["count"] for p in pts) == 2
+        assert sum(p["sum"] for p in pts) == 2.0
+
+    def test_family_filter_applies_to_labeled_series(self):
+        m, ts = Metrics(), TimeSeriesStore()
+        m.instrument(ts, families=["fleet_utilization"])
+        m.set('fleet_utilization{slice_type="v5e-16"}', 0.75)
+        m.set("cron_jobs_pending", 4.0)  # not opted in
+        m.inc("audit_records_total")  # not opted in
+        assert ts.series_names() == [
+            'fleet_utilization{slice_type="v5e-16"}',
+        ]
+
+    def test_detach_stops_mirroring(self):
+        m, ts = Metrics(), TimeSeriesStore()
+        m.instrument(ts)
+        m.set("cron_jobs_pending", 1.0)
+        m.instrument(None)
+        m.set("cron_jobs_pending", 2.0)
+        pts = ts.snapshot("cron_jobs_pending")
+        assert sum(p["count"] for p in pts) == 1
+
+    def test_default_families_cover_fleet_and_deadline_series(self):
+        for fam in ("cron_deadline_hits_total", "cron_deadline_misses_total",
+                    "fleet_utilization", "workload_mfu"):
+            assert fam in DEFAULT_HISTORY_FAMILIES
+
+    def test_remove_series_gc(self):
+        m = Metrics()
+        wl = 'workload_tokens_per_s{workload="default/train-abc"}'
+        m.set(wl, 123.0)
+        assert m.remove_series(wl)
+        assert not m.remove_series(wl)  # already gone
+        assert wl not in m.render_prometheus()
