@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet chaos-soak chaos-soak-preempt obs-report
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt obs-report
 
 all: gate
 
@@ -86,6 +86,19 @@ bench-shards:
 # target on REGRESSION (the CI-gate leg).
 bench-fleet:
 	python hack/fleet_bench.py $(if $(CHECK),--check --stdout)
+
+# Step-speed benchmark (hack/step_bench.py -> BENCH_STEP.json): the
+# overlap-aware executor A/B — seed synchronous path (one dispatch per
+# step, inline staging) vs the default scan-chained + double-buffered
+# mode on the same MLP run, gated >= 1.3x samples/s with bit-exact
+# param parity; plus fused-vs-external, the timed_chain device-compute
+# floor, and a Bert-tiny flash-vs-XLA attention leg (tokens/s). CHECK=1
+# runs the CI smoke (small sizes, parity + nonzero-overlap asserts, no
+# artifact rewrite). SEED_MATRIX=<path> also writes the measured rates
+# as a fleet ThroughputMatrix seed sidecar (runtime/fleet.py load_seed).
+bench-step:
+	python hack/step_bench.py $(if $(CHECK),--check --stdout) \
+	    $(if $(SEED_MATRIX),--emit-matrix-seed $(SEED_MATRIX))
 
 # Seeded chaos soak: N Crons reconciled under a deterministic fault
 # schedule (conflicts, transient server errors, latency, submit

@@ -374,6 +374,8 @@ class LocalExecutor:
             "workload_tokens_per_s",
             "workload_last_step_seconds",
             "workload_mfu",
+            "workload_steps_per_call",
+            "workload_data_stall_ms",
         ):
             self.metrics.remove_series(f"{family}{wl}")
 
@@ -696,6 +698,16 @@ class LocalExecutor:
                 )
             if p.get("mfu") is not None:
                 self.metrics.set(f"workload_mfu{wl}", float(p["mfu"]))
+            if p.get("steps_per_call") is not None:
+                self.metrics.set(
+                    f"workload_steps_per_call{wl}",
+                    float(p["steps_per_call"]),
+                )
+            if p.get("data_stall_ms_p50") is not None:
+                self.metrics.set(
+                    f"workload_data_stall_ms{wl}",
+                    float(p["data_stall_ms_p50"]),
+                )
         first = p.get("first_step_at")
         if not first or key in self._telemetry_done:
             return

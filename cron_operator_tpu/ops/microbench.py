@@ -26,6 +26,67 @@ def _parse(argv):
     return out
 
 
+def timed_chain(chain_fn, carry, iters: int = 20, span_s: float = 0.5):
+    """Per-iteration time of ``chain_fn`` (carry → same-shaped carry)
+    with constant overhead subtracted out, or ``None`` when the
+    measurement is invalid (noise made the difference non-positive).
+
+    ONE compiled program — a jitted ``lax.scan`` of the chain, length
+    ``iters`` — is fed its own output k times per span (k and 2k), and
+    the report is (t_2k − t_k)/(k·iters). The device sync + tunnel
+    round-trip (~80 ms there — milliseconds of per-iter noise for a
+    dispatch-per-iteration loop, which timed the same kernel at
+    0.023 ms and 0.209 ms across runs) happens once per span and
+    cancels in the difference; the k async re-dispatches cost ~µs
+    each. k is calibrated so a span is ~``span_s``, dwarfing round-trip
+    jitter. Feeding outputs back as inputs keeps XLA from folding
+    repeats; compiling a single length keeps Mosaic compile time (a
+    seq-2048 fwd+bwd program is expensive) out of the bench budget.
+
+    THE chain-timing primitive: the attention/MoE legs below,
+    ``hack/step_bench.py``'s device-floor leg, and the thin
+    ``hack/mfu_probe.py`` / ``hack/mfu_attrib.py`` wrappers all share
+    this one implementation (they used to carry copies)."""
+    import jax
+    from jax import lax
+
+    run = jax.jit(lambda c: lax.scan(
+        lambda c, _: (chain_fn(c), None), c, None, length=iters
+    )[0])
+    out = run(carry)  # compile; value-fetch = true sync (see spanned)
+    float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+
+    def spanned(k):
+        best = float("inf")
+        for _ in range(3):  # best-of-3: min is the least-interference
+            c = carry       # estimate on a shared/tunneled device,
+            t0 = time.perf_counter()  # and differencing mins keeps
+            for _ in range(k):        # t_2k − t_k positive
+                c = run(c)
+            # A value fetch, not just block_until_ready: the tunneled
+            # PJRT client's block can return optimistically (observed:
+            # 1 ms for a ≥36 ms serial computation). Pulling one
+            # scalar forces true completion; its constant cost cancels
+            # in the t_2k − t_k difference.
+            float(jax.tree_util.tree_leaves(c)[0].ravel()[0])
+            best = min(best, time.perf_counter() - t0)
+        return best, c
+
+    # Calibration estimate must itself be overhead-free (a raw span/k
+    # estimate is RTT-inflated and sizes k smaller → coarser), so it
+    # is a two-span difference too.
+    t1, _ = spanned(1)
+    t2, _ = spanned(2)
+    per_block = max(t2 - t1, 1e-6)  # seconds per iters-length block
+    k = max(1, min(256, int(span_s / per_block)))
+    t_k, out = spanned(k)
+    t_2k, _ = spanned(2 * k)
+    diff = t_2k - t_k
+    if diff <= 0:  # interference beat the differencing: no number is
+        return None, out  # better than a garbage 0.0/∞-speedup one
+    return diff / (k * iters), out
+
+
 def main(argv=None) -> int:
     params = _parse(sys.argv[1:] if argv is None else argv)
     platform = params.get("platform")
@@ -58,59 +119,8 @@ def main(argv=None) -> int:
         for kk in jax.random.split(key, 3)
     )
 
-    from jax import lax
-
-    def timed_chain(chain_fn, carry):
-        """Per-iteration time of ``chain_fn`` (carry → same-shaped carry)
-        with constant overhead subtracted out, or ``None`` when the
-        measurement is invalid (noise made the difference non-positive).
-
-        ONE compiled program — a jitted ``lax.scan`` of the chain, length
-        ``iters`` — is fed its own output k times per span (k and 2k), and
-        the report is (t_2k − t_k)/(k·iters). The device sync + tunnel
-        round-trip (~80 ms here — milliseconds of per-iter noise for a
-        dispatch-per-iteration loop, which timed the same kernel at
-        0.023 ms and 0.209 ms across runs) happens once per span and
-        cancels in the difference; the k async re-dispatches cost ~µs
-        each. k is calibrated so a span is ~0.5 s, dwarfing round-trip
-        jitter. Feeding outputs back as inputs keeps XLA from folding
-        repeats; compiling a single length keeps Mosaic compile time (a
-        seq-2048 fwd+bwd program is expensive) out of the bench budget."""
-        run = jax.jit(lambda c: lax.scan(
-            lambda c, _: (chain_fn(c), None), c, None, length=iters
-        )[0])
-        out = run(carry)  # compile; value-fetch = true sync (see spanned)
-        float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
-
-        def spanned(k):
-            best = float("inf")
-            for _ in range(3):  # best-of-3: min is the least-interference
-                c = carry       # estimate on a shared/tunneled device,
-                t0 = time.perf_counter()  # and differencing mins keeps
-                for _ in range(k):        # t_2k − t_k positive
-                    c = run(c)
-                # A value fetch, not just block_until_ready: the tunneled
-                # PJRT client's block can return optimistically (observed:
-                # 1 ms for a ≥36 ms serial computation). Pulling one
-                # scalar forces true completion; its constant cost cancels
-                # in the t_2k − t_k difference.
-                float(jax.tree_util.tree_leaves(c)[0].ravel()[0])
-                best = min(best, time.perf_counter() - t0)
-            return best, c
-
-        # Calibration estimate must itself be overhead-free (a raw span/k
-        # estimate is RTT-inflated and sizes k smaller → coarser), so it
-        # is a two-span difference too.
-        t1, _ = spanned(1)
-        t2, _ = spanned(2)
-        per_block = max(t2 - t1, 1e-6)  # seconds per iters-length block
-        k = max(1, min(256, int(0.5 / per_block)))
-        t_k, out = spanned(k)
-        t_2k, _ = spanned(2 * k)
-        diff = t_2k - t_k
-        if diff <= 0:  # interference beat the differencing: no number is
-            return None, out  # better than a garbage 0.0/∞-speedup one
-        return diff / (k * iters), out
+    def chain(chain_fn, carry):
+        return timed_chain(chain_fn, carry, iters=iters)
 
     # Both sides jitted: fused-program vs fused-program (ADVICE r2 — timing
     # jitted flash against eager op-by-op XLA overstated the kernel).
@@ -121,8 +131,8 @@ def main(argv=None) -> int:
         q, k, v, causal=causal, impl="xla"
     ))
     # The attention output has q's shape: chain it as the next q.
-    flash_t, _ = timed_chain(lambda c: flash_fn(c, k, v), q)
-    xla_t, _ = timed_chain(lambda c: xla_fn(c, k, v), q)
+    flash_t, _ = chain(lambda c: flash_fn(c, k, v), q)
+    xla_t, _ = chain(lambda c: xla_fn(c, k, v), q)
     flash_out = flash_fn(q, k, v)  # single un-chained call for correctness
 
     # Training-path comparison: full value_and_grad through each impl
@@ -146,8 +156,8 @@ def main(argv=None) -> int:
             return dq + ((dk.sum() + dv.sum()) * 1e-20).astype(dq.dtype)
         return chain
 
-    flash_bwd_t, _ = timed_chain(chain_all_grads(flash_grad), q)
-    xla_bwd_t, _ = timed_chain(chain_all_grads(xla_grad), q)
+    flash_bwd_t, _ = chain(chain_all_grads(flash_grad), q)
+    xla_bwd_t, _ = chain(chain_all_grads(xla_grad), q)
 
     ref = reference_attention(
         q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
@@ -182,7 +192,7 @@ def main(argv=None) -> int:
         # y has x's shape: chain it. The grad chain carries dL/dx (same
         # shape as x) while still computing the param grads each iteration
         # (argnums covers both).
-        moe_fwd_t, _ = timed_chain(
+        moe_fwd_t, _ = chain(
             lambda c: moe_ffn(mp, c, compute_dtype=jnp.bfloat16)[0], x
         )
         moe_grad = jax.grad(moe_loss, argnums=(0, 1))
@@ -195,7 +205,7 @@ def main(argv=None) -> int:
             # Keep the param-grad branch live (see chain_all_grads).
             return (gx + live * 1e-20).astype(x.dtype)
 
-        moe_step_t, _ = timed_chain(moe_chain, x)
+        moe_step_t, _ = chain(moe_chain, x)
         moe = {
             "tokens": tokens, "d_model": d_model, "experts": n_exp,
             "fwd_ms": _ms(moe_fwd_t),

@@ -20,6 +20,12 @@ from cron_operator_tpu.parallel.mesh import (
     pspec_for_shape,
     sharding_for_tree,
 )
+from cron_operator_tpu.parallel.overlap import (
+    DoubleBuffer,
+    chain_steps,
+    chunk_schedule,
+    stacked_shardings,
+)
 from cron_operator_tpu.parallel.moe import (
     init_moe_params,
     moe_ffn,
@@ -54,4 +60,8 @@ __all__ = [
     "init_moe_params",
     "moe_ffn",
     "moe_param_sharding",
+    "DoubleBuffer",
+    "chain_steps",
+    "chunk_schedule",
+    "stacked_shardings",
 ]

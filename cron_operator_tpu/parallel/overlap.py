@@ -1,0 +1,222 @@
+"""Overlap primitives: background staging and scan-chained dispatch.
+
+The step-speed finding (PERF.md finding 3) is that steady-state training
+loses its margin to per-step HOST work — batch generation, ``device_put``,
+python dispatch — not to device compute. The collective-heavy attention
+paths already overlap internally (ring rotates K/V behind the current
+block's compute, ulysses pipelines its all-to-alls); this module exposes
+the same discipline to the Trainer's outer loop:
+
+- :class:`DoubleBuffer` — a bounded background pipeline that runs a
+  ``stage`` callable (typically host batch build + sharded ``device_put``)
+  over an iterator from a producer thread, so item N+1 is staged while
+  item N computes. ``workloads.data.Prefetcher`` (single batches) and
+  ``workloads.data.ChunkStager`` (stacked scan chunks) are thin facades
+  over it.
+- :func:`chain_steps` — the scan-chained K-steps-per-dispatch program
+  builder: one jitted ``lax.scan`` of the step body, state donated
+  through, so K optimizer steps cost one python dispatch + one
+  host↔device round trip. Fused mode scans with no xs (the body derives
+  its batch from ``state.step``); external mode scans over a stacked
+  batch (leading axis = step index).
+- :func:`stacked_shardings` — the placement rule for those stacked
+  batches: the per-step sharding with the scan axis replicated
+  (``P(None, *spec)``), so every device holds its shard of each step's
+  slice and the scan body consumes bytes that are already laid out
+  exactly as ``steps_per_call=1`` would have placed them.
+
+No jax import at module scope on the DoubleBuffer path: the staging
+machinery is plain threads + queues and stays importable from host-only
+contexts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional
+
+
+class DoubleBuffer:
+    """Background staging: overlap ``stage(item)`` with the consumer.
+
+    The producer thread pulls from ``items``, applies ``stage`` (device
+    placement happens on that thread), and parks the result in a bounded
+    queue (``depth`` caps memory spent on staged-ahead work). The consumer
+    iterates staged results; with ``depth >= 2`` the next item is already
+    staged while the current one is being consumed — classic
+    double-buffering.
+
+    Must be :meth:`close`'d (the Trainer does, in ``run``'s finally) — the
+    producer thread of an infinite generator would otherwise park forever
+    per job in a long-lived executor process. A ``stage``/generator
+    exception is re-raised on the consumer at the point of ``next()``;
+    after exhaustion or :meth:`close` the iterator keeps raising
+    ``StopIteration`` (never parks on a dead producer).
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        items: Iterable[Any],
+        stage: Callable[[Any], Any],
+        depth: int = 2,
+        name: str = "stage-ahead",
+    ):
+        import queue as _queue
+        import threading as _threading
+
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(1, depth))
+        self._stop = _threading.Event()
+        self._exc: Optional[Exception] = None
+        self._finished = False  # terminal: next() keeps raising StopIteration
+        self._items = items
+        self._stage = stage
+        self._thread = _threading.Thread(
+            target=self._fill, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _fill(self) -> None:
+        import queue as _queue
+
+        def offer(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        try:
+            for item in self._items:
+                if not offer(self._stage(item)):
+                    return
+                if self._stop.is_set():
+                    return
+        except Exception as exc:  # noqa: BLE001 — re-raised on the consumer
+            self._exc = exc
+        offer(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            # Iterator protocol: repeated next() after exhaustion (or
+            # after close()) must keep raising, never park on q.get()
+            # waiting for a producer that already exited.
+            raise StopIteration
+        item = self._q.get()
+        if item is self._DONE:
+            self._finished = True
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        import logging as _logging
+        import queue as _queue
+
+        self._stop.set()
+        self._finished = True
+        # Unblock a producer parked on a full queue. Only Empty ends the
+        # drain — anything else is a real bug and must surface, not be
+        # swallowed into a silent thread leak.
+        try:
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            _logging.getLogger("parallel.overlap").warning(
+                "stage-ahead producer thread still alive 5s after close(); "
+                "a stage()/generator call is blocked — leaking the thread"
+            )
+
+
+def chain_steps(
+    step_fn: Callable[[Any, Dict[str, Any]], Any],
+    *,
+    length: Optional[int] = None,
+    over_batch: bool = False,
+    jit_kwargs: Optional[dict] = None,
+):
+    """Build the jitted K-steps-per-dispatch program for ``step_fn``
+    (``(state, batch) -> (state, loss)``).
+
+    ``over_batch=False`` (fused data): scan ``length`` times with no xs —
+    the body regenerates its batch from the live ``state.step``, so the
+    data stream is identical to ``steps_per_call=1``. ``over_batch=True``
+    (external data): scan over ``batch`` whose leaves carry a leading
+    step axis (see :func:`stacked_shardings`) — step i consumes slice i,
+    exactly the batch it would have received as its own dispatch.
+
+    Returns ``(state, last_loss)`` — the chunk's final step's loss, the
+    one a synced dispatch fetches. ``jit_kwargs`` carries the Trainer's
+    in/out shardings and ``donate_argnums=(0,)`` so the state buffers are
+    donated through the chain (no K-step live-copy spike).
+    """
+    import jax
+    from jax import lax
+
+    def chained(state, batch):
+        if over_batch:
+            def body(s, b):
+                return step_fn(s, b)
+
+            state, losses = lax.scan(body, state, batch)
+        else:
+            def body(s, _):
+                return step_fn(s, batch)
+
+            state, losses = lax.scan(body, state, None, length=length)
+        return state, losses[-1]
+
+    return jax.jit(chained, **(jit_kwargs or {}))
+
+
+def stacked_shardings(batch_shardings: Dict[str, Any]) -> Dict[str, Any]:
+    """Shardings for a scan-stacked batch: each per-step sharding with the
+    new leading step axis replicated (``P(None, *spec)``) — the scan body
+    then consumes per-step slices laid out exactly like single-step
+    batches, so GSPMD inserts no relayout inside the chain."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    out: Dict[str, Any] = {}
+    for k, sh in batch_shardings.items():
+        out[k] = NamedSharding(sh.mesh, PartitionSpec(None, *sh.spec))
+    return out
+
+
+def chunk_schedule(
+    start: int, target: int, steps_per_call: int, boundary: int = 0
+) -> list:
+    """Chunk sizes for a scan-chained run from ``start`` to ``target``
+    total steps: each dispatch carries up to ``steps_per_call`` steps but
+    never crosses a ``boundary`` multiple (checkpoint ``save_every`` — a
+    save must land ON its step, not up to K-1 late) and never overshoots
+    ``target``. ``boundary=0`` disables snapping."""
+    out = []
+    done = max(0, int(start))
+    target = int(target)
+    spc = max(1, int(steps_per_call))
+    while done < target:
+        chunk = min(spc, target - done)
+        if boundary and boundary > 0:
+            to_boundary = boundary - (done % boundary)
+            chunk = min(chunk, to_boundary)
+        out.append(chunk)
+        done += chunk
+    return out
+
+
+__all__ = [
+    "DoubleBuffer",
+    "chain_steps",
+    "stacked_shardings",
+    "chunk_schedule",
+]

@@ -269,6 +269,16 @@ _FAMILY_META: Dict[str, tuple] = {
         "gauge", "Rolling model-FLOPs-utilization estimate per live "
                  "workload (XLA-counted flops/step ÷ step time ÷ slice "
                  "peak FLOP/s); series expire when the run terminates"),
+    "workload_steps_per_call": (
+        "gauge", "Resolved scan-chain length per live workload: optimizer "
+                 "steps per dispatched program under the overlap-aware "
+                 "executor (param.steps_per_call=auto); series expire "
+                 "when the run terminates"),
+    "workload_data_stall_ms": (
+        "gauge", "Per-step host data stall (p50 ms) per live workload: "
+                 "the un-hidden remainder of batch build + device_put "
+                 "after async staging overlap — ~0 when the stager keeps "
+                 "up; series expire when the run terminates"),
     "fleet_utilization": (
         "gauge", "Busy-chip-seconds ÷ capacity-chip-seconds per slice "
                  "type since observatory start (capacity flaps "

@@ -31,7 +31,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 #: Hot-path budget for one append across all resolutions, microseconds.
 #: Mirrors hack/controlplane_bench.py's AUDIT_RECORD_GATE_US: history

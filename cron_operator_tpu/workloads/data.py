@@ -16,6 +16,8 @@ from typing import Dict, Iterator
 
 import numpy as np
 
+from cron_operator_tpu.parallel.overlap import DoubleBuffer
+
 
 def mnist_batches(batch_size: int, seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
     """28×28 grayscale images, 10 classes."""
@@ -194,7 +196,7 @@ def device_causal_token_batches(
     )
 
 
-class Prefetcher:
+class Prefetcher(DoubleBuffer):
     """Background batch placement: overlap host→device transfer with
     compute.
 
@@ -208,86 +210,49 @@ class Prefetcher:
 
     Must be :meth:`close`'d (Trainer does, in ``run``'s finally) — the
     producer thread of an infinite generator would otherwise park forever
-    per job in a long-lived executor process.
+    per job in a long-lived executor process. The engine (bounded queue,
+    producer thread, exception propagation, terminal-StopIteration close
+    semantics) is :class:`parallel.overlap.DoubleBuffer`.
     """
 
-    _DONE = object()
-
     def __init__(self, batches, place, depth: int = 2):
-        import queue as _queue
-        import threading as _threading
+        super().__init__(batches, place, depth, name="batch-prefetch")
 
-        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(1, depth))
-        self._stop = _threading.Event()
-        self._exc: Exception | None = None
-        self._finished = False  # terminal: next() keeps raising StopIteration
-        self._batches = batches
-        self._place = place
-        self._thread = _threading.Thread(
-            target=self._fill, name="batch-prefetch", daemon=True
+
+def grouped(batches: Iterator[Dict[str, np.ndarray]], schedule) -> Iterator[list]:
+    """Group a batch stream into lists sized by ``schedule`` (an iterable
+    of chunk lengths, e.g. :func:`parallel.overlap.chunk_schedule`). A
+    stream that exhausts mid-group yields the partial group and stops —
+    the consumer trains what exists rather than dropping staged work."""
+    it = iter(batches)
+    for k in schedule:
+        group = []
+        # Explicit catch: inside a generator an escaping StopIteration
+        # from next() is a RuntimeError (PEP 479), not normal exhaustion.
+        try:
+            for _ in range(max(1, k)):
+                group.append(next(it))
+        except StopIteration:
+            if group:
+                yield group
+            return
+        yield group
+
+
+class ChunkStager(DoubleBuffer):
+    """Background CHUNK staging for scan-chained dispatch: groups the
+    batch stream into ``schedule``-sized chunks and runs ``place_chunk``
+    (``Trainer.put_chunk`` — stack along a leading step axis + one
+    sharded ``device_put``) on a producer thread, so chunk N+1 is built,
+    stacked and device-resident while chunk N's K steps run in a single
+    dispatched scan. ``depth`` bounds staged-ahead chunks (2 = classic
+    double buffering); memory cost is ``depth × K`` batches."""
+
+    def __init__(self, batches, schedule, place_chunk, depth: int = 2):
+        super().__init__(
+            grouped(batches, schedule), place_chunk, depth,
+            name="chunk-stager",
         )
-        self._thread.start()
-
-    def _fill(self) -> None:
-        import queue as _queue
-
-        def offer(item) -> bool:
-            while not self._stop.is_set():
-                try:
-                    self._q.put(item, timeout=0.1)
-                    return True
-                except _queue.Full:
-                    continue
-            return False
-
-        try:
-            for batch in self._batches:
-                if not offer(self._place(batch)):
-                    return
-                if self._stop.is_set():
-                    return
-        except Exception as exc:  # noqa: BLE001 — re-raised on the consumer
-            self._exc = exc
-        offer(self._DONE)
-
-    def __iter__(self):
-        return self
-
-    def __next__(self):
-        if self._finished:
-            # Iterator protocol: repeated next() after exhaustion (or
-            # after close()) must keep raising, never park on q.get()
-            # waiting for a producer that already exited.
-            raise StopIteration
-        item = self._q.get()
-        if item is self._DONE:
-            self._finished = True
-            if self._exc is not None:
-                exc, self._exc = self._exc, None
-                raise exc
-            raise StopIteration
-        return item
-
-    def close(self) -> None:
-        import logging as _logging
-        import queue as _queue
-
-        self._stop.set()
-        self._finished = True
-        # Unblock a producer parked on a full queue. Only Empty ends the
-        # drain — anything else is a real bug and must surface, not be
-        # swallowed into a silent thread leak.
-        try:
-            while True:
-                self._q.get_nowait()
-        except _queue.Empty:
-            pass
-        self._thread.join(timeout=5.0)
-        if self._thread.is_alive():
-            _logging.getLogger("workloads.data").warning(
-                "prefetch producer thread still alive 5s after close(); "
-                "a place()/generator call is blocked — leaking the thread"
-            )
 
 
 __all__ = ["mnist_batches", "imagenet_batches", "token_batches",
@@ -295,4 +260,4 @@ __all__ = ["mnist_batches", "imagenet_batches", "token_batches",
            "token_sample", "causal_token_sample", "device_batches",
            "device_mnist_batches", "device_imagenet_batches",
            "device_token_batches", "device_causal_token_batches",
-           "Prefetcher"]
+           "Prefetcher", "ChunkStager", "grouped"]

@@ -14,6 +14,16 @@ axis sizes), ``data`` (``device`` default | ``host`` | ``fused`` — see
 :func:`_batches`), ``lr``/``lr_schedule``/``warmup_steps``/
 ``schedule_steps``/``sync_every`` (see :func:`_train_kwargs`).
 Model-specific params documented per entrypoint.
+
+Execution mode: by default every training entrypoint runs the
+overlap-aware executor — ``param.steps_per_call=auto`` scan-chains up to
+8 optimizer steps per dispatched program (snapped to checkpoint
+``save_every`` and the step target, bit-exact with single-step), and
+``param.stage_async=1`` double-buffers external batches/chunks on a
+background thread so steady-state steps stop paying host time (PERF.md
+"Step speed"). ``param.steps_per_call=1`` + ``param.stage_async=0``
+restores the pre-overlap synchronous loop; ``on_step`` telemetry
+(``step_timeline``, rolling MFU) stays per-step in every mode.
 """
 
 from __future__ import annotations
@@ -130,6 +140,21 @@ def _gqa_rope_kwargs(ctx: JobContext) -> dict:
     }
 
 
+def _steps_per_call(ctx: JobContext):
+    """param.steps_per_call — "auto" (the DEFAULT execution mode: the
+    Trainer scan-chains min(8, save_every) optimizer steps per dispatch,
+    snapped to checkpoint and target boundaries, bit-exact with 1) or an
+    explicit int. A profiled run (param.profile_dir) pins it to 1: the
+    profiler starts after the first dispatch, and a single fused chunk
+    would leave the steady-state trace window empty."""
+    raw = ctx.params.get("steps_per_call", "auto")
+    if raw != "auto":
+        return int(raw)
+    if ctx.params.get("profile_dir"):
+        return 1
+    return "auto"
+
+
 def _train_kwargs(ctx: JobContext, steps: int, **defaults) -> dict:
     """TrainConfig kwargs shared by every entrypoint: per-entrypoint
     defaults overridden by the common ``param.*`` surface — ``lr``,
@@ -137,15 +162,18 @@ def _train_kwargs(ctx: JobContext, steps: int, **defaults) -> dict:
     ``schedule_steps`` (defaults to the run's total-step target),
     ``grad_clip`` (global-norm clip, 0=off), ``decay_mask`` (AdamW decay
     only on rank≥2 params), ``save_every``, ``prefetch``,
-    ``sync_every``."""
+    ``sync_every``, ``steps_per_call`` (="auto": scan-chained dispatch,
+    the default execution mode), ``stage_async`` (="1": background
+    double-buffered staging of external batches/chunks)."""
     kw = dict(defaults)
     kw.update(
         save_every=_save_every(ctx),
         prefetch=_prefetch(ctx),
         sync_every=_sync_every(ctx),
-        # K optimizer steps per dispatched program (fused data only) —
-        # the host-roundtrip amortizer for remote/tunneled devices.
-        steps_per_call=int(ctx.params.get("steps_per_call", 1)),
+        # K optimizer steps per dispatched program — the host-roundtrip
+        # amortizer; "auto" by default (scan-chained execution).
+        steps_per_call=_steps_per_call(ctx),
+        stage_async=ctx.params.get("stage_async", "1") in ("1", "true"),
         lr_schedule=ctx.params.get("lr_schedule", "constant"),
         warmup_steps=int(ctx.params.get("warmup_steps", 0)),
         schedule_steps=int(ctx.params.get("schedule_steps", steps)),
@@ -204,6 +232,11 @@ def _run(
     ``workload_tokens_per_s`` gauge.
     """
     ctx.progress["started_at"] = time.time()
+    # Execution-mode telemetry: the resolved scan-chain length and where
+    # batches materialize — what the workload_steps_per_call gauge and a
+    # perf triage read to see which mode a run actually trained under.
+    ctx.progress["steps_per_call"] = trainer.resolved_steps_per_call
+    ctx.progress["data_mode"] = ctx.params.get("data", "device")
     # Monotonic anchor for same-process latency deltas: the wall-clock
     # started_at/first_step_at pair stays for cross-process alignment,
     # but a wall jump (NTP slew) between them must not distort the
@@ -387,6 +420,16 @@ def _run(
     if async_ms:
         ctx.progress["async_dispatch_ms_p50"] = round(
             async_ms[len(async_ms) // 2], 2
+        )
+    # Per-step host data stall: with async staging this is the UN-hidden
+    # remainder of batch build + device_put (≈0 when the stager keeps
+    # up); synchronous staging pays the whole thing here. The companion
+    # gauge to async_dispatch_ms_p50 for attributing a slow run to input
+    # starvation vs dispatch overhead vs device compute.
+    stall_ms = sorted(s.data_s / s.chunk * 1e3 for s in tail)
+    if stall_ms:
+        ctx.progress["data_stall_ms_p50"] = round(
+            stall_ms[len(stall_ms) // 2], 3
         )
     # Opt-in (param.flops_accounting=1) because Trainer.flops_per_step
     # re-lowers + re-compiles the step for its cost analysis — a cache

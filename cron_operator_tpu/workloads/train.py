@@ -17,15 +17,28 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax.training import train_state
 from jax.sharding import Mesh, NamedSharding
 
 from cron_operator_tpu.parallel.mesh import batch_pspec, sharding_for_tree
+from cron_operator_tpu.parallel.overlap import (
+    chain_steps,
+    chunk_schedule,
+    stacked_shardings,
+)
+
+# "auto" steps_per_call resolves to at most this many optimizer steps per
+# dispatched scan. 8 amortizes the per-dispatch host cost to ~1/8 (already
+# deep in diminishing returns vs a ~ms dispatch) while bounding the
+# overshoot an external stop (preemption, budget) can suffer — a stop
+# lands between dispatches, up to K-1 steps late.
+_AUTO_MAX_CHUNK = 8
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -73,20 +86,40 @@ class TrainConfig:
     # batch key is fold_in(PRNGKey(data_seed), state.step), so resume
     # continues the data stream instead of replaying it.
     data_seed: int = 0
-    # Optimizer steps per dispatched program (lax.scan of the step body;
-    # requires fused data — external batches can't be replayed inside
-    # the scan). >1 amortizes the per-dispatch host/link cost K× — on a
-    # tunneled device whose dispatch latency drifts (PERF.md finding 5)
-    # this pins the measured rate to the chip. The data stream is
-    # IDENTICAL to steps_per_call=1: each in-scan step derives its batch
-    # from the live state.step.
+    # Optimizer steps per dispatched program (lax.scan of the step body).
+    # >1 amortizes the per-dispatch host/link cost K× — on a tunneled
+    # device whose dispatch latency drifts (PERF.md finding 5) this pins
+    # the measured rate to the chip. FUSED data scans with no xs (each
+    # in-scan step derives its batch from the live state.step); EXTERNAL
+    # data scans over a chunk of K batches stacked along a leading step
+    # axis (Trainer.put_chunk), staged ahead by a background thread when
+    # stage_async is on. Either way the data stream and the math are
+    # IDENTICAL to steps_per_call=1 — run() snaps chunks to checkpoint
+    # save_every multiples and the step target, so saves land on their
+    # exact step and the run never overshoots its target.
+    #
+    # "auto" picks the chunk length (min(8, save_every when
+    # checkpointing)) — the default execution mode for the registered
+    # entrypoints (param.steps_per_call).
     #
     # Stop granularity: a dispatched K-step program runs to completion —
     # an external stop (preemption, budget, deadline) lands between
     # dispatches, so the run can overshoot the stop point by up to K-1
-    # optimizer steps. Pick K against checkpoint/stop granularity, not
-    # just dispatch amortization.
-    steps_per_call: int = 1
+    # optimizer steps.
+    steps_per_call: Union[int, str] = 1
+    # Background double-buffered staging for EXTERNAL data (on by
+    # default): batch/chunk N+1 is built and device_put (sharded) by a
+    # producer thread while N computes, so steady-state steps stop paying
+    # host time (PERF.md finding 3 — host work, not the model, dominated
+    # the step). prefetch > 0 overrides the staging depth; stage_async =
+    # False forces fully synchronous staging (the pre-overlap behavior,
+    # and the A-side of hack/step_bench.py). Only ARMED when the batch
+    # shardings span ONE device: on a multi-device mesh the staging
+    # thread would be a second program dispatcher racing the step
+    # program's collectives across the per-device queues (XLA rendezvous
+    # deadlock — the in-job analog of the gang_slots hazard), so run()
+    # silently stages inline there.
+    stage_async: bool = True
     # Block on the loss every N steps (1 = every step). Fetching a scalar
     # is a full host↔device round trip — ~80 ms on a tunneled device,
     # swamping a ~20 ms train step — so steady-state throughput needs the
@@ -272,19 +305,24 @@ class Trainer:
         )
         self._step_fn = step_fn
         self._step = jax.jit(step_fn, **self._jit_kwargs)
-        if self.config.steps_per_call > 1 and sample_fn is None:
+        spc = self.config.steps_per_call
+        if not (spc == "auto" or isinstance(spc, int)):
             raise ValueError(
-                "steps_per_call > 1 requires fused data (sample_fn): "
-                "external batches cannot be replayed inside the scan"
+                f"steps_per_call must be an int or 'auto' (got {spc!r})"
             )
-        # Chunk length → jitted scan program. Bounded: a steady run uses
-        # at most two lengths (full chunk + partial tail), but a caller
-        # driving step(chunk=) with varying lengths would otherwise
-        # accumulate one compiled program per distinct length for the
-        # process lifetime. FIFO-evict beyond the cap — recompiling a
-        # rare length is cheap next to leaking compiled executables.
+        # Chunk length → jitted scan program (fused mode). Bounded: a
+        # steady run uses at most two lengths (full chunk + snapped/tail
+        # chunk), but a caller driving step(chunk=) with varying lengths
+        # would otherwise accumulate one compiled program per distinct
+        # length for the process lifetime. LRU-evict beyond the cap —
+        # recompiling a rare length is cheap next to leaking compiled
+        # executables.
         self._multi: Dict[int, Any] = {}
         self._multi_cap = 8
+        # External scan-chained program (one jitted fn; jax.jit caches
+        # per stacked shape internally, so chunk lengths don't need the
+        # _multi bookkeeping).
+        self._ext_step = None
         self._batch_struct = None  # set on first put_batch (flops_per_step)
         self._flops_per_step: Optional[float] = None
         # Wall-clock of this process's first dispatch (XLA compile + first
@@ -293,39 +331,84 @@ class Trainer:
         # tick→first-step latency into its compile component on /metrics.
         self.first_dispatch_time_s: Optional[float] = None
 
+    @property
+    def resolved_steps_per_call(self) -> int:
+        """``config.steps_per_call`` with ``"auto"`` resolved: chunks of
+        ``min(8, save_every)`` when checkpointing (run() snaps chunks to
+        save_every multiples, so a longer chunk would only fragment into
+        the same pieces), plain ``min(8, ·)`` otherwise."""
+        spc = self.config.steps_per_call
+        if spc == "auto":
+            se = self.config.save_every
+            spc = (
+                min(_AUTO_MAX_CHUNK, se)
+                if (self.checkpoint is not None and se > 0)
+                else _AUTO_MAX_CHUNK
+            )
+        return max(1, int(spc))
+
     def _stepper(self, chunk: int):
-        """The jitted program for ``chunk`` optimizer steps per dispatch
-        (1 → the plain step). Cached per length — a partial final chunk
-        compiles its own (second, at most) program."""
+        """The jitted FUSED program for ``chunk`` optimizer steps per
+        dispatch (1 → the plain step). Cached per length under an LRU cap
+        — a snapped schedule alternates steady and boundary/tail lengths,
+        and an eviction keyed on insertion age (the old FIFO) would
+        recompile the steady program on every other call once the cap was
+        hit; re-inserting on hit keeps every length in active rotation
+        cached."""
         if chunk <= 1:
             return self._step
         if self.sample_fn is None:
-            # Same guard as __init__ for config.steps_per_call — the
-            # public step(chunk=) path must not silently replay one
-            # external batch for every step of the scan.
+            # The public step(chunk=) path must not silently replay one
+            # external batch for every step of the scan — external chunks
+            # go through put_chunk (a stacked _PlacedChunk), which
+            # carries one REAL batch per scan step.
             raise ValueError(
                 "chunk > 1 requires fused data (sample_fn): external "
-                "batches cannot be replayed inside the scan"
+                "batches cannot be replayed inside the scan — stage a "
+                "stacked chunk via put_chunk instead"
             )
         fn = self._multi.get(chunk)
-        if fn is None:
-            step_fn = self._step_fn
-
-            def multi(state, batch):
-                def body(s, _):
-                    s2, loss = step_fn(s, batch)
-                    return s2, loss
-
-                state, losses = jax.lax.scan(
-                    body, state, None, length=chunk
-                )
-                return state, losses[-1]
-
-            fn = jax.jit(multi, **self._jit_kwargs)
-            while len(self._multi) >= self._multi_cap:
-                self._multi.pop(next(iter(self._multi)))
-            self._multi[chunk] = fn
+        if fn is not None:
+            self._multi[chunk] = self._multi.pop(chunk)  # LRU touch
+            return fn
+        fn = chain_steps(
+            self._step_fn, length=chunk, jit_kwargs=self._jit_kwargs
+        )
+        while len(self._multi) >= self._multi_cap:
+            self._multi.pop(next(iter(self._multi)))
+        self._multi[chunk] = fn
         return fn
+
+    def _chunk_stepper(self):
+        """The jitted EXTERNAL scan-chained program: scans over a stacked
+        chunk (leading step axis), state donated through. One function for
+        every chunk length — jit specializes per stacked shape in its own
+        cache."""
+        if self._ext_step is None:
+            self._ext_step = chain_steps(
+                self._step_fn,
+                over_batch=True,
+                jit_kwargs=dict(
+                    in_shardings=(
+                        self.state_sharding,
+                        stacked_shardings(self.batch_sharding),
+                    ),
+                    out_shardings=self._jit_kwargs["out_shardings"],
+                    donate_argnums=(0,),
+                ),
+            )
+        return self._ext_step
+
+    def _staging_devices(self) -> int:
+        """Device count under the batch shardings — the async stager is
+        only spawned when this is 1 (see the single-controller rule in
+        :meth:`run`)."""
+        for s in (self.batch_sharding or {}).values():
+            try:
+                return len(s.device_set)
+            except (AttributeError, TypeError):
+                return 1
+        return 1
 
     def put_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
         placed = {
@@ -337,6 +420,36 @@ class Trainer:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), placed
             )
         return placed
+
+    def put_chunk(self, group: List[Dict[str, Any]]) -> "_PlacedChunk":
+        """Stack K external batches along a new leading (step) axis and
+        place them in ONE sharded transfer (scan axis replicated, per-step
+        layout unchanged — parallel.overlap.stacked_shardings). The
+        scan-chained program consumes slice i at step i, so the data
+        stream is identical to K single dispatches. This is the
+        ChunkStager's ``place`` callable — it runs on the staging thread,
+        overlapping the whole host cost of the next chunk with the
+        current chunk's device compute."""
+        if not group:
+            raise ValueError("put_chunk needs a non-empty batch group")
+        shardings = stacked_shardings(self.batch_sharding)
+        stacked = {}
+        for name in group[0]:
+            parts = [b[name] for b in group]
+            if all(isinstance(p, np.ndarray) for p in parts):
+                arr = np.stack(parts)
+            else:
+                arr = jnp.stack([jnp.asarray(p) for p in parts])
+            stacked[name] = jax.device_put(arr, shardings[name])
+        if self._batch_struct is None:
+            # ONE step's batch struct (leading axis stripped): the MFU /
+            # flops_per_step numerator is per optimizer step, not per
+            # dispatched chunk.
+            self._batch_struct = {
+                k: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                for k, a in stacked.items()
+            }
+        return _PlacedChunk(stacked, len(group))
 
     def flops_per_step(self) -> Optional[float]:
         """XLA's own flop count for ONE compiled train step (fwd + bwd +
@@ -371,17 +484,28 @@ class Trainer:
         return self._flops_per_step
 
     def step(
-        self, batch: Dict[str, Any], sync: bool = True, chunk: int = 1
+        self,
+        batch: Union[Dict[str, Any], "_PlacedChunk"],
+        sync: bool = True,
+        chunk: int = 1,
     ) -> StepStats:
         """One dispatch of ``chunk`` optimizer steps (see
         TrainConfig.steps_per_call). ``step_time_s`` is normalized PER
         STEP (dispatch wall / chunk) so throughput math is
-        chunk-agnostic; ``loss`` is the chunk's last step's."""
+        chunk-agnostic; ``loss`` is the chunk's last step's. A
+        pre-staged :meth:`put_chunk` result dispatches the external
+        scan-chained program (its length IS the chunk)."""
         compiled = self.first_dispatch_time_s is None
         t0 = time.perf_counter()
-        device_batch = self.put_batch(batch)
+        if isinstance(batch, _PlacedChunk):
+            chunk = batch.chunk
+            device_batch = batch.arrays
+            stepper = self._chunk_stepper()
+        else:
+            device_batch = self.put_batch(batch)
+            stepper = self._stepper(chunk)
         t_data = time.perf_counter()
-        self.state, loss = self._stepper(chunk)(self.state, device_batch)
+        self.state, loss = stepper(self.state, device_batch)
         t_disp = time.perf_counter()
         # Blocking keeps the step-time numbers honest; sync=False lets the
         # caller amortize the round trip (see TrainConfig.sync_every).
@@ -419,6 +543,35 @@ class Trainer:
             compiled=compiled,
         )
 
+    @staticmethod
+    def per_step_stats(s: StepStats) -> List[StepStats]:
+        """A dispatch's StepStats divided into per-STEP records — what
+        run() feeds ``on_step`` so the step-phase timeline and rolling
+        MFU stay per-step truthful under scan-chained dispatch. The
+        chunk's phase walls are split evenly (the scan gives no per-step
+        brackets), the loss rides the last step (the only one the
+        dispatch fetched), and the checkpoint stall lands on the last
+        step (chunks snap to save_every, so the save step IS the chunk's
+        last)."""
+        k = s.chunk
+        if k <= 1:
+            return [s]
+        out = []
+        for i in range(k):
+            last = i == k - 1
+            out.append(StepStats(
+                step=s.step - (k - 1 - i),
+                loss=s.loss if last else None,
+                step_time_s=s.step_time_s,  # already per-step
+                chunk=1,
+                data_s=s.data_s / k,
+                dispatch_s=s.dispatch_s / k,
+                sync_s=s.sync_s / k,
+                ckpt_s=s.ckpt_s if last else 0.0,
+                compiled=s.compiled,
+            ))
+        return out
+
     def run(
         self,
         batches: Iterator[Dict[str, Any]],
@@ -428,20 +581,73 @@ class Trainer:
     ) -> list:
         """Train until ``steps_done`` reaches ``steps`` (a TOTAL-step
         target, so a checkpoint-restored trainer only runs the remainder —
-        preempted work is not repeated)."""
+        preempted work is not repeated).
+
+        Execution mode is picked from the config: external data with
+        ``steps_per_call`` > 1 (or ``"auto"``) runs scan-chained chunks
+        staged ahead by a background ChunkStager (double-buffered:
+        chunk N+1 is stacked + device_put while chunk N computes);
+        external single-step runs stage batch-ahead via the Prefetcher
+        (on by default — ``stage_async``); fused data scans in-step.
+        Chunk sizes come from :func:`parallel.overlap.chunk_schedule`,
+        snapped to checkpoint ``save_every`` multiples and the step
+        target. ``on_step`` receives PER-STEP stats (chunk aggregates
+        divided — :meth:`per_step_stats`); the returned list stays
+        per-dispatch.
+        """
+        se = max(1, self.config.sync_every)
+        spc = self.resolved_steps_per_call
+        external = self.sample_fn is None
+        boundary = (
+            self.config.save_every
+            if (self.checkpoint is not None and self.config.save_every > 0)
+            else 0
+        )
+        depth = (
+            self.config.prefetch if self.config.prefetch > 0
+            else (2 if self.config.stage_async else 0)
+        )
+        if depth > 0 and self._staging_devices() > 1:
+            # Single-controller rule: a staging thread is a SECOND program
+            # dispatcher. On a >1-device mesh its jitted work (device-side
+            # batch generators, stack-and-reshard placements) interleaves
+            # program enqueue with the step program's collectives across
+            # the per-device queues — the same XLA rendezvous deadlock
+            # gang_slots serializes between jobs, now inside one job.
+            # Stage inline instead; scan-chained dispatch (the dominant
+            # win) is thread-free and keeps.
+            depth = 0
+        stager = None
         prefetcher = None
+        chunks = None  # iterator of _PlacedChunk (external chunked mode)
+        sched: List[int] = []
         # Lazy: a no-op run (target already reached after checkpoint
         # restore, or an immediate stop) must not consume + device-place
-        # depth+1 batches it will never use.
-        if self.config.prefetch > 0 and self.steps_done < steps:
+        # staged batches it will never use.
+        pending = self.steps_done < steps
+        if pending and external and spc > 1:
+            from cron_operator_tpu.workloads.data import ChunkStager, grouped
+
+            schedule = chunk_schedule(self.steps_done, steps, spc, boundary)
+            if depth > 0:
+                stager = ChunkStager(
+                    batches, schedule, self.put_chunk, depth
+                )
+                chunks = stager
+            else:
+                # Synchronous staging (stage_async=False): same chunked
+                # program, stack + place on the consumer thread — the
+                # A-side of the step bench's overlap A/B.
+                chunks = (
+                    self.put_chunk(g) for g in grouped(batches, schedule)
+                )
+        elif pending and depth > 0 and (external or self.config.prefetch > 0):
             from cron_operator_tpu.workloads.data import Prefetcher
 
-            prefetcher = Prefetcher(
-                batches, self.put_batch, self.config.prefetch
-            )
+            prefetcher = Prefetcher(batches, self.put_batch, depth)
             batches = prefetcher  # step's put_batch is a no-op re-place
-        se = max(1, self.config.sync_every)
-        spc = max(1, self.config.steps_per_call)
+        elif pending and not external and spc > 1:
+            sched = chunk_schedule(self.steps_done, steps, spc, boundary)
         first = self.steps_done + 1
         stats = []
         try:
@@ -449,7 +655,17 @@ class Trainer:
                 if should_stop is not None and should_stop():
                     break
                 nxt = self.steps_done + 1
-                chunk = min(spc, steps - self.steps_done)
+                placed = None
+                wait_s = 0.0
+                if chunks is not None:
+                    t_wait = time.perf_counter()
+                    placed = next(chunks)  # StopIteration = stream ended
+                    wait_s = time.perf_counter() - t_wait
+                    chunk = placed.chunk
+                elif sched:
+                    chunk = min(sched.pop(0), steps - self.steps_done)
+                else:
+                    chunk = min(spc, steps - self.steps_done)
                 last_of_call = self.steps_done + chunk
                 # Always sync the first call (the tick→first-step anchor
                 # must be device-completed, not merely dispatched) and the
@@ -462,10 +678,21 @@ class Trainer:
                     or (last_of_call - first + 1) // se
                     > (nxt - first) // se
                 )
-                s = self.step(next(batches), sync=sync, chunk=chunk)
+                if placed is not None:
+                    s = self.step(placed, sync=sync)
+                    if wait_s:
+                        # The stager wait is the UN-hidden remainder of
+                        # host data work (≈0 when staging keeps up) —
+                        # charge it where put_batch time used to go so
+                        # throughput stays honest.
+                        s.data_s += wait_s
+                        s.step_time_s += wait_s / s.chunk
+                else:
+                    s = self.step(next(batches), sync=sync, chunk=chunk)
                 stats.append(s)
                 if on_step is not None:
-                    on_step(s)
+                    for ps in self.per_step_stats(s):
+                        on_step(ps)
         finally:
             if stats and stats[-1].loss is None:
                 # Exited (should_stop / exception) behind async steps:
@@ -480,11 +707,28 @@ class Trainer:
                 stats[-1].step_time_s += (
                     (time.perf_counter() - t0) / stats[-1].chunk
                 )
+            if stager is not None:
+                stager.close()
             if prefetcher is not None:
                 prefetcher.close()
         if self.checkpoint is not None:
             self.checkpoint.wait()
         return stats
+
+
+class _PlacedChunk:
+    """Device-resident stacked chunk from :meth:`Trainer.put_chunk`: K
+    external batches stacked along a leading step axis, placed with the
+    scan-axis-replicated sharding. Recognized by :meth:`Trainer.step` as
+    pre-staged input for the scan-chained program — a plain dict with
+    ``chunk > 1`` still raises (one external batch cannot be replayed
+    across the scan)."""
+
+    __slots__ = ("arrays", "chunk")
+
+    def __init__(self, arrays: Dict[str, jax.Array], chunk: int):
+        self.arrays = arrays
+        self.chunk = int(chunk)
 
 
 __all__ = ["Trainer", "TrainConfig", "StepStats", "cross_entropy_loss"]

@@ -149,6 +149,16 @@ echo "==> fleet scheduler smoke (makespan A/B, fairness, p50, zero-write)"
 # REGRESSION. Full run: make bench-fleet (updates BENCH_FLEET.json).
 python hack/fleet_bench.py --check --stdout >/dev/null
 
+echo "==> step-speed smoke (scan-chain parity + async staging overlap)"
+# Small-size run of the step bench (hack/step_bench.py): the default
+# scan-chained + double-buffered executor mode must produce BIT-exact
+# params vs the per-step path on the same stream, and the async stager
+# must hide host staging time (its per-step wait strictly below the
+# synchronous stager's inline cost). The 1.3x throughput gate stays a
+# full-run claim (make bench-step) — a loaded CI host must not flake
+# the commit gate on a timing ratio.
+JAX_PLATFORMS=cpu python hack/step_bench.py --check --stdout >/dev/null
+
 echo "==> fleet capacity-flap soak (quotas, preemption + elastic resume)"
 # Fixed-seed flap rounds against the fleet scheduler: the slice pool
 # shrinks past its free slices mid-storm (forcing preemptions through

@@ -1,4 +1,4 @@
-"""ResNet-50 step-time attribution (VERDICT r4 #1, stage 2).
+"""ResNet-50 step-time attribution (VERDICT r4 #1, stage 2) — thin wrapper.
 
 The sweep (hack/mfu_probe.py) showed chain ≈ dispatch (no tunnel/host
 overhead) and best MFU ~15% at batch 128 — so the compute itself is the
@@ -17,8 +17,10 @@ ceiling. This probe times the step's components separately:
                    two-pass reduction each ⇒ prime HBM-traffic suspect).
 - ``step``       — the full step as benched (rng + fwd + bwd + opt).
 
-Also prints XLA's own flop count for the fwd (cost_analysis), checking
-the 12.3 GFLOP/img MFU denominator.
+All timing delegates to ``cron_operator_tpu.ops.microbench.timed_chain``
+(span-differenced scan-of-chain; this file used to carry a private copy
+of that logic). Also prints XLA's own flop count for the fwd
+(cost_analysis), checking the 12.3 GFLOP/img MFU denominator.
 
 Run: ``python hack/mfu_attrib.py [batch=128] [image=224] [chain=5]``.
 Prints one JSON line.
@@ -29,7 +31,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
@@ -37,6 +38,9 @@ os.environ.setdefault(
                  ".jax_cache"),
 )
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from cron_operator_tpu.ops.microbench import timed_chain  # noqa: E402
 
 
 def _parse(argv):
@@ -74,59 +78,32 @@ def main() -> int:
 
     tx = optax.sgd(0.1, momentum=0.9)
 
-    def fetch(c):
-        float(jax.tree_util.tree_leaves(c)[0].ravel()[0])
-
-    def timed(run, carry):
-        """(t_2k - t_k)/(k*chain) span differencing, best-of-3."""
-        c = run(carry)
-        fetch(c)
-
-        def span(k):
-            nonlocal c
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                for _ in range(k):
-                    c = run(c)
-                fetch(c)
-                best = min(best, time.perf_counter() - t0)
-            return best
-
-        t1, t2 = span(1), span(2)
-        per = max(t2 - t1, 1e-6)
-        k = max(1, min(64, int(1.0 / per)))
-        tk, t2k = span(k), span(2 * k)
-        diff = t2k - tk
-        return (diff / (k * chain)) if diff > 0 else None
-
-    def scan_of(body):
-        return jax.jit(
-            lambda c: jax.lax.scan(body, c, None, length=chain)[0],
-            donate_argnums=0,
-        )
+    def timed(body, carry):
+        """Per-step ms of a carry→carry body via timed_chain (scan of
+        CHAIN iterations, span-differenced). timed_chain's sync pulls
+        the FIRST carry leaf as a scalar — keep a plain float leading
+        each carry (not a typed PRNG key)."""
+        t, _ = timed_chain(body, carry, iters=chain)
+        return round(t * 1e3, 2) if t else None
 
     out = {"batch": batch, "image": image, "chain": chain}
 
     # --- rng-only --------------------------------------------------------
-    # acc leads the carry: timed()'s fetch pulls the FIRST leaf, which
-    # must be a plain scalar, not a (typed) PRNG key.
-    def rng_body(carry, _):
+    def rng_body(carry):
         acc, key = carry
         key, k1, k2 = jax.random.split(key, 3)
         x = jax.random.normal(k1, (batch, image, image, 3), jnp.bfloat16)
         y = jax.random.randint(k2, (batch,), 0, 1000)
         # Touch the outputs so XLA cannot DCE the generation.
-        return (acc + x.mean().astype(jnp.float32) + y.sum(), key), None
+        return (acc + x.mean().astype(jnp.float32) + y.sum(), key)
 
-    t = timed(scan_of(rng_body), (jnp.float32(0), jax.random.PRNGKey(0)))
-    out["rng_ms"] = round(t * 1e3, 2) if t else None
+    out["rng_ms"] = timed(rng_body, (jnp.float32(0), jax.random.PRNGKey(0)))
 
     # --- rng under rbg ---------------------------------------------------
     try:
-        t = timed(scan_of(rng_body),
-                  (jnp.float32(0), jax.random.key(0, impl="rbg")))
-        out["rng_rbg_ms"] = round(t * 1e3, 2) if t else None
+        out["rng_rbg_ms"] = timed(
+            rng_body, (jnp.float32(0), jax.random.key(0, impl="rbg"))
+        )
     except Exception as exc:  # noqa: BLE001
         out["rng_rbg_ms"] = f"error: {str(exc)[-200:]}"
 
@@ -149,11 +126,6 @@ def main() -> int:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
 
-    def fresh(tree):
-        """Deep-copy a param tree so a donated carry never deletes the
-        original's buffers (each timed() run donates its carry)."""
-        return jax.tree_util.tree_map(jnp.copy, tree)
-
     x_fix = jax.random.normal(
         jax.random.PRNGKey(3), (batch, image, image, 3), jnp.bfloat16
     )
@@ -175,51 +147,43 @@ def main() -> int:
         out["xla_fwd_flops_per_image"] = f"error: {str(exc)[-200:]}"
 
     # fwd only
-    def fwd_body(carry, _):
-        acc = carry
-        l = loss_of(model, params, x_fix, y_fix)
-        return acc + l, None
-
-    t = timed(scan_of(fwd_body), jnp.float32(0))
-    out["fwd_ms"] = round(t * 1e3, 2) if t else None
+    out["fwd_ms"] = timed(
+        lambda acc: acc + loss_of(model, params, x_fix, y_fix),
+        jnp.float32(0),
+    )
 
     # fwd+bwd+opt, fixed data
     def make_step(model, params):
-        p0 = fresh(params)
-
-        def body(carry, _):
+        def body(carry):
             p, o = carry
             _, g = jax.value_and_grad(
                 lambda pp: loss_of(model, pp, x_fix, y_fix)
             )(p)
             u, o = tx.update(g, o, p)
-            return (optax.apply_updates(p, u), o), None
-        return body, (p0, tx.init(p0))
+            return (optax.apply_updates(p, u), o)
+        return body, (params, tx.init(params))
 
     body, carry = make_step(model, params)
-    t = timed(scan_of(body), carry)
-    out["fwdbwd_ms"] = round(t * 1e3, 2) if t else None
+    out["fwdbwd_ms"] = timed(body, carry)
 
     # fwd+bwd+opt with identity norm
     model_nn, params_nn = build(norm=_Identity)
     body, carry = make_step(model_nn, params_nn)
-    t = timed(scan_of(body), carry)
-    out["fwdbwd_nonorm_ms"] = round(t * 1e3, 2) if t else None
+    out["fwdbwd_nonorm_ms"] = timed(body, carry)
 
     # full step (rng + train), the benched configuration
-    def full_body(carry, _):
+    def full_body(carry):
         p, o, key = carry
         key, k1, k2 = jax.random.split(key, 3)
         x = jax.random.normal(k1, (batch, image, image, 3), jnp.bfloat16)
         y = jax.random.randint(k2, (batch,), 0, 1000)
         _, g = jax.value_and_grad(lambda pp: loss_of(model, pp, x, y))(p)
         u, o = tx.update(g, o, p)
-        return (optax.apply_updates(p, u), o, key), None
+        return (optax.apply_updates(p, u), o, key)
 
-    p0 = fresh(params)
-    t = timed(scan_of(full_body),
-              (p0, tx.init(p0), jax.random.PRNGKey(1)))
-    out["step_ms"] = round(t * 1e3, 2) if t else None
+    out["step_ms"] = timed(
+        full_body, (params, tx.init(params), jax.random.PRNGKey(1))
+    )
 
     print(json.dumps(out))
     return 0

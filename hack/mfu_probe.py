@@ -1,4 +1,4 @@
-"""ResNet-50 MFU attribution probe (VERDICT r4 #1).
+"""ResNet-50 MFU attribution probe (VERDICT r4 #1) — thin wrapper.
 
 The r4 artifact reported mfu=0.1247 at batch 64 with no attribution. This
 probe separates the three candidate causes:
@@ -6,19 +6,20 @@ probe separates the three candidate causes:
 - **batch too small** — sweep batch sizes; MFU should climb if the MXU is
   under-fed at 64.
 - **dispatch/tunnel overhead** — time the SAME train step two ways:
-  ``chain`` (one jitted ``lax.scan`` of CHAIN steps, span-differenced —
-  pure device compute, zero per-step host involvement) vs ``dispatch``
-  (one jitted call per step, value fetch at the end — the Trainer's
-  shape). The difference is host dispatch + tunnel cost, not the model.
+  ``chain`` (one jitted ``lax.scan`` of CHAIN steps — pure device
+  compute, zero per-step host involvement) vs ``dispatch`` (a
+  scan-of-one program re-dispatched per step — the pre-overlap
+  Trainer's shape). The difference is host dispatch + tunnel cost,
+  not the model.
 - **conv efficiency** — if the chain MFU is still low at the best batch,
   the convs themselves are the ceiling; optionally dump a profiler trace
   (``profile_dir=...``) for the best config.
 
-Timing methodology is ops/microbench.timed_chain's: one compiled program
-fed its own output across two spans of k and 2k repeats; report
-(t_2k - t_k) / (k * CHAIN). A value fetch (not block_until_ready — the
-axon client's block returns optimistically) bounds each span, and its
-constant cost cancels in the difference.
+All timing is ``cron_operator_tpu.ops.microbench.timed_chain`` — the
+span-differenced ((t_2k − t_k)/(k·iters), value-fetch-synced) chain
+primitive this file used to carry a private copy of. See its docstring
+for the methodology; hack/step_bench.py's device-floor leg uses the
+same function, so probe numbers and bench numbers are comparable.
 
 Run: ``python hack/mfu_probe.py [batch=64,128,256] [image=224]
 [chain=5] [profile_dir=/tmp/trace]``. Prints one JSON line.
@@ -43,6 +44,8 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 # lesson (its r3 dict produced mfu:null on the real chip).
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import PEAK_FLOPS, _flops_per_image  # noqa: E402
+
+from cron_operator_tpu.ops.microbench import timed_chain  # noqa: E402
 
 
 def _parse(argv):
@@ -80,15 +83,11 @@ def main() -> int:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
 
-    def fetch(c):
-        # True sync: pull one scalar (axon block_until_ready is optimistic).
-        float(jax.tree_util.tree_leaves(c)[0].ravel()[0])
-
     def make_step(batch):
-        """The train-step body — ONE definition shared by the sweep and
-        the profiler block, so the profiled trace is the same program the
-        sweep timed."""
-        def step(carry, _):
+        """The train-step body (carry → carry) — ONE definition shared
+        by both timing modes and the profiler block, so the profiled
+        trace is the same program the sweep timed."""
+        def step(carry):
             p, o, key = carry
             key, k1, k2 = jax.random.split(key, 3)
             x = jax.random.normal(k1, (batch, image, image, 3),
@@ -96,15 +95,8 @@ def main() -> int:
             y = jax.random.randint(k2, (batch,), 0, 1000)
             _, g = jax.value_and_grad(loss_of)(p, x, y)
             u, o = tx.update(g, o, p)
-            return (optax.apply_updates(p, u), o, key), None
+            return (optax.apply_updates(p, u), o, key)
         return step
-
-    def make_chain_run(batch):
-        step = make_step(batch)
-        return jax.jit(
-            lambda c: jax.lax.scan(step, c, None, length=chain)[0],
-            donate_argnums=0,
-        )
 
     def init_carry():
         params = jax.jit(model.init)(
@@ -116,33 +108,13 @@ def main() -> int:
     for batch in batches:
         rec = {"batch": batch, "image": image}
         try:
-            # --- chain mode: pure device compute --------------------------
-            run = make_chain_run(batch)
+            step = make_step(batch)
+
+            # --- chain mode: scan-of-CHAIN, pure device compute -----------
             t0 = time.perf_counter()
-            c = run(init_carry())
-            fetch(c)
-            rec["compile_plus_first_s"] = round(time.perf_counter() - t0, 1)
-
-            def span(k):
-                nonlocal c
-                best = float("inf")
-                for _ in range(3):
-                    t0 = time.perf_counter()
-                    for _ in range(k):
-                        c = run(c)
-                    fetch(c)
-                    best = min(best, time.perf_counter() - t0)
-                return best
-
-            t1 = span(1)
-            t2 = span(2)
-            per_block = max(t2 - t1, 1e-6)
-            k = max(1, min(64, int(1.0 / per_block)))
-            tk = span(k)
-            t2k = span(2 * k)
-            diff = t2k - tk
-            if diff > 0:
-                chain_step = diff / (k * chain)
+            chain_step, c = timed_chain(step, init_carry(), iters=chain)
+            rec["compile_plus_measure_s"] = round(time.perf_counter() - t0, 1)
+            if chain_step is not None:
                 rec["chain_step_ms"] = round(chain_step * 1e3, 2)
                 rec["chain_images_per_s"] = round(batch / chain_step, 1)
                 if peak:
@@ -152,30 +124,20 @@ def main() -> int:
             else:
                 rec["chain_step_ms"] = None
 
-            # --- dispatch mode: one call per step, fetch at the end -------
-            # (the Trainer's shape: value_and_grad + apply per dispatch)
-            step = make_step(batch)
-            one = jax.jit(
-                lambda c: step(c, None)[0], donate_argnums=0
-            )
-            c1 = one(c)
-            fetch(c1)
-            n = max(10, int(0.5 / max(chain_step, 1e-3)) if diff > 0 else 10)
-            best = float("inf")
-            for _ in range(3):
-                t0 = time.perf_counter()
-                for _ in range(n):
-                    c1 = one(c1)
-                fetch(c1)
-                best = min(best, time.perf_counter() - t0)
-            disp_step = best / n
-            rec["dispatch_step_ms"] = round(disp_step * 1e3, 2)
-            rec["dispatch_n"] = n
-            if peak:
-                rec["dispatch_mfu"] = round(
-                    batch * flops_per_image / disp_step / peak, 4
-                )
-            del c, c1
+            # --- dispatch mode: scan-of-ONE re-dispatched per step --------
+            # (the pre-overlap Trainer's shape: one call per step; the
+            # span differencing cancels the end-of-span sync, leaving
+            # per-dispatch cost = device step + host dispatch)
+            disp_step, _ = timed_chain(step, c, iters=1)
+            if disp_step is not None:
+                rec["dispatch_step_ms"] = round(disp_step * 1e3, 2)
+                if peak:
+                    rec["dispatch_mfu"] = round(
+                        batch * flops_per_image / disp_step / peak, 4
+                    )
+            else:
+                rec["dispatch_step_ms"] = None
+            del c
         except Exception as exc:  # noqa: BLE001 — one OOM batch must not
             rec["error"] = str(exc)[-400:]  # kill the sweep
         results.append(rec)
@@ -190,17 +152,23 @@ def main() -> int:
     profile_error = None
     if profile_dir and best is not None:
         # Re-run the best config briefly under the profiler for op-level
-        # attribution (TensorBoard/XProf artifact). Same program as the
-        # sweep: make_chain_run is the single step-builder. Guarded: an
+        # attribution (TensorBoard/XProf artifact). Same step body as the
+        # sweep: make_step is the single step-builder. Guarded: an
         # optional trace must never discard the sweep's measurements.
         try:
-            run = make_chain_run(best["batch"])
+            step = make_step(best["batch"])
+            run = jax.jit(
+                lambda c: jax.lax.scan(
+                    lambda c, _: (step(c), None), c, None, length=chain
+                )[0],
+                donate_argnums=0,
+            )
             c = run(init_carry())
-            fetch(c)
+            float(jax.tree_util.tree_leaves(c)[0].ravel()[0])
             jax.profiler.start_trace(profile_dir)
             for _ in range(3):
                 c = run(c)
-            fetch(c)
+            float(jax.tree_util.tree_leaves(c)[0].ravel()[0])
             jax.profiler.stop_trace()
         except Exception as exc:  # noqa: BLE001
             profile_error = str(exc)[-400:]
@@ -211,6 +179,7 @@ def main() -> int:
         "peak_flops": peak,
         "flops_per_image": flops_per_image,
         "chain_len": chain,
+        "timing": "ops.microbench.timed_chain (span-differenced)",
         "sweep": results,
         "best": best,
         "profile_dir": profile_dir if best else None,

@@ -1,0 +1,369 @@
+"""Step-speed benchmark for the overlap-aware training executor (PR 12).
+
+Measures what the executor rework actually bought, on real Trainer runs
+(not synthetic kernels), and gates it:
+
+- ``external_ab`` — THE acceptance gate. The same MLP training run on
+  host-generated MNIST batches, two ways: **A** = the seed synchronous
+  path (``steps_per_call=1``, ``stage_async=False``: one dispatch per
+  step, batch staged inline on the step's critical path) vs **B** = the
+  new default mode (``steps_per_call="auto"`` scan-chained chunks,
+  double-buffered background staging). Verdict is OK iff B ≥
+  ``--min-speedup`` (default 1.3×) samples/s over A AND the final
+  params of a fresh A/B pair trained on identical streams are
+  bit-exact (same math, fewer dispatches — the whole point).
+- ``fused_vs_external`` — fused in-step data generation (the r5 zero
+  host-traffic mode) vs the new external chunked+staged path: how close
+  external data now gets to the fused ceiling.
+- ``chain_floor`` — ops.microbench.timed_chain (the span-differenced
+  primitive hack/mfu_probe.py and hack/mfu_attrib.py wrap) on a
+  hand-built fused step: the pure device-compute floor per step.
+  ``overlap_headroom_ms`` = A's per-step wall minus this floor — the
+  host+dispatch slice the overlap machinery exists to hide.
+- ``transformer`` — Bert-tiny MLM leg: flash-attention impl vs XLA
+  attention through the full train step (flash runs interpret=True off
+  TPU — correctness-checked, meaningless for speed; the JSON says which
+  mode ran). The XLA side's tokens/s is the ``train-large`` rate.
+
+Writes BENCH_STEP.json (one verdict over every leg). ``--check`` is the
+CI-gate smoke: small sizes, transformer leg skipped, asserts bit-exact
+parity and NONZERO OVERLAP (B's per-step host wait strictly below A's
+inline staging cost) — not the 1.3× gate, which stays a full-run claim.
+``--emit-matrix-seed PATH`` additionally writes the measured rates as a
+fleet ``ThroughputMatrix`` sidecar (``{"alpha":…, "rates": {"<class>/
+<slice>": rate}}`` — the format ``ThroughputMatrix.load_seed`` reads),
+so a fresh operator's placement scorer starts from measured throughput
+instead of the chips-proportional prior.
+
+Run: ``make bench-step`` (full), ``make bench-step CHECK=1`` (smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _r(x, nd=2):
+    return None if x is None else round(x, nd)
+
+
+def write_matrix_seed(path, slice_type, rates_by_class):
+    """Write measured rates as a fleet ``ThroughputMatrix`` seed sidecar
+    — the exact shape :meth:`ThroughputMatrix.load_seed` reads
+    (``rates`` keyed ``"<workload-class>/<slice-type>"``; ``"*"`` is the
+    scorer's any-class fallback row). ``rates_by_class`` maps workload
+    class → measured rate; falsy rates are dropped, not zero-seeded.
+    Returns the rates dict written."""
+    rates = {
+        f"{wclass}/{slice_type}": round(float(rate), 1)
+        for wclass, rate in rates_by_class.items() if rate
+    }
+    doc = {"alpha": 0.3, "rates": rates, "source": "hack/step_bench.py"}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return rates
+
+
+def _measure_run(make_trainer, make_batches, warm, steps, batch):
+    """Wall-clock a Trainer over ``steps`` steady-state steps (compile +
+    ``warm`` steps excluded via a first run() call on the same trainer;
+    run()'s target is cumulative, so the second call runs exactly
+    ``steps`` more). Returns (samples_per_s, per_step_ms, host_wait_ms)
+    where host_wait_ms is the mean per-step data_s — inline staging cost
+    on the synchronous path, residual stager wait on the async one."""
+    tr = make_trainer()
+    it = make_batches()
+    waits = []
+
+    def on_step(s):
+        if tr.steps_done > warm or s.step > warm:
+            waits.append(s.data_s)
+
+    tr.run(it, warm, on_step=lambda s: None)
+    t0 = time.perf_counter()
+    tr.run(it, warm + steps, on_step=on_step)
+    dt = time.perf_counter() - t0
+    host_wait = sum(waits) / len(waits) if waits else 0.0
+    return batch * steps / dt, dt / steps * 1e3, host_wait * 1e3
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None,
+                    help="JSON artifact path (default BENCH_STEP.json; "
+                         "never written in --check unless given)")
+    ap.add_argument("--stdout", action="store_true",
+                    help="print the JSON to stdout too")
+    ap.add_argument("--check", action="store_true",
+                    help="CI smoke: small sizes, no transformer leg; "
+                         "fails on parity break or zero overlap")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="timed steady-state steps per side")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--min-speedup", type=float, default=1.3,
+                    help="external_ab gate: B over A samples/s")
+    ap.add_argument("--emit-matrix-seed", default=None, metavar="PATH",
+                    help="write measured rates as a fleet "
+                         "ThroughputMatrix seed sidecar")
+    ap.add_argument("--skip-transformer", action="store_true")
+    args = ap.parse_args()
+
+    # Warmup and timed steps are multiples of the auto chunk (8): the
+    # warm run must compile the SAME chunk length the timed segment uses
+    # — a warm count below one chunk compiles a short program, then the
+    # full-length chunk compiles inside the timed window and the "B"
+    # number measures XLA, not the executor.
+    _CHUNK = 8
+    steps = args.steps or (48 if args.check else 96)
+    steps = max(_CHUNK, (steps // _CHUNK) * _CHUNK)
+    warm = 2 * _CHUNK
+    batch = args.batch
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cron_operator_tpu.models import MLP
+    from cron_operator_tpu.ops.microbench import timed_chain
+    from cron_operator_tpu.parallel import mesh_for_devices
+    from cron_operator_tpu.workloads import data as datasets
+    from cron_operator_tpu.workloads.train import TrainConfig, Trainer
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu", "gpu")
+    kind = jax.devices()[0].device_kind
+    slice_type = backend if not on_tpu else kind.split()[0].lower()
+    mesh = mesh_for_devices(jax.devices())
+
+    model = MLP(features=(64,))
+    init_params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+    )["params"]
+    apply_fn = lambda p, x: model.apply({"params": p}, x)  # noqa: E731
+
+    def trainer(**cfg_kw):
+        # Fresh trainer from the SAME init params each call — A and B
+        # must start from identical weights for parity to mean anything.
+        return Trainer(
+            apply_fn,
+            jax.tree_util.tree_map(jnp.copy, init_params),
+            mesh,
+            TrainConfig(optimizer="sgd", **cfg_kw),
+        )
+
+    cfg_a = dict(steps_per_call=1, stage_async=False)  # seed sync path
+    cfg_b = dict(steps_per_call="auto", stage_async=True)  # new default
+
+    # --- external_ab: the gate ------------------------------------------
+    a_rate, a_ms, a_wait = _measure_run(
+        lambda: trainer(**cfg_a),
+        lambda: datasets.mnist_batches(batch, seed=5), warm, steps, batch,
+    )
+    b_rate, b_ms, b_wait = _measure_run(
+        lambda: trainer(**cfg_b),
+        lambda: datasets.mnist_batches(batch, seed=5), warm, steps, batch,
+    )
+    speedup = b_rate / a_rate if a_rate else None
+
+    # Overlap proof, apples-to-apples: the SAME chunked path with the
+    # stager forced synchronous pays the full stack+device_put inline;
+    # the async wait must sit strictly below it (what the background
+    # thread hid). Structural, not a cross-config timing race — this is
+    # the --check assertion, robust on a loaded CI host.
+    _, _, bs_wait = _measure_run(
+        lambda: trainer(steps_per_call="auto", stage_async=False),
+        lambda: datasets.mnist_batches(batch, seed=5), warm, steps, batch,
+    )
+    overlap_ms = bs_wait - b_wait
+
+    # Bit-exact parity: fresh pair, identical streams, a step count that
+    # straddles the auto chunk (8) with a non-divisible tail.
+    psteps = 13
+    tr_a, tr_b = trainer(**cfg_a), trainer(**cfg_b)
+    tr_a.run(datasets.mnist_batches(batch, seed=9), psteps)
+    tr_b.run(datasets.mnist_batches(batch, seed=9), psteps)
+    la = jax.tree_util.tree_leaves(tr_a.state.params)
+    lb = jax.tree_util.tree_leaves(tr_b.state.params)
+    parity = all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+    auto_chunk = tr_b.resolved_steps_per_call
+
+    external_ab = {
+        "model": "mlp(64) mnist", "batch": batch, "steps": steps,
+        "a_samples_per_s": _r(a_rate, 1), "b_samples_per_s": _r(b_rate, 1),
+        "a_step_ms": _r(a_ms), "b_step_ms": _r(b_ms),
+        "a_host_wait_ms": _r(a_wait, 3), "b_host_wait_ms": _r(b_wait, 3),
+        "b_sync_stage_wait_ms": _r(bs_wait, 3),
+        "overlap_hidden_ms_per_step": _r(overlap_ms, 3),
+        "auto_steps_per_call": auto_chunk,
+        "speedup_b_over_a": _r(speedup, 3),
+        "min_speedup": args.min_speedup,
+        "params_bit_exact": parity,
+        "ok": bool(parity and speedup and speedup >= args.min_speedup),
+    }
+
+    # --- fused_vs_external ----------------------------------------------
+    import itertools
+
+    f_rate, f_ms, _ = _measure_run(
+        lambda: Trainer(
+            apply_fn, jax.tree_util.tree_map(jnp.copy, init_params), mesh,
+            TrainConfig(optimizer="sgd", steps_per_call=8),
+            sample_fn=datasets.mnist_sample(batch),
+        ),
+        lambda: itertools.repeat({}), warm, steps, batch,
+    )
+    fused_vs_external = {
+        "fused_samples_per_s": _r(f_rate, 1), "fused_step_ms": _r(f_ms),
+        "external_b_samples_per_s": _r(b_rate, 1),
+        "external_over_fused": _r(b_rate / f_rate, 3) if f_rate else None,
+    }
+
+    # --- chain_floor (shared timed_chain primitive) ---------------------
+    import optax
+
+    tx = optax.sgd(1e-3)
+    sample = datasets.mnist_sample(batch)
+    from cron_operator_tpu.workloads.train import cross_entropy_loss
+
+    def floor_step(carry):
+        p, o, key = carry
+        key, kb = jax.random.split(key)
+        b = sample(kb)
+
+        def loss(pp):
+            return cross_entropy_loss(apply_fn(pp, b["x"]), b["y"])
+
+        g = jax.grad(loss)(p)
+        u, o = tx.update(g, o, p)
+        return (optax.apply_updates(p, u), o, key)
+
+    p0 = jax.tree_util.tree_map(jnp.copy, init_params)
+    floor_t, _ = timed_chain(
+        floor_step, (p0, tx.init(p0), jax.random.PRNGKey(2)), iters=8
+    )
+    chain_floor = {
+        "floor_step_ms": _r(floor_t * 1e3 if floor_t else None, 3),
+        "overlap_headroom_ms": _r(
+            a_ms - floor_t * 1e3 if floor_t else None, 3
+        ),
+    }
+
+    # --- transformer (flash vs xla through the full step) ---------------
+    transformer = None
+    if not (args.check or args.skip_transformer):
+        from cron_operator_tpu.models import Bert, BertConfig
+
+        # seq 128: the flash kernel's block size — smaller sequences
+        # reject the Pallas path outright.
+        tseq, tbatch, tsteps, twarm = 128, 4, 12, 4
+
+        def bert_rate(impl):
+            cfg = BertConfig.tiny(
+                max_len=tseq, attention_impl=impl,
+                attention_interpret=not on_tpu and impl == "flash",
+            )
+            m = Bert(cfg, mesh=mesh)
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, tseq), jnp.int32)
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(optimizer="sgd", seq_dim_in_batch=1,
+                            labels_follow_seq=True, steps_per_call=4),
+                sample_fn=datasets.token_sample(tbatch, tseq,
+                                                cfg.vocab_size),
+            )
+            it = itertools.repeat({})
+            tr.run(it, twarm)
+            t0 = time.perf_counter()
+            tr.run(it, twarm + tsteps)
+            dt = time.perf_counter() - t0
+            return tbatch * tseq * tsteps / dt
+
+        xla_tps = bert_rate("xla")
+        try:
+            flash_tps = bert_rate("flash")
+        except Exception as exc:  # noqa: BLE001 — interpret-mode flash
+            flash_tps = None      # must not kill the artifact
+            transformer_err = str(exc)[-300:]
+        else:
+            transformer_err = None
+        transformer = {
+            "model": "bert-tiny mlm", "seq": tseq, "batch": tbatch,
+            "flash_mode": "mosaic" if on_tpu else "interpret",
+            "xla_tokens_per_s": _r(xla_tps, 1),
+            "flash_tokens_per_s": _r(flash_tps, 1),
+            "flash_over_xla": (
+                _r(flash_tps / xla_tps, 3) if flash_tps else None
+            ),
+            "error": transformer_err,
+        }
+
+    verdict = "OK" if external_ab["ok"] else "REGRESSION"
+    report = {
+        "backend": backend, "device_kind": kind,
+        "slice_type": slice_type,
+        "mode": "check" if args.check else "full",
+        "timing": "steady-state Trainer wall clock, compile+warmup "
+                  "excluded; chain floor via ops.microbench.timed_chain",
+        "external_ab": external_ab,
+        "fused_vs_external": fused_vs_external,
+        "chain_floor": chain_floor,
+        "transformer": transformer,
+        "verdict": verdict,
+    }
+
+    if args.emit_matrix_seed:
+        # train-small rides the measured MLP rate (and seeds the "*"
+        # fallback row), train-large the transformer tokens/s when the
+        # full run measured it.
+        by_class = {"train-small": b_rate, "*": b_rate}
+        if transformer and transformer.get("xla_tokens_per_s"):
+            by_class["train-large"] = transformer["xla_tokens_per_s"]
+        report["matrix_seed_rates"] = write_matrix_seed(
+            args.emit_matrix_seed, slice_type, by_class
+        )
+        report["matrix_seed"] = args.emit_matrix_seed
+
+    out_path = args.out or (None if args.check else "BENCH_STEP.json")
+    if out_path and out_path != "/dev/null":
+        tmp = out_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, out_path)
+    if args.stdout or not out_path:
+        print(json.dumps(report))
+
+    if args.check:
+        # Smoke gate: the math must be identical and the overlap real.
+        # The 1.3x throughput claim stays a full-run gate — a loaded CI
+        # host must not flake the commit gate on a timing ratio.
+        assert parity, "scan-chained params diverged from per-step path"
+        assert overlap_ms > 0, (
+            "async staging hid no host time (sync stage wait %.3f ms <= "
+            "async wait %.3f ms)" % (bs_wait, b_wait)
+        )
+        return 0
+    return 0 if verdict == "OK" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

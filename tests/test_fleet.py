@@ -143,6 +143,36 @@ class TestThroughputMatrix:
         m.observe("w2", "a", 3.0)  # first observation seeds the cell
         assert m.rate("w2", "a") == pytest.approx(3.0)
 
+    def test_load_seed_accepts_step_bench_sidecar(self, tmp_path):
+        """hack/step_bench.py --emit-matrix-seed writes measured rates
+        in the save() sidecar format; load_seed must read them back so a
+        fresh operator's placement scorer starts from bench-measured
+        throughput instead of the chips-proportional prior."""
+        import importlib.util
+        from pathlib import Path
+
+        path = (Path(__file__).resolve().parent.parent
+                / "hack" / "step_bench.py")
+        spec = importlib.util.spec_from_file_location("step_bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        out = tmp_path / "fleet_matrix_seed.json"
+        mod.write_matrix_seed(
+            str(out), "cpu",
+            {"train-small": 54874.3, "*": 54874.3,
+             "train-large": 6043.5, "eval": None},  # unmeasured dropped
+        )
+        seed = ThroughputMatrix.load_seed(str(out))
+        assert seed == {
+            ("train-small", "cpu"): 54874.3,
+            ("*", "cpu"): 54874.3,
+            ("train-large", "cpu"): 6043.5,
+        }
+        m = ThroughputMatrix(seed)
+        assert m.rate("train-small", "cpu") == 54874.3
+        assert m.rate("preprocess", "cpu") == 54874.3  # "*" fallback row
+
 
 class TestPlanAssignments:
     def test_matches_brute_force_optimum(self):

@@ -281,3 +281,62 @@ class TestUlyssesAttention:
         it = datasets.token_batches(4, 64, cfg.vocab_size)
         s1, s2 = tr.step(next(it)), tr.step(next(it))
         assert jnp.isfinite(s1.loss) and jnp.isfinite(s2.loss)
+
+
+class TestOverlapPrimitives:
+    """parallel.overlap: the chunk scheduler and stacked-chunk sharding
+    the scan-chained executor builds on (PR 12)."""
+
+    def test_chunk_schedule_plain_and_tail(self):
+        from cron_operator_tpu.parallel.overlap import chunk_schedule
+
+        assert chunk_schedule(0, 7, 3) == [3, 3, 1]  # non-divisible tail
+        assert chunk_schedule(0, 6, 3) == [3, 3]
+        assert chunk_schedule(4, 6, 8) == [2]  # resumed run, short rest
+        assert chunk_schedule(6, 6, 4) == []  # target already reached
+        assert chunk_schedule(0, 4, 1) == [1, 1, 1, 1]
+
+    def test_chunk_schedule_boundary_snapping(self):
+        """No chunk may straddle a save_every multiple: saves must land
+        on their exact step, so the schedule realigns at boundaries —
+        including a mid-interval start (checkpoint-restored run)."""
+        from cron_operator_tpu.parallel.overlap import chunk_schedule
+
+        assert chunk_schedule(0, 7, 5, boundary=3) == [3, 3, 1]
+        assert chunk_schedule(2, 10, 4, boundary=4) == [2, 4, 2]
+        for start, target, spc, bd in [
+            (0, 23, 8, 5), (3, 17, 4, 4), (1, 9, 8, 3),
+        ]:
+            sched = chunk_schedule(start, target, spc, boundary=bd)
+            assert sum(sched) == target - start
+            done = start
+            for c in sched:
+                assert 1 <= c <= spc
+                # crossing a boundary mid-chunk is the bug snapping
+                # exists to prevent
+                assert (done % bd) + c <= bd
+                done += c
+
+    def test_stacked_shardings_prepend_replicated_axis(self, cpus):
+        from jax.sharding import NamedSharding
+
+        from cron_operator_tpu.parallel.overlap import stacked_shardings
+
+        mesh = mesh_for_devices(cpus)
+        spec = batch_pspec(mesh)
+        sh = {"x": NamedSharding(mesh, spec)}
+        st = stacked_shardings(sh)
+        # scan axis replicated, per-step layout untouched
+        assert st["x"].spec == P(None, *spec)
+        assert st["x"].mesh == mesh
+
+    def test_grouped_yields_schedule_and_partial_tail(self):
+        from cron_operator_tpu.workloads.data import grouped
+
+        src = ({"i": n} for n in range(100))
+        got = [[b["i"] for b in g] for g in grouped(src, [3, 3, 1])]
+        assert got == [[0, 1, 2], [3, 4, 5], [6]]
+
+        short = ({"i": n} for n in range(4))
+        got = [[b["i"] for b in g] for g in grouped(short, [3, 3])]
+        assert got == [[0, 1, 2], [3]]  # partial final group, no raise

@@ -633,20 +633,23 @@ class TestStepsPerCall:
         _, c1 = self._final_checksum(cpus, steps=7, spc=1)
         assert c == c1
 
-    def test_requires_fused_data(self, cpus):
-        import pytest
-
+    def test_external_chunked_construction_ok(self, cpus):
+        """steps_per_call > 1 without a fused sample_fn is legal now:
+        run() scans over STACKED external batches (put_chunk), so
+        construction must not reject the combination. Only feeding a
+        single un-stacked batch through step(chunk>1) is an error
+        (next test) — that path would replay one batch K times."""
         with jax.default_device(cpus[0]):
             mesh = mesh_for_devices(cpus)
             m = MLP(features=(32,))
             params = m.init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
             )["params"]
-            with pytest.raises(ValueError, match="fused data"):
-                Trainer(
-                    lambda p, x: m.apply({"params": p}, x), params, mesh,
-                    TrainConfig(optimizer="sgd", steps_per_call=4),
-                )
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(optimizer="sgd", steps_per_call=4),
+            )
+            assert tr.resolved_steps_per_call == 4
 
     def test_step_chunk_requires_fused_data_too(self, cpus):
         """The public step(chunk=) path must hit the same guard as
@@ -667,6 +670,161 @@ class TestStepsPerCall:
             batch = next(datasets.mnist_batches(8))
             with pytest.raises(ValueError, match="fused data"):
                 tr.step(batch, chunk=4)
+
+
+class TestScanChainedExternal:
+    """External-data scan chaining (the PR-12 executor default): run()
+    stacks K real host batches (put_chunk) and scans over the stacked
+    chunk — a pure dispatch-count change. Params must be BIT-exact
+    against steps_per_call=1 on the same stream, published losses within
+    1 ulp, and the per-step stats timeline must stay dense (the
+    step-phase profiler and rolling MFU consume it)."""
+
+    def _run(self, cpus, steps, spc, stage_async=False, store=None,
+             save_every=0):
+        with jax.default_device(cpus[0]):
+            mesh = mesh_for_devices(cpus)
+            m = MLP(features=(32,))
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(optimizer="sgd", steps_per_call=spc,
+                            stage_async=stage_async,
+                            save_every=save_every),
+                checkpoint=store,
+            )
+            per_step = []
+            stats = tr.run(datasets.mnist_batches(16, seed=21), steps,
+                           on_step=per_step.append)
+            if store is not None:
+                store.close()
+            return tr, stats, per_step
+
+    @staticmethod
+    def _leaves(tr):
+        import numpy as np
+
+        return [
+            np.asarray(leaf)
+            for leaf in jax.tree_util.tree_leaves(tr.state.params)
+        ]
+
+    @pytest.mark.parametrize("spc", [2, 5])
+    def test_bit_exact_params_and_ulp_losses(self, cpus, spc):
+        import numpy as np
+
+        ref, _, ref_steps = self._run(cpus, steps=7, spc=1)
+        tr, stats, _ = self._run(cpus, steps=7, spc=spc)
+        for a, b in zip(self._leaves(ref), self._leaves(tr)):
+            assert np.array_equal(a, b)  # bit-exact, not allclose
+        # Each dispatch publishes its chunk-final loss; it must match
+        # the per-step path's loss at that step to 1 ulp.
+        ref_loss = {s.step: s.loss for s in ref_steps
+                    if s.loss is not None}
+        for s in stats:
+            np.testing.assert_array_max_ulp(
+                np.float32(s.loss), np.float32(ref_loss[s.step]),
+                maxulp=1,
+            )
+
+    def test_per_step_emission_stays_dense(self, cpus):
+        _, stats, per_step = self._run(cpus, steps=7, spc=5)
+        assert [s.step for s in stats] == [5, 7]  # dispatch-level
+        assert [s.step for s in per_step] == [1, 2, 3, 4, 5, 6, 7]
+        assert all(s.chunk == 1 for s in per_step)
+        assert all(s.step_time_s > 0 for s in per_step)
+        # loss rides the chunk-final step only (the one fetched)
+        assert [s.step for s in per_step
+                if s.loss is not None] == [5, 7]
+
+    def test_save_every_snaps_chunks(self, cpus, tmp_path):
+        """Chunks must not straddle a save_every multiple: the snapped
+        schedule for spc=5 over 7 steps at save_every=3 is [3, 3, 1],
+        saves land on their exact steps, and the math stays bit-exact
+        vs the unchunked uncheckpointed run."""
+        import numpy as np
+
+        from cron_operator_tpu.workloads.checkpoint import CheckpointStore
+
+        store = CheckpointStore("ns", "chain-1785339000",
+                                root=str(tmp_path))
+        tr, stats, _ = self._run(cpus, steps=7, spc=5, store=store,
+                                 save_every=3)
+        assert [s.step for s in stats] == [3, 6, 7]
+        reopened = CheckpointStore("ns", "chain-1785339000",
+                                   root=str(tmp_path), create=False)
+        assert reopened.latest_step() == 6
+        reopened.close()
+        ref, _, _ = self._run(cpus, steps=7, spc=1)
+        for a, b in zip(self._leaves(ref), self._leaves(tr)):
+            assert np.array_equal(a, b)
+
+    def test_async_stager_bit_exact(self, cpus):
+        """The background ChunkStager must deliver the exact batches the
+        synchronous path stages — identical final params, and "auto"
+        resolves to the documented chunk length. Single-device mesh: the
+        stager only arms there (see test_multi_device_stages_inline)."""
+        import numpy as np
+
+        a, _, _ = self._run(cpus[:1], steps=11, spc="auto",
+                            stage_async=False)
+        b, _, _ = self._run(cpus[:1], steps=11, spc="auto",
+                            stage_async=True)
+        assert a.resolved_steps_per_call == 8
+        for x, y in zip(self._leaves(a), self._leaves(b)):
+            assert np.array_equal(x, y)
+
+    def test_multi_device_stages_inline(self, cpus, monkeypatch):
+        """Deadlock gate: on a >1-device mesh the staging thread would be
+        a second program dispatcher racing the step program's collectives
+        across the per-device queues (XLA rendezvous deadlock — observed
+        as a wedged training thread surviving preempt/stop). stage_async
+        must silently degrade to inline staging there, never spawn the
+        ChunkStager."""
+        if len(cpus) < 2:
+            pytest.skip("needs a multi-device mesh")
+        from cron_operator_tpu.workloads import data as data_mod
+
+        def _forbidden(*a, **k):
+            raise AssertionError(
+                "ChunkStager spawned on a multi-device mesh"
+            )
+
+        monkeypatch.setattr(data_mod, "ChunkStager", _forbidden)
+        monkeypatch.setattr(data_mod, "Prefetcher", _forbidden)
+        tr, _, per_step = self._run(cpus, steps=6, spc=3,
+                                    stage_async=True)
+        assert tr._staging_devices() == len(cpus)
+        assert [s.step for s in per_step] == list(range(1, 7))
+
+
+class TestStepperLRU:
+    def test_hit_refreshes_recency(self, cpus):
+        """The fused _multi cache is an LRU, not FIFO: a snapped
+        schedule alternates steady and boundary/tail lengths, so a hit
+        must re-protect the entry — FIFO eviction would recompile the
+        steady program on every other dispatch once the cap was hit."""
+        with jax.default_device(cpus[0]):
+            mesh = mesh_for_devices(cpus)
+            m = MLP(features=(32,))
+            params = m.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1))
+            )["params"]
+            tr = Trainer(
+                lambda p, x: m.apply({"params": p}, x), params, mesh,
+                TrainConfig(optimizer="sgd"),
+                sample_fn=datasets.mnist_sample(8),
+            )
+            tr._multi_cap = 2
+            f2 = tr._stepper(2)
+            f3 = tr._stepper(3)
+            assert tr._stepper(2) is f2  # hit — must refresh recency
+            tr._stepper(4)  # cap hit: must evict 3 (stale), not 2
+            assert set(tr._multi) == {2, 4}
+            assert tr._stepper(2) is f2
+            assert tr._stepper(3) is not f3  # was evicted, rebuilt
 
 
 @pytest.mark.slow  # re-exec without a platform pin makes jax's TPU init
