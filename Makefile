@@ -118,6 +118,9 @@ chaos-soak:
 	python hack/chaos_soak.py --seed $(or $(SEED),0) \
 	    --crons $(or $(N),200) --rounds $(or $(ROUNDS),6) \
 	    --no-durability --expect-violation --out /dev/null
+	python hack/chaos_soak.py --processes --seed $(or $(SEED),0) \
+	    --crons $(or $(N),200) --rounds $(or $(ROUNDS_PROC),3) \
+	    --out CHAOS.json
 
 # Preemption-storm soak (elastic training, I8): the classic soak plus an
 # elastic leg where REAL CPU-mesh training jobs (LocalExecutor threads

@@ -334,6 +334,35 @@ def build_parser() -> argparse.ArgumentParser:
                             "as one JSON line to FILE — the durable "
                             "flight-recorder tape. Unset = in-memory ring "
                             "only (always on; served at /debug/audit)")
+    start.add_argument("--shard-role", default=None,
+                       choices=["router", "shard", "standby", "follower",
+                                "supervisor"],
+                       help="multi-PROCESS control plane role (see README "
+                            "'Scale-out'). 'shard': one shard backend "
+                            "process (store + WAL + Manager pool + WAL "
+                            "ship socket + lease heartbeat); 'standby' "
+                            "(alias 'follower'): the shard's socket-fed "
+                            "replica that self-promotes on lease expiry; "
+                            "'router': the consistent-hash front door over "
+                            "--peers; 'supervisor': spawn the whole "
+                            "topology as child processes (dev mode)")
+    start.add_argument("--shard-index", type=int, default=0, metavar="I",
+                       help="shard/standby roles: which shard this process "
+                            "serves (owns <data-dir>/shard-I)")
+    start.add_argument("--ship-port", type=int, default=0, metavar="PORT",
+                       help="shard role: WAL ship socket port (0 = "
+                            "ephemeral); standby role: the leader's ship "
+                            "port to subscribe to")
+    start.add_argument("--peers", default=None, metavar="HOST:PORT,...",
+                       help="router role: comma-separated shard API "
+                            "addresses in shard-index order")
+    start.add_argument("--lease-ttl", type=float, default=2.0, metavar="S",
+                       help="shard/standby roles: leader lease TTL in "
+                            "seconds (heartbeat renews at TTL/4; a standby "
+                            "treats a lease older than TTL as leader death)")
+    start.add_argument("--port-base", type=int, default=18080, metavar="P",
+                       help="supervisor role: router serves on P, shard i "
+                            "API on P+1+i, shard i WAL ship on P+51+i")
 
     # kubectl-style inspection for standalone mode: the reference relies
     # on kubectl + CRD printcolumns (cron_types.go:33-36); with no
@@ -427,7 +456,248 @@ def _configure_logging(level: str, encoder: str) -> None:
     logging.basicConfig(level=lvl, format=fmt, stream=sys.stderr)
 
 
+def _parse_hostport(spec: Optional[str], default_host: str = "127.0.0.1",
+                    default_port: int = 0) -> tuple:
+    """'[HOST]:PORT' → (host, port); None → defaults."""
+    if not spec:
+        return default_host, default_port
+    host, _, port = spec.rpartition(":")
+    return host or default_host, int(port)
+
+
+def _shard_manager_stack(store, scheme, metrics, tracer, journal,
+                         args, recovering: bool):
+    """The per-shard worker pool: Manager + CronReconciler + local
+    executor against THIS process's store — the in-process analog of
+    what each shard got in `--shards N` mode, now per OS process."""
+    from cron_operator_tpu.api.scheme import GVK_CRON
+    from cron_operator_tpu.backends.local import LocalExecutor
+    from cron_operator_tpu.controller import CronReconciler
+    from cron_operator_tpu.runtime import Manager
+
+    manager = Manager(
+        store,
+        max_concurrent_reconciles=args.max_concurrent_reconciles,
+        recovering=recovering,
+        metrics=metrics,
+        audit=journal,
+    )
+    reconciler = CronReconciler(store, metrics=manager.metrics,
+                                tracer=tracer, audit=journal)
+    manager.add_controller(
+        "cron", reconciler.reconcile,
+        for_gvk=GVK_CRON, owns=scheme.workload_kinds(),
+    )
+    executor = None
+    if (args.backend or "local") == "local":
+        executor = LocalExecutor(store, metrics=metrics, tracer=tracer,
+                                 audit=journal)
+        executor.start()
+    manager.start()
+    return manager, executor
+
+
+def cmd_start_process(args: argparse.Namespace) -> int:
+    """``start --shard-role ...``: one role of the multi-process control
+    plane (runtime/transport.py). Each shard is a real OS process; the
+    router proxies by shard index; standbys follow the shard's WAL over
+    a socket and self-promote on lease-file expiry — so a literal
+    ``kill -9`` of a shard leader is survivable (chaos_soak --processes
+    proves it)."""
+    _configure_logging(args.zap_log_level, args.zap_encoder)
+    log = logging.getLogger("setup")
+
+    from cron_operator_tpu.api.scheme import default_scheme
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.runtime.transport import (
+        RouterServer,
+        ShardServing,
+        StandbyServer,
+    )
+    from cron_operator_tpu.telemetry import AuditJournal, Tracer
+
+    role = "standby" if args.shard_role == "follower" else args.shard_role
+    scheme = default_scheme()
+    stop = threading.Event()
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
+
+    if role == "supervisor":
+        return _run_supervisor(args, stop, log)
+
+    host, port = _parse_hostport(args.serve_api)
+    metrics = Metrics()
+    tracer = Tracer()
+    tracer.instrument(metrics)
+
+    if role == "shard":
+        if not args.data_dir:
+            log.error("--shard-role shard requires --data-dir")
+            return 2
+        serving = ShardServing(
+            args.shard_index, args.data_dir, api_host=host, api_port=port,
+            ship_port=args.ship_port, lease_ttl_s=args.lease_ttl,
+            token=args.serve_api_token, scheme=scheme, metrics=metrics,
+        )
+        serving.audit.instrument(metrics)
+        recovering = (serving.recovered is not None
+                      and not serving.recovered.empty)
+        if recovering:
+            log.info(
+                "shard %d recovered %d object(s) at rv=%d from %s",
+                args.shard_index, len(serving.recovered.objects),
+                serving.recovered.rv, serving.sdir,
+            )
+        manager, executor = _shard_manager_stack(
+            serving.store, scheme, metrics, tracer, serving.audit,
+            args, recovering,
+        )
+        log.info(
+            "shard %d serving: api %s:%d, WAL ship :%d, lease ttl %.2fs "
+            "(pid %d)", args.shard_index, host, serving.api_port,
+            serving.ship_port, args.lease_ttl, _os.getpid(),
+        )
+        stop.wait(timeout=args.run_for)
+        log.info("shard %d shutting down", args.shard_index)
+        manager.stop()
+        if executor is not None:
+            executor.stop()
+        serving.close()  # writes the audit-check (I9) report
+        return 0
+
+    if role == "standby":
+        if not args.data_dir:
+            log.error("--shard-role standby requires --data-dir")
+            return 2
+        if not args.ship_port:
+            log.error("--shard-role standby requires --ship-port "
+                      "(the leader's WAL ship socket)")
+            return 2
+        standby = StandbyServer(
+            args.shard_index, args.data_dir, leader_host=host,
+            ship_port=args.ship_port, api_port=port,
+            lease_ttl_s=args.lease_ttl, token=args.serve_api_token,
+            scheme=scheme, metrics=metrics,
+        )
+        log.info(
+            "shard %d standby: following :%d, watching lease %s (pid %d)",
+            args.shard_index, args.ship_port, standby.lease.path,
+            _os.getpid(),
+        )
+        report = standby.run(stop, max_wait_s=args.run_for)
+        if report is None:
+            log.info("shard %d standby stopping (never promoted)",
+                     args.shard_index)
+            standby.close()
+            return 0
+        log.info(
+            "shard %d standby PROMOTED in %.3fs (i6_ok=%s, rv=%d); "
+            "now serving api :%d", args.shard_index,
+            report["duration_s"], report["i6_ok"], report["rv"], port,
+        )
+        standby.serving.audit.instrument(metrics)
+        manager, executor = _shard_manager_stack(
+            standby.serving.store, scheme, metrics, tracer,
+            standby.serving.audit, args, recovering=True,
+        )
+        stop.wait(timeout=args.run_for)
+        log.info("shard %d (promoted) shutting down", args.shard_index)
+        manager.stop()
+        if executor is not None:
+            executor.stop()
+        standby.close()
+        return 0
+
+    if role == "router":
+        if not args.peers:
+            log.error("--shard-role router requires --peers")
+            return 2
+        router = RouterServer(
+            [p.strip() for p in args.peers.split(",") if p.strip()],
+            host=host, port=port, token=args.serve_api_token,
+            peer_token=args.serve_api_token, scheme=scheme,
+            metrics=metrics,
+        )
+        log.info("router serving %d shard(s) on %s:%d (pid %d)",
+                 len(router.clients), host, router.port, _os.getpid())
+        stop.wait(timeout=args.run_for)
+        log.info("router shutting down")
+        router.close()
+        return 0
+
+    log.error("unknown --shard-role %r", role)
+    return 2
+
+
+def _run_supervisor(args: argparse.Namespace, stop: threading.Event,
+                    log) -> int:
+    """Dev-mode topology: spawn router + N shard leaders + N standbys as
+    child processes on deterministic ports and babysit them."""
+    import subprocess
+    import time
+
+    if not args.data_dir:
+        log.error("--shard-role supervisor requires --data-dir")
+        return 2
+    n = max(1, args.shards)
+    base = args.port_base
+    common = ["--zap-log-level", args.zap_log_level,
+              "--health-probe-bind-address", "0",
+              "--lease-ttl", str(args.lease_ttl)]
+    if args.serve_api_token:
+        common += ["--serve-api-token", args.serve_api_token]
+
+    def spawn(extra):
+        cmd = [sys.executable, "-m", "cron_operator_tpu.cli.main",
+               "start"] + extra + common
+        return subprocess.Popen(cmd)
+
+    procs = []
+    peers = []
+    for i in range(n):
+        api_port, ship_port = base + 1 + i, base + 51 + i
+        peers.append(f"127.0.0.1:{api_port}")
+        procs.append(spawn([
+            "--shard-role", "shard", "--shard-index", str(i),
+            "--data-dir", args.data_dir,
+            "--serve-api", f"127.0.0.1:{api_port}",
+            "--ship-port", str(ship_port),
+        ]))
+        procs.append(spawn([
+            "--shard-role", "standby", "--shard-index", str(i),
+            "--data-dir", args.data_dir,
+            "--serve-api", f"127.0.0.1:{api_port}",
+            "--ship-port", str(ship_port),
+        ]))
+    procs.append(spawn([
+        "--shard-role", "router",
+        "--serve-api", f"127.0.0.1:{base}",
+        "--peers", ",".join(peers),
+    ]))
+    log.info(
+        "supervisor: %d shard(s) + standbys + router on ports %d..%d "
+        "(router %d); SIGINT/SIGTERM tears the topology down",
+        n, base, base + 51 + n - 1, base,
+    )
+    try:
+        stop.wait(timeout=args.run_for)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 10.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return 0
+
+
 def cmd_start(args: argparse.Namespace) -> int:
+    if getattr(args, "shard_role", None):
+        return cmd_start_process(args)
     _configure_logging(args.zap_log_level, args.zap_encoder)
     log = logging.getLogger("setup")
 

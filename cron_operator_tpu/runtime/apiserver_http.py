@@ -726,6 +726,7 @@ class HTTPAPIServer:
         metrics=None,
         durable_writes: bool = True,
         selector_watch: Optional[bool] = None,
+        debug_routes: Optional[Dict[str, Any]] = None,
     ):
         """``tls_ctx`` (an ``ssl.SSLContext``, e.g. from
         ``utils.tlsutil.server_context``) serves the API over HTTPS — the
@@ -749,7 +750,12 @@ class HTTPAPIServer:
 
         ``selector_watch`` controls watch-socket adoption into the
         event-driven fan-out loop; default: on for plain HTTP, off for
-        TLS (those streams keep a handler thread)."""
+        TLS (those streams keep a handler thread).
+
+        ``debug_routes`` maps exact GET paths (e.g. ``/debug/shards``)
+        to zero-arg callables returning a JSON-serializable object (or a
+        pre-rendered JSON string). Shard/router processes use it to
+        expose liveness, pid and lag without a second server socket."""
         # Identity check, not truthiness: APIServer defines __len__, and
         # an empty-but-live store must not be swapped for a fresh one.
         self.api = api if api is not None else APIServer()
@@ -779,6 +785,7 @@ class HTTPAPIServer:
         self.selector_watch = (
             (not self.tls) if selector_watch is None else selector_watch
         )
+        self.debug_routes: Dict[str, Any] = dict(debug_routes or {})
         self._kinds: Dict[Tuple[str, str, str], str] = {}
         for gvk, plural in list(self.scheme.items()) + _CORE_KINDS:
             self._kinds[(gvk.group, gvk.version, plural)] = gvk.kind
@@ -973,6 +980,29 @@ class HTTPAPIServer:
                     self._send_status(401, "Unauthorized", "bad bearer token")
                     return
                 parsed = urlparse(self.path)
+                route = outer.debug_routes.get(parsed.path)
+                if route is not None:
+                    if method != "GET":
+                        self._send_status(405, "MethodNotAllowed",
+                                          "debug routes are GET-only")
+                        return
+                    try:
+                        payload = route()
+                    except Exception as err:  # pragma: no cover
+                        logger.exception("debug route %s failed", parsed.path)
+                        self._send_status(500, "InternalError", str(err))
+                        return
+                    if isinstance(payload, str):
+                        data = payload.encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        self._code = 200
+                    else:
+                        self._send_json(200, payload)
+                    return
                 try:
                     av, kind, ns, name, sub = outer._parse_path(parsed.path)
                 except NotFoundError as err:

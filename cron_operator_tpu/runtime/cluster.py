@@ -481,7 +481,22 @@ class ClusterAPIServer:
                                exc_info=True)
                 rv = None
                 self._stop.wait(1.0)
+            except (OSError, urllib.error.URLError) as err:
+                if self._stop.is_set():
+                    # Teardown races the stream: the peer (or this
+                    # client) is going away, so a refused/reset connect
+                    # here is shutdown, not a crash.
+                    break
+                # Peer unreachable — expected while a shard process is
+                # between death and its standby's promotion. One line,
+                # no traceback; the loop keeps dialing.
+                logger.warning("watch %s connection lost (%s); retrying",
+                               gvk, err)
+                rv = None
+                self._stop.wait(1.0)
             except Exception:
+                if self._stop.is_set():
+                    break
                 logger.error("watch %s crashed; retrying", gvk, exc_info=True)
                 rv = None
                 self._stop.wait(1.0)

@@ -212,6 +212,17 @@ _FAMILY_META: Dict[str, tuple] = {
                      "detected -> follower promoted -> catch-up "
                      "verified -> serving (label shard=N); the phase "
                      "breakdown is recorded as failover trace spans"),
+    "shard_follower_stalls_total": (
+        "counter", "Follower ship-queue overflows: the bounded async "
+                   "send queue to one follower filled (wedged socket / "
+                   "slow peer), was dropped whole, and the follower was "
+                   "marked for resync (runtime/persistence.py "
+                   "drop-then-resync policy)"),
+    "shard_follower_reconnects_total": (
+        "counter", "Follower WAL-ship socket reconnects: the follower "
+                   "redialed its shard leader after a drop and "
+                   "re-bootstrapped from the leader's durable state "
+                   "(runtime/transport.py ShipFollower)"),
     "wal_group_commit_total": (
         "counter", "Group-commit leader flushes: one fsync covering "
                    "every concurrent writer waiting in wait_durable "

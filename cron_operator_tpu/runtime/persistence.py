@@ -58,13 +58,14 @@ them before committing), so a steady-state reconcile sweep appends
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from cron_operator_tpu.runtime.kube import ApiError, object_key
 
@@ -88,6 +89,11 @@ DEFAULT_SNAPSHOT_EVERY = 4096
 #: kill -9. 0 disables the flusher (the chaos soak does, so its flush
 #: points stay seed-deterministic).
 DEFAULT_FLUSH_INTERVAL_S = 0.25
+
+#: Byte cap of one follower's send queue. A follower that cannot drain
+#: this much backlog is stalled; the leader drops the queue and schedules
+#: a resync instead of blocking its own write path.
+DEFAULT_SHIP_QUEUE_BYTES = 4 * 1024 * 1024
 
 #: Bucket ladder for WAL write-path latencies (append is tens of µs,
 #: fsync tens of µs to tens of ms depending on the device).
@@ -130,6 +136,171 @@ class RecoveredState:
         return not self.objects and self.rv == 0
 
 
+class _ShipSink:
+    """Bounded, asynchronous delivery channel to ONE shipping sink.
+
+    The WAL write path (``Persistence._ship``, lock held) only ever
+    *offers* byte runs to the queue — it never calls the sink function
+    itself, so a wedged follower socket cannot block the leader's
+    writes. A dedicated daemon thread drains the queue and invokes
+    ``send`` outside every lock.
+
+    Overflow policy is **drop-then-resync**: when the queue would exceed
+    ``max_buffered_bytes`` (or a delivery raises), the whole backlog is
+    dropped, ``shard_follower_stalls_total`` is incremented, and — when
+    the sink supports it — a resync is scheduled. The resync re-reads
+    the on-disk state under the WAL lock (so the cut between "in the
+    bootstrap" and "shipped after it" is exact) and hands it to
+    ``resync(RecoveredState)``; a follower re-bootstraps from it, which
+    is safe because replicated applies are idempotent in rv.
+    Without a resync fn the sink simply lags (drops are still counted).
+    """
+
+    def __init__(
+        self,
+        owner: "Persistence",
+        send: Callable[[bytes], None],
+        resync: Optional[Callable[["RecoveredState"], None]] = None,
+        name: str = "follower",
+        max_buffered_bytes: int = DEFAULT_SHIP_QUEUE_BYTES,
+        needs_resync: bool = False,
+    ):
+        self.owner = owner
+        self.send = send
+        self.resync = resync
+        self.name = name
+        self.max_buffered_bytes = max(1, int(max_buffered_bytes))
+        self._q: collections.deque = collections.deque()
+        self._q_bytes = 0
+        self._cond = threading.Condition()
+        self._needs_resync = bool(needs_resync) and resync is not None
+        self._delivering = False
+        self._closed = False
+        self.stalls = 0
+        self.resyncs = 0
+        self._thread = threading.Thread(
+            target=self._run, name=f"wal-ship-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # -- leader side (called under the WAL lock; must never block) ------
+
+    def offer(self, data: bytes) -> None:
+        stalled = False
+        with self._cond:
+            if self._closed or self._needs_resync:
+                return  # dropped; the pending resync covers it
+            if self._q_bytes + len(data) > self.max_buffered_bytes:
+                self._q.clear()
+                self._q_bytes = 0
+                self.stalls += 1
+                if self.resync is not None:
+                    self._needs_resync = True
+                self._cond.notify_all()
+                stalled = True
+            else:
+                self._q.append(data)
+                self._q_bytes += len(data)
+                self._cond.notify_all()
+        if stalled:
+            self.owner._count("shard_follower_stalls_total")
+            logger.warning(
+                "WAL sink %r stalled: backlog over %d bytes dropped%s",
+                self.name, self.max_buffered_bytes,
+                ", resync scheduled" if self.resync else "",
+            )
+
+    # -- sender thread --------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._closed and not self._needs_resync
+                       and not self._q):
+                    self._cond.wait(0.5)
+                if self._closed and not self._q and not self._needs_resync:
+                    return
+                if self._needs_resync:
+                    do_resync, data = True, b""
+                else:
+                    do_resync = False
+                    data = self._q.popleft()
+                    self._q_bytes -= len(data)
+                self._delivering = True
+            try:
+                if do_resync:
+                    self._do_resync()
+                else:
+                    self.send(data)
+            except Exception:  # noqa: BLE001 — a broken follower must
+                # never take down the sender loop
+                logger.exception("WAL sink %r delivery failed", self.name)
+                with self._cond:
+                    self.stalls += 1
+                    self._q.clear()
+                    self._q_bytes = 0
+                    if self.resync is not None and not self._closed:
+                        self._needs_resync = True
+                    dead_end = self._closed
+                self.owner._count("shard_follower_stalls_total")
+                if dead_end:
+                    return  # the finally clears _delivering
+                time.sleep(0.01)
+            finally:
+                with self._cond:
+                    self._delivering = False
+                    self._cond.notify_all()
+
+    def _do_resync(self) -> None:
+        pers = self.owner
+        with pers._lock:
+            if not pers._dead:
+                pers._flush_locked(fsync=True)
+            state = pers.recover()
+            # Clear queue + flag while STILL holding the WAL lock: every
+            # offer() after this instant carries records strictly after
+            # ``state``, so bootstrap + queue replay is gapless.
+            with self._cond:
+                self._q.clear()
+                self._q_bytes = 0
+                self._needs_resync = False
+        assert self.resync is not None
+        self.resync(state)
+        with self._cond:
+            self.resyncs += 1
+
+    # -- lifecycle ------------------------------------------------------
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until the queue is empty, no delivery is in flight and
+        no resync is pending (or the deadline passes)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._q or self._delivering or self._needs_resync:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.05))
+            return True
+
+    def close(self, timeout: float = 2.0) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=timeout)
+
+    def stats(self) -> Dict[str, int]:
+        with self._cond:
+            return {
+                "queued_bytes": self._q_bytes,
+                "queued_runs": len(self._q),
+                "stalls": self.stalls,
+                "resyncs": self.resyncs,
+                "needs_resync": int(self._needs_resync),
+            }
+
+
 class Persistence:
     """WAL + snapshot writer for one data dir.
 
@@ -159,11 +330,13 @@ class Persistence:
         self._snap_tmp_path = os.path.join(data_dir, SNAPSHOT_TMP_NAME)
         self._f: Optional[Any] = None  # binary append handle, open()ed
         self._buf: List[bytes] = []    # serialized records awaiting flush
-        # WAL shipping sinks (hot-standby replicas, runtime/shard.py):
-        # each gets the exact byte runs this layer writes to disk, at the
-        # moment they become durable — so a sink's replayed state can
-        # never run ahead of what a crash would leave on disk.
-        self._shippers: List[Any] = []
+        # WAL shipping sinks (hot-standby replicas in runtime/shard.py,
+        # socket shippers in runtime/transport.py): each gets the exact
+        # byte runs this layer writes to disk, at the moment they become
+        # durable — so a sink's replayed state can never run ahead of
+        # what a crash would leave on disk. Delivery is asynchronous
+        # through a bounded per-sink queue (_ShipSink).
+        self._shippers: List[_ShipSink] = []
         self._flusher: Optional[threading.Thread] = None
         self._stop_flusher = threading.Event()
         self._since_snapshot = 0
@@ -260,6 +433,12 @@ class Persistence:
         # Join OUTSIDE the lock: the flusher may be blocked acquiring it.
         if flusher is not None and flusher is not threading.current_thread():
             flusher.join(timeout=2.0)
+        # Deliver whatever the sinks still hold, then stop their sender
+        # threads. Drain-before-close so a follower attached to a layer
+        # being shut down ends byte-identical to the on-disk WAL.
+        if self._shippers:
+            self.drain_shippers()
+            self.close_shippers()
 
     def kill(self, point: str = "external") -> None:
         """Simulate ``kill -9`` at a clean boundary: the unflushed buffer
@@ -356,9 +535,14 @@ class Persistence:
 
     def flush(self, fsync: bool = True) -> None:
         with self._lock:
-            if self._dead:
-                return
-            self._flush_locked(fsync=fsync)
+            if not self._dead:
+                self._flush_locked(fsync=fsync)
+        # Outside the lock: let the sinks catch up, preserving the
+        # pre-async contract that a follower has seen every byte a
+        # flush() made durable. (Also runs on a dead layer — bytes
+        # already on disk still reach the sinks after a kill.)
+        if self._shippers:
+            self.drain_shippers()
 
     def _flush_locked(self, fsync: bool) -> None:
         if not self._buf and (not fsync or self.durable_seq >= self._written_seq):
@@ -454,19 +638,17 @@ class Persistence:
             self._count("wal_group_commit_total")
 
     def _ship(self, data: bytes) -> None:
-        """Forward a just-written byte run to every shipping sink.
-        Called with the lock held, AFTER the bytes hit the file — a
-        follower therefore only ever sees bytes an independent replay
-        of the on-disk WAL would also see."""
+        """Offer a just-written byte run to every shipping sink's
+        bounded queue. Called with the lock held, AFTER the bytes hit
+        the file — a follower therefore only ever sees bytes an
+        independent replay of the on-disk WAL would also see. The offer
+        never blocks: a sink that cannot keep up drops its backlog and
+        resyncs (see :class:`_ShipSink`)."""
         if not self._shippers or not data:
             return
         self._count("wal_shipped_bytes_total", float(len(data)))
-        for fn in self._shippers:
-            try:
-                fn(data)
-            except Exception:  # noqa: BLE001 — a broken follower must
-                # never fail the leader's write path
-                logger.exception("WAL shipper raised; follower may lag")
+        for sink in self._shippers:
+            sink.offer(data)
 
     def attach_follower(self, follower) -> "RecoveredState":
         """Bootstrap ``follower`` from the current on-disk state and
@@ -475,15 +657,76 @@ class Persistence:
         between the bootstrap read and the first shipped run.
 
         ``follower`` implements ``bootstrap(RecoveredState)`` and
-        ``apply_bytes(bytes)`` (see :class:`runtime.shard.FollowerReplica`).
-        Returns the bootstrap state (forensics/logging)."""
+        ``apply_bytes(bytes)`` (see :class:`runtime.shard.FollowerReplica`);
+        when it also implements ``resync(RecoveredState)`` the sink can
+        recover it after a stall. Returns the bootstrap state
+        (forensics/logging)."""
         with self._lock:
             if not self._dead:
                 self._flush_locked(fsync=True)
             state = self.recover()
             follower.bootstrap(state)
-            self._shippers.append(follower.apply_bytes)
+            self._shippers.append(_ShipSink(
+                self, follower.apply_bytes,
+                resync=getattr(follower, "resync", None),
+                name=getattr(follower, "name", "follower"),
+            ))
             return state
+
+    def attach_sink(
+        self,
+        send: Callable[[bytes], None],
+        resync: Optional[Callable[["RecoveredState"], None]] = None,
+        name: str = "sink",
+        max_buffered_bytes: int = DEFAULT_SHIP_QUEUE_BYTES,
+    ) -> "_ShipSink":
+        """Subscribe an arbitrary sink (e.g. a socket writer,
+        :mod:`runtime.transport`) to future durable byte runs.
+
+        Unlike :meth:`attach_follower` the initial bootstrap is NOT
+        performed synchronously here: the sink starts in needs-resync
+        state and its sender thread delivers the bootstrap via
+        ``resync`` — attaching never blocks on the remote end.
+
+        The sink must be registered in ``_shippers`` before its sender
+        thread can take the bootstrap snapshot (``_do_resync`` needs
+        this same lock): constructing the sink starts that thread, and
+        a record appended between the snapshot and registration would
+        be in neither the bootstrap nor any offered run — silently
+        invisible to the follower forever."""
+        with self._lock:
+            sink = _ShipSink(
+                self, send, resync=resync, name=name,
+                max_buffered_bytes=max_buffered_bytes,
+                needs_resync=resync is not None,
+            )
+            self._shippers.append(sink)
+        return sink
+
+    def detach_sink(self, sink: "_ShipSink") -> None:
+        with self._lock:
+            try:
+                self._shippers.remove(sink)
+            except ValueError:
+                pass
+        sink.close()
+
+    def drain_shippers(self, timeout: float = 5.0) -> bool:
+        """Wait until every sink has delivered its backlog (including a
+        pending resync). Called by failover before the I6 check — the
+        follower must have seen every durable byte first — and by
+        ``flush()`` so 'flush then compare follower state' keeps its
+        pre-async meaning. Must NOT be called with the WAL lock held
+        (a pending resync needs it)."""
+        deadline = time.monotonic() + timeout
+        ok = True
+        for sink in list(self._shippers):
+            ok = sink.drain(max(0.0, deadline - time.monotonic())) and ok
+        return ok
+
+    def close_shippers(self, timeout: float = 2.0) -> None:
+        for sink in list(self._shippers):
+            sink.close(timeout=timeout)
 
     # ---- snapshots --------------------------------------------------------
 
@@ -671,6 +914,7 @@ __all__ = [
     "SimulatedCrash",
     "DEFAULT_FSYNC_EVERY",
     "DEFAULT_SNAPSHOT_EVERY",
+    "DEFAULT_SHIP_QUEUE_BYTES",
     "SNAPSHOT_NAME",
     "WAL_NAME",
 ]

@@ -187,12 +187,15 @@ class FollowerReplica:
     the torn record. That is what keeps the I6 equivalence exact.
     """
 
-    def __init__(self, clock: Optional[Clock] = None):
+    def __init__(self, clock: Optional[Clock] = None, name: str = "follower"):
         self.store = APIServer(clock)
+        self.name = name
+        self._clock = clock
         self._lock = threading.Lock()
         self._tail = b""
         self.records_applied = 0
         self.records_dropped = 0  # unparseable lines (corrupt mid-stream)
+        self.resyncs = 0
         self.bootstrap_rv = 0
         #: Total shipped bytes received (applied + torn tail) — compared
         #: against the leader's ``bytes_appended`` for byte-domain lag.
@@ -211,6 +214,34 @@ class FollowerReplica:
         for key in state.wal_deleted_keys:
             self.deleted_keys[tuple(key)] = state.rv
         self.bootstrap_rv = state.rv
+
+    def resync(self, state: RecoveredState) -> None:
+        """Re-bootstrap from a fresh recovered state after the shipping
+        channel lost bytes (queue overflow drop, socket reconnect).
+
+        ``APIServer.restore_state`` refuses a non-empty store, so the
+        replica swaps in a FRESH store seeded from ``state`` — readers
+        holding the old store keep a consistent (stale) view until they
+        re-fetch. Counters stay cumulative across resyncs, so record/byte
+        lag deltas versus the leader are only exact between resyncs.
+        """
+        fresh = APIServer(self._clock)
+        if not state.empty:
+            fresh.restore_state(state.objects, state.rv)
+        with self._lock:
+            old = self.store
+            self.store = fresh
+            self._tail = b""
+            self.deleted_keys = {
+                tuple(key): state.rv for key in state.wal_deleted_keys
+            }
+            self.bootstrap_rv = state.rv
+            self.resyncs += 1
+            self.last_apply_monotonic = time.monotonic()
+        try:
+            old.close()
+        except Exception:  # pragma: no cover - teardown best-effort
+            logger.exception("follower old store close failed")
 
     def apply_bytes(self, data: bytes) -> None:
         """Consume a shipped byte run; applies every COMPLETE line."""
@@ -696,10 +727,19 @@ class ShardedControlPlane:
             detected_at_s = t_start
 
         old_pers = shard.persistence
-        if old_pers is not None and not old_pers.dead:
-            # Clean handover (e.g. rolling restart): flush + stop the old
-            # durability layer first so the follower has every byte.
-            old_pers.close()
+        if old_pers is not None:
+            if not old_pers.dead:
+                # Clean handover (e.g. rolling restart): flush + stop the
+                # old durability layer first (close() also drains the
+                # async ship queues) so the follower has every byte.
+                old_pers.close()
+            else:
+                # Killed leader: bytes that are already durable on disk
+                # may still sit in the async ship queues — the socket
+                # analog of frames the kernel accepted before the kill.
+                # Deliver them before judging I6, then stop the senders.
+                old_pers.drain_shippers()
+                old_pers.close_shippers()
         t_caught_up = time.time()
 
         # I6, per shard: the follower must equal an independent replay of
@@ -822,6 +862,8 @@ class ShardedControlPlane:
         for s in self.shards:
             entry: Dict[str, Any] = {
                 "shard": s.index,
+                "pid": os.getpid(),
+                "alive": s.persistence is None or not s.persistence.dead,
                 "objects": len(s.store),
                 "rv": int(getattr(s.store, "_rv", 0)),
                 "failovers": s.failovers,
@@ -832,18 +874,22 @@ class ShardedControlPlane:
                 entry["wal"] = s.persistence.stats()
                 entry["wal_buffered_bytes"] = s.persistence.buffered_bytes()
             if s.follower is not None:
+                lag = s.lag()
                 entry["follower"] = {
                     "records_applied": s.follower.records_applied,
                     "records_dropped": s.follower.records_dropped,
+                    "resyncs": s.follower.resyncs,
                     "bytes_applied": s.follower.bytes_applied,
                     "torn_tail_bytes": s.follower.lag_bytes,
-                    "lag": s.lag(),
+                    "lag": lag,
+                    "lag_seconds": lag["seconds"],
                 }
             shards.append(entry)
         self.refresh_lag_gauges()
         return {
             "n_shards": self.n_shards,
             "replicas": self.replicas,
+            "pid": os.getpid(),
             "composite_rv": int(self.router._rv),
             "objects": len(self.router),
             "shards": shards,
@@ -861,9 +907,14 @@ class ShardedControlPlane:
                 shard.store.close()
             except Exception:  # pragma: no cover - teardown best-effort
                 logger.exception("shard %d store close failed", shard.index)
-            if shard.persistence is not None and not shard.persistence.dead:
+            if shard.persistence is not None:
                 try:
-                    shard.persistence.close()
+                    if not shard.persistence.dead:
+                        shard.persistence.close()
+                    else:
+                        # Dead layers skip close(), but their async ship
+                        # sender threads must still be stopped.
+                        shard.persistence.close_shippers()
                 except Exception:  # pragma: no cover
                     logger.exception(
                         "shard %d persistence close failed", shard.index

@@ -2332,6 +2332,387 @@ def check_fleet_invariants(ev: dict) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# multi-PROCESS leg: real OS processes, literal SIGKILL, lease failover
+# ---------------------------------------------------------------------------
+
+
+def _proc_cron(i: int) -> dict:
+    # Far-future schedule: the process leg proves durability + failover
+    # of the CONTROL PLANE; cron firings would make the expected surface
+    # a moving target across kills (fired workloads have their own legs).
+    return {
+        "apiVersion": CRON_API_VERSION,
+        "kind": "Cron",
+        "metadata": {"name": f"proc-{i}", "namespace": NAMESPACE},
+        "spec": {
+            "schedule": "0 0 1 1 *",
+            "concurrencyPolicy": POLICIES[i % len(POLICIES)],
+            "template": {"workload": {
+                "apiVersion": WORKLOAD_API_VERSION,
+                "kind": WORKLOAD_KIND,
+                "metadata": {},
+                "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+            }},
+        },
+    }
+
+
+def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
+                     lease_ttl_s: float = 1.0,
+                     failover_timeout_s: float = 30.0) -> dict:
+    """SIGKILL a shard *process* mid-storm, every round.
+
+    Spawns the real topology — one leader + one standby process per
+    shard, one router process — then drives a CRUD storm through the
+    router while a PRF-chosen victim shard's serving process gets a
+    literal ``kill -9`` each round. The standby must self-promote on
+    lease expiry (I6 checked against an independent on-disk WAL replay
+    before it serves, from its ``promotion-*.json``), the storm's writes
+    must survive via retry, and every generation that shuts down
+    gracefully must prove I9 (audit ≡ WAL) in its ``audit-check`` file.
+    """
+    import random
+    import signal as _signal
+    import subprocess
+    import urllib.request
+
+    from cron_operator_tpu.runtime.kube import (
+        AlreadyExistsError,
+        ApiError,
+        ConflictError,
+        NotFoundError,
+    )
+    from cron_operator_tpu.runtime.transport import ShardClient
+    from cron_operator_tpu.runtime.shard import shard_index
+
+    rng = random.Random(0x9E3779B9 ^ seed)
+    data_dir = tempfile.mkdtemp(prefix="chaos-processes-")
+    log_dir = os.path.join(data_dir, "logs")
+    os.makedirs(log_dir)
+    base = 21840 + (seed % 17) * 128
+    t_start = time.time()
+
+    def spawn(role_args: list, tag: str) -> subprocess.Popen:
+        log = open(os.path.join(log_dir, f"{tag}.log"), "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m", "cron_operator_tpu.cli.main", "start",
+             "--health-probe-bind-address", "0",
+             "--lease-ttl", str(lease_ttl_s)] + role_args,
+            stdout=log, stderr=subprocess.STDOUT,
+        )
+
+    def spawn_leader(si: int) -> subprocess.Popen:
+        return spawn([
+            "--shard-role", "shard", "--shard-index", str(si),
+            "--data-dir", data_dir,
+            "--serve-api", f"127.0.0.1:{base + 1 + si}",
+            "--ship-port", str(base + 64 + si),
+        ], f"shard-{si}-leader")
+
+    def spawn_standby(si: int, gen: int) -> subprocess.Popen:
+        return spawn([
+            "--shard-role", "standby", "--shard-index", str(si),
+            "--data-dir", data_dir,
+            "--serve-api", f"127.0.0.1:{base + 1 + si}",
+            "--ship-port", str(base + 64 + si),
+        ], f"shard-{si}-standby-{gen}")
+
+    def debug_doc(port: int, timeout: float = 1.0):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/shards",
+                    timeout=timeout) as r:
+                return json.loads(r.read())
+        except Exception:
+            return None
+
+    def wait_serving(port: int, deadline_s: float):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            doc = debug_doc(port)
+            if doc is not None:
+                return doc
+            time.sleep(0.05)
+        return None
+
+    serving: dict = {}   # shard -> its current serving Popen
+    standbys: dict = {}  # shard -> its current standby Popen
+    everyone: list = []
+    for si in range(shards):
+        serving[si] = spawn_leader(si)
+        everyone.append(serving[si])
+    for si in range(shards):
+        doc = wait_serving(base + 1 + si, 30.0)
+        assert doc is not None, f"shard {si} never served"
+    for si in range(shards):
+        standbys[si] = spawn_standby(si, 0)
+        everyone.append(standbys[si])
+    router = spawn([
+        "--shard-role", "router",
+        "--serve-api", f"127.0.0.1:{base}",
+        "--peers", ",".join(f"127.0.0.1:{base + 1 + si}"
+                            for si in range(shards)),
+    ], "router")
+    everyone.append(router)
+    assert wait_serving(base, 30.0) is not None, "router never served"
+
+    client = ShardClient(f"http://127.0.0.1:{base}")
+    expected: dict = {}  # name -> True (live crons by the storm's book)
+    retried_ops = 0
+
+    def storm_op(op: str, name: str) -> None:
+        """One storm verb through the router, retried across the
+        failover window. A retried CREATE observing AlreadyExists (or a
+        retried DELETE observing NotFound) means the first attempt
+        committed before the kill — success, not an error."""
+        nonlocal retried_ops
+        deadline = time.monotonic() + failover_timeout_s
+        attempt = 0
+        while True:
+            try:
+                if op == "create":
+                    client.create(_proc_cron_named(name))
+                elif op == "delete":
+                    client.delete(CRON_API_VERSION, "Cron", NAMESPACE, name)
+                else:  # update
+                    cur = client.get(CRON_API_VERSION, "Cron", NAMESPACE,
+                                     name)
+                    labels = dict((cur["metadata"].get("labels") or {}))
+                    labels["chaos"] = f"round-{attempt}"
+                    cur["metadata"]["labels"] = labels
+                    client.update(cur)
+                return
+            except AlreadyExistsError:
+                if op == "create":
+                    return  # first attempt committed before the kill
+                raise
+            except NotFoundError:
+                if op in ("delete", "update"):
+                    return  # delete committed / update target deleted
+                raise
+            except ConflictError:
+                pass  # re-read and retry
+            except (ApiError, OSError):
+                if time.monotonic() >= deadline:
+                    raise
+            attempt += 1
+            retried_ops += 1
+            time.sleep(0.1)
+
+    def _proc_cron_named(name: str) -> dict:
+        doc = _proc_cron(0)
+        doc["metadata"]["name"] = name
+        return doc
+
+    for i in range(n_crons):
+        name = f"proc-{i}"
+        storm_op("create", name)
+        expected[name] = True
+
+    next_id = n_crons
+    kills = []
+    try:
+        for r in range(rounds):
+            victim = rng.randrange(shards)
+            ops = []
+            for _ in range(24):
+                verb = rng.random()
+                live = [n for n, ok in expected.items() if ok]
+                if verb < 0.5 or not live:
+                    ops.append(("create", f"proc-{next_id}"))
+                    next_id += 1
+                elif verb < 0.75:
+                    ops.append(("delete", rng.choice(live)))
+                    # mirror the book immediately so later ops this
+                    # round don't double-delete
+                    expected[ops[-1][1]] = False
+                else:
+                    ops.append(("update", rng.choice(live)))
+            for op, name in ops[:12]:
+                storm_op(op, name)
+                if op == "create":
+                    expected[name] = True
+
+            # Mid-storm: SIGKILL the victim shard's serving process.
+            doc = debug_doc(base + 1 + victim, timeout=2.0)
+            assert doc is not None, f"round {r}: victim {victim} not up"
+            victim_pid = doc["pid"]
+            os.kill(victim_pid, _signal.SIGKILL)
+            t_kill = time.monotonic()
+            serving[victim].wait(timeout=10)
+
+            # The storm keeps going while the standby promotes: writes
+            # to other shards proceed; victim-shard writes retry.
+            for op, name in ops[12:]:
+                storm_op(op, name)
+                if op == "create":
+                    expected[name] = True
+
+            doc = wait_serving(base + 1 + victim, failover_timeout_s)
+            failover_s = time.monotonic() - t_kill
+            assert doc is not None, (
+                f"round {r}: shard {victim} never failed over")
+            promoted_pid = doc["pid"]
+            assert promoted_pid == standbys[victim].pid, (
+                f"round {r}: serving pid {promoted_pid} is not the "
+                f"standby {standbys[victim].pid}")
+
+            # The standby's I6 verdict, written before it served.
+            prom_path = os.path.join(
+                data_dir, f"shard-{victim}",
+                f"promotion-{promoted_pid}.json")
+            with open(prom_path) as f:
+                promotion = json.load(f)
+
+            # The promoted process is the new leader; arm a fresh
+            # standby behind it (spawned only now — two armed standbys
+            # would race each other to the same ports).
+            serving[victim] = standbys[victim]
+            standbys[victim] = spawn_standby(victim, r + 1)
+            everyone.append(standbys[victim])
+
+            kills.append({
+                "round": r,
+                "shard": victim,
+                "victim_pid": victim_pid,
+                "promoted_pid": promoted_pid,
+                "failover_s": round(failover_s, 3),
+                "promotion_s": round(promotion["duration_s"], 3),
+                "i6_ok": bool(promotion["i6_ok"]),
+                "replica_matched_socket": bool(
+                    promotion["replica_matched_socket"]),
+                "objects": promotion["objects"],
+                "rv": promotion["rv"],
+            })
+            print(
+                f"  round {r}: SIGKILL shard {victim} pid {victim_pid} "
+                f"-> promoted pid {promoted_pid} in {failover_s:.2f}s "
+                f"(i6_ok={promotion['i6_ok']})",
+                flush=True,
+            )
+
+        # Surface check: the storm's book vs the store, through the
+        # router, after every kill (retries make writes exactly-once at
+        # this surface, so the sets must match exactly).
+        want = {n for n, ok in expected.items() if ok}
+        got = {o["metadata"]["name"]
+               for o in client.list(CRON_API_VERSION, "Cron")}
+        surface = {
+            "expected": len(want),
+            "found": len(got),
+            "missing": sorted(want - got)[:10],
+            "extra": sorted(got - want)[:10],
+            "ok": got == want,
+        }
+
+        # Per-shard split (each shard's own front door), for the report.
+        split = {}
+        for si in range(shards):
+            c = ShardClient(f"http://127.0.0.1:{base + 1 + si}")
+            try:
+                split[si] = len(c.list(CRON_API_VERSION, "Cron"))
+            finally:
+                c.close()
+        routed = {n: shard_index(NAMESPACE, n, shards) for n in want}
+        split_ok = all(
+            split[si] == sum(1 for s in routed.values() if s == si)
+            for si in range(shards)
+        )
+    finally:
+        client.close()
+        # Graceful SIGTERM for everything still alive: each serving
+        # generation writes its audit-check (I9) file on the way out.
+        for p in everyone:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + 20.0
+        for p in everyone:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    audit_checks = []
+    for si in range(shards):
+        sdir = os.path.join(data_dir, f"shard-{si}")
+        for fn in sorted(os.listdir(sdir)):
+            if fn.startswith("audit-check-"):
+                with open(os.path.join(sdir, fn)) as f:
+                    doc = json.load(f)
+                audit_checks.append({
+                    "shard": si, "file": fn, "ok": bool(doc["ok"]),
+                    "audited_records": doc["audited_records"],
+                    "wal_records_appended": doc["wal_records_appended"],
+                })
+
+    shutil.rmtree(data_dir, ignore_errors=True)
+    return {
+        "mode": "processes",
+        "shards": shards,
+        "lease_ttl_s": lease_ttl_s,
+        "port_base": base,
+        "kills": kills,
+        "retried_ops": retried_ops,
+        "surface": surface,
+        "per_shard_objects": split,
+        "shard_split_matches_hash": split_ok,
+        "audit_checks": audit_checks,
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+
+
+def check_process_invariants(ev: dict) -> dict:
+    """I6/I9 and the storm-surface checks for the process leg."""
+    kills = ev["kills"]
+    bad_i6 = [k for k in kills if not k["i6_ok"]]
+    i6 = {
+        "ok": bool(kills) and not bad_i6,
+        "detail": (
+            f"{len(kills)} SIGKILL round(s): every promoted standby "
+            "matched an independent replay of the on-disk WAL before "
+            "serving" if kills and not bad_i6
+            else {"kill_rounds": len(kills), "failed": bad_i6}
+        ),
+    }
+    checks = ev["audit_checks"]
+    bad_i9 = [c for c in checks if not c["ok"]]
+    i9 = {
+        "ok": bool(checks) and not bad_i9,
+        "detail": (
+            f"{len(checks)} surviving generation(s) proved audit ≡ WAL "
+            "at graceful shutdown (SIGKILLed generations die with their "
+            "journals, by design)" if checks and not bad_i9
+            else {"checks": len(checks), "failed": bad_i9}
+        ),
+    }
+    surface = {
+        "ok": ev["surface"]["ok"] and ev["shard_split_matches_hash"],
+        "detail": (
+            f"storm book == routed surface ({ev['surface']['found']} "
+            "cron(s)) and per-shard split matches the hash"
+            if ev["surface"]["ok"] and ev["shard_split_matches_hash"]
+            else {"surface": ev["surface"],
+                  "split": ev["per_shard_objects"]}
+        ),
+    }
+    failovers = [k["failover_s"] for k in kills]
+    bounded = {
+        "ok": bool(failovers) and max(failovers) < 15.0,
+        "detail": {
+            "failover_s": failovers,
+            "max_s": max(failovers) if failovers else None,
+            "bound_s": 15.0,
+        },
+    }
+    return {
+        "I6_recovered_equals_wal_replay": i6,
+        "I9_audit_equals_wal": i9,
+        "surface_consistent": surface,
+        "failover_bounded": bounded,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -2381,6 +2762,20 @@ def main(argv=None) -> int:
                          "mid-storm; no admitted job may be lost, quotas "
                          "never exceeded, preempted runs resume into one "
                          "history entry (invariants F1-F3)")
+    ap.add_argument("--processes", action="store_true", default=False,
+                    help="run ONLY the multi-PROCESS leg: spawn the real "
+                         "topology (per-shard leader + standby processes "
+                         "behind a router process), drive a CRUD storm "
+                         "through the router, and SIGKILL a PRF-chosen "
+                         "shard's serving process every round — the "
+                         "standby must self-promote on lease-file expiry "
+                         "with I6 (promoted ≡ on-disk WAL replay) checked "
+                         "before serving and I9 (audit ≡ WAL) proved at "
+                         "each graceful shutdown; --shards sets the "
+                         "topology width (default 2)")
+    ap.add_argument("--lease-ttl", type=float, default=1.0,
+                    help="processes leg: leader lease TTL in seconds "
+                         "(bounds failover detection)")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
 
@@ -2407,6 +2802,52 @@ def main(argv=None) -> int:
         plan_a.schedule(args.rounds) == plan_b.schedule(args.rounds)
         and plan_a.trace_hash(args.rounds) == plan_b.trace_hash(args.rounds)
     )
+
+    if args.processes:
+        shards = args.shards if args.shards > 0 else 2
+        n_crons = min(args.crons, 120)  # wire CRUD, not an HTTP bench
+        print(
+            f"chaos soak (processes): seed={args.seed} crons={n_crons} "
+            f"rounds={args.rounds} shards={shards} "
+            f"lease_ttl={args.lease_ttl}s — literal SIGKILL per round",
+            flush=True,
+        )
+        ev = run_process_soak(args.seed, n_crons, args.rounds, shards,
+                              lease_ttl_s=args.lease_ttl)
+        invariants = check_process_invariants(ev)
+        ok = all(v["ok"] for v in invariants.values())
+        report = {
+            "seed": args.seed,
+            "mode": "processes",
+            "rounds": args.rounds,
+            "shards": shards,
+            "processes_leg": ev,
+            "invariants": invariants,
+            "ok": ok,
+        }
+        # If --out already holds a classic single-process soak report
+        # (make chaos-soak writes that leg first), fold this one in
+        # under "processes" so CHAOS.json carries both, with a combined
+        # top-level ok.
+        out_doc = report
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+            if (isinstance(existing, dict)
+                    and existing.get("mode") != "processes"):
+                existing["processes"] = report
+                existing["ok"] = bool(existing.get("ok")) and ok
+                out_doc = existing
+        except (OSError, ValueError):
+            pass
+        with open(args.out, "w") as f:
+            json.dump(out_doc, f, indent=2, default=str)
+            f.write("\n")
+        for name, v in invariants.items():
+            mark = "PASS" if v["ok"] else "FAIL"
+            print(f"  [{mark}] {name}: {v['detail']}")
+        print(f"wrote {args.out} (ok={ok})")
+        return 0 if ok else 1
 
     if args.fleet_flap:
         # Standalone fleet leg: the heterogeneity-aware scheduler under

@@ -90,6 +90,20 @@ echo "==> shard-kill failover smoke (2 shards, WAL-shipping hot standby)"
 python hack/chaos_soak.py --seed 11 --crons 24 --rounds 3 --shards 2 \
     --out /dev/null
 
+echo "==> multi-process kill -9 smoke (2 shard processes, lease failover)"
+# Fixed-seed PROCESS-mode soak: spawns the real topology (one leader +
+# one standby OS process per shard, socket WAL shipping, on-disk lease
+# files, one router process), SIGKILLs a PRF-chosen shard's serving
+# process mid-storm, and requires the standby to self-promote within the
+# bounded failover window with I6 proven against an independent disk
+# replay before serving (promotion-*.json) and I9 (audit ≡ WAL) proven
+# by every gracefully-stopped generation (audit-check-*.json). The storm
+# book must equal the routed surface exactly, split per shard by the
+# consistent hash. Full run: make chaos-soak (processes leg of
+# CHAOS.json).
+python hack/chaos_soak.py --processes --seed 7 --crons 24 --rounds 1 \
+    --out /dev/null
+
 echo "==> preempt-storm smoke (elastic reshard-on-preemption, I8)"
 # Fixed-seed storm over REAL CPU-mesh training jobs: two rounds of
 # PRF-scheduled slice preemptions against paced mnist runs; the

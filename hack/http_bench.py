@@ -33,6 +33,12 @@ and ``http.client``, not mocks:
 - **zero steady-state writes**: a read-only phase (lists, gets, a live
   watch) brackets the store's resourceVersion counter and the WAL's
   record count; both deltas must be zero.
+- **distributed sweep**: the real multi-process topology — N shard
+  processes (own store + WAL each) behind the consistent-hash router
+  process. Watch streams on the router must deliver every event fanned
+  in from the shards, and the routed closed-loop durable-create
+  aggregate must stay within 20% of the shared-nothing sum (the same
+  load driven directly at every shard concurrently, rates summed).
 
 Writes ``BENCH_HTTP.json`` with per-scenario OK/REGRESSION verdicts and
 an overall verdict; ``--check`` exits non-zero on REGRESSION and is the
@@ -657,6 +663,340 @@ def fairness_leg(quiet_samples: int, quiet_interval_ms: float,
 
 
 # ---------------------------------------------------------------------------
+# Scenario 5: distributed sweep — shard processes behind the router
+# ---------------------------------------------------------------------------
+
+# The routed aggregate must stay within 20% of the shared-nothing sum:
+# the same concurrent load, driven directly at each shard process and
+# summed, is the ceiling the single-process router proxy is measured
+# against.
+DIST_MIN_SUM_RATIO = 0.8
+# Far-future schedule so the per-shard CronReconcilers never fire a
+# workload mid-bench — the measured surface is pure front-door traffic.
+DIST_SCHEDULE = "0 0 1 1 *"
+
+
+def _balanced_names(prefix: str, total: int, shards: int):
+    """``total`` cron names spread as evenly as the consistent hash
+    allows across homes (remainder to the lowest indices), so the routed
+    drive offers near-identical load to every shard process and the
+    comparison against the shared-nothing sum is not skewed by hash
+    luck."""
+    from cron_operator_tpu.runtime.shard import shard_index
+
+    want = {si: total // shards + (1 if si < total % shards else 0)
+            for si in range(shards)}
+    buckets: dict = {si: [] for si in range(shards)}
+    i = 0
+    while any(len(buckets[si]) < want[si] for si in range(shards)):
+        name = f"{prefix}-{i}"
+        i += 1
+        si = shard_index("default", name, shards)
+        if len(buckets[si]) < want[si]:
+            buckets[si].append(name)
+    names: list = []
+    for si in range(shards):
+        names.extend(buckets[si])
+    return names, {str(si): len(b) for si, b in buckets.items()}
+
+
+def _drive_creates(host: str, port: int, names, threads_n: int, errors):
+    """Closed-loop create drive: ``threads_n`` keep-alive connections
+    split ``names`` and POST as fast as the durable 201s come back.
+    Returns (completed, elapsed_s)."""
+    import http.client
+
+    path = f"/apis/{CRON_AV}/namespaces/default/crons"
+    chunks = [names[i::threads_n] for i in range(threads_n)]
+    done = [0] * threads_n
+    gate = threading.Barrier(threads_n + 1)
+
+    def worker(idx: int) -> None:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            gate.wait()
+            for name in chunks[idx]:
+                status = _post_json(
+                    conn, path, _cron(name, schedule=DIST_SCHEDULE))
+                if status == 201:
+                    done[idx] += 1
+                else:
+                    errors.append(f"{name}: HTTP {status}")
+        except Exception as exc:  # pragma: no cover — surfaced in artifact
+            errors.append(f"drive-{idx}: {exc!r}")
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(threads_n)]
+    for t in threads:
+        t.start()
+    gate.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join(timeout=300.0)
+    return sum(done), time.perf_counter() - t0
+
+
+def _routed_watch(host: str, port: int, watchers: int, events: int,
+                  names, timeout_s: float) -> dict:
+    """W watch streams on the ROUTER's front door; E creates spread
+    across the shard processes underneath. Every frame crosses two
+    sockets (shard -> router watch stream -> hub -> client) and must
+    still arrive exactly once per watcher."""
+    import http.client
+
+    socks = []
+    t0 = time.perf_counter()
+    try:
+        pairs = [_open_watch_socket(host, port) for _ in range(watchers)]
+        socks = [s for s, _ in pairs]
+        establish_s = time.perf_counter() - t0
+
+        sel = selectors.DefaultSelector()
+        counts = {}
+        for s, carry in pairs:
+            counts[s] = carry.count(ADDED_MARKER)
+            sel.register(s, selectors.EVENT_READ,
+                         carry[-(len(ADDED_MARKER) - 1):])
+
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        path = f"/apis/{CRON_AV}/namespaces/default/crons"
+        expected = watchers * events
+        delivered = sum(counts.values())
+        t0 = time.perf_counter()
+        for name in names[:events]:
+            _post_json(conn, path, _cron(name, schedule=DIST_SCHEDULE))
+        conn.close()
+        deadline = t0 + timeout_s
+        while delivered < expected and time.perf_counter() < deadline:
+            for key, _ in sel.select(timeout=0.5):
+                s = key.fileobj
+                try:
+                    data = s.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    sel.unregister(s)
+                    continue
+                if not data:
+                    sel.unregister(s)
+                    continue
+                combined = key.data + data
+                counts[s] += combined.count(ADDED_MARKER) - \
+                    key.data.count(ADDED_MARKER)
+                sel.modify(s, selectors.EVENT_READ,
+                           combined[-(len(ADDED_MARKER) - 1):])
+            delivered = sum(counts.values())
+        elapsed = time.perf_counter() - t0
+        sel.close()
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+    return {
+        "watchers": watchers,
+        "events": events,
+        "expected_frames": expected,
+        "delivered_frames": delivered,
+        "establish_s": round(establish_s, 3),
+        "drain_s": round(elapsed, 3),
+        "events_per_s": round(delivered / elapsed, 1) if elapsed else 0.0,
+        "timed_out": delivered < expected,
+    }
+
+
+def distributed_leg(shards: int, writers_per_shard: int,
+                    creates_per_writer: int, watchers: int, events: int,
+                    timeout_s: float) -> dict:
+    """Spawn the real process topology — one shard process per index plus
+    the consistent-hash router, each its own OS process with its own
+    store + WAL — and measure it two ways:
+
+    - **routed watch**: W streams on the router, E creates spread across
+      shard homes, full delivery through the cross-process fan-in.
+    - **routed vs shared-nothing writes**: the same closed-loop durable
+      create load driven (a) directly at every shard concurrently and
+      summed — the shared-nothing ceiling — and (b) through the router.
+      Gate: routed aggregate >= ``DIST_MIN_SUM_RATIO`` of the sum.
+    """
+    import shutil as _shutil
+    import signal as _signal
+    import urllib.request
+
+    data_dir = tempfile.mkdtemp(prefix="httpbench-dist-")
+    log_dir = os.path.join(data_dir, "logs")
+    os.makedirs(log_dir)
+    base = 23360 + (os.getpid() % 13) * 128
+    procs: list = []
+    errors_direct: list = []
+    errors_routed: list = []
+    leg: dict = {"shards": shards, "port_base": base, "spawn_ok": False}
+
+    def spawn(role_args, tag):
+        log = open(os.path.join(log_dir, f"{tag}.log"), "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m", "cron_operator_tpu.cli.main", "start",
+             "--health-probe-bind-address", "0",
+             "--serve-api-token", TOKEN] + role_args,
+            stdout=log, stderr=subprocess.STDOUT, cwd=_TREE,
+        )
+
+    def debug_doc(port, timeout=1.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/shards",
+            headers={"Authorization": f"Bearer {TOKEN}"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read())
+        except Exception:
+            return None
+
+    def wait_serving(port, deadline_s):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            doc = debug_doc(port)
+            if doc is not None:
+                return doc
+            time.sleep(0.05)
+        return None
+
+    try:
+        for si in range(shards):
+            procs.append(spawn([
+                "--shard-role", "shard", "--shard-index", str(si),
+                "--data-dir", data_dir,
+                "--serve-api", f"127.0.0.1:{base + 1 + si}",
+                "--ship-port", str(base + 64 + si),
+            ], f"shard-{si}"))
+        for si in range(shards):
+            if wait_serving(base + 1 + si, 30.0) is None:
+                raise RuntimeError(f"shard {si} never served")
+        procs.append(spawn([
+            "--shard-role", "router",
+            "--serve-api", f"127.0.0.1:{base}",
+            "--peers", ",".join(f"127.0.0.1:{base + 1 + si}"
+                                for si in range(shards)),
+        ], "router"))
+        if wait_serving(base, 30.0) is None:
+            raise RuntimeError("router never served")
+        leg["spawn_ok"] = True
+
+        # Phase 1: routed watch fan-in (empty stores, so expected frames
+        # are exactly watchers * events).
+        watch_names, _ = _balanced_names("dw", events, shards)
+        leg["watch"] = _routed_watch(
+            "127.0.0.1", base, watchers, events, watch_names, timeout_s)
+
+        # Phase 2: shared-nothing ceiling — every shard driven directly
+        # and concurrently, per-shard rate summed.
+        per_shard_total = writers_per_shard * creates_per_writer
+        direct: dict = {}
+
+        def drive_shard(si: int) -> None:
+            names = [f"sn{si}-{j}" for j in range(per_shard_total)]
+            completed, elapsed = _drive_creates(
+                "127.0.0.1", base + 1 + si, names, writers_per_shard,
+                errors_direct)
+            direct[str(si)] = {
+                "completed": completed,
+                "elapsed_s": round(elapsed, 3),
+                "writes_per_s": round(completed / elapsed, 1)
+                if elapsed else 0.0,
+            }
+
+        drivers = [threading.Thread(target=drive_shard, args=(si,))
+                   for si in range(shards)]
+        for t in drivers:
+            t.start()
+        for t in drivers:
+            t.join(timeout=300.0)
+        shared_nothing_sum = round(
+            sum(d["writes_per_s"] for d in direct.values()), 1)
+
+        # Phase 3: the same total load through the router, names balanced
+        # across hash homes.
+        routed_names, split = _balanced_names(
+            "rt", per_shard_total * shards, shards)
+        routed_completed, routed_elapsed = _drive_creates(
+            "127.0.0.1", base, routed_names, writers_per_shard * shards,
+            errors_routed)
+        routed_rate = round(routed_completed / routed_elapsed, 1) \
+            if routed_elapsed else 0.0
+
+        doc = debug_doc(base, timeout=5.0)
+        leg.update({
+            "writers_per_shard": writers_per_shard,
+            "creates_per_writer": creates_per_writer,
+            "direct": direct,
+            "shared_nothing_sum_writes_per_s": shared_nothing_sum,
+            "routed": {
+                "completed": routed_completed,
+                "elapsed_s": round(routed_elapsed, 3),
+                "writes_per_s": routed_rate,
+                "name_split_by_hash_home": split,
+            },
+            "sum_ratio": round(routed_rate / shared_nothing_sum, 3)
+            if shared_nothing_sum else None,
+            "errors": (errors_direct + errors_routed)[:5],
+            "errors_total": len(errors_direct) + len(errors_routed),
+            "debug_shards": doc,
+        })
+    except Exception as exc:
+        leg["error"] = repr(exc)
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(_signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + 20.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        _shutil.rmtree(data_dir, ignore_errors=True)
+    return leg
+
+
+def _distributed_verdict(leg: dict, check_mode: bool) -> dict:
+    watch = leg.get("watch") or {}
+    ratio = leg.get("sum_ratio")
+    mech_ok = (leg.get("spawn_ok") and "error" not in leg
+               and not watch.get("timed_out", True)
+               and leg.get("errors_total", 1) == 0)
+    if check_mode:
+        # Smoke: gate the mechanism (topology up, full watch delivery,
+        # zero failed writes); the throughput ratio is reported, not
+        # gated — CI boxes are too noisy for a 20% margin.
+        ok = bool(mech_ok and ratio is not None)
+        gate = "mechanism only (--check)"
+    else:
+        ok = bool(mech_ok and ratio is not None
+                  and ratio >= DIST_MIN_SUM_RATIO)
+        gate = f"ratio >= {DIST_MIN_SUM_RATIO}"
+    return {
+        "status": "OK" if ok else "REGRESSION",
+        "sum_ratio": ratio,
+        "required_ratio": None if check_mode else DIST_MIN_SUM_RATIO,
+        "summary": (
+            f"{'OK' if ok else 'REGRESSION'}: routed aggregate "
+            f"{(leg.get('routed') or {}).get('writes_per_s')} writes/s vs "
+            f"shared-nothing sum "
+            f"{leg.get('shared_nothing_sum_writes_per_s')} writes/s across "
+            f"{leg.get('shards')} shard processes (ratio {ratio}, gate "
+            f"{gate}); watch fan-in delivered "
+            f"{watch.get('delivered_frames')}/{watch.get('expected_frames')}"
+            f" frames through the router"
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Baseline A/B (fan-out only: the one scenario the old server can run)
 # ---------------------------------------------------------------------------
 
@@ -752,6 +1092,15 @@ def main() -> int:
                         "closed-loop flood clears a 50x rate ratio")
     p.add_argument("--noisy-threads", type=int, default=24)
     p.add_argument("--fairness-fleet", type=int, default=400)
+    p.add_argument("--dist-shards", type=int, default=2)
+    p.add_argument("--dist-writers", type=int, default=6,
+                   help="closed-loop writer connections per shard in the "
+                        "distributed sweep")
+    p.add_argument("--dist-creates", type=int, default=40,
+                   help="creates per writer connection per phase")
+    p.add_argument("--dist-watchers", type=int, default=200)
+    p.add_argument("--dist-events", type=int, default=10)
+    p.add_argument("--dist-timeout", type=float, default=120.0)
     p.add_argument("--stdout", action="store_true",
                    help="print the artifact JSON to stdout only")
     p.add_argument("--check", action="store_true",
@@ -769,6 +1118,10 @@ def main() -> int:
         args.quiet_samples = 40
         args.noisy_threads = 8
         args.fairness_fleet = 150
+        args.dist_writers = 2
+        args.dist_creates = 10
+        args.dist_watchers = 25
+        args.dist_events = 5
 
     if args.role == "fanout-only":
         result = fanout_leg(args.watchers, args.events, args.fanout_timeout)
@@ -792,12 +1145,17 @@ def main() -> int:
     fairness = fairness_leg(
         args.quiet_samples, args.quiet_interval_ms, args.noisy_threads,
         args.fairness_fleet)
+    distributed = distributed_leg(
+        args.dist_shards, args.dist_writers, args.dist_creates,
+        args.dist_watchers, args.dist_events, args.dist_timeout)
+    distributed_v = _distributed_verdict(distributed, args.check)
 
     verdicts = {
         "fanout": fanout_v,
         "write_fanin": writes["verdict"],
         "fairness": fairness["verdict"],
         "zero_steady_state": writes["zero_steady_state"]["verdict"],
+        "distributed": distributed_v,
     }
     ok = all(v["status"] == "OK" for v in verdicts.values())
     artifact = {
@@ -807,6 +1165,8 @@ def main() -> int:
         "fanout_baseline": baseline,
         "write_fanin": writes,
         "fairness": fairness,
+        "distributed": distributed,
+        "distributed_verdict": distributed_v,
         "verdict": {
             "status": "OK" if ok else "REGRESSION",
             "summary": "; ".join(v["summary"] for v in verdicts.values()),

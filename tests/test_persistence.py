@@ -412,6 +412,133 @@ class TestKillPoints(_TmpDirTest):
         )
 
 
+class TestShipSinkBackpressure(_TmpDirTest):
+    """The async bounded ship queue (Persistence._ship): a wedged
+    follower sink must never block the leader's write path — the queue
+    drops whole, counts a stall, and the sink resyncs from durable
+    state once it unwedges."""
+
+    def test_wedged_sink_drop_then_resync(self):
+        import threading
+        import time as _time
+
+        from cron_operator_tpu.runtime.shard import (
+            FollowerReplica,
+            canonical_state,
+        )
+        from cron_operator_tpu.utils.clock import RealClock
+
+        store = APIServer(clock=FakeClock())
+        metrics = Metrics()
+        pers = Persistence(self.dir, fsync_every=1)
+        pers.instrument(metrics)
+        pers.start(store)
+        self.addCleanup(pers.close)
+
+        replica = FollowerReplica(RealClock(), name="wedged")
+        gate = threading.Event()
+
+        def wedged_apply(data: bytes) -> None:
+            gate.wait()  # deliberately wedged until the test opens it
+            replica.apply_bytes(data)
+
+        sink = pers.attach_sink(
+            wedged_apply, resync=replica.resync, name="wedged",
+            max_buffered_bytes=512,  # tiny: the wedge must trip fast
+        )
+
+        t0 = _time.monotonic()
+        for i in range(100):
+            store.create(_obj(f"w-{i}"))
+        elapsed = _time.monotonic() - t0
+        pers.flush()
+
+        # The whole burst committed without waiting on the wedged sink.
+        self.assertEqual(len(store), 100)
+        self.assertLess(elapsed, 5.0)
+        # The bounded queue overflowed: dropped whole + stall counted
+        # (both on the sink and in the metrics registry) + resync armed.
+        self.assertGreaterEqual(sink.stalls, 1)
+        self.assertGreaterEqual(
+            metrics.counters.get("shard_follower_stalls_total", 0), 1)
+
+        # Unwedge: the pending resync re-seeds the replica from durable
+        # state; it must converge to exactly the on-disk replay.
+        gate.set()
+        self.assertTrue(pers.drain_shippers(timeout=10.0))
+        replay = Persistence(self.dir).recover()
+        self.assertEqual(
+            replica.state(),
+            canonical_state(replay.objects, replay.rv),
+        )
+        self.assertGreaterEqual(sink.resyncs, 1)
+
+
+class TestTornTailOverSocket(_TmpDirTest):
+    """Satellite: the torn-tail contract extended to the socket path. A
+    WAL record deliberately torn at the kill-point ships to a socket
+    follower as-is; the follower must hold it unapplied (line
+    buffering) and end byte-identical to an independent on-disk
+    replay — never a partial apply."""
+
+    def test_torn_tail_socket_follower_equals_disk_replay(self):
+        import time as _time
+
+        from cron_operator_tpu.runtime.shard import (
+            FollowerReplica,
+            canonical_state,
+        )
+        from cron_operator_tpu.runtime.transport import (
+            ShipFollower,
+            WALShipServer,
+        )
+        from cron_operator_tpu.utils.clock import RealClock
+
+        store = APIServer(clock=FakeClock())
+        # Seed 13 pins the torn_tail kill-point (see KillSwitch PRF).
+        pers = Persistence(self.dir, fsync_every=1,
+                           kill_switch=KillSwitch(13, 0))
+        pers.start(store)
+        server = WALShipServer(pers)
+        self.addCleanup(server.close)
+        replica = FollowerReplica(RealClock(), name="torn-socket")
+        follower = ShipFollower("127.0.0.1", server.port, replica)
+        self.addCleanup(follower.stop)
+        self.assertTrue(follower.wait_connected(5.0))
+
+        crashed = None
+        for i in range(64):
+            try:
+                store.create(_obj(f"w-{i}"))
+            except SimulatedCrash:
+                crashed = f"w-{i}"
+                break
+        self.assertEqual(pers.kill_switch.point, "torn_tail")
+        self.assertIsNotNone(crashed)
+        # The kill-point ships the torn fragment itself; deliver every
+        # queued byte (the "kernel accepted it" analog), then compare.
+        pers.drain_shippers(timeout=10.0)
+
+        replay = Persistence(self.dir).recover()
+        self.assertEqual(replay.torn_records_dropped, 1)
+        deadline = _time.monotonic() + 10.0
+        want = canonical_state(replay.objects, replay.rv)
+        # Wait for the follower to consume everything the drain handed to
+        # the socket: the intact records (state converges to the replay)
+        # AND the trailing fragment (parks in the line buffer — it can
+        # arrive after state already matches, so poll for both).
+        while _time.monotonic() < deadline and (
+                replica.state() != want or len(replica._tail) == 0):
+            _time.sleep(0.02)
+        # End state ≡ disk replay: the torn record applied NOWHERE.
+        self.assertEqual(replica.state(), want)
+        names = {o["metadata"]["name"] for o in replica.store.all_objects()}
+        self.assertNotIn(crashed, names)
+        # The fragment is visibly parked in the line buffer, unapplied.
+        self.assertGreater(len(replica._tail), 0)
+        pers.close_shippers()
+
+
 class TestRestartCatchup(_TmpDirTest):
     """Downtime crosses tick boundaries: catch-up fires the missed tick
     unless ``startingDeadlineSeconds`` says it is too stale."""
