@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt obs-report
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow obs-report
 
 all: gate
 
@@ -136,6 +136,23 @@ chaos-soak-preempt:
 	python hack/chaos_soak.py --seed $(or $(SEED),5) \
 	    --rounds $(or $(ROUNDS),2) --no-elastic \
 	    --elastic-jobs $(or $(JOBS),3) --expect-violation --out /dev/null
+
+# Bidirectional-elasticity soak (grow + shrink-back): the fleet
+# capacity-flap leg plus the grow pair — one REAL CPU-mesh training job
+# checkpoint-and-regrown into progressively wider idle slices by the
+# GrowPlanner, then shrunk back under pinned high-priority pressure,
+# measured against the identical shrink-only baseline. Gates: goodput
+# margin >= 1.15x and invariants F1-F4 (F4: params bit-exact across
+# every width change, restored from the actual soak checkpoints). Folds
+# into CHAOS.json; then the counter-proof re-runs the grow scenario
+# with the planner OFF and requires a measurable idle chip-second gap
+# left on the table. See README "Elastic training".
+chaos-soak-grow:
+	python hack/chaos_soak.py --seed $(or $(SEED),17) \
+	    --crons $(or $(N),12) --rounds $(or $(ROUNDS),2) \
+	    --fleet-flap --grow --out CHAOS.json
+	python hack/chaos_soak.py --seed $(or $(SEED),17) \
+	    --no-grow --expect-violation --out /dev/null
 
 # Observability / SLO report (hack/obs_report.py -> BENCH_OBS.json): the
 # flight-recorder scenario (audit ≡ WAL cross-check, lineage traces,

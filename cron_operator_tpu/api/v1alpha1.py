@@ -306,8 +306,9 @@ class CronHistory:
 
     One entry is one LOGICAL run: when a preempted workload is elastically
     resumed, every resume attempt collapses into the root attempt's entry —
-    ``resumes`` counts the attempts after the first and ``lastResumedAt``
-    is the newest attempt's creation time. Both serialize only when set, so
+    ``resumes`` counts the attempts after the first (``grows`` the subset
+    that were planned fleet-grow reconfigures) and ``lastResumedAt``
+    is the newest attempt's creation time. All serialize only when set, so
     non-elastic histories are byte-identical to before (the controller's
     no-op status elision depends on that)."""
 
@@ -318,6 +319,7 @@ class CronHistory:
     finished: Optional[datetime] = None
     resumes: int = 0
     last_resumed_at: Optional[datetime] = None
+    grows: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"uid": self.uid, "object": self.object.to_dict()}
@@ -329,6 +331,8 @@ class CronHistory:
             out["finished"] = rfc3339(self.finished)
         if self.resumes:
             out["resumes"] = int(self.resumes)
+        if self.grows:
+            out["grows"] = int(self.grows)
         if self.last_resumed_at:
             out["lastResumedAt"] = rfc3339(self.last_resumed_at)
         return out
@@ -343,6 +347,7 @@ class CronHistory:
             finished=parse_time(d.get("finished")),
             resumes=int(d.get("resumes") or 0),
             last_resumed_at=parse_time(d.get("lastResumedAt")),
+            grows=int(d.get("grows") or 0),
         )
 
 

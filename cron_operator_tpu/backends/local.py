@@ -956,6 +956,105 @@ class LocalExecutor:
             )
         return record
 
+    def reconfigure(self, namespace: str, name: str, kind: str = "JAXJob",
+                    api_version: str = "kubeflow.org/v1",
+                    target_devices: int = 0,
+                    reason: str = "FleetGrow") -> Dict[str, Any]:
+        """Planned reconfigure teardown: checkpoint-and-regrow/shrink a
+        running job so the controller resumes it at ``target_devices``.
+
+        Unlike :meth:`preempt` nothing is *lost*: every device the job
+        held returns to the pool the moment its program exits, pods are
+        deleted without a ``Preempted`` marker, and the job's status
+        carries a ``Resharding`` condition (reason ``FleetGrow`` or
+        ``FleetShrink``) plus a ``status.resharding`` record — the
+        controller's resume wiring reads that record, not the preemption
+        one, so the attempt is stamped as a planned reconfigure and does
+        not burn the preemption resume budget.
+
+        Ordering mirrors preempt (the durability guarantee): cancel →
+        join the job thread → flush open checkpoint stores → tear down →
+        flip conditions. A reconfigure never loses a completed save.
+        """
+        key: JobKey = (api_version, kind, namespace, name)
+        with self._lock:
+            ctx = self._jobs.get(key)
+            thread = self._threads.get(key)
+        if ctx:
+            ctx.cancel.set()
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=15.0)
+        try:
+            from cron_operator_tpu.backends.tpu import logical_run_root
+            from cron_operator_tpu.workloads.checkpoint import (
+                flush_open_stores,
+            )
+
+            obj_for_ann = self.api.try_get(api_version, kind, namespace, name)
+            ann0 = ((obj_for_ann or {}).get("metadata") or {}).get(
+                "annotations") or {}
+            flush_open_stores(namespace, name)
+            root = logical_run_root(name, ann0)
+            if root != name:
+                flush_open_stores(namespace, root)
+        except Exception:
+            logger.warning("checkpoint flush on reconfigure failed",
+                           exc_info=True)
+
+        self._delete_pods(namespace, name)
+
+        obj = self.api.try_get(api_version, kind, namespace, name)
+        ann = ((obj or {}).get("metadata") or {}).get("annotations") or {}
+        try:
+            from cron_operator_tpu.backends.tpu import params_from_annotations
+
+            prior = int(params_from_annotations(ann).get("devices") or 0)
+        except (TypeError, ValueError):
+            prior = 0
+        record = {
+            "priorDevices": prior or self.capacity(),
+            "targetDevices": int(target_devices),
+            "reason": reason,
+            "reshardedAt": rfc3339(self.api.clock.now()),
+        }
+        if obj is None:
+            return record
+        # Same terminal-race fence as preempt: a job that finished before
+        # the join must keep its Succeeded status untouched.
+        from cron_operator_tpu.controller.workload import is_workload_finished
+
+        try:
+            _, finished = is_workload_finished(obj)
+        except ValueError:
+            finished = False
+        if finished:
+            record["jobFinished"] = True
+            return record
+        if self.audit is not None:
+            self.audit.record(
+                "decision", "reconfigure",
+                key=f"{api_version}/{kind}/{namespace}/{name}",
+                trace_id=ann.get(ANNOTATION_TRACE_ID),
+                reason=reason,
+                prior_devices=record["priorDevices"],
+                target_devices=record["targetDevices"],
+            )
+        # Resharding is a cause, never the last condition (the Kubeflow
+        # convention reads the last condition as the final status); the
+        # terminal Failed hands the chain to the controller's resume pass.
+        self._append_condition(
+            key, "Resharding", reason,
+            f"planned reconfigure: {record['priorDevices']} → "
+            f"{record['targetDevices']} device(s).",
+            extra={"resharding": record},
+        )
+        self._append_condition(
+            key, "Failed", reason,
+            "job torn down for a planned reconfigure.",
+            extra={"completionTime": rfc3339(self.api.clock.now())},
+        )
+        return record
+
 
 __all__ = [
     "LocalExecutor",

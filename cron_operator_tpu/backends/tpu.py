@@ -56,6 +56,16 @@ ANNOTATION_RESUME_OF = "tpu.kubedl.io/resume-of"
 ANNOTATION_RESUME_ATTEMPT = "tpu.kubedl.io/resume-attempt"
 ANNOTATION_MAX_RESUMES = "tpu.kubedl.io/max-resumes"
 DEFAULT_MAX_RESUMES = 5
+# Why the attempt exists: "preemption" (capacity was lost under the job)
+# or a planned reconfigure — "grow" / "shrink" (the fleet resized the
+# job on purpose). Only preemption-caused attempts count against
+# `max-resumes`; planned reconfigures are flap-rate-limited instead, so
+# an elastic job can never be killed by its own scheduler.
+ANNOTATION_RESUME_CAUSE = "tpu.kubedl.io/resume-cause"
+# Stamped on grow attempts: the device count the logical run was FIRST
+# launched with — what shrink-back returns the job to, and what the grow
+# replan restores model axes toward.
+ANNOTATION_ORIGINAL_DEVICES = "tpu.kubedl.io/original-devices"
 
 
 def logical_run_root(name: str, annotations: Optional[Dict[str, str]] = None
@@ -379,6 +389,8 @@ __all__ = [
     "ANNOTATION_RESUME_ATTEMPT",
     "ANNOTATION_MAX_RESUMES",
     "DEFAULT_MAX_RESUMES",
+    "ANNOTATION_RESUME_CAUSE",
+    "ANNOTATION_ORIGINAL_DEVICES",
     "slice_for",
     "slice_for_shorthand",
     "render_coordinator_env",

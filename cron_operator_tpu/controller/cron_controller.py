@@ -61,7 +61,9 @@ from cron_operator_tpu.controller.workload import (
 from cron_operator_tpu.backends.tpu import (
     ANNOTATION_ELASTIC_RESUME,
     ANNOTATION_MAX_RESUMES,
+    ANNOTATION_ORIGINAL_DEVICES,
     ANNOTATION_RESUME_ATTEMPT,
+    ANNOTATION_RESUME_CAUSE,
     ANNOTATION_RESUME_OF,
     DEFAULT_MAX_RESUMES,
     PARAM_ANNOTATION_PREFIX,
@@ -107,6 +109,19 @@ SKIP_DEDUP_CAP = 4096
 SUBMIT_ATTEMPTS = 6
 SUBMIT_BACKOFF_BASE_S = 0.01
 SUBMIT_BACKOFF_CAP_S = 0.5
+# Planned reconfigures (grow/shrink-back) do not count against the
+# preemption resume budget — they are the scheduler's own decisions, and
+# charging them to `max-resumes` would let the fleet kill an elastic job
+# by resizing it six times. They are flap-rate-limited instead: at most
+# one planned resume per logical run per this many seconds (template
+# override via the annotation below).
+DEFAULT_MIN_RECONFIGURE_INTERVAL_S = 2.0
+ANNOTATION_MIN_RECONFIGURE_INTERVAL = \
+    "tpu.kubedl.io/min-reconfigure-interval"
+# First resume of a run stashes the launch-time mesh params here so a
+# later grow can restore model axes toward the ORIGINAL factorization
+# (the live `param.*` annotations are overwritten by every replan).
+ORIGINAL_PARAM_PREFIX = "tpu.kubedl.io/original-param."
 
 
 @dataclass
@@ -160,6 +175,10 @@ class CronReconciler:
         # Logical runs whose resume budget ran out — the Warning event
         # fires once per run, not once per reconcile of a terminal state.
         self._resume_exhausted: set = set()
+        # (ns, root) → monotonic time of the last PLANNED resume (grow /
+        # shrink-back) — the flap-rate limiter for reconfigure attempts,
+        # which are exempt from the preemption resume budget.
+        self._last_planned_resume: Dict[Tuple[str, str], float] = {}
         # Resume-attempt UIDs whose lineage span has been recorded (the
         # span waits for the attempt's trainingProgress to show where it
         # actually resumed, so it's recorded lazily, exactly once).
@@ -842,6 +861,29 @@ class CronReconciler:
         return dict(rec) if isinstance(rec, dict) else {}
 
     @staticmethod
+    def _resharding_of(w: Unstructured) -> Optional[Dict[str, Any]]:
+        """The planned-reconfigure record if ``w`` was torn down by the
+        fleet's grow/shrink-back path — a ``Resharding`` condition
+        (reason ``FleetGrow``/``FleetShrink``) appended by the executor
+        before the terminal one. Returns ``status.resharding`` (may be
+        ``{}``), or None when the workload was not reconfigured."""
+        status = w.get("status") or {}
+        conds = status.get("conditions") or []
+        if not any(c.get("type") == "Resharding" for c in conds):
+            return None
+        rec = status.get("resharding")
+        return dict(rec) if isinstance(rec, dict) else {}
+
+    @staticmethod
+    def _attempt_cause(w: Unstructured) -> str:
+        """Why a resume attempt exists: ``preemption`` (default — every
+        attempt predating the budget split was preemption-caused) or a
+        planned ``grow``/``shrink``."""
+        ann = (w.get("metadata") or {}).get("annotations") or {}
+        cause = str(ann.get(ANNOTATION_RESUME_CAUSE, "")).strip().lower()
+        return cause if cause in ("grow", "shrink") else "preemption"
+
+    @staticmethod
     def _attempt_number(w: Unstructured) -> int:
         ann = (w.get("metadata") or {}).get("annotations") or {}
         try:
@@ -913,7 +955,9 @@ class CronReconciler:
             if str(ann.get(ANNOTATION_ELASTIC_RESUME, "")).strip().lower() \
                     not in ("1", "true", "yes"):
                 continue
-            record = self._preemption_of(latest)
+            reshard = self._resharding_of(latest)
+            record = reshard if reshard is not None \
+                else self._preemption_of(latest)
             if record is None:
                 continue
             status_str, finished = is_workload_finished(latest)
@@ -926,21 +970,50 @@ class CronReconciler:
                 )
             except (TypeError, ValueError):
                 max_resumes = DEFAULT_MAX_RESUMES
-            if next_no > max_resumes:
-                key = (cron.metadata.namespace, root)
-                if key not in self._resume_exhausted:
-                    self._resume_exhausted.add(key)
-                    self.api.record_event(
-                        cron.to_dict(),
-                        "Warning",
-                        "ResumeBudgetExhausted",
-                        f"not resuming {root}: {next_no - 1} resume "
-                        f"attempt(s) already made (max {max_resumes})",
-                    )
-                continue
+            if reshard is not None:
+                # Planned grow/shrink-back: exempt from the preemption
+                # budget (the scheduler must never kill its own elastic
+                # job by resizing it), but flap-rate-limited per run.
+                cause = ("shrink"
+                         if str(reshard.get("reason", "")) == "FleetShrink"
+                         else "grow")
+                try:
+                    min_gap = float(ann.get(
+                        ANNOTATION_MIN_RECONFIGURE_INTERVAL,
+                        DEFAULT_MIN_RECONFIGURE_INTERVAL_S,
+                    ))
+                except (TypeError, ValueError):
+                    min_gap = DEFAULT_MIN_RECONFIGURE_INTERVAL_S
+                lkey = (cron.metadata.namespace, root)
+                last_planned = self._last_planned_resume.get(lkey)
+                if (last_planned is not None
+                        and time.monotonic() - last_planned < min_gap):
+                    continue  # retried next sweep; the record persists
+            else:
+                cause = "preemption"
+                # Only preemption-caused attempts burn the budget:
+                # planned reconfigures in the chain don't count.
+                preempt_attempts = sum(
+                    1 for w in attempts
+                    if self._attempt_number(w) > 0
+                    and self._attempt_cause(w) == "preemption"
+                )
+                if preempt_attempts + 1 > max_resumes:
+                    key = (cron.metadata.namespace, root)
+                    if key not in self._resume_exhausted:
+                        self._resume_exhausted.add(key)
+                        self.api.record_event(
+                            cron.to_dict(),
+                            "Warning",
+                            "ResumeBudgetExhausted",
+                            f"not resuming {root}: {preempt_attempts} "
+                            f"preemption resume attempt(s) already made "
+                            f"(max {max_resumes})",
+                        )
+                    continue
 
             resume = self._new_resume_attempt(
-                cron, latest, root, next_no, record, log
+                cron, latest, root, next_no, record, log, cause=cause
             )
             rname = resume["metadata"]["name"]
             try:
@@ -960,6 +1033,16 @@ class CronReconciler:
                 # sweep did not start a new resume.
                 continue
             self._count("cron_workload_resumes_total")
+            if reshard is not None:
+                self._last_planned_resume[
+                    (cron.metadata.namespace, root)
+                ] = time.monotonic()
+                if len(self._last_planned_resume) > SKIP_DEDUP_CAP:
+                    self._last_planned_resume.pop(
+                        next(iter(self._last_planned_resume))
+                    )
+            reason = ("TPUSlicePreempted" if reshard is None
+                      else str(reshard.get("reason") or "FleetGrow"))
             self._audit(
                 "resume",
                 key=(f"{resume.get('apiVersion', gvk.api_version)}"
@@ -967,26 +1050,40 @@ class CronReconciler:
                      f"/{cron.metadata.namespace}/{rname}"),
                 trace_id=(resume.get("metadata", {}).get("annotations")
                           or {}).get(ANNOTATION_TRACE_ID),
-                reason="TPUSlicePreempted",
+                reason=reason,
+                cause=cause,
                 root=root, attempt=next_no,
                 surviving_devices=record.get("survivingDevices"),
+                target_devices=record.get("targetDevices"),
                 lost_devices=record.get("lostDevices"),
             )
-            surviving = record.get("survivingDevices")
-            self.api.record_event(
-                cron.to_dict(),
-                "Normal",
-                "ElasticResume",
-                f"resuming preempted run {root} as {rname}"
-                + (
-                    f" on {surviving} surviving device(s)"
-                    if surviving
-                    else ""
+            if reshard is not None:
+                target = record.get("targetDevices")
+                self.api.record_event(
+                    cron.to_dict(),
+                    "Normal",
+                    "ElasticRegrow" if cause == "grow" else "ElasticShrink",
+                    f"resuming reconfigured run {root} as {rname}"
+                    + (f" on {target} device(s)" if target else "")
+                    + f" (planned {cause}, attempt {next_no})",
                 )
-                + f" (attempt {next_no}/{max_resumes})",
-            )
+            else:
+                surviving = record.get("survivingDevices")
+                self.api.record_event(
+                    cron.to_dict(),
+                    "Normal",
+                    "ElasticResume",
+                    f"resuming preempted run {root} as {rname}"
+                    + (
+                        f" on {surviving} surviving device(s)"
+                        if surviving
+                        else ""
+                    )
+                    + f" (attempt {next_no}/{max_resumes})",
+                )
             log.info(
-                "elastic resume: %s → %s (attempt %d)", root, rname, next_no
+                "elastic resume (%s): %s → %s (attempt %d)",
+                cause, root, rname, next_no,
             )
             try:  # prefer the committed copy (uid, creationTimestamp)
                 resume = self.api.get(
@@ -1008,11 +1105,13 @@ class CronReconciler:
         attempt: int,
         record: Dict[str, Any],
         log,
+        cause: str = "preemption",
     ) -> Unstructured:
-        """Build the successor workload for a preempted attempt: same
-        template, deterministic name ``<root>-r<attempt>``, resume
-        annotations, and ``tpu.kubedl.io/param.*`` mesh annotations
-        recomputed for the surviving device count."""
+        """Build the successor workload for a preempted or reconfigured
+        attempt: same template, deterministic name ``<root>-r<attempt>``,
+        resume annotations, and ``tpu.kubedl.io/param.*`` mesh
+        annotations recomputed for the surviving (preemption) or target
+        (planned grow/shrink) device count."""
         w = copy.deepcopy(preempted)
         w.pop("status", None)
         meta = w.setdefault("metadata", {})
@@ -1030,6 +1129,7 @@ class CronReconciler:
         ann = meta.setdefault("annotations", {})
         ann[ANNOTATION_RESUME_OF] = root
         ann[ANNOTATION_RESUME_ATTEMPT] = str(attempt)
+        ann[ANNOTATION_RESUME_CAUSE] = cause
         # Every attempt of a run reads (and keeps extending) the ROOT
         # attempt's checkpoint lineage — this is the resume-from-checkpoint
         # contract the runner env inherits as TPU_PARAM_CHECKPOINT_JOB.
@@ -1043,10 +1143,13 @@ class CronReconciler:
             ann[ANNOTATION_TRACE_ID] = new_trace_id()
 
         try:
-            surviving = int(record.get("survivingDevices") or 0)
+            if cause == "preemption":
+                target = int(record.get("survivingDevices") or 0)
+            else:
+                target = int(record.get("targetDevices") or 0)
         except (TypeError, ValueError):
-            surviving = 0
-        if surviving > 0:
+            target = 0
+        if target > 0:
             params = params_from_annotations(ann)
 
             def _p(key: str) -> int:
@@ -1055,31 +1158,76 @@ class CronReconciler:
                 except (TypeError, ValueError):
                     return 1
 
+            old_n = 0
+            try:
+                old_n = int(
+                    params.get("devices")
+                    or record.get("priorDevices")
+                    or 0
+                )
+            except (TypeError, ValueError):
+                pass
+            # First rewrite of the mesh params stashes the launch-time
+            # factorization, so a later grow can restore model axes
+            # toward the ORIGINAL plan (the live param.* annotations are
+            # overwritten by every replan below).
+            ann.setdefault(
+                ANNOTATION_ORIGINAL_DEVICES,
+                str(old_n if old_n > 0 else target),
+            )
+            for axis in ("tensor", "seq", "fsdp", "pipe", "expert"):
+                ann.setdefault(ORIGINAL_PARAM_PREFIX + axis, str(_p(axis)))
+
             new_plan = None
             try:
                 from cron_operator_tpu.parallel import mesh as _mesh
 
-                old_n = 0
-                try:
-                    old_n = int(
-                        params.get("devices")
-                        or record.get("priorDevices")
-                        or 0
-                    )
-                except (TypeError, ValueError):
-                    pass
                 old_plan = _mesh.plan_for_devices(
-                    old_n if old_n > 0 else surviving,
+                    old_n if old_n > 0 else target,
                     tensor=_p("tensor"),
                     seq=_p("seq"),
                     fsdp=_p("fsdp"),
                     pipe=_p("pipe"),
                     expert=_p("expert"),
                 )
-                # A resume never grows past the original mesh even when
-                # more capacity survived than the job was using.
+                original_plan = None
+                if cause != "preemption":
+                    try:
+                        orig_n = int(
+                            ann.get(ANNOTATION_ORIGINAL_DEVICES) or 0
+                        )
+
+                        def _op(key: str) -> int:
+                            try:
+                                return max(int(
+                                    ann.get(ORIGINAL_PARAM_PREFIX + key)
+                                    or 1
+                                ), 1)
+                            except (TypeError, ValueError):
+                                return 1
+
+                        if orig_n > 0:
+                            original_plan = _mesh.plan_for_devices(
+                                orig_n,
+                                tensor=_op("tensor"),
+                                seq=_op("seq"),
+                                fsdp=_op("fsdp"),
+                                pipe=_op("pipe"),
+                                expert=_op("expert"),
+                            )
+                    except Exception:  # noqa: BLE001 — optional restore
+                        original_plan = None
+                # A PREEMPTION resume never grows past the old mesh even
+                # when more capacity survived than the job was using;
+                # only a planned reconfigure may widen (grow path:
+                # data axis first, shrunk model axes restored toward the
+                # original factorization when divisibility allows).
                 new_plan = _mesh.replan(
-                    old_plan, min(surviving, old_plan.n_devices)
+                    old_plan,
+                    target if cause != "preemption"
+                    else min(target, old_plan.n_devices),
+                    allow_grow=cause != "preemption",
+                    original_plan=original_plan,
                 )
                 axes = {
                     "tensor": new_plan.axis(_mesh.TENSOR_AXIS),
@@ -1091,18 +1239,18 @@ class CronReconciler:
             except Exception as err:
                 # Non-divisible axes, pipeline stages, jax unavailable in
                 # the control plane, … — fall back to pure data
-                # parallelism over the survivors (checkpoint restore is
-                # parallelism-independent, so any valid mesh resumes).
+                # parallelism over the target count (checkpoint restore
+                # is parallelism-independent, so any valid mesh resumes).
                 log.warning(
                     "replan for %s failed (%s); resuming data-parallel "
                     "on %d device(s)",
-                    root, err, surviving,
+                    root, err, target,
                 )
                 axes = {
                     "tensor": 1, "seq": 1, "fsdp": 1, "pipe": 1, "expert": 1,
                 }
             n_devices = new_plan.n_devices if new_plan is not None \
-                else surviving
+                else target
             ann[PARAM_ANNOTATION_PREFIX + "devices"] = str(n_devices)
             for axis, size in axes.items():
                 key = PARAM_ANNOTATION_PREFIX + axis
@@ -1272,6 +1420,11 @@ class CronReconciler:
             last = max(attempts, key=self._attempt_number)
             fmeta = first.get("metadata") or {}
             resumes = self._attempt_number(last)
+            grows = sum(
+                1 for w in attempts
+                if self._attempt_number(w) > 0
+                and self._attempt_cause(w) == "grow"
+            )
             status_str, finished = is_workload_finished(last)
             entry = CronHistory(
                 uid=fmeta.get("uid", ""),
@@ -1285,6 +1438,7 @@ class CronReconciler:
                 status=status_str,
                 created=parse_time(fmeta.get("creationTimestamp")),
                 resumes=resumes,
+                grows=grows,
             )
             if resumes:
                 entry.last_resumed_at = parse_time(
@@ -1297,6 +1451,7 @@ class CronReconciler:
                     and ph.finished
                     and ph.status == status_str
                     and int(ph.resumes or 0) == resumes
+                    and int(ph.grows or 0) == grows
                 ):
                     entry.finished = ph.finished
                 else:

@@ -114,20 +114,40 @@ def plan_for_devices(
     return MeshPlan(sizes)
 
 
-def replan(old_plan: MeshPlan, surviving_devices: Any) -> MeshPlan:
-    """Recompute a plan after preemption shrank the device pool.
+def replan(
+    old_plan: MeshPlan,
+    surviving_devices: Any,
+    *,
+    allow_grow: bool = False,
+    original_plan: Optional[MeshPlan] = None,
+) -> MeshPlan:
+    """Recompute a plan after the device pool changed size.
 
-    ``surviving_devices`` is a device count or a sequence of devices. The
-    ``data`` axis absorbs the shrink first — data parallelism is the one
-    axis a training job can lose without changing what any single device
-    computes (the global batch shrinks; the Tenplex reconfiguration-plan
-    restriction we implement). Model axes (pipe/fsdp/expert/seq/tensor)
-    keep their sizes whenever the surviving count stays divisible by their
-    product; otherwise they are reduced largest-first by prime factors
-    until a valid factorization exists (VirtualFlow's virtual-node remap,
-    collapsed onto our named axes). Raises ValueError when nothing
-    survives or when the pool *grew* — growing is a scale-up decision the
-    caller must make explicitly with :func:`plan_for_devices`.
+    ``surviving_devices`` is a device count or a sequence of devices.
+
+    **Shrink** (the preemption path): the ``data`` axis absorbs the
+    shrink first — data parallelism is the one axis a training job can
+    lose without changing what any single device computes (the global
+    batch shrinks; the Tenplex reconfiguration-plan restriction we
+    implement). Model axes (pipe/fsdp/expert/seq/tensor) keep their
+    sizes whenever the surviving count stays divisible by their product;
+    otherwise they are reduced largest-first by prime factors until a
+    valid factorization exists (VirtualFlow's virtual-node remap,
+    collapsed onto our named axes).
+
+    **Grow** (the fleet scale-up path, ``allow_grow=True``): the exact
+    mirror. The ``data`` axis widens first; when ``original_plan`` is
+    given (the mesh the job was first launched on), model axes that a
+    previous shrink reduced are restored toward their original sizes —
+    largest deficit first, one prime factor at a time — whenever the
+    target count stays divisible. Without ``allow_grow`` a larger pool
+    raises, so every existing shrink-only caller keeps its guarantee:
+    growing is a scale-up decision the caller must make explicitly
+    (:func:`regrow` is the convenience wrapper).
+
+    Raises ValueError when nothing survives, when the pool grew without
+    ``allow_grow``, or when a grow target is not divisible by the model
+    parallelism that survives restoration.
     """
     try:
         surviving = int(surviving_devices)
@@ -135,7 +155,7 @@ def replan(old_plan: MeshPlan, surviving_devices: Any) -> MeshPlan:
         surviving = len(surviving_devices)
     if surviving <= 0:
         raise ValueError("no surviving devices to replan onto")
-    if surviving > old_plan.n_devices:
+    if surviving > old_plan.n_devices and not allow_grow:
         raise ValueError(
             f"replan is shrink-only: {surviving} surviving > "
             f"{old_plan.n_devices} planned"
@@ -153,12 +173,39 @@ def replan(old_plan: MeshPlan, surviving_devices: Any) -> MeshPlan:
             n *= s
         return n
 
-    while surviving % _model_par():
-        name = max((a for a in model if model[a] > 1),
-                   key=lambda a: model[a])
-        size = model[name]
-        factor = next(p for p in range(2, size + 1) if size % p == 0)
-        model[name] //= factor
+    if surviving > old_plan.n_devices:
+        # Grow: restore previously-shrunk model axes toward the original
+        # plan while divisibility holds; the data axis absorbs the rest.
+        if original_plan is not None:
+            while True:
+                deficits = {
+                    a: original_plan.axis(a) // model[a]
+                    for a in model
+                    if original_plan.axis(a) > model[a]
+                    and original_plan.axis(a) % model[a] == 0
+                }
+                restorable = None
+                for a in sorted(deficits, key=lambda a: -deficits[a]):
+                    f = deficits[a]
+                    p = next(q for q in range(2, f + 1) if f % q == 0)
+                    if surviving % (_model_par() * p) == 0:
+                        restorable = (a, p)
+                        break
+                if restorable is None:
+                    break
+                model[restorable[0]] *= restorable[1]
+        if surviving % _model_par():
+            raise ValueError(
+                f"cannot grow onto {surviving} devices: not divisible by "
+                f"model parallelism {_model_par()}"
+            )
+    else:
+        while surviving % _model_par():
+            name = max((a for a in model if model[a] > 1),
+                       key=lambda a: model[a])
+            size = model[name]
+            factor = next(p for p in range(2, size + 1) if size % p == 0)
+            model[name] //= factor
     return plan_for_devices(
         surviving,
         tensor=model[TENSOR_AXIS],
@@ -166,6 +213,20 @@ def replan(old_plan: MeshPlan, surviving_devices: Any) -> MeshPlan:
         fsdp=model[FSDP_AXIS],
         pipe=model[PIPE_AXIS],
         expert=model[EXPERT_AXIS],
+    )
+
+
+def regrow(
+    old_plan: MeshPlan,
+    devices: Any,
+    original_plan: Optional[MeshPlan] = None,
+) -> MeshPlan:
+    """Explicit grow: widen ``old_plan`` onto a larger device pool
+    (sibling of the shrink default — see :func:`replan` with
+    ``allow_grow=True``). ``original_plan``, when given, lets a
+    previously-shrunk job recover its original model-axis sizes."""
+    return replan(
+        old_plan, devices, allow_grow=True, original_plan=original_plan
     )
 
 
@@ -412,6 +473,7 @@ __all__ = [
     "MeshPlan",
     "plan_for_devices",
     "replan",
+    "regrow",
     "make_mesh",
     "mesh_for_devices",
     "mesh_for_slice",

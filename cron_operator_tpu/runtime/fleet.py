@@ -45,6 +45,9 @@ from cron_operator_tpu.api.v1alpha1 import LABEL_CRON_NAME
 from cron_operator_tpu.backends.tpu import (
     _FAMILIES,
     ANNOTATION_ACCELERATOR,
+    ANNOTATION_ELASTIC_RESUME,
+    ANNOTATION_ORIGINAL_DEVICES,
+    ANNOTATION_RESUME_CAUSE,
     ANNOTATION_TOPOLOGY,
     SliceSpec,
     TopologyError,
@@ -113,23 +116,32 @@ def _is_terminal(obj: Dict[str, Any]) -> bool:
 
 @dataclass(frozen=True)
 class SliceType:
-    """One pool entry: a named slice shape with a count of instances."""
+    """One pool entry: a named slice shape with a count of instances.
+
+    ``host_chips`` gives a host-local (non-TPU) type an explicit device
+    width — how the grow soak models ``cpu-small``/``cpu-wide`` tiers
+    over one host's devices. TPU types take their width from the spec."""
 
     name: str
     count: int
     spec: Optional[SliceSpec] = None  # None = host-local (CPU) capacity
+    host_chips: int = 1
 
     @property
     def chips(self) -> int:
-        return self.spec.chips if self.spec is not None else 1
+        if self.spec is not None:
+            return self.spec.chips
+        return max(int(self.host_chips), 1)
 
 
 def parse_pool(text: str) -> List[SliceType]:
     """``"v5e-16=2,v4-8=4,cpu=8"`` → pool entries. Names that resolve via
     ``slice_for_shorthand`` model real slice shapes; anything else is a
-    1-chip host-local type (``cpu``) — unless the name leads with a known
-    TPU family (``v5e-12``, ``v4_8``), which is almost certainly a typo'd
-    slice shorthand and must not silently become CPU capacity."""
+    host-local type (``cpu``) of 1 chip — or ``count@chips``
+    (``cpu-wide=1@8``) to model wider host-local tiers — unless the name
+    leads with a known TPU family (``v5e-12``, ``v4_8``), which is almost
+    certainly a typo'd slice shorthand and must not silently become CPU
+    capacity."""
     pool: List[SliceType] = []
     for part in text.split(","):
         part = part.strip()
@@ -137,6 +149,7 @@ def parse_pool(text: str) -> List[SliceType]:
             continue
         name, _, count_s = part.partition("=")
         name = name.strip()
+        count_s, _, chips_s = count_s.partition("@")
         try:
             count = int(count_s) if count_s else 1
         except ValueError:
@@ -146,6 +159,14 @@ def parse_pool(text: str) -> List[SliceType]:
         if count < 1:
             raise ValueError(f"fleet pool entry {part!r}: count must be >= 1")
         try:
+            host_chips = int(chips_s) if chips_s else 1
+        except ValueError:
+            raise ValueError(
+                f"fleet pool entry {part!r}: chips must be an integer"
+            ) from None
+        if host_chips < 1:
+            raise ValueError(f"fleet pool entry {part!r}: chips must be >= 1")
+        try:
             spec: Optional[SliceSpec] = slice_for_shorthand(name)
         except TopologyError as err:
             if re.split(r"[-_]", name.lower(), maxsplit=1)[0] in _FAMILIES:
@@ -153,7 +174,12 @@ def parse_pool(text: str) -> List[SliceType]:
                     f"fleet pool entry {part!r}: {err}"
                 ) from None
             spec = None  # host-local capacity
-        pool.append(SliceType(name, count, spec))
+        if spec is not None and chips_s:
+            raise ValueError(
+                f"fleet pool entry {part!r}: @chips only applies to "
+                "host-local types (TPU widths come from the topology)"
+            )
+        pool.append(SliceType(name, count, spec, host_chips))
     if not pool:
         raise ValueError(f"fleet pool {text!r} names no slice types")
     return pool
@@ -285,6 +311,12 @@ class _Tracked:
     slice_type: Optional[str] = None
     state: str = "queued"
     attempts: int = 0
+    # Elastic-growth bookkeeping: elastic jobs checkpoint and may be
+    # grown; a tracked attempt whose resume-cause is "grow" is a grown
+    # job, and original_devices is the width shrink-back returns it to.
+    elastic: bool = False
+    grown: bool = False
+    original_devices: int = 0
 
 
 def plan_assignments(
@@ -369,6 +401,9 @@ class FleetScheduler:
         audit: Optional[Any] = None,
         on_create: Optional[Callable[[Dict[str, Any], str], None]] = None,
         backend_name: str = "local",
+        grow_enabled: bool = False,
+        grow_idle_pumps: int = 3,
+        grow_min_gain: float = 1.1,
     ):
         if not pool:
             raise ValueError("fleet pool must name at least one slice type")
@@ -410,6 +445,23 @@ class FleetScheduler:
         self.rejected_total = 0
         self.preempted_total = 0
         self.backfilled_total = 0
+        # GrowPlanner (bidirectional elasticity): when enabled, pump()
+        # runs a grow pass — sustained idle capacity (hysteresis over
+        # grow_idle_pumps consecutive idle pumps with an empty queue) is
+        # reclaimed by checkpoint-and-regrowing the running elastic gang
+        # with the best ThroughputMatrix-weighted marginal gain. The
+        # teardown goes through backend.reconfigure() (Resharding /
+        # FleetGrow, not Preempted) so the controller's resume chain
+        # brings the job back at the wider param.devices. Shrink-back
+        # rides the existing preemption victim selection: a grown gang
+        # is reconfigured back to its original width instead of being
+        # preempted outright.
+        self.grow_enabled = grow_enabled
+        self.grow_idle_pumps = max(int(grow_idle_pumps), 1)
+        self.grow_min_gain = float(grow_min_gain)
+        self.grows_total = 0
+        self.shrinks_total = 0
+        self._grow_idle_streak = 0
         # Bounded, append-only decision trail (determinism tests replay
         # it; /debug/audit carries the full records).
         self.decision_log: deque = deque(maxlen=65536)
@@ -498,6 +550,12 @@ class FleetScheduler:
         except (TypeError, ValueError):
             est_work = 0.0
         pinned = self._pinned_type(ann)
+        try:
+            original_devices = int(
+                ann.get(ANNOTATION_ORIGINAL_DEVICES) or 0
+            )
+        except (TypeError, ValueError):
+            original_devices = 0
         self._seq += 1
         return _Tracked(
             key=(ns, name),
@@ -511,6 +569,11 @@ class FleetScheduler:
             pinned=pinned,
             est_work=est_work,
             seq=self._seq,
+            elastic=str(ann.get(ANNOTATION_ELASTIC_RESUME, "")).lower()
+            in ("1", "true", "yes"),
+            grown=str(ann.get(ANNOTATION_RESUME_CAUSE, "")).lower()
+            == "grow",
+            original_devices=original_devices,
         )
 
     def _pinned_type(self, ann: Dict[str, str]) -> Optional[str]:
@@ -803,9 +866,14 @@ class FleetScheduler:
         ]
         if not candidates:
             return None
-        # Lowest priority first; among equals the most recently placed
-        # (least sunk work) goes.
-        return min(candidates, key=lambda r: (r.priority, -r.seq))
+        # Lowest priority first; among equals, previously-GROWN gangs go
+        # first (they hand back reclaimed idle capacity via shrink-back,
+        # the cheapest eviction), then the most recently placed (least
+        # sunk work).
+        return min(
+            candidates,
+            key=lambda r: (r.priority, 0 if r.grown else 1, -r.seq),
+        )
 
     def _find_victim_locked(self, tr: _Tracked) -> Optional[_Tracked]:
         names = self._allowed_types_locked(tr)
@@ -820,8 +888,9 @@ class FleetScheduler:
             back = chips if r.tenant == tr.tenant else 0
             if chips > headroom + back:
                 continue
-            if best is None or (r.priority, -r.seq) < (best.priority,
-                                                       -best.seq):
+            if best is None or (
+                r.priority, 0 if r.grown else 1, -r.seq
+            ) < (best.priority, 0 if best.grown else 1, -best.seq):
                 best = r
         return best
 
@@ -931,6 +1000,39 @@ class FleetScheduler:
 
     def _do_preempt(self, victim: _Tracked, reason: str,
                     for_key: Optional[str] = None) -> None:
+        backend = self.backend
+        if (
+            victim.grown
+            and victim.original_devices > 0
+            and backend is not None
+            and hasattr(backend, "reconfigure")
+        ):
+            # Shrink-back: the victim is a previously-grown gang — it
+            # returns to its original width through the planned
+            # reconfigure path (checkpointed teardown, Resharding /
+            # FleetShrink, no Preempted marker, no resume-budget burn)
+            # instead of being preempted outright.
+            self.shrinks_total += 1
+            self._count("fleet_shrinks_total")
+            self._record(
+                "fleet_shrink", key=f"{victim.key[0]}/{victim.key[1]}",
+                reason=reason, for_key=for_key,
+                slice_type=victim.slice_type,
+                target_devices=victim.original_devices,
+            )
+            ns, name = victim.key
+            try:
+                backend.reconfigure(
+                    ns, name, kind=victim.kind,
+                    api_version=victim.api_version,
+                    target_devices=victim.original_devices,
+                    reason="FleetShrink",
+                )
+            except Exception:  # noqa: BLE001 — victim may be finishing
+                logger.exception(
+                    "fleet shrink-back of %s/%s failed", ns, name
+                )
+            return
         self.preempted_total += 1
         self._count("fleet_preemptions_total")
         self._record(
@@ -938,7 +1040,6 @@ class FleetScheduler:
             reason=reason, for_key=for_key, slice_type=victim.slice_type,
             priority=victim.priority,
         )
-        backend = self.backend
         if backend is None or not hasattr(backend, "preempt"):
             return
         ns, name = victim.key
@@ -992,7 +1093,100 @@ class FleetScheduler:
             if tps is not None:
                 self.matrix.observe(tr.wclass, tr.slice_type, tps)
         self._dispatch()
+        self._grow_pass()
         return processed
+
+    # ---- GrowPlanner (elastic scale-up) -----------------------------------
+
+    def _grow_candidate_locked(
+        self,
+    ) -> Optional[Tuple[_Tracked, str, float]]:
+        """The (gang, target type, gain) with the best marginal tokens/s
+        from relocating a running elastic gang onto an idle wider slice.
+        None when nothing qualifies (no idle wider capacity, no elastic
+        gang, gain below the grow_min_gain floor, or quota-bound)."""
+        idle = [n for n, k in self._free.items() if k > 0]
+        if not idle:
+            return None
+        best: Optional[Tuple[float, int, _Tracked, str]] = None
+        for tr in self._running.values():
+            if not tr.elastic or tr.state != "running":
+                continue
+            if tr.pinned is not None:
+                continue  # user pinned the hardware; never relocate it
+            cur = self.pool[tr.slice_type]
+            cur_rate = self.matrix.rate(tr.wclass, cur.name, cur.chips)
+            headroom = self._quota_headroom_locked(tr.tenant)
+            for name in idle:
+                t = self.pool[name]
+                if t.chips <= cur.chips:
+                    continue  # growing means more devices, not a lateral
+                if t.chips - cur.chips > headroom:
+                    continue  # the wider slice would bust the quota
+                new_rate = self.matrix.rate(tr.wclass, name, t.chips)
+                if new_rate < cur_rate * self.grow_min_gain:
+                    continue
+                gain = new_rate - cur_rate
+                pick = (gain, -tr.seq, tr, name)
+                if best is None or pick[:2] > best[:2]:
+                    best = pick
+        if best is None:
+            return None
+        return best[2], best[3], best[0]
+
+    def _grow_pass(self) -> None:
+        """One GrowPlanner step, run from every pump: detect *sustained*
+        idle capacity (``grow_idle_pumps`` consecutive idle pumps with no
+        queued work that could use the slices — the hysteresis window)
+        and checkpoint-and-regrow the best-gaining running elastic gang
+        into it via ``backend.reconfigure``. At most one grow per
+        hysteresis window; the resumed attempt re-enters through
+        ``submit()`` with the wider ``param.devices`` and is placed like
+        any other gang."""
+        backend = self.backend
+        if (
+            not self.grow_enabled
+            or backend is None
+            or not hasattr(backend, "reconfigure")
+        ):
+            return
+        with self._lock:
+            if self._queue:
+                # Queued work has first claim on idle capacity — growing
+                # over it would just trade one wait for another.
+                self._grow_idle_streak = 0
+                return
+            candidate = self._grow_candidate_locked()
+            if candidate is None:
+                self._grow_idle_streak = 0
+                return
+            self._grow_idle_streak += 1
+            if self._grow_idle_streak < self.grow_idle_pumps:
+                return
+            self._grow_idle_streak = 0
+            tr, target_type, gain = candidate
+            target_chips = self.pool[target_type].chips
+            prior_chips = self.pool[tr.slice_type].chips
+            # Free the gang's current slot now: its teardown is ordered
+            # (checkpoint flush before pods drop), and the resume attempt
+            # re-reserves through the normal placement path.
+            self._release_locked(tr.key)
+        self.grows_total += 1
+        self._count("fleet_grows_total")
+        self._record(
+            "fleet_grow", key=f"{tr.key[0]}/{tr.key[1]}",
+            slice_type=tr.slice_type, target_type=target_type,
+            prior_chips=prior_chips, target_chips=target_chips,
+            gain=round(gain, 6), tenant=tr.tenant, wclass=tr.wclass,
+        )
+        ns, name = tr.key
+        try:
+            backend.reconfigure(
+                ns, name, kind=tr.kind, api_version=tr.api_version,
+                target_devices=target_chips, reason="FleetGrow",
+            )
+        except Exception:  # noqa: BLE001 — gang may be finishing/deleted
+            logger.exception("fleet grow of %s/%s failed", ns, name)
 
     def release(self, namespace: str, name: str) -> bool:
         """Explicitly free the slice held by a finished job (simulation
@@ -1165,6 +1359,14 @@ class FleetScheduler:
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
+            grown = {
+                f"{tr.key[0]}/{tr.key[1]}": max(
+                    0,
+                    self.pool[tr.slice_type].chips - tr.original_devices,
+                )
+                for tr in self._running.values()
+                if tr.grown and tr.slice_type is not None
+            }
             return {
                 "policy": self.policy,
                 "free": dict(self._free),
@@ -1176,6 +1378,11 @@ class FleetScheduler:
                 "rejected_total": self.rejected_total,
                 "preempted_total": self.preempted_total,
                 "backfilled_total": self.backfilled_total,
+                "grows_total": self.grows_total,
+                "shrinks_total": self.shrinks_total,
+                # running grown gangs → chips reclaimed from idle (what
+                # the observatory integrates into reclaimed chip-seconds)
+                "grown": grown,
             }
 
 

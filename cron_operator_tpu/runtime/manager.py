@@ -134,6 +134,14 @@ _FAMILY_META: Dict[str, tuple] = {
     "fleet_backfills_total": (
         "counter", "Queued workloads dispatched past a still-blocked "
                    "queue head (backfill)"),
+    "fleet_grows_total": (
+        "counter", "Running elastic gangs checkpoint-and-regrown into "
+                   "sustained idle capacity by the fleet GrowPlanner "
+                   "(planned reconfigure, reason FleetGrow)"),
+    "fleet_shrinks_total": (
+        "counter", "Previously-grown gangs returned to their original "
+                   "width because a higher-priority gang needed the "
+                   "chips (planned reconfigure, reason FleetShrink)"),
     "fleet_rejections_total": (
         "counter", "Fired workloads shed because the fleet queue was at "
                    "max depth"),

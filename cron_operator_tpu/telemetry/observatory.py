@@ -44,6 +44,7 @@ _PRIORITY_NAMES = {100: "system", 50: "high", 0: "normal", -50: "batch"}
 _HANDLED_EVENTS = frozenset((
     "tick_fired", "tick_skipped", "tick_shed",
     "fleet_place", "fleet_dispatch",
+    "fleet_grow", "fleet_shrink",
 ))
 
 
@@ -124,6 +125,14 @@ class FleetObservatory:
         # slice type → integrated chip-seconds since start.
         self._busy_chip_s: Dict[str, float] = {}
         self._cap_chip_s: Dict[str, float] = {}
+        # Bidirectional elasticity: grow/shrink decision counts folded
+        # from the audit stream, plus idle chip-seconds RECLAIMED —
+        # integrated from the fleet's running grown-gang bookkeeping
+        # (stats()["grown"]: extra chips each grown gang holds beyond
+        # its original width).
+        self._grows_seen = 0
+        self._shrinks_seen = 0
+        self._reclaimed_chip_s = 0.0
         self._last_sample_mono: Optional[float] = None
         self.records_seen = 0
         self.rollups_total = 0
@@ -178,6 +187,10 @@ class FleetObservatory:
                     attrs.get("cron") or self._cron_from_key(rec.key),
                     attrs.get("lateness_s"),
                 )
+            elif event == "fleet_grow":
+                self._grows_seen += 1
+            elif event == "fleet_shrink":
+                self._shrinks_seen += 1
             elif event == "fleet_place":
                 self._remember_tenant(rec.key, attrs.get("tenant"))
             elif event == "fleet_dispatch":
@@ -272,6 +285,10 @@ class FleetObservatory:
             stats = fleet.stats()
             free = stats.get("free", {})
             lost = stats.get("lost", {})
+            if last is not None and now_mono > last:
+                extra = sum((stats.get("grown") or {}).values())
+                if extra:
+                    self._reclaimed_chip_s += extra * (now_mono - last)
             for name, st in fleet.pool.items():
                 cap = max(0, st.count - int(lost.get(name, 0)))
                 busy = max(0, cap - int(free.get(name, 0)))
@@ -312,6 +329,11 @@ class FleetObservatory:
                 for cls, res in sorted(self._queue_wait.items())
             }
             tenants = dict(self._tenant_of)
+            elasticity = {
+                "grows": self._grows_seen,
+                "shrinks": self._shrinks_seen,
+                "reclaimed_idle_chip_s": round(self._reclaimed_chip_s, 3),
+            }
             records_seen = self.records_seen
             rollups = self.rollups_total
         hits = sum(s["hits"] for s in slo.values())
@@ -327,6 +349,7 @@ class FleetObservatory:
             },
             "queue_wait_s": waits,
             "goodput": self._goodput(tenants),
+            "elasticity": elasticity,
             "records_seen": records_seen,
             "rollups_total": rollups,
         }

@@ -63,6 +63,8 @@ DEFAULT_HISTORY_FAMILIES: Tuple[str, ...] = (
     "fleet_preemptions_total",
     "fleet_rejections_total",
     "fleet_backfills_total",
+    "fleet_grows_total",
+    "fleet_shrinks_total",
 )
 
 #: Default cap on distinct series — history memory must stay bounded

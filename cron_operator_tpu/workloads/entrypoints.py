@@ -106,7 +106,10 @@ def _checkpoint_store(ctx: JobContext):
     ("job" default, "family" to continue one run across Forbid ticks).
     param.checkpoint_job pins the store to another job's lineage — the
     elastic resume path sets it to the logical-run root so every resumed
-    attempt reads (and keeps extending) one checkpoint chain."""
+    attempt reads (and keeps extending) one checkpoint chain.
+    param.checkpoint_keep widens retention past the default 3 — an
+    elastic run that reshards many times keeps its width-boundary steps
+    auditable instead of garbage-collecting them."""
     if ctx.params.get("checkpoint", "0") not in ("1", "true", "yes"):
         return None
     from cron_operator_tpu.workloads.checkpoint import CheckpointStore
@@ -115,6 +118,7 @@ def _checkpoint_store(ctx: JobContext):
         ctx.namespace or "default",
         ctx.params.get("checkpoint_job") or ctx.name,
         root=ctx.params.get("checkpoint_dir"),
+        max_to_keep=int(ctx.params.get("checkpoint_keep", 3)),
         lineage=ctx.params.get("checkpoint_lineage", "job"),
     )
 

@@ -2333,6 +2333,647 @@ def check_fleet_invariants(ev: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Bidirectional-elasticity grow leg (ISSUE 14): checkpoint-and-regrow a
+# running training job into sustained idle fleet capacity, shrink it back
+# under priority pressure, and prove the goodput margin over shrink-only.
+# ---------------------------------------------------------------------------
+
+#: Width tiers for the grow leg: host-local slices of 2/4/8 virtual CPU
+#: devices (the @chips pool syntax). The elastic job launches on the
+#: narrow tier because the wider ones are busy; as they idle, the
+#: GrowPlanner regrows it 2 → 4 → 8.
+GROW_POOL = "cpu-small=1@2,cpu-mid=1@4,cpu-wide=1@8"
+GROW_QUOTAS = {"team-grow": 8, "team-block": 64}
+GROW_CRON = "growme"
+#: Required goodput advantage of the grow-enabled leg over shrink-only.
+GROW_MARGIN_FLOOR = 1.15
+#: Counter-proof floor: shrink-only must leave at least this much idle
+#: wider-slice capacity unreclaimed while the elastic gang trains narrow.
+GROW_IDLE_GAP_FLOOR_CHIP_S = 2.0
+#: Per-device batch of the grow entrypoint: tokens/step scale with mesh
+#: width, so regrowing genuinely raises token throughput.
+GROW_BATCH_PER_DEVICE = 8
+GROW_STEPS_TARGET = ELASTIC_SAVE_EVERY * 40
+
+
+def _register_grow_entrypoint() -> None:
+    """A real training entrypoint whose GLOBAL batch scales with the
+    mesh (``batch_per_device × n_devices``): a regrown job processes
+    proportionally more samples per step, which is the throughput the
+    goodput comparison measures. Steps are paced (``param.pace_s``) so
+    the scenario's grows land mid-run."""
+    from cron_operator_tpu.backends.registry import (
+        register_entrypoint,
+        resolve_entrypoint,
+    )
+
+    try:
+        resolve_entrypoint("chaos-grow-paced")
+        return  # both legs of one soak share the registration
+    except Exception:  # noqa: BLE001 — not registered yet
+        pass
+
+    import jax
+    import jax.numpy as jnp
+
+    from cron_operator_tpu.workloads import entrypoints as eps
+    from cron_operator_tpu.workloads.train import TrainConfig, Trainer
+
+    dim, classes = 16, 10
+
+    def _apply(p, x):
+        return x @ p["w"] + p["b"]
+
+    def _params0():
+        k = jax.random.PRNGKey(7)
+        return {
+            "w": jax.random.normal(k, (dim, classes), jnp.float32) * 0.1,
+            "b": jnp.zeros((classes,), jnp.float32),
+        }
+
+    @register_entrypoint("chaos-grow-paced")
+    def grow_train(ctx):
+        steps = int(ctx.params.get("steps", GROW_STEPS_TARGET))
+        pace = float(ctx.params.get("pace_s", 0.05))
+        devs = eps._devices(ctx)
+        per_dev = int(
+            ctx.params.get("batch_per_device", GROW_BATCH_PER_DEVICE)
+        )
+        batch = per_dev * max(1, len(devs))
+
+        def _sample(key):
+            kx, ky = jax.random.split(key)
+            return {
+                "x": jax.random.normal(kx, (batch, dim), jnp.float32),
+                "y": jax.random.randint(ky, (batch,), 0, classes),
+            }
+
+        with jax.default_device(devs[0]):
+            mesh = eps._mesh(ctx, devs)
+            trainer = Trainer(
+                _apply, _params0(), mesh,
+                TrainConfig(**eps._train_kwargs(
+                    ctx, steps, optimizer="sgd", learning_rate=0.05,
+                    data_seed=3,
+                )),
+                checkpoint=eps._checkpoint_store(ctx),
+                sample_fn=_sample,
+            )
+
+            def paced():
+                while True:
+                    time.sleep(pace)
+                    yield {}
+
+            eps._run(ctx, trainer, paced(), steps)
+
+
+def _grow_cron(name: str, ann: dict) -> dict:
+    return {
+        "apiVersion": CRON_API_VERSION,
+        "kind": "Cron",
+        "metadata": {"name": name, "namespace": NAMESPACE},
+        "spec": {
+            "schedule": "*/1 * * * *",
+            "concurrencyPolicy": "Forbid",
+            "historyLimit": 3,
+            "template": {"workload": {
+                "apiVersion": WORKLOAD_API_VERSION,
+                "kind": WORKLOAD_KIND,
+                "metadata": {"annotations": ann},
+                "spec": {},
+            }},
+        },
+    }
+
+
+def run_grow_soak(seed: int, grow: bool = True,
+                  train_timeout_s: float = 240.0) -> dict:
+    """The bidirectional-elasticity leg: ONE real paced training job
+    (per-device batch) over a three-tier width pool, driven by the REAL
+    fleet scheduler with the GrowPlanner on (``grow=True``) or off (the
+    shrink-only baseline the goodput margin is measured against).
+
+    Scripted scenario, phase-driven by observed state:
+
+    1. Simulated blockers occupy the 8- and 4-chip slices; the elastic
+       job launches on the 2-chip tier (``param.devices=2``).
+    2. The 4-chip blocker finishes → sustained idle → the GrowPlanner
+       checkpoint-and-regrows the job to width 4 (``-r1``).
+    3. The 8-chip blocker finishes → second grow to width 8 (``-r2``).
+    4. A high-priority aggressor pinned to the wide slice arrives → the
+       grown gang shrinks BACK to its original width 2 via the planned
+       reconfigure path (``-r3``, reason FleetShrink — not Preempted).
+    5. The job trains to completion; history collapses to one entry
+       carrying both ``resumes`` and ``grows`` counts.
+
+    With ``grow=False`` the same timeline runs but the job stays at
+    width 2 throughout; the loop additionally integrates the idle
+    chip-seconds of wider slices the job COULD have used — the measured
+    gap the counter-proof (``--no-grow --expect-violation``) asserts.
+    """
+    from cron_operator_tpu.backends.local import LocalExecutor
+    from cron_operator_tpu.controller.cron_controller import CronReconciler
+    from cron_operator_tpu.runtime.fleet import FleetScheduler, parse_pool
+    from cron_operator_tpu.runtime.kube import APIServer
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    _register_grow_entrypoint()
+    t0 = time.time()
+    ckpt_root = tempfile.mkdtemp(prefix="chaos-grow-ckpt-")
+    clock = FakeClock()
+    store = APIServer(clock=clock)
+    metrics = Metrics()
+    # gang_slots=1 serializes REAL training gangs on the shared virtual
+    # device pool (simulated blockers bypass gang admission).
+    ex = LocalExecutor(store, metrics=metrics, gang_slots=1)
+    ex.start()
+    fs = FleetScheduler(
+        parse_pool(GROW_POOL), api=store, backend=ex,
+        quotas=dict(GROW_QUOTAS), metrics=metrics,
+        grow_enabled=grow, grow_idle_pumps=3,
+    )
+    store.add_watcher(fs._on_event, coalesce=True)
+    rec = CronReconciler(store, metrics=metrics, fleet=fs)
+
+    grow_ann = {
+        "tpu.kubedl.io/entrypoint": "chaos-grow-paced",
+        "tpu.kubedl.io/param.steps": str(GROW_STEPS_TARGET),
+        "tpu.kubedl.io/param.pace_s": "0.15",
+        "tpu.kubedl.io/param.batch_per_device": str(GROW_BATCH_PER_DEVICE),
+        "tpu.kubedl.io/param.platform": "cpu",
+        "tpu.kubedl.io/param.devices": "2",
+        "tpu.kubedl.io/param.checkpoint": "1",
+        "tpu.kubedl.io/param.checkpoint_dir": ckpt_root,
+        "tpu.kubedl.io/param.save_every": str(ELASTIC_SAVE_EVERY),
+        # Keep every step: F4 restores the exact width-boundary
+        # checkpoints post-hoc; default retention (3) would GC them.
+        "tpu.kubedl.io/param.checkpoint_keep": "64",
+        "tpu.kubedl.io/elastic-resume": "true",
+        "tpu.kubedl.io/min-reconfigure-interval": "0.2",
+        "tpu.kubedl.io/priority": "batch",
+        "tpu.kubedl.io/tenant": "team-grow",
+        "tpu.kubedl.io/workload-class": "train",
+    }
+    blockers = [
+        # Reconcile order decides placement: the first blocker takes the
+        # widest free slice. Durations stagger the idle windows.
+        ("block-wide", "5s"),
+        ("block-mid", "2.5s"),
+    ]
+    for bname, dur in blockers:
+        store.create(_grow_cron(bname, {
+            "tpu.kubedl.io/simulate-duration": dur,
+            "tpu.kubedl.io/priority": "high",
+            "tpu.kubedl.io/tenant": "team-block",
+        }))
+    store.create(_grow_cron(GROW_CRON, grow_ann))
+    crons = [b for b, _d in blockers] + [GROW_CRON]
+
+    def sweep():
+        for name in crons:
+            rec.reconcile(NAMESPACE, name)
+
+    def suspend(name):
+        import copy as _copy
+
+        obj = _copy.deepcopy(
+            store.get(CRON_API_VERSION, "Cron", NAMESPACE, name)
+        )
+        obj["spec"]["suspend"] = True
+        store.update(obj)
+
+    # One fired tick per cron (fake minute), then park the blockers so
+    # later clock advances don't re-fire them.
+    clock.advance(timedelta(seconds=61))
+    sweep()
+    for bname, _d in blockers:
+        suspend(bname)
+    root = ""
+    for w in store.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                        namespace=NAMESPACE):
+        meta = w.get("metadata") or {}
+        if (meta.get("labels") or {}).get(LABEL_CRON_NAME) == GROW_CRON:
+            root = meta.get("name", "")
+    timeouts: list = []
+    idle_gap_chip_s = 0.0
+    train_started_at = None
+    train_ended_at = None
+
+    def latest_attempt():
+        best, best_no = None, -1
+        for w in store.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                            namespace=NAMESPACE):
+            meta = w.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            wroot = ann.get("tpu.kubedl.io/resume-of",
+                            meta.get("name", ""))
+            if wroot != root:
+                continue
+            try:
+                no = int(ann.get("tpu.kubedl.io/resume-attempt", 0))
+            except (TypeError, ValueError):
+                no = 0
+            if no > best_no:
+                best, best_no = w, no
+        return best
+
+    def churn_until(cond, what, timeout_s=60.0):
+        """Pump/sweep until cond(latest attempt) — integrating the idle
+        gap of wider slices the elastic gang is not using."""
+        nonlocal idle_gap_chip_s, train_started_at, train_ended_at
+        pool = {t.name: t for t in parse_pool(GROW_POOL)}
+        deadline = time.time() + timeout_s
+        last = time.time()
+        while time.time() < deadline:
+            store.flush(0.05)
+            fs.pump()
+            sweep()
+            now = time.time()
+            dt, last = now - last, now
+            w = latest_attempt()
+            if w is not None:
+                ann = (w.get("metadata") or {}).get("annotations") or {}
+                terminal = _is_terminal(w)
+                if train_started_at is None and (
+                    (w.get("status") or {}).get("trainingProgress")
+                ):
+                    train_started_at = now
+                if terminal == "Succeeded":
+                    train_ended_at = train_ended_at or now
+                try:
+                    cur_width = int(
+                        ann.get("tpu.kubedl.io/param.devices") or 0
+                    )
+                except (TypeError, ValueError):
+                    cur_width = 0
+                if not terminal and cur_width > 0:
+                    free = fs.stats()["free"]
+                    wider = [
+                        pool[n].chips - cur_width
+                        for n, k in free.items()
+                        if k > 0 and pool[n].chips > cur_width
+                    ]
+                    if wider:
+                        idle_gap_chip_s += max(wider) * dt
+                if cond(w):
+                    return w
+            time.sleep(0.05)
+        timeouts.append({"phase": what})
+        return latest_attempt()
+
+    def width_of(w):
+        if w is None:
+            return 0
+        ann = (w.get("metadata") or {}).get("annotations") or {}
+        try:
+            return int(ann.get("tpu.kubedl.io/param.devices") or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def steps_of(w):
+        if w is None:
+            return 0
+        prog = (w.get("status") or {}).get("trainingProgress") or {}
+        return int(prog.get("steps_done") or 0)
+
+    if grow:
+        # Phase 2/3: each blocker's exit opens a wider tier; the
+        # GrowPlanner must regrow the job into it.
+        churn_until(lambda w: width_of(w) >= 4, "grow-to-4",
+                    train_timeout_s / 3)
+        churn_until(lambda w: width_of(w) >= 8, "grow-to-8",
+                    train_timeout_s / 3)
+        # Train a little at full width before the pressure arrives.
+        wide_floor = steps_of(latest_attempt()) + 2 * ELASTIC_SAVE_EVERY
+        churn_until(lambda w: steps_of(w) >= wide_floor or _is_terminal(w),
+                    "train-at-8", train_timeout_s / 3)
+    else:
+        # Shrink-only baseline: same timeline, no grows — wait out both
+        # blockers, then let the job train past the half-way mark with
+        # the wider slices sitting idle (the measured gap).
+        churn_until(
+            lambda w: steps_of(w) >= GROW_STEPS_TARGET // 2
+            or _is_terminal(w),
+            "train-narrow", train_timeout_s / 2,
+        )
+
+    # Phase 4: high-priority pressure on the wide slice. In the grow leg
+    # the victim is the grown gang → planned shrink-back to width 2.
+    # Submitted straight to the fleet (the controller's fire path does
+    # the same) so no clock tick is needed — advancing the fake minute
+    # here would re-fire the growme cron into a second logical run.
+    aggressor_name = "aggressor-0"
+    fs.submit({
+        "apiVersion": WORKLOAD_API_VERSION,
+        "kind": WORKLOAD_KIND,
+        "metadata": {
+            "name": aggressor_name,
+            "namespace": NAMESPACE,
+            "annotations": {
+                "tpu.kubedl.io/simulate-duration": "2s",
+                "tpu.kubedl.io/priority": "high",
+                "tpu.kubedl.io/tenant": "team-block",
+                "tpu.kubedl.io/fleet-slice-type": "cpu-wide",
+            },
+        },
+        "spec": {},
+    })
+    if grow:
+        churn_until(
+            lambda w: width_of(w) == 2 and int(
+                ((w.get("metadata") or {}).get("annotations") or {}).get(
+                    "tpu.kubedl.io/resume-attempt", 0)
+            ) >= 3 or _is_terminal(w) == "Succeeded",
+            "shrink-back", train_timeout_s / 3,
+        )
+
+    # Phase 5: drain to completion.
+    churn_until(lambda w: _is_terminal(w) == "Succeeded", "drain",
+                train_timeout_s)
+    ex.wait_idle(timeout=train_timeout_s)
+    sweep()
+    store.flush(2.0)
+    fs.pump()
+    sweep()
+
+    # ---- end-state evidence ----------------------------------------------
+    runs: dict = {}
+    for cron in crons:
+        chain: list = []
+        croot = ""
+        for w in store.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                            namespace=NAMESPACE):
+            meta = w.get("metadata") or {}
+            if (meta.get("labels") or {}).get(LABEL_CRON_NAME) == cron \
+                    and "tpu.kubedl.io/resume-of" not in (
+                        meta.get("annotations") or {}):
+                croot = meta.get("name", "")
+        for w in store.list(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                            namespace=NAMESPACE):
+            meta = w.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            wroot = ann.get("tpu.kubedl.io/resume-of",
+                            meta.get("name", ""))
+            if wroot != croot or not croot:
+                continue
+            try:
+                no = int(ann.get("tpu.kubedl.io/resume-attempt", 0))
+            except (TypeError, ValueError):
+                no = 0
+            prog = (w.get("status") or {}).get("trainingProgress") or {}
+            chain.append({
+                "attempt": no,
+                "name": meta.get("name", ""),
+                "terminal": _is_terminal(w),
+                "devices": ann.get("tpu.kubedl.io/param.devices") or "",
+                "cause": ann.get("tpu.kubedl.io/resume-cause") or "",
+                "slice_type": ann.get("tpu.kubedl.io/fleet-slice-type"),
+                "resumed_from_step": prog.get("resumed_from_step"),
+                "steps_done": int(prog.get("steps_done") or 0),
+            })
+        chain.sort(key=lambda a: a["attempt"])
+        cron_obj = store.get(CRON_API_VERSION, "Cron", NAMESPACE, cron)
+        hist = (cron_obj.get("status") or {}).get("history") or []
+        runs[cron] = {
+            "root": croot,
+            "chain": chain,
+            "history": [
+                {
+                    "name": (h.get("object") or {}).get("name", ""),
+                    "status": h.get("status", ""),
+                    "resumes": int(h.get("resumes") or 0),
+                    "grows": int(h.get("grows") or 0),
+                }
+                for h in hist
+            ],
+        }
+
+    agg = store.try_get(WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE,
+                        aggressor_name)
+    runs["aggressor"] = {
+        "root": aggressor_name,
+        "chain": [{
+            "attempt": 0,
+            "name": aggressor_name,
+            "terminal": _is_terminal(agg) if agg is not None else "",
+            "devices": "",
+            "cause": "",
+            "slice_type": "cpu-wide",
+            "resumed_from_step": None,
+            "steps_done": 0,
+        }],
+        "history": [],
+    }
+
+    stats = fs.stats()
+    fs.stop()
+    ex.stop()
+    store.close()
+    elapsed_train = (
+        round(train_ended_at - train_started_at, 2)
+        if train_started_at and train_ended_at else None
+    )
+    return {
+        "grow_enabled": grow,
+        "pool": GROW_POOL,
+        "quotas": dict(GROW_QUOTAS),
+        "steps_target": GROW_STEPS_TARGET,
+        "save_every": ELASTIC_SAVE_EVERY,
+        "batch_per_device": GROW_BATCH_PER_DEVICE,
+        "ckpt_root": ckpt_root,
+        "runs": runs,
+        "fleet_stats": stats,
+        "idle_gap_chip_s": round(idle_gap_chip_s, 2),
+        "train_elapsed_s": elapsed_train,
+        "timeouts": timeouts,
+        "metrics": {
+            "fleet_grows": metrics.get("fleet_grows_total"),
+            "fleet_shrinks": metrics.get("fleet_shrinks_total"),
+            "resumes": metrics.get("cron_workload_resumes_total"),
+        },
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+def compute_grow_goodput(ev: dict) -> dict:
+    """Token goodput of the elastic job: tokens/step scale with the
+    attempt's width (per-device batch), first-time steps count once,
+    re-trained steps after a resume are waste."""
+    run = (ev.get("runs") or {}).get(GROW_CRON) or {}
+    chain = run.get("chain") or []
+    per_dev = int(ev.get("batch_per_device") or GROW_BATCH_PER_DEVICE)
+    tokens_useful = 0
+    tokens_trained = 0
+    prev_peak = 0
+    for a in chain:
+        devices = int(a.get("devices") or 0) or 1
+        start = int(a.get("resumed_from_step") or 0)
+        end = int(a.get("steps_done") or 0)
+        trained = max(0, end - start)
+        useful = max(0, end - max(start, prev_peak))
+        tokens_trained += trained * devices * per_dev
+        tokens_useful += useful * devices * per_dev
+        prev_peak = max(prev_peak, end)
+    elapsed = ev.get("train_elapsed_s") or 0.0
+    return {
+        "attempts": len(chain),
+        "tokens_useful": tokens_useful,
+        "tokens_trained": tokens_trained,
+        "wasted_tokens": max(0, tokens_trained - tokens_useful),
+        "train_elapsed_s": elapsed,
+        "tokens_per_s": (
+            round(tokens_useful / elapsed, 2) if elapsed else 0.0
+        ),
+    }
+
+
+def check_f4(ev: dict) -> dict:
+    """F4 grow_bit_exact: at EVERY width-change boundary of the grown
+    job's chain, the checkpoint written at the old width restores
+    bit-for-bit onto a mesh of the new width (``restore_resharded``
+    against the actual soak checkpoints — resharding moves bytes, never
+    rounds them)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cron_operator_tpu.parallel.mesh import mesh_for_devices
+    from cron_operator_tpu.workloads.checkpoint import CheckpointStore
+
+    run = (ev.get("runs") or {}).get(GROW_CRON) or {}
+    chain = run.get("chain") or []
+    root = run.get("root") or ""
+    boundaries: list = []
+    problems: list = []
+    if not root or len(chain) < 2:
+        return {"ok": False,
+                "detail": {"error": "no attempt chain to check",
+                           "chain": chain}}
+    store = CheckpointStore(NAMESPACE, root, root=ev["ckpt_root"])
+    try:
+        for prev, cur in zip(chain, chain[1:]):
+            try:
+                w_prev = int(prev.get("devices") or 0)
+                w_new = int(cur.get("devices") or 0)
+            except (TypeError, ValueError):
+                continue
+            if w_new == w_prev or w_new <= 0:
+                continue
+            step = cur.get("resumed_from_step")
+            if step is None:
+                problems.append({
+                    "attempt": cur["attempt"],
+                    "error": "no resumed_from_step recorded",
+                })
+                continue
+            step = int(step)
+            raw = store.restore_params(step)  # host bytes, old layout
+            mesh = mesh_for_devices(jax.devices()[:w_new])
+            spec = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()
+            )
+            like = {"params": {
+                k: jax.device_put(
+                    jnp.zeros(np.shape(v), np.asarray(v).dtype), spec
+                )
+                for k, v in raw.items()
+            }}
+            out = store.restore_resharded(step, like)["params"]
+            exact = all(
+                np.array_equal(np.asarray(out[k]), np.asarray(raw[k]))
+                for k in raw
+            )
+            boundaries.append({
+                "step": step, "from_devices": w_prev,
+                "to_devices": w_new, "cause": cur.get("cause"),
+                "bit_exact": exact,
+            })
+            if not exact:
+                problems.append({"attempt": cur["attempt"],
+                                 "step": step, "error": "bytes differ"})
+    finally:
+        store.close()
+    ok = bool(boundaries) and not problems
+    return {
+        "ok": ok,
+        "detail": (
+            f"{len(boundaries)} width change(s) each restored bit-exact"
+            if ok else {"boundaries": boundaries, "problems": problems}
+        ),
+        "boundaries": boundaries,
+    }
+
+
+def check_grow_invariants(ev: dict) -> dict:
+    """F1 no admitted job lost, F2 quotas never exceeded, F3 the grown
+    run collapses to ONE history entry (Succeeded, grows >= 2, a
+    shrink-back returned it to the launch width), F4 params bit-exact
+    across every width change."""
+    lost = []
+    for cron, run in ev["runs"].items():
+        chain = run["chain"]
+        if not run["root"] or not chain \
+                or chain[-1]["terminal"] != "Succeeded":
+            lost.append({"cron": cron, "chain": chain})
+    f1 = {
+        "ok": not lost,
+        "detail": (f"all {len(ev['runs'])} admitted runs completed"
+                   if not lost else {"lost": lost}),
+    }
+
+    peaks = ev["fleet_stats"]["tenant_peak"]
+    over = {
+        t: {"peak": peaks.get(t, 0), "quota": q}
+        for t, q in ev["quotas"].items()
+        if peaks.get(t, 0) > q
+    }
+    f2 = {
+        "ok": not over,
+        "detail": (f"tenant peaks {peaks} within quotas {ev['quotas']}"
+                   if not over else {"exceeded": over}),
+    }
+
+    run = ev["runs"].get(GROW_CRON) or {}
+    chain = run.get("chain") or []
+    hist = run.get("history") or []
+    grows = sum(1 for a in chain if a.get("cause") == "grow")
+    shrinks = sum(1 for a in chain if a.get("cause") == "shrink")
+    # Every shrink-back attempt must return to the LAUNCH width (the
+    # loaned chips go back whole). The chain may keep going after that —
+    # the planner legitimately re-grows once the aggressor drains — so
+    # the final width is not asserted, only the shrink semantics.
+    shrink_widths = [
+        int(a["devices"] or 0) for a in chain if a.get("cause") == "shrink"
+    ]
+    f3_ok = (
+        len(hist) == 1
+        and hist[0]["status"] == "Succeeded"
+        and hist[0]["grows"] == grows >= 2
+        and hist[0]["resumes"] == len(chain) - 1
+        and shrinks >= 1
+        and all(w == 2 for w in shrink_widths)
+    )
+    f3 = {
+        "ok": f3_ok,
+        "detail": (
+            f"one Succeeded history entry: resumes={hist[0]['resumes']} "
+            f"grows={hist[0]['grows']} shrinks={shrinks}, shrink-back "
+            f"widths {shrink_widths}" if f3_ok
+            else {"history": hist, "chain": chain}
+        ),
+    }
+
+    return {
+        "F1_no_admitted_job_lost": f1,
+        "F2_quotas_never_exceeded": f2,
+        "F3_grown_run_single_history": f3,
+        "F4_bit_exact_across_width_changes": check_f4(ev),
+    }
+
+
+# ---------------------------------------------------------------------------
 # multi-PROCESS leg: real OS processes, literal SIGKILL, lease failover
 # ---------------------------------------------------------------------------
 
@@ -2762,6 +3403,18 @@ def main(argv=None) -> int:
                          "mid-storm; no admitted job may be lost, quotas "
                          "never exceeded, preempted runs resume into one "
                          "history entry (invariants F1-F3)")
+    ap.add_argument("--grow", action="store_true", default=False,
+                    help="also run the bidirectional-elasticity leg: a "
+                         "REAL training job is checkpoint-and-regrown "
+                         "into idle width tiers by the GrowPlanner, "
+                         "shrunk back under priority pressure, and its "
+                         "goodput compared against a shrink-only "
+                         "baseline (margin >= 1.15x, invariants F1-F4)")
+    ap.add_argument("--no-grow", action="store_true", default=False,
+                    help="run ONLY the grow scenario with the "
+                         "GrowPlanner disabled — the counter-proof: "
+                         "shrink-only measurably leaves the idle "
+                         "wider-slice capacity on the table")
     ap.add_argument("--processes", action="store_true", default=False,
                     help="run ONLY the multi-PROCESS leg: spawn the real "
                          "topology (per-shard leader + standby processes "
@@ -2779,7 +3432,7 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
 
-    if args.preempt_storm or args.no_elastic:
+    if args.preempt_storm or args.no_elastic or args.grow or args.no_grow:
         # The elastic leg shards real arrays over host devices; the flag
         # must be set before ANY jax import in this process.
         flags = os.environ.get("XLA_FLAGS", "")
@@ -2870,14 +3523,134 @@ def main(argv=None) -> int:
             "invariants": invariants,
             "ok": ok,
         }
+        if args.grow:
+            # Bidirectional-elasticity pair: grow-enabled leg, then the
+            # shrink-only baseline from the SAME seed/scenario. The
+            # goodput margin is the perf claim; F1-F4 are correctness.
+            print("  grow leg: GrowPlanner ON (real training)",
+                  flush=True)
+            grow_ev = run_grow_soak(args.seed, grow=True)
+            print(
+                f"    done in {grow_ev['elapsed_s']}s "
+                f"grows={grow_ev['metrics']['fleet_grows']} "
+                f"shrinks={grow_ev['metrics']['fleet_shrinks']}",
+                flush=True,
+            )
+            print("  baseline leg: GrowPlanner OFF (shrink-only)",
+                  flush=True)
+            nogrow_ev = run_grow_soak(args.seed, grow=False)
+            print(f"    done in {nogrow_ev['elapsed_s']}s", flush=True)
+            grow_inv = check_grow_invariants(grow_ev)
+            for e in (grow_ev, nogrow_ev):
+                shutil.rmtree(e.pop("ckpt_root", ""), ignore_errors=True)
+            gp = compute_grow_goodput(grow_ev)
+            ngp = compute_grow_goodput(nogrow_ev)
+            margin = (
+                round(gp["tokens_per_s"] / ngp["tokens_per_s"], 3)
+                if ngp["tokens_per_s"] else 0.0
+            )
+            goodput = {
+                "grow": gp,
+                "shrink_only": ngp,
+                "margin": margin,
+                "floor": GROW_MARGIN_FLOOR,
+                "idle_gap_chip_s": {
+                    "grow": grow_ev["idle_gap_chip_s"],
+                    "shrink_only": nogrow_ev["idle_gap_chip_s"],
+                },
+                "ok": margin >= GROW_MARGIN_FLOOR,
+            }
+            grow_ok = (
+                all(v["ok"] for v in grow_inv.values()) and goodput["ok"]
+            )
+            report["grow"] = {
+                "grow_leg": grow_ev,
+                "shrink_only_leg": nogrow_ev,
+                "invariants": grow_inv,
+                "goodput": goodput,
+                "ok": grow_ok,
+            }
+            ok = ok and grow_ok
+            report["ok"] = ok
+            for name, v in grow_inv.items():
+                mark = "PASS" if v["ok"] else "FAIL"
+                print(f"  [{mark}] {name}: {v['detail']}")
+            mark = "PASS" if goodput["ok"] else "FAIL"
+            print(
+                f"  [{mark}] goodput_margin: grow "
+                f"{gp['tokens_per_s']} tok/s vs shrink-only "
+                f"{ngp['tokens_per_s']} tok/s = {margin}x "
+                f"(floor {GROW_MARGIN_FLOOR}x)"
+            )
+        # If --out already holds a classic soak report, fold this leg in
+        # (the processes-leg idiom) so CHAOS.json carries both.
+        out_doc = report
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+            if (isinstance(existing, dict)
+                    and existing.get("mode") != "fleet-flap"
+                    and "invariants" in existing):
+                existing["fleet"] = report
+                existing["ok"] = bool(existing.get("ok")) and ok
+                out_doc = existing
+        except (OSError, ValueError):
+            pass
         with open(args.out, "w") as f:
-            json.dump(report, f, indent=2, default=str)
+            json.dump(out_doc, f, indent=2, default=str)
             f.write("\n")
         for name, v in invariants.items():
             mark = "PASS" if v["ok"] else "FAIL"
             print(f"  [{mark}] {name}: {v['detail']}")
         print(f"wrote {args.out} (ok={ok})")
         return 0 if ok else 1
+
+    if args.no_grow:
+        # Counter-proof: the SAME grow scenario with the GrowPlanner off.
+        # The elastic gang trains at its launch width while wider slices
+        # sit idle — the integrated idle gap must be measurably large,
+        # the capacity a grow would have reclaimed.
+        print(
+            f"chaos soak (grow counter-proof): seed={args.seed} "
+            "GrowPlanner disabled",
+            flush=True,
+        )
+        ev = run_grow_soak(args.seed, grow=False)
+        shutil.rmtree(ev.pop("ckpt_root", ""), ignore_errors=True)
+        gap = ev["idle_gap_chip_s"]
+        run = ev["runs"].get(GROW_CRON) or {}
+        chain = run.get("chain") or []
+        finished = bool(chain) and chain[-1]["terminal"] == "Succeeded"
+        grew = any(a.get("cause") == "grow" for a in chain)
+        gap_left = finished and not grew and gap >= GROW_IDLE_GAP_FLOOR_CHIP_S
+        report = {
+            "seed": args.seed,
+            "mode": "no-grow",
+            "grow_scenario_leg": ev,
+            "idle_gap_chip_s": gap,
+            "idle_gap_floor_chip_s": GROW_IDLE_GAP_FLOOR_CHIP_S,
+            "gap_left_on_table": gap_left,
+            "ok": not gap_left,
+        }
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+        print(
+            f"  idle gap left unreclaimed: {gap} chip-s "
+            f"(floor {GROW_IDLE_GAP_FLOOR_CHIP_S}) — job finished at "
+            f"width {chain[-1]['devices'] if chain else '?'}"
+        )
+        print(f"wrote {args.out}")
+        if args.expect_violation:
+            if gap_left:
+                print("expected violation observed — shrink-only left "
+                      f"{gap} idle chip-seconds on the table that the "
+                      "GrowPlanner would have reclaimed")
+                return 0
+            print("ERROR: expected an idle-gap violation but shrink-only "
+                  "left none (gap below floor or the job grew)")
+            return 1
+        return 0 if not gap_left else 1
 
     if args.no_elastic:
         # Counter-proof mode: ONLY the elastic leg, with elastic resume

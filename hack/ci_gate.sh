@@ -183,6 +183,19 @@ echo "==> fleet capacity-flap soak (quotas, preemption + elastic resume)"
 python hack/chaos_soak.py --seed 13 --crons 18 --rounds 3 --fleet-flap \
     --out /dev/null
 
+echo "==> bidirectional elasticity (grow soak + shrink-only counter-proof)"
+# Fixed-seed grow smoke: one real CPU-mesh training job is
+# checkpoint-and-regrown 2→4→8 into idle slices by the GrowPlanner,
+# shrunk back under pinned pressure, and must beat the shrink-only
+# baseline's goodput by >= 1.15x with params bit-exact across every
+# width change (F4). Then the same scenario with the planner OFF must
+# leave a measurable idle chip-second gap — the counter-proof that the
+# grow gate measures reclaimed capacity, not noise.
+python hack/chaos_soak.py --seed 17 --crons 12 --rounds 2 --fleet-flap \
+    --grow --out /dev/null
+python hack/chaos_soak.py --seed 17 --no-grow --expect-violation \
+    --out /dev/null
+
 echo "==> metric registry drift (every emitted family declared + typed)"
 # Explicit run of the registry drift guard: scans every metrics.inc/
 # observe/set call site AND interned-series assignment in the package,

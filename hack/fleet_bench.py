@@ -21,7 +21,18 @@ Two legs:
    the fleet's in-memory books, never the store — the control plane's
    steady-state zero-write invariant survives the new subsystem.
 
-Output: BENCH_FLEET.json with one OK/REGRESSION verdict over both legs.
+3. **Bidirectional-elasticity A-B (grow leg)** — the REAL GrowPlanner
+   (``grow_enabled=True``) over a three-tier ``host_chips`` pool on a
+   virtual clock: blockers vacate progressively wider slices and the
+   planner checkpoint-and-regrows one elastic job into them (each
+   reshard pays a fixed virtual penalty); the baseline is the identical
+   timeline with the planner off. Job physics are chips-proportional
+   (tokens/s = width). Gates: the grown job finishes ``--min-grow-speedup``
+   (default 2x) faster, exactly two grow decisions fire, and the
+   planner reclaims >= 90% of the idle chip-seconds the baseline
+   leaves on the table.
+
+Output: BENCH_FLEET.json with one OK/REGRESSION verdict over all legs.
 ``--check`` runs small sizes and exits non-zero on REGRESSION (the CI
 gate smoke); ``--stdout`` prints the JSON document.
 """
@@ -217,6 +228,141 @@ def run_zero_write_leg(n_jobs=40, pumps=200):
     }
 
 
+GROW_POOL = "small=1@2,mid=1@4,wide=1@8"
+GROW_WORK_TOKENS = 240.0
+GROW_RESHARD_PENALTY_S = 0.5
+GROW_RELEASES = [(2.0, "block-mid"), (5.0, "block-wide")]
+
+
+def run_grow_leg(grow_idle_pumps=3):
+    """Elasticity A-B on a virtual clock. One elastic job (tokens/s =
+    width) launches on the 2-chip tier while blockers hold the 4- and
+    8-chip slices; as each blocker finishes, the grow-enabled leg lets
+    the REAL GrowPlanner relocate the job (``backend.reconfigure`` is
+    recorded, the controller's resume is simulated by resubmitting at
+    the target width, and each reshard costs a fixed dead-time
+    penalty). The baseline leg runs the same timeline with the planner
+    off. Also integrates the idle chip-second gap — wider-slice
+    capacity sitting free while the job runs narrower — which the
+    planner is supposed to reclaim."""
+    elastic_ann = {
+        "tpu.kubedl.io/elastic-resume": "true",
+        "tpu.kubedl.io/workload-class": "train",
+    }
+
+    def wl(name, ann):
+        return {
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "metadata": {
+                "namespace": "bench", "name": name,
+                "annotations": dict(ann),
+            },
+            "spec": {},
+        }
+
+    def leg(grow):
+        recon = []
+
+        class _Recorder:
+            def reconfigure(self, ns, name, kind, api_version,
+                            target_devices, reason):
+                recon.append((ns, name, int(target_devices), reason))
+                return True
+
+        placed = {}
+        fs = FleetScheduler(
+            parse_pool(GROW_POOL),
+            backend=_Recorder(),
+            on_create=lambda w, t: placed.__setitem__(
+                w["metadata"]["name"], t
+            ),
+            grow_enabled=grow,
+            grow_idle_pumps=grow_idle_pumps,
+            max_queue=8,
+        )
+        # Chips-proportional prior: the first blocker takes the widest
+        # free slice, the second the next, the elastic job the 2-chip.
+        fs.submit(wl("block-wide", {"tpu.kubedl.io/priority": "high"}))
+        fs.submit(wl("block-mid", {"tpu.kubedl.io/priority": "high"}))
+        fs.submit(wl("job", {**elastic_ann,
+                             "tpu.kubedl.io/param.devices": "2"}))
+        assert placed.get("job") == "small", placed
+
+        chips = {t.name: t.chips for t in parse_pool(GROW_POOL)}
+        now = 0.0
+        tokens = 0.0
+        width = 2
+        idle_gap = 0.0
+        free_wider = []  # chip widths of freed slices wider than `width`
+        grows = 0
+        jname = "job"
+
+        def advance(to):
+            nonlocal now, tokens, idle_gap
+            dt = to - now
+            tokens += width * dt
+            if free_wider:
+                idle_gap += (max(free_wider) - width) * dt
+            now = to
+
+        for rel_t, rel_name in GROW_RELEASES:
+            if tokens + width * (rel_t - now) >= GROW_WORK_TOKENS:
+                break  # done before this slice even frees
+            advance(rel_t)
+            fs.release("bench", rel_name)
+            free_wider.append(chips[placed[rel_name]])
+            if not grow:
+                continue
+            for _ in range(grow_idle_pumps):
+                fs.pump()
+            if recon and recon[-1][1] == jname:
+                _ns, _n, target, reason = recon[-1]
+                assert reason == "FleetGrow", recon
+                # Reshard dead time, then the controller-side resume:
+                # the regrown attempt lands on the freed wider slice.
+                now += GROW_RESHARD_PENALTY_S
+                grows += 1
+                jname = f"job-r{grows}"
+                fs.submit(wl(jname, {
+                    **elastic_ann,
+                    "tpu.kubedl.io/param.devices": str(target),
+                    "tpu.kubedl.io/resume-of": "job",
+                }))
+                free_wider = [c for c in free_wider if c > target]
+                width = target
+        remaining = max(0.0, GROW_WORK_TOKENS - tokens)
+        done_at = now + remaining / width
+        if free_wider:
+            idle_gap += (max(free_wider) - width) * (done_at - now)
+        return {
+            "completion_s": round(done_at, 3),
+            "final_width": width,
+            "grows": grows,
+            "reconfigures": recon,
+            "idle_gap_chip_s": round(idle_gap, 3),
+        }
+
+    grown = leg(True)
+    base = leg(False)
+    speedup = (
+        base["completion_s"] / grown["completion_s"]
+        if grown["completion_s"] else 0.0
+    )
+    reclaimed = (
+        1.0 - grown["idle_gap_chip_s"] / base["idle_gap_chip_s"]
+        if base["idle_gap_chip_s"] else 0.0
+    )
+    return {
+        "pool": GROW_POOL,
+        "work_tokens": GROW_WORK_TOKENS,
+        "reshard_penalty_s": GROW_RESHARD_PENALTY_S,
+        "grow": grown,
+        "baseline": base,
+        "grow_speedup": round(speedup, 3),
+        "idle_reclaimed_frac": round(reclaimed, 4),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--jobs", type=int, default=10000,
@@ -228,6 +374,9 @@ def main(argv=None):
                     help="placement decision p50 budget on the tick path")
     ap.add_argument("--jain-slack", type=float, default=0.02,
                     help="allowed Jain-fairness deficit vs the baseline")
+    ap.add_argument("--min-grow-speedup", type=float, default=2.0,
+                    help="required completion speedup of the grow leg "
+                         "over the shrink-only baseline")
     ap.add_argument("--check", action="store_true",
                     help="small sizes; exit 1 on REGRESSION (CI smoke)")
     ap.add_argument("--stdout", action="store_true",
@@ -242,6 +391,7 @@ def main(argv=None):
     hetero = run_storm("hetero", jobs)
     fifo = run_storm("fifo", jobs)
     zero_write = run_zero_write_leg()
+    grow = run_grow_leg()
 
     speedup = fifo["makespan_s"] / hetero["makespan_s"]
     jain_ok = (
@@ -249,7 +399,13 @@ def main(argv=None):
     )
     p50_ok = hetero["submit_p50_ms"] <= args.max_p50_ms
     zw_ok = zero_write["steady_state_store_writes"] == 0
-    ok = speedup >= args.min_speedup and jain_ok and p50_ok and zw_ok
+    grow_ok = (
+        grow["grow_speedup"] >= args.min_grow_speedup
+        and grow["grow"]["grows"] == 2
+        and grow["idle_reclaimed_frac"] >= 0.9
+    )
+    ok = (speedup >= args.min_speedup and jain_ok and p50_ok and zw_ok
+          and grow_ok)
 
     doc = {
         "bench": "fleet",
@@ -261,11 +417,14 @@ def main(argv=None):
         "makespan_speedup": round(speedup, 3),
         "min_speedup": args.min_speedup,
         "zero_write": zero_write,
+        "grow_leg": grow,
+        "min_grow_speedup": args.min_grow_speedup,
         "gates": {
             "makespan_speedup_ok": speedup >= args.min_speedup,
             "jain_ok": jain_ok,
             "submit_p50_ok": p50_ok,
             "steady_state_zero_write_ok": zw_ok,
+            "grow_speedup_ok": grow_ok,
         },
         "verdict": "OK" if ok else "REGRESSION",
     }
@@ -284,7 +443,10 @@ def main(argv=None):
         f"{hetero['jain_fairness']} vs {fifo['jain_fairness']}, "
         f"submit p50 {hetero['submit_p50_ms']}ms "
         f"(<= {args.max_p50_ms}ms), steady-state writes "
-        f"{zero_write['steady_state_store_writes']}",
+        f"{zero_write['steady_state_store_writes']}, grow leg "
+        f"{grow['grow_speedup']}x (need >= {args.min_grow_speedup}x, "
+        f"{grow['grow']['grows']} grows, "
+        f"{grow['idle_reclaimed_frac']:.0%} idle reclaimed)",
         file=sys.stderr,
     )
     return 0 if (ok or not args.check) else 1

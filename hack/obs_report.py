@@ -396,6 +396,88 @@ def run_utilization_leg() -> dict:
     }
 
 
+def run_elasticity_leg() -> dict:
+    """Bidirectional-elasticity observability: the REAL GrowPlanner over
+    a simulated two-tier pool with the audit journal attached. The
+    observatory must count the grow and the shrink-back decision
+    (``fleet_grow``/``fleet_shrink`` audit kinds), integrate the
+    reclaimed idle chip-seconds while the grown gang holds the loaned
+    width, and both counters must land in metrics."""
+    from cron_operator_tpu.runtime.fleet import FleetScheduler, parse_pool
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.telemetry import AuditJournal, FleetObservatory
+
+    metrics = Metrics()
+    journal = AuditJournal()
+    recon = []
+
+    class _Recorder:
+        def reconfigure(self, ns, name, kind, api_version,
+                        target_devices, reason):
+            recon.append((name, int(target_devices), reason))
+            return True
+
+    fleet = FleetScheduler(
+        parse_pool("narrow=1@2,wide=1@8"),
+        backend=_Recorder(), metrics=metrics, audit=journal,
+        on_create=lambda w, t: None,
+        grow_enabled=True, grow_idle_pumps=2,
+    )
+    obs = FleetObservatory(metrics=metrics)
+    obs.attach_fleet(fleet)
+    journal.attach_observer(obs.on_record)
+
+    def _wl(name: str, ann: dict) -> dict:
+        return {
+            "apiVersion": WORKLOAD_API_VERSION, "kind": WORKLOAD_KIND,
+            "metadata": {"namespace": NAMESPACE, "name": name,
+                         "annotations": ann},
+            "spec": {},
+        }
+
+    # Blocker seizes the wide slice; the elastic job lands narrow.
+    fleet.submit(_wl("blocker", {"tpu.kubedl.io/priority": "high"}))
+    fleet.submit(_wl("growme", {
+        "tpu.kubedl.io/elastic-resume": "true",
+        "tpu.kubedl.io/param.devices": "2",
+    }))
+    obs.sample_fleet(now_mono=0.0)
+    fleet.release(NAMESPACE, "blocker")
+    for _ in range(2):  # hysteresis window, then the grow fires
+        fleet.pump()
+    grew = bool(recon) and recon[-1] == ("growme", 8, "FleetGrow")
+    # Controller-side resume: the regrown attempt at the loaned width.
+    fleet.submit(_wl("growme-r1", {
+        "tpu.kubedl.io/elastic-resume": "true",
+        "tpu.kubedl.io/param.devices": "8",
+        "tpu.kubedl.io/resume-of": "growme",
+        "tpu.kubedl.io/resume-cause": "grow",
+        "tpu.kubedl.io/original-devices": "2",
+    }))
+    obs.sample_fleet(now_mono=10.0)  # 10s holding +6 loaned chips
+    # Priority pressure pinned to the wide slice → planned shrink-back.
+    fleet.submit(_wl("aggressor", {
+        "tpu.kubedl.io/priority": "high",
+        "tpu.kubedl.io/fleet-slice-type": "wide",
+    }))
+    shrank = any(r == ("growme-r1", 2, "FleetShrink") for r in recon)
+    rep = obs.report()["elasticity"]
+    return {
+        "reconfigures": recon,
+        "observatory": rep,
+        "fleet_grows_total": metrics.get("fleet_grows_total"),
+        "fleet_shrinks_total": metrics.get("fleet_shrinks_total"),
+        "ok": (
+            grew and shrank
+            and rep["grows"] >= 1
+            and rep["shrinks"] >= 1
+            and rep["reclaimed_idle_chip_s"] > 0
+            and (metrics.get("fleet_grows_total") or 0) >= 1
+            and (metrics.get("fleet_shrinks_total") or 0) >= 1
+        ),
+    }
+
+
 def run_mfu_leg() -> dict:
     """Step-profiler timeline + MFU estimator on ONE real (CPU) training
     run: the mnist entrypoint must publish a bounded per-step phase
@@ -408,6 +490,10 @@ def run_mfu_leg() -> dict:
         job={"metadata": {"annotations": {}}},
         params={
             "steps": "6", "batch_size": "32", "platform": "cpu",
+            # One dispatch per step: the leg asserts per-step compile
+            # flags (first step compiles, the rest reuse), which the
+            # default scan-chained mode folds into one fused dispatch.
+            "steps_per_call": "1", "stage_async": "0",
             # Synthetic per-chip peak: on host CPU no TPU family applies,
             # so the estimator's denominator comes from the override —
             # the verdict is presence + positivity, not an MFU range.
@@ -473,6 +559,7 @@ def main(argv=None) -> int:
           flush=True)
     report = {"mode": mode, **run_fast_legs()}
     report["utilization"] = run_utilization_leg()
+    report["elasticity"] = run_elasticity_leg()
     report["mfu_timeline"] = run_mfu_leg()
 
     if not args.check:
@@ -490,6 +577,7 @@ def main(argv=None) -> int:
             ("timeline", report["timeline"]),
             ("deadline_slo", report["deadline_slo"]),
             ("utilization", report["utilization"]),
+            ("elasticity", report["elasticity"]),
             ("mfu_timeline", report["mfu_timeline"])]
     if "goodput" in report:
         legs.append(("goodput", report["goodput"]))
@@ -536,6 +624,15 @@ def main(argv=None) -> int:
                 for t, row in leg["per_slice_type"].items()
             )
             detail = f"busy/capacity chip-s: {util_s}"
+        elif name == "elasticity":
+            rep = leg["observatory"]
+            detail = (
+                f"{rep['grows']} grow(s) / {rep['shrinks']} shrink(s) "
+                f"observed, reclaimed {rep['reclaimed_idle_chip_s']} "
+                f"idle chip-s, counters grows="
+                f"{leg['fleet_grows_total']} "
+                f"shrinks={leg['fleet_shrinks_total']}"
+            )
         elif name == "mfu_timeline":
             detail = (
                 f"{leg['timeline_entries']} timeline entries over "

@@ -88,6 +88,52 @@ class TestReplan:
         with pytest.raises(ValueError):
             replan(old, 0)
 
+    def test_explicit_grow_widens_data_first(self):
+        old = plan_for_devices(4, fsdp=2)  # data=2 x fsdp=2
+        new = replan(old, 8, allow_grow=True)
+        assert new.n_devices == 8
+        assert new.axis(FSDP_AXIS) == 2  # model axes untouched
+        assert new.axis(DATA_AXIS) == 4  # growth landed on data
+
+    def test_grow_restores_shrunk_model_axes(self):
+        """The mirror of the shrink rule: growing back to the launch
+        width with the launch plan in hand restores the model axes the
+        shrink sacrificed, not just the data axis."""
+        orig = plan_for_devices(8, fsdp=4)  # data=2 x fsdp=4
+        shrunk = replan(orig, 2)  # fsdp halved to fit
+        assert shrunk.axis(FSDP_AXIS) == 2
+        back = replan(shrunk, 8, allow_grow=True, original_plan=orig)
+        assert back.axis(FSDP_AXIS) == 4  # model axis restored
+        assert back.axis(DATA_AXIS) == 2  # original factorization
+
+    def test_grow_partial_restore_when_divisible(self):
+        orig = plan_for_devices(8, fsdp=4)
+        shrunk = replan(orig, 2)  # data=1 x fsdp=2
+        mid = replan(shrunk, 4, allow_grow=True, original_plan=orig)
+        # 4 devices fit the restored fsdp=4 exactly; data stays 1.
+        assert mid.axis(FSDP_AXIS) == 4
+        assert mid.axis(DATA_AXIS) == 1
+
+    def test_grow_without_original_stays_data_parallel(self):
+        shrunk = plan_for_devices(2, fsdp=2)
+        wide = replan(shrunk, 8, allow_grow=True)
+        assert wide.axis(FSDP_AXIS) == 2
+        assert wide.axis(DATA_AXIS) == 4
+
+    def test_grow_indivisible_rejected(self):
+        old = plan_for_devices(4, fsdp=4)
+        with pytest.raises(ValueError):
+            replan(old, 6, allow_grow=True)  # 6 % fsdp(4) != 0
+
+    def test_regrow_wrapper(self):
+        from cron_operator_tpu.parallel.mesh import regrow
+
+        orig = plan_for_devices(8, fsdp=4)
+        shrunk = replan(orig, 2)
+        back = regrow(shrunk, 8, original_plan=orig)
+        assert back.axis(FSDP_AXIS) == 4
+        assert back.n_devices == 8
+
 
 # ---------------------------------------------------------------------------
 # CheckpointStore: the flush guarantee (preempt/SIGTERM durability)
@@ -284,6 +330,127 @@ class TestRestoreResharded:
             np.asarray(out["w"]), np.asarray(state["w"])
         )
         assert int(out["step"]) == 9
+
+    def test_bitwise_roundtrip_onto_larger_mesh(self, tmp_path):
+        """Grow direction of the same contract: a save written on a
+        2-device mesh restores bit-for-bit onto an 8-device template —
+        checkpoint-and-regrow never rounds a parameter byte."""
+        mesh2 = mesh_for_devices(jax.devices()[:2])
+        state = {
+            "w": jax.device_put(
+                jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                jax.sharding.NamedSharding(
+                    mesh2, jax.sharding.PartitionSpec(DATA_AXIS)
+                ),
+            ),
+            "step": jnp.int32(5),
+        }
+        store = CheckpointStore("ns", "gw", root=str(tmp_path))
+        store.save(5, state)
+        store.wait()
+        store.close()
+
+        mesh8 = mesh_for_devices(jax.devices()[:8])
+        like = {
+            "w": jax.device_put(
+                jnp.zeros((8, 8), jnp.float32),
+                jax.sharding.NamedSharding(
+                    mesh8, jax.sharding.PartitionSpec(DATA_AXIS)
+                ),
+            ),
+            "step": jnp.int32(0),
+        }
+        fresh = CheckpointStore("ns", "gw", root=str(tmp_path))
+        out = fresh.restore_resharded(5, like)
+        fresh.close()
+        assert out["w"].sharding.mesh.devices.size == 8
+        assert np.array_equal(
+            np.asarray(out["w"]), np.asarray(state["w"])
+        )
+        assert int(out["step"]) == 5
+
+
+# ---------------------------------------------------------------------------
+# Grow-direction cross-shape: save on 2 devices, regrow onto 4, then 8
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cross_shape_grow(tmp_path_factory):
+    """The mirror of ``cross_shape``: one elastic chain growing 2 → 4 →
+    8 devices plus an uninterrupted 2-device reference. The second grow
+    lands exactly on a ``save_every`` boundary (leg 2 stops at step 8
+    with save_every=4), so the regrown leg resumes with zero lost steps."""
+    root = str(tmp_path_factory.mktemp("xgrow"))
+
+    ref_store = CheckpointStore("t", "gref", root=root)
+    ref = _trainer(2, ref_store)
+    ref_losses = _losses(ref.run(repeat({}), 12))
+    ref_store.close()
+
+    s1 = CheckpointStore("t", "gjob", root=root)
+    t1 = _trainer(2, s1)
+    l1 = _losses(t1.run(repeat({}), 6))  # checkpoint lands at step 4
+    s1.close()
+
+    s2 = CheckpointStore("t", "gjob", root=root)
+    t2 = _trainer(4, s2)  # first grow: restore 2-dev save on 4 devices
+    resumed2 = t2.steps_done
+    restored4 = jax.tree_util.tree_map(np.asarray, t2.state.params)
+    raw2 = s2.restore_params(4)  # the step-4 save as written on 2 devs
+    l2 = _losses(t2.run(repeat({}), 8))  # stops ON the save boundary
+    s2.close()
+
+    s3 = CheckpointStore("t", "gjob", root=root)
+    t3 = _trainer(8, s3)  # second grow: resumes from the boundary save
+    resumed3 = t3.steps_done
+    l3 = _losses(t3.run(repeat({}), 12))
+    s3.close()
+
+    chain = {}
+    chain.update(l1)
+    chain.update(l2)
+    chain.update(l3)
+    return {
+        "ref": ref_losses,
+        "chain": chain,
+        "resumed": (resumed2, resumed3),
+        "raw2": raw2,
+        "restored4": restored4,
+    }
+
+
+class TestCrossShapeGrow:
+    def test_resumes_land_on_checkpoint_steps(self, cross_shape_grow):
+        # 2-dev leg saved at 4 (ran to 6); 4-dev leg stopped exactly on
+        # the step-8 save boundary, so the 8-dev leg loses zero steps.
+        assert cross_shape_grow["resumed"] == (4, 8)
+
+    def test_restored_params_bit_exact(self, cross_shape_grow):
+        """What the 4-device mesh restored is bit-for-bit the 2-device
+        save — growing moves bytes across more devices, never rounds."""
+        raw2 = cross_shape_grow["raw2"]
+        restored4 = cross_shape_grow["restored4"]
+        assert set(raw2) == set(restored4) == {"w", "b"}
+        for leaf in ("w", "b"):
+            assert np.array_equal(
+                np.asarray(raw2[leaf]), restored4[leaf]
+            ), leaf
+
+    def test_loss_curve_continues(self, cross_shape_grow):
+        ref, chain = cross_shape_grow["ref"], cross_shape_grow["chain"]
+        assert sorted(chain) == sorted(ref) == list(range(1, 13))
+        # Same-mesh prefix (steps 1-6 ran on the identical 2-dev mesh in
+        # both runs): bit-for-bit.
+        for step in range(1, 7):
+            assert np.float32(chain[step]) == np.float32(ref[step]), step
+        # Cross-mesh continuation after each grow: batch at step k is
+        # fold_in(data_seed, k) regardless of mesh, so only a 1-ulp
+        # reduction-order wobble is permitted.
+        for step in range(7, 13):
+            assert np.isclose(
+                chain[step], ref[step], rtol=0.0, atol=1e-6
+            ), (step, chain[step], ref[step])
 
 
 # ---------------------------------------------------------------------------

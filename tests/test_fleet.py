@@ -855,3 +855,164 @@ class TestControllerWiring:
         # created, so the fired counter must not misreport it.
         assert metrics.get("cron_ticks_fired_total") == 0.0
         api.close()
+
+
+class TestGrowPlanner:
+    """Bidirectional elasticity at the fleet layer: sustained-idle grow
+    via planned reconfigure, shrink-back of grown gangs under priority
+    pressure, and the @chips host-local pool syntax that models width
+    tiers for the grow soak."""
+
+    ELASTIC = {"tpu.kubedl.io/elastic-resume": "true"}
+
+    class RecordingBackend:
+        def __init__(self):
+            self.reconfigures = []
+            self.preempts = []
+
+        def reconfigure(self, ns, name, kind=None, api_version=None,
+                        target_devices=0, reason=""):
+            self.reconfigures.append((ns, name, target_devices, reason))
+            return {"targetDevices": target_devices, "reason": reason}
+
+        def preempt(self, ns, name, kind=None, api_version=None):
+            self.preempts.append((ns, name))
+            return {"lostDevices": 1, "jobFinished": False}
+
+        def restore_capacity(self, n=None):
+            pass
+
+    def _fleet(self, pool, **kw):
+        be = self.RecordingBackend()
+        kw.setdefault("grow_enabled", True)
+        kw.setdefault("grow_idle_pumps", 3)
+        fs = FleetScheduler(
+            parse_pool(pool), backend=be, on_create=lambda w, t: None, **kw
+        )
+        return fs, be
+
+    def test_parse_pool_host_chips(self):
+        pool = {t.name: t for t in parse_pool("cpu-small=1@2,cpu-wide=2@8")}
+        assert pool["cpu-small"].chips == 2
+        assert pool["cpu-wide"].chips == 8
+        assert pool["cpu-wide"].count == 2
+        assert pool["cpu-wide"].spec is None  # still host-local
+
+    def test_parse_pool_host_chips_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_pool("v5e-16=1@4")  # TPU shapes fix their own chips
+        with pytest.raises(ValueError):
+            parse_pool("cpu=1@0")
+        with pytest.raises(ValueError):
+            parse_pool("cpu=1@x")
+
+    def _grow_setup(self, **kw):
+        """Elastic gang on the narrow slice, wide slice just freed."""
+        fs, be = self._fleet("cpu-small=1@2,cpu-wide=1@8", **kw)
+        assert fs.submit(make_job("blocker")).action == "placed"
+        assert fs.submit(
+            make_job("growme", extra_ann=self.ELASTIC)
+        ).action == "placed"
+        # Chips-proportional prior: blocker grabbed the 8-chip slice.
+        fs.release("default", "blocker")
+        return fs, be
+
+    def test_grow_fires_after_sustained_idle(self):
+        fs, be = self._grow_setup()
+        fs.pump()
+        fs.pump()
+        assert be.reconfigures == []  # hysteresis window not yet met
+        fs.pump()
+        assert be.reconfigures == [("default", "growme", 8, "FleetGrow")]
+        assert fs.grows_total == 1
+        assert fs.stats()["grows_total"] == 1
+        # The gang's slot was handed back; the resume re-enters through
+        # submit() like any other gang.
+        assert fs.stats()["free"] == {"cpu-small": 1, "cpu-wide": 1}
+
+    def test_grow_streak_resets_on_queued_work(self):
+        fs, be = self._grow_setup()
+        fs.pump()
+        fs.pump()
+        # Queued work has first claim on the idle slice: streak resets.
+        assert fs.submit(
+            make_job("wait", pinned_type="cpu-small")
+        ).action == "queued"
+        for _ in range(5):
+            fs.pump()
+        assert be.reconfigures == []
+        assert fs.grows_total == 0
+
+    def test_grow_respects_min_gain(self):
+        fs, be = self._grow_setup(grow_min_gain=100.0)
+        for _ in range(6):
+            fs.pump()
+        assert be.reconfigures == []
+
+    def test_grow_disabled_by_default(self):
+        fs, be = self._fleet("cpu-small=1@2,cpu-wide=1@8",
+                             grow_enabled=False)
+        fs.submit(make_job("blocker"))
+        fs.submit(make_job("growme", extra_ann=self.ELASTIC))
+        fs.release("default", "blocker")
+        for _ in range(6):
+            fs.pump()
+        assert be.reconfigures == []
+
+    def test_grow_skips_pinned_gangs(self):
+        fs, be = self._fleet("cpu-small=1@2,cpu-wide=1@8")
+        ann = dict(self.ELASTIC)
+        fs.submit(make_job("pinned", pinned_type="cpu-small",
+                           extra_ann=ann))
+        for _ in range(6):
+            fs.pump()
+        assert be.reconfigures == []
+
+    def test_grow_skips_non_elastic_gangs(self):
+        fs, be = self._fleet("cpu-small=1@2,cpu-wide=1@8")
+        fs.submit(make_job("blocker"))
+        fs.submit(make_job("rigid"))  # no elastic-resume annotation
+        fs.release("default", "blocker")
+        for _ in range(6):
+            fs.pump()
+        assert be.reconfigures == []
+
+    def test_shrink_back_on_priority_pressure(self):
+        """A previously-grown gang under pressure returns to its original
+        width via planned reconfigure (FleetShrink) — not Preempted."""
+        fs, be = self._fleet("cpu-wide=1@8")
+        grown_ann = dict(self.ELASTIC)
+        grown_ann["tpu.kubedl.io/resume-cause"] = "grow"
+        grown_ann["tpu.kubedl.io/original-devices"] = "2"
+        d = fs.submit(make_job("grown", priority="batch",
+                               extra_ann=grown_ann))
+        assert d.action == "placed"
+        d = fs.submit(make_job("hi", priority="high"))
+        assert (d.action, d.preempted) == ("placed", "default/grown")
+        assert be.reconfigures == [("default", "grown", 2, "FleetShrink")]
+        assert be.preempts == []  # planned path, not preemption
+        assert fs.shrinks_total == 1
+        assert fs.preempted_total == 0
+        assert fs.stats()["shrinks_total"] == 1
+
+    def test_grown_gang_is_preferred_victim(self):
+        """Among equal-priority victims the grown gang goes first: its
+        eviction is the cheap one (shrink-back reclaims loaned width)."""
+        fs, be = self._fleet("cpu-wide=2@8")
+        grown_ann = dict(self.ELASTIC)
+        grown_ann["tpu.kubedl.io/resume-cause"] = "grow"
+        grown_ann["tpu.kubedl.io/original-devices"] = "2"
+        fs.submit(make_job("plain", priority="batch"))
+        fs.submit(make_job("grown", priority="batch", extra_ann=grown_ann))
+        d = fs.submit(make_job("hi", priority="high"))
+        assert (d.action, d.preempted) == ("placed", "default/grown")
+        assert be.reconfigures == [("default", "grown", 2, "FleetShrink")]
+        assert be.preempts == []
+
+    def test_stats_grown_reports_reclaimed_width(self):
+        fs, be = self._fleet("cpu-wide=1@8")
+        grown_ann = dict(self.ELASTIC)
+        grown_ann["tpu.kubedl.io/resume-cause"] = "grow"
+        grown_ann["tpu.kubedl.io/original-devices"] = "2"
+        fs.submit(make_job("grown", extra_ann=grown_ann))
+        assert fs.stats()["grown"] == {"default/grown": 6}
