@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow obs-report
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray obs-report
 
 all: gate
 
@@ -153,6 +153,26 @@ chaos-soak-grow:
 	    --fleet-flap --grow --out CHAOS.json
 	python hack/chaos_soak.py --seed $(or $(SEED),17) \
 	    --no-grow --expect-violation --out /dev/null
+
+# Gray-failure soak (fencing, watchdogs, breakers): SIGSTOP rounds turn
+# a live leader into a zombie mid-lease; the standby must promote with a
+# bumped generation and the woken zombie must fence itself before any
+# stale-epoch write commits (I10, proven by a byte-level scan of every
+# WAL/snapshot for stale-generation records). A router leg SIGSTOPs one
+# shard of two and requires its circuit breaker to trip, the healthy
+# shard's p99 to stay bounded, tripped calls to fail fast, and the
+# breaker to close again after SIGCONT. A hang leg injects silent
+# wedges into REAL CPU-mesh training runs; the step watchdog must
+# declare HangDetected within its EMA budget and the elastic chain must
+# finish every run in one history entry (I11). Then the counter-proof:
+# the same SIGSTOP schedule with fencing OFF must land stale-generation
+# writes on disk — proof I10 detects the split-brain fencing prevents.
+chaos-soak-gray:
+	python hack/chaos_soak.py --seed $(or $(SEED),7) \
+	    --rounds $(or $(ROUNDS),4) --gray --out CHAOS.json
+	python hack/chaos_soak.py --seed $(or $(SEED),7) \
+	    --rounds 2 --gray --no-fencing --expect-violation \
+	    --out /dev/null
 
 # Observability / SLO report (hack/obs_report.py -> BENCH_OBS.json): the
 # flight-recorder scenario (audit ≡ WAL cross-check, lineage traces,

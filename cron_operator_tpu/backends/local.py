@@ -46,6 +46,7 @@ from cron_operator_tpu.runtime.kube import (
 )
 from cron_operator_tpu.runtime.retry import with_conflict_retry
 from cron_operator_tpu.runtime.manager import PHASE_BUCKETS
+from cron_operator_tpu.runtime.watchdog import StepWatchdog
 from cron_operator_tpu.telemetry import ANNOTATION_TRACE_ID
 
 logger = logging.getLogger("backends.local")
@@ -81,7 +82,11 @@ class LocalExecutor:
                  metrics: Optional[Any] = None,
                  tracer: Optional[Any] = None,
                  gang_slots: Optional[int] = None,
-                 audit: Optional[Any] = None):
+                 audit: Optional[Any] = None,
+                 hang_watchdog: bool = True,
+                 watchdog_floor_s: float = 30.0,
+                 watchdog_multiplier: float = 8.0,
+                 watchdog_poll_s: float = 1.0):
         if isolation not in ("thread", "subprocess"):
             raise ValueError(f"unknown isolation mode {isolation!r}")
         self.isolation = isolation
@@ -129,6 +134,17 @@ class LocalExecutor:
         # re-provisioned the slice).
         self._lost_devices = 0
         self._device_total: Optional[int] = None
+        # Gray-failure watchdog: a poll thread compares each running job's
+        # step-heartbeat staleness against an EMA-derived budget
+        # (runtime.watchdog.StepWatchdog) and routes a hung gang through
+        # the preempt → elastic resume chain. Gray hangs — alive process,
+        # dead progress — are invisible to every other check here.
+        self.hang_watchdog = hang_watchdog
+        self.watchdog_floor_s = watchdog_floor_s
+        self.watchdog_multiplier = watchdog_multiplier
+        self.watchdog_poll_s = watchdog_poll_s
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread: Optional[threading.Thread] = None
 
     # ---- capacity ---------------------------------------------------------
 
@@ -166,6 +182,12 @@ class LocalExecutor:
             target=self._dispatch_loop, name="local-executor", daemon=True
         )
         self._dispatcher.start()
+        if self.hang_watchdog:
+            self._watchdog_stop.clear()
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, name="hang-watchdog", daemon=True
+            )
+            self._watchdog_thread.start()
         # Adopt pre-existing jobs (informer initial list).
         for av, kind in self._handled_kinds:
             for obj in self.api.list(av, kind):
@@ -173,6 +195,7 @@ class LocalExecutor:
 
     def stop(self) -> None:
         self._running = False
+        self._watchdog_stop.set()
         with self._lock:
             for ctx in self._jobs.values():
                 ctx.cancel.set()
@@ -185,6 +208,8 @@ class LocalExecutor:
             t.join(timeout=30.0)
         if self._dispatcher:
             self._dispatcher.join(timeout=2.0)
+        if self._watchdog_thread:
+            self._watchdog_thread.join(timeout=2.0)
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
         """Block until no jobs are executing (test/bench helper)."""
@@ -211,6 +236,92 @@ class LocalExecutor:
                 return True
             time.sleep(0.02)
         return False
+
+    # ---- hang watchdog ----------------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Poll running jobs for step-progress staleness. One cheap pass
+        per ``watchdog_poll_s``; the per-job verdict is StepWatchdog's."""
+        while not self._watchdog_stop.wait(self.watchdog_poll_s):
+            with self._lock:
+                items = [
+                    (k, ctx, self._threads.get(k))
+                    for k, ctx in self._jobs.items()
+                ]
+            for key, ctx, thread in items:
+                wd = ctx.watchdog
+                if wd is None or ctx.cancel.is_set():
+                    continue
+                if thread is None or not thread.is_alive():
+                    # Completed runs linger in _jobs until DELETE/preempt;
+                    # a finished job stops beating but is not hung.
+                    continue
+                if "hang_detected" in ctx.progress:
+                    continue  # already fired for this run
+                if not wd.stale():
+                    continue
+                try:
+                    self._declare_hang(key, ctx)
+                except Exception:
+                    logger.error("hang remediation for %s/%s failed:\n%s",
+                                 key[2], key[3], traceback.format_exc())
+
+    def _declare_hang(self, key: JobKey, ctx: JobContext) -> None:
+        """Verdict → condition → remediation. The wedged gang is routed
+        through the SAME preempt → elastic resume chain a real reclaim
+        uses (invariant I11: one logical run, one history entry) — hang
+        recovery is not a second lifecycle, it is a preemption whose
+        cause is a HangDetected condition instead of a cloud event."""
+        av, kind, ns, name = key
+        wd = ctx.watchdog
+        snap = wd.snapshot() if wd is not None else {}
+        import time as _time
+
+        detected_at = _time.time()
+        detail = {
+            "detectedAt": rfc3339(self.api.clock.now()),
+            "stalenessSeconds": snap.get("staleness_s"),
+            "budgetSeconds": snap.get("budget_s"),
+            "emaStepSeconds": snap.get("ema_step_s"),
+            "beats": snap.get("beats"),
+        }
+        # Detection latency relative to the injected wedge, when the chaos
+        # seam stamped one — what CHAOS.json reports against I11's budget.
+        injected_at = ctx.progress.get("hang_injected_at")
+        if injected_at is not None:
+            detail["detectionLatencySeconds"] = max(
+                0.0, detected_at - float(injected_at)
+            )
+        ctx.progress["hang_detected"] = detail
+        logger.warning(
+            "hang detected for %s/%s: no step progress for %.1fs "
+            "(budget %.1fs, %s beats); preempting for elastic resume",
+            ns, name, snap.get("staleness_s") or -1.0,
+            snap.get("budget_s") or -1.0, snap.get("beats"),
+        )
+        if self.metrics is not None:
+            self.metrics.inc("watchdog_hangs_detected_total")
+        if self.audit is not None:
+            ann = (ctx.job.get("metadata") or {}).get("annotations") or {}
+            self.audit.record(
+                "decision", "hang_detected",
+                key=f"{av}/{kind}/{ns}/{name}",
+                trace_id=ann.get(ANNOTATION_TRACE_ID),
+                reason="StepProgressStalled",
+                staleness_s=snap.get("staleness_s"),
+                budget_s=snap.get("budget_s"),
+            )
+        try:
+            self._append_condition(
+                key, "HangDetected", "StepProgressStalled",
+                f"{kind} {name} made no step progress for "
+                f"{snap.get('staleness_s', 0.0):.1f}s "
+                f"(budget {snap.get('budget_s', 0.0):.1f}s).",
+                extra={"hang": detail},
+            )
+        except NotFoundError:
+            return  # job deleted under us — nothing to remediate
+        self.preempt(ns, name, kind=kind, api_version=av)
 
     # ---- watch dispatch ---------------------------------------------------
 
@@ -337,6 +448,17 @@ class LocalExecutor:
                 extra={"startTime": rfc3339(self.api.clock.now())},
             )
 
+            if self.hang_watchdog:
+                # Built here, armed in _execute_entrypoint once the gang
+                # actually starts (after slot admission): queue wait is
+                # not silence, and the pre-first-step window gets the
+                # startup grace (compile/restore), not the step floor. A
+                # gang wedged inside compile or a collective that never
+                # forms still trips the verdict on the grace budget.
+                ctx.watchdog = StepWatchdog(
+                    floor_s=self.watchdog_floor_s,
+                    multiplier=self.watchdog_multiplier,
+                )
             self._execute_entrypoint(ctx)
             self._publish_progress(key, ctx)
 
@@ -389,12 +511,18 @@ class LocalExecutor:
             else:
                 fn = resolve_entrypoint(entry_ref)
                 if self._gang_slots is None:
+                    if ctx.watchdog is not None:
+                        ctx.watchdog.start()
                     fn(ctx)
                     return
                 # Gang admission: poll in small increments so deleting or
                 # preempting a still-QUEUED job stays prompt.
                 while not ctx.cancel.is_set():
                     if self._gang_slots.acquire(timeout=0.05):
+                        # Arm only now: time spent QUEUED behind another
+                        # gang is not step silence.
+                        if ctx.watchdog is not None:
+                            ctx.watchdog.start()
                         try:
                             fn(ctx)
                         finally:
@@ -799,6 +927,23 @@ class LocalExecutor:
         with_conflict_retry(_apply)
 
     # ---- failure injection ------------------------------------------------
+
+    def hang(self, namespace: str, name: str, kind: str = "JAXJob",
+             api_version: str = "kubeflow.org/v1") -> bool:
+        """Chaos seam: wedge a running job's step loop cooperatively —
+        the process stays alive, heartbeats stop, nothing errors. This is
+        the gray failure the step watchdog exists to catch; remediation
+        must come from detection, never from this injection."""
+        key: JobKey = (api_version, kind, namespace, name)
+        with self._lock:
+            ctx = self._jobs.get(key)
+            thread = self._threads.get(key)
+        if ctx is None or ctx.cancel.is_set():
+            return False
+        if thread is None or not thread.is_alive():
+            return False  # already finished — nothing left to wedge
+        ctx.hang.set()
+        return True
 
     def _mark_pods_preempted(self, ns: str, name: str) -> None:
         """Record a ``Preempted`` condition on every host pod of the slice

@@ -46,6 +46,14 @@ class JobContext:
     # tpu.kubedl.io/trace-id annotation / TPU_TRACE_ID env); telemetry the
     # entrypoint emits is tagged with it so spans across layers correlate
     trace_id: Optional[str] = None
+    # step-progress watchdog (runtime.watchdog.StepWatchdog): armed by
+    # the executor at launch, beaten by the entrypoint's on_step; the
+    # executor's poll thread reads it to declare HangDetected
+    watchdog: Optional[Any] = None
+    # chaos seam: when set, the entrypoint's step path wedges
+    # cooperatively (blocks without erroring) until cancelled — the
+    # injected gray failure the watchdog exists to catch
+    hang: threading.Event = field(default_factory=threading.Event)
 
     def should_stop(self) -> bool:
         return self.cancel.is_set()

@@ -363,6 +363,38 @@ def build_parser() -> argparse.ArgumentParser:
     start.add_argument("--port-base", type=int, default=18080, metavar="P",
                        help="supervisor role: router serves on P, shard i "
                             "API on P+1+i, shard i WAL ship on P+51+i")
+    start.add_argument("--no-fencing", action="store_true", default=False,
+                       help="shard/standby roles: do NOT fence the "
+                            "persistence layer when the lease is lost to "
+                            "a higher generation — a demoted zombie "
+                            "keeps appending into the shared WAL "
+                            "(split-brain). For the chaos counter-proof "
+                            "only; never disable in a real deployment")
+    start.add_argument("--promote-api-port", type=int, default=None,
+                       metavar="PORT",
+                       help="standby role: API port to bind AFTER "
+                            "promotion (default: the followed leader's "
+                            "--serve-api port). A gray-failed leader — "
+                            "SIGSTOPped, not dead — still holds its "
+                            "sockets, so promotion onto the same port "
+                            "would fail; give the standby its own")
+    start.add_argument("--promote-ship-port", type=int, default=None,
+                       metavar="PORT",
+                       help="standby role: WAL ship port to bind after "
+                            "promotion (default: --ship-port); see "
+                            "--promote-api-port")
+    start.add_argument("--router-timeout", type=float, default=None,
+                       metavar="S",
+                       help="router role: per-request timeout toward "
+                            "shard peers (default 30s). The circuit "
+                            "breaker scores timeouts as failures, so a "
+                            "tight timeout bounds how long a wedged "
+                            "shard can hold requests before the "
+                            "breaker fails fast")
+    start.add_argument("--no-breakers", action="store_true", default=False,
+                       help="router role: disable the per-shard circuit "
+                            "breakers (every request goes to the wire "
+                            "even when the shard is known-wedged)")
 
     # kubectl-style inspection for standalone mode: the reference relies
     # on kubectl + CRD printcolumns (cron_types.go:33-36); with no
@@ -539,6 +571,7 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             args.shard_index, args.data_dir, api_host=host, api_port=port,
             ship_port=args.ship_port, lease_ttl_s=args.lease_ttl,
             token=args.serve_api_token, scheme=scheme, metrics=metrics,
+            fencing=not args.no_fencing,
         )
         serving.audit.instrument(metrics)
         recovering = (serving.recovered is not None
@@ -579,6 +612,9 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             ship_port=args.ship_port, api_port=port,
             lease_ttl_s=args.lease_ttl, token=args.serve_api_token,
             scheme=scheme, metrics=metrics,
+            promote_api_port=args.promote_api_port,
+            promote_ship_port=args.promote_ship_port,
+            fencing=not args.no_fencing,
         )
         log.info(
             "shard %d standby: following :%d, watching lease %s (pid %d)",
@@ -594,7 +630,8 @@ def cmd_start_process(args: argparse.Namespace) -> int:
         log.info(
             "shard %d standby PROMOTED in %.3fs (i6_ok=%s, rv=%d); "
             "now serving api :%d", args.shard_index,
-            report["duration_s"], report["i6_ok"], report["rv"], port,
+            report["duration_s"], report["i6_ok"], report["rv"],
+            standby.serving.api_port,
         )
         standby.serving.audit.instrument(metrics)
         manager, executor = _shard_manager_stack(
@@ -618,6 +655,8 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             host=host, port=port, token=args.serve_api_token,
             peer_token=args.serve_api_token, scheme=scheme,
             metrics=metrics,
+            breakers=not args.no_breakers,
+            request_timeout_s=args.router_timeout,
         )
         log.info("router serving %d shard(s) on %s:%d (pid %d)",
                  len(router.clients), host, router.port, _os.getpid())

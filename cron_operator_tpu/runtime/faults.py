@@ -446,6 +446,20 @@ class FaultInjector:
         self._record("preempt", "preempt", f"{namespace}/{name}")
         return record
 
+    def inject_hang(self, executor, namespace: str, name: str,
+                    **kwargs) -> bool:
+        """Wedge one workload's step loop cooperatively — the gray
+        failure: process alive, progress dead, no error raised. Unlike
+        ``inject_preempt`` this touches no status and frees no capacity;
+        the ONLY path back to health is the executor's step watchdog
+        noticing the silence (``watchdog_hangs_detected_total``) and
+        preempting the gang itself. Returns False when the job already
+        finished (nothing left to wedge — not a recorded fault)."""
+        ok = bool(executor.hang(namespace, name, **kwargs))
+        if ok:
+            self._record("hang", "hang", f"{namespace}/{name}")
+        return ok
+
     # ---- leadership faults -------------------------------------------------
 
     def revoke_leader(self, identity: str = "chaos-rival") -> bool:

@@ -152,7 +152,7 @@ _FAMILY_META: Dict[str, tuple] = {
     "faults_injected_total": (
         "counter", "Faults injected by the chaos layer (label kind: "
                    "conflict, transient, latency, submit_fail, "
-                   "watch_break, leader_revoke, preempt)"),
+                   "watch_break, leader_revoke, preempt, hang)"),
     "cron_workload_preemptions_total": (
         "counter", "Workloads whose TPU slice was preempted (backend "
                    "preempt path; elastic resume replans survivors)"),
@@ -313,6 +313,25 @@ _FAMILY_META: Dict[str, tuple] = {
     "observatory_rollups_total": (
         "counter", "Periodic observatory JSONL rollups persisted into "
                    "--data-dir"),
+    "lease_lost_total": (
+        "counter", "Shard lease-file renewals that observed a foreign "
+                   "holder or a higher generation and self-demoted "
+                   "(gray-failure fencing; sharded deployments add a "
+                   "shard=N label)"),
+    "wal_fenced_appends_total": (
+        "counter", "WAL appends and snapshots refused because the "
+                   "persistence layer was fenced after losing its lease "
+                   "generation — each one is a stale-epoch write that "
+                   "did NOT reach disk (invariant I10; sharded "
+                   "deployments add a shard=N label)"),
+    "watchdog_hangs_detected_total": (
+        "counter", "Runs declared hung by the step-progress watchdog "
+                   "(heartbeat silent past the EMA budget) and routed "
+                   "through the preempt → elastic resume chain"),
+    "router_breaker_state": (
+        "gauge", "Per-shard circuit breaker state at the router client "
+                 "(label shard=N): 0 closed, 1 open (fail-fast), 2 "
+                 "half-open (probing)"),
 }
 
 

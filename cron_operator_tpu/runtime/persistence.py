@@ -112,6 +112,15 @@ class SimulatedCrash(ApiError):
     deployment never sees this."""
 
 
+class FencedError(ApiError):
+    """Raised by a fenced persistence layer: this process observed a
+    higher lease generation (a standby promoted while it was wedged), so
+    every further durable write is refused. Fail-closed is the whole
+    point — a zombie leader that lost the SIGSTOP/SIGCONT race must not
+    be able to land a single stale-generation record in any WAL or
+    snapshot (chaos invariant I10)."""
+
+
 @dataclass
 class RecoveredState:
     """Result of replaying a data dir: the objects and counters a fresh
@@ -130,6 +139,10 @@ class RecoveredState:
     #: the delete durable without its DELETED watch event ever firing;
     #: observers reconciling across the restart need the disk's verdict.
     wal_deleted_keys: List[tuple] = field(default_factory=list)
+    #: Highest lease generation stamped on any replayed artifact
+    #: (snapshot header or WAL record). 0 on dirs written before fencing
+    #: existed, or by an unsharded single-process deployment.
+    generation: int = 0
 
     @property
     def empty(self) -> bool:
@@ -343,6 +356,13 @@ class Persistence:
         self._dead = False
         self._die_mid_snapshot = False
         self._metrics = None
+        # Fencing token (lease generation epoch): when > 0, every WAL
+        # record and snapshot carries it, so a replay can prove no
+        # stale-generation write ever landed. fence() flips _fenced and
+        # this layer refuses all further durable writes (FencedError).
+        self.generation = 0
+        self._fenced = False
+        self.fenced_appends = 0
         # Group-commit state (wait_durable): sequence numbers partition
         # the append stream into buffered / written-to-file / fsynced.
         # records_appended counts appends, _written_seq the prefix that
@@ -394,10 +414,48 @@ class Persistence:
     def dead(self) -> bool:
         return self._dead
 
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
+
+    def set_generation(self, generation: int) -> None:
+        """Stamp the lease generation epoch this leader writes under.
+        Must be called BEFORE the first durable write of the tenure
+        (ShardServing acquires the lease first for exactly this reason),
+        so every record/snapshot of the tenure carries the epoch."""
+        with self._lock:
+            self.generation = int(generation)
+
+    def fence(self, observed_generation: Optional[int] = None) -> None:
+        """Fail-close this layer: a higher lease generation exists (the
+        holder was demoted), so no further byte may reach the WAL or a
+        snapshot. The unflushed buffer is dropped — those appends were
+        never acknowledged durable, and flushing them now could land
+        old-generation bytes inside the new leader's truncated WAL (the
+        shared-inode split-brain the fence exists to prevent)."""
+        with self._lock:
+            if self._fenced:
+                return
+            self._fenced = True
+            self._stop_flusher.set()
+            self._buf.clear()
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+            logger.warning(
+                "persistence fenced at generation %d (observed %s)",
+                self.generation, observed_generation,
+            )
+
     def open(self) -> None:
         """Open the WAL for appending (creating it if absent) and start
         the background flusher (when ``flush_interval_s`` > 0)."""
         with self._lock:
+            if self._fenced:
+                return
             if self._f is None:
                 self._f = open(self._wal_path, "ab")
             if (self.flush_interval_s > 0 and self._flusher is None
@@ -475,10 +533,21 @@ class Persistence:
 
     def _append(self, rec: Dict[str, Any]) -> None:
         t0 = time.monotonic()
+        if self.generation and "gen" not in rec:
+            # Stamp the fencing epoch. Unsharded deployments (generation
+            # 0) keep the legacy record shape byte-for-byte.
+            rec["gen"] = self.generation
         line = (
             json.dumps(rec, separators=(",", ":"), default=str) + "\n"
         ).encode("utf-8")
         with self._lock:
+            if self._fenced:
+                self.fenced_appends += 1
+                self._count("wal_fenced_appends_total")
+                raise FencedError(
+                    "persistence layer is fenced: a higher lease "
+                    "generation exists (this holder was demoted)"
+                )
             if self._dead:
                 raise SimulatedCrash("persistence layer is dead (kill-point fired)")
             if self._f is None:
@@ -545,6 +614,8 @@ class Persistence:
             self.drain_shippers()
 
     def _flush_locked(self, fsync: bool) -> None:
+        if self._fenced:
+            return  # fenced: nothing buffered, nothing may reach disk
         if not self._buf and (not fsync or self.durable_seq >= self._written_seq):
             return
         if self._f is None:
@@ -742,6 +813,13 @@ class Persistence:
         records (rv <= snapshot rv) are skipped on replay, so dying
         between rename and truncate also recovers cleanly."""
         with self._lock:
+            if self._fenced:
+                self.fenced_appends += 1
+                self._count("wal_fenced_appends_total")
+                raise FencedError(
+                    "persistence layer is fenced: refusing snapshot "
+                    "rotation (it would truncate the new leader's WAL)"
+                )
             if self._dead:
                 return  # a dead process compacts nothing
             t0 = time.monotonic()
@@ -752,6 +830,8 @@ class Persistence:
                 "rv": int(rv),
                 "objects": objects,
             }
+            if self.generation:
+                payload["generation"] = self.generation
             with open(self._snap_tmp_path, "w") as f:
                 json.dump(payload, f, separators=(",", ":"), default=str)
                 f.flush()
@@ -810,6 +890,7 @@ class Persistence:
             state.had_snapshot = True
             state.snapshot_rv = int(payload.get("rv") or 0)
             state.rv = state.snapshot_rv
+            state.generation = int(payload.get("generation") or 0)
             for obj in payload.get("objects") or []:
                 objects[object_key(obj)] = obj
         self._replay_wal(state, objects)
@@ -841,6 +922,9 @@ class Persistence:
                 # tail was torn, not that a later record is fine).
                 state.torn_records_dropped += 1
                 break
+            state.generation = max(
+                state.generation, int(rec.get("gen") or 0)
+            )
             if rv <= state.snapshot_rv:
                 state.wal_records_skipped += 1
             else:
@@ -899,6 +983,9 @@ class Persistence:
                 "fsyncs": self.fsyncs,
                 "snapshots_written": self.snapshots_written,
                 "buffered": len(self._buf),
+                "generation": self.generation,
+                "fenced": int(self._fenced),
+                "fenced_appends": self.fenced_appends,
             }
 
     def buffered_bytes(self) -> int:
@@ -912,6 +999,7 @@ __all__ = [
     "Persistence",
     "RecoveredState",
     "SimulatedCrash",
+    "FencedError",
     "DEFAULT_FSYNC_EVERY",
     "DEFAULT_SNAPSHOT_EVERY",
     "DEFAULT_SHIP_QUEUE_BYTES",

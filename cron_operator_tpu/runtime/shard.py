@@ -195,8 +195,16 @@ class FollowerReplica:
         self._tail = b""
         self.records_applied = 0
         self.records_dropped = 0  # unparseable lines (corrupt mid-stream)
+        #: Stale-generation records refused (fencing, chaos invariant
+        #: I10): a record stamped with a lease generation below the
+        #: highest this replica has seen came from a demoted zombie
+        #: leader and must never reach the store.
+        self.records_rejected = 0
         self.resyncs = 0
         self.bootstrap_rv = 0
+        #: Highest lease generation observed (bootstrap state or any
+        #: applied record). Records below it are rejected.
+        self.generation = 0
         #: Total shipped bytes received (applied + torn tail) — compared
         #: against the leader's ``bytes_appended`` for byte-domain lag.
         self.bytes_received = 0
@@ -214,6 +222,9 @@ class FollowerReplica:
         for key in state.wal_deleted_keys:
             self.deleted_keys[tuple(key)] = state.rv
         self.bootstrap_rv = state.rv
+        self.generation = max(
+            self.generation, int(getattr(state, "generation", 0) or 0)
+        )
 
     def resync(self, state: RecoveredState) -> None:
         """Re-bootstrap from a fresh recovered state after the shipping
@@ -236,6 +247,9 @@ class FollowerReplica:
                 tuple(key): state.rv for key in state.wal_deleted_keys
             }
             self.bootstrap_rv = state.rv
+            self.generation = max(
+                self.generation, int(getattr(state, "generation", 0) or 0)
+            )
             self.resyncs += 1
             self.last_apply_monotonic = time.monotonic()
         try:
@@ -266,6 +280,19 @@ class FollowerReplica:
             # Corrupt mid-stream line: recovery would drop it too.
             self.records_dropped += 1
             return
+        gen = int(rec.get("gen") or 0)
+        if gen:
+            if gen < self.generation:
+                # Fencing (I10): a demoted leader's stale-generation
+                # record arrived over a still-open ship socket. Refuse
+                # it — the new leader's stream is authoritative.
+                self.records_rejected += 1
+                logger.warning(
+                    "follower %s rejected stale-generation record "
+                    "(gen %d < %d)", self.name, gen, self.generation,
+                )
+                return
+            self.generation = gen
         if op == "put":
             obj = rec.get("obj")
             if isinstance(obj, dict):

@@ -54,6 +54,7 @@ at its own shutdown.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import os
@@ -65,7 +66,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from cron_operator_tpu.api.scheme import Scheme, default_scheme
 from cron_operator_tpu.runtime.cluster import ClusterAPIServer, ClusterConfig
-from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.runtime.kube import (
+    APIServer,
+    AlreadyExistsError,
+    ConflictError,
+    InvalidError,
+    NotFoundError,
+    ServerTimeoutError,
+)
 from cron_operator_tpu.runtime.persistence import Persistence, RecoveredState
 from cron_operator_tpu.runtime.shard import (
     FollowerReplica,
@@ -141,6 +149,7 @@ def encode_bootstrap(state: RecoveredState) -> bytes:
         "wal_deleted_keys": [list(k) for k in state.wal_deleted_keys],
         "had_snapshot": state.had_snapshot,
         "wal_records_replayed": state.wal_records_replayed,
+        "generation": int(getattr(state, "generation", 0) or 0),
     }, separators=(",", ":"), default=str).encode("utf-8")
 
 
@@ -151,6 +160,7 @@ def decode_bootstrap(payload: bytes) -> RecoveredState:
         rv=int(doc.get("rv") or 0),
         had_snapshot=bool(doc.get("had_snapshot")),
         wal_records_replayed=int(doc.get("wal_records_replayed") or 0),
+        generation=int(doc.get("generation") or 0),
     )
     state.wal_deleted_keys = [
         tuple(k) for k in doc.get("wal_deleted_keys") or []
@@ -259,6 +269,18 @@ class WALShipServer:
                 continue
             except OSError:
                 return  # listener closed
+            if self.persistence.fenced:
+                # A fenced (demoted) leader must not hand out bootstraps
+                # of its dead epoch — refuse the subscription outright.
+                logger.warning(
+                    "ship server fenced: refusing subscriber %s:%s",
+                    *addr[:2],
+                )
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             try:
@@ -353,6 +375,7 @@ class ShipFollower:
 
     def _run(self) -> None:
         attempt = 0
+        consume_failures = 0
         while not self._stop.is_set():
             try:
                 sock = socket.create_connection(
@@ -375,6 +398,7 @@ class ShipFollower:
             if self.connects > 1:
                 self.reconnects += 1
                 self._count("shard_follower_reconnects_total")
+            boots_before = self.bootstraps
             try:
                 self._consume(sock)
             except Exception as err:  # noqa: BLE001 — stream must survive
@@ -389,9 +413,18 @@ class ShipFollower:
                     pass
             if self._stop.is_set():
                 return
-            # The leader died or dropped us; back off before redialing
-            # (the standby promotion window — hammering helps nobody).
-            if self._stop.wait(RECONNECT_BASE_S):
+            # A connection that never delivered its bootstrap is a GRAY
+            # leader — it accepts connects but serves nothing. A flat
+            # base wait here redials it in a tight spin; escalate with
+            # the same bounded exponential backoff as connect failures,
+            # reset the moment a stream bootstraps again.
+            if self.bootstraps > boots_before:
+                consume_failures = 0
+            else:
+                consume_failures += 1
+            delay = min(RECONNECT_BASE_S * (2 ** consume_failures),
+                        RECONNECT_CAP_S)
+            if self._stop.wait(delay):
                 return
 
     def _consume(self, sock: socket.socket) -> None:
@@ -452,15 +485,34 @@ class LeaseFile:
     torn lease), a standby polls and treats ``renewed_at + ttl < now``
     (or a missing file) as leader death. ``generation`` increments on
     every takeover, so a stale leader that wakes up can detect it lost
-    the lease (it reads a generation it never wrote)."""
+    the lease (it reads a generation it never wrote).
 
-    def __init__(self, path: str, holder: str, ttl_s: float = 2.0):
+    Renewal is read-before-write: a holder that observes a higher
+    generation — or a foreign holder at its own generation — has been
+    taken over (it was wedged past its TTL and a standby promoted) and
+    SELF-DEMOTES instead of stealing the lease back: the heartbeat
+    stops, ``lease_lost_total`` counts it, and the ``on_lost`` callback
+    fires exactly once (ShardServing fences its persistence there).
+    Blindly overwriting here was the split-brain bug the gray-failure
+    soak exists to catch."""
+
+    def __init__(self, path: str, holder: str, ttl_s: float = 2.0,
+                 metrics: Optional[Any] = None):
         self.path = path
         self.holder = holder
         self.ttl_s = float(ttl_s)
         self.generation = 0
+        self._metrics = metrics
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+        self._lost_lock = threading.Lock()
+        self._lost = False
+        #: Generation observed at demotion time (the usurper's epoch);
+        #: handed to ``on_lost`` so the fence records what it observed.
+        self.lost_generation = 0
+        #: Called once, with the usurper's lease doc, when renewal
+        #: observes the lease was taken over.
+        self.on_lost: Optional[Callable[[Dict[str, Any]], None]] = None
 
     # -- file I/O -------------------------------------------------------
 
@@ -485,10 +537,34 @@ class LeaseFile:
         """Take (or take over) the lease; returns the new generation."""
         current = self.read()
         self.generation = int((current or {}).get("generation") or 0) + 1
+        with self._lost_lock:
+            self._lost = False
         self.renew()
         return self.generation
 
-    def renew(self) -> None:
+    @property
+    def lost(self) -> bool:
+        return self._lost
+
+    def renew(self) -> bool:
+        """Renew iff this process still holds the lease. Returns False
+        (after self-demoting) when a takeover is observed."""
+        with self._lost_lock:
+            if self._lost:
+                return False
+        current = self.read()
+        if current is not None:
+            cur_gen = int(current.get("generation") or 0)
+            foreign = current.get("holder") != self.holder
+            if cur_gen > self.generation or (foreign
+                                             and cur_gen == self.generation):
+                # A standby promoted past us (we were wedged beyond the
+                # TTL). The usurper's generation is authoritative —
+                # demote, never write this file again.
+                self._demote(current)
+                return False
+            # cur_gen < self.generation: our own acquire() bumped past a
+            # stale doc — the write below installs the new epoch.
         self._write({
             "holder": self.holder,
             "pid": os.getpid(),
@@ -496,10 +572,37 @@ class LeaseFile:
             "ttl_s": self.ttl_s,
             "generation": self.generation,
         })
+        return True
+
+    def _demote(self, current: Dict[str, Any]) -> None:
+        with self._lost_lock:
+            if self._lost:
+                return
+            self._lost = True
+            self.lost_generation = int(current.get("generation") or 0)
+        # Stop future beats without joining (the heartbeat thread itself
+        # lands here; stop_heartbeat() would self-join).
+        self._hb_stop.set()
+        if self._metrics is not None:
+            self._metrics.inc("lease_lost_total")
+        logger.warning(
+            "lease lost: holder %r observed generation %d held by %r "
+            "(own generation %d) — demoting",
+            self.holder, self.lost_generation, current.get("holder"),
+            self.generation,
+        )
+        cb = self.on_lost
+        if cb is not None:
+            try:
+                cb(current)
+            except Exception:  # noqa: BLE001 — demotion must complete
+                logger.exception("lease on_lost callback failed")
 
     def start_heartbeat(self, interval_s: Optional[float] = None) -> None:
         """Renew on a daemon thread. A SIGKILLed holder stops renewing
-        by construction — that silence IS the failover signal."""
+        by construction — that silence IS the failover signal. A wedged
+        (SIGSTOPped) holder that wakes past its TTL observes the
+        usurper's generation on its first beat and self-demotes."""
         if self._hb_thread is not None:
             return
         period = interval_s if interval_s is not None else self.ttl_s / 4.0
@@ -508,7 +611,8 @@ class LeaseFile:
         def beat() -> None:
             while not self._hb_stop.wait(period):
                 try:
-                    self.renew()
+                    if not self.renew():
+                        return  # demoted: silence is the contract now
                 except OSError:
                     logger.exception("lease renewal failed")
 
@@ -569,6 +673,128 @@ class LeaseFile:
 
 
 # ---------------------------------------------------------------------------
+# circuit breaker: fail-fast on a wedged-but-alive shard
+# ---------------------------------------------------------------------------
+
+#: Breaker states, also the value of the ``router_breaker_state`` gauge.
+BREAKER_CLOSED = 0
+BREAKER_OPEN = 1
+BREAKER_HALF_OPEN = 2
+
+_BREAKER_STATE_NAMES = {
+    BREAKER_CLOSED: "closed",
+    BREAKER_OPEN: "open",
+    BREAKER_HALF_OPEN: "half_open",
+}
+
+
+class CircuitBreaker:
+    """Per-shard health scorer: rolling error rate + latency over the
+    last ``window`` requests; trips OPEN when the failure fraction
+    crosses ``error_threshold`` (with at least ``min_samples`` seen).
+
+    The gray-failure case this exists for: a SIGSTOPped shard keeps its
+    TCP backlog accepting, so every routed request hangs until the
+    client timeout — a closed breaker would drag the whole front door's
+    p99 up to that timeout. Open = fail fast without touching the
+    socket; after ``cooldown_s`` the breaker goes HALF-OPEN and admits
+    exactly one probe — success closes it, failure re-opens.
+
+    A request slower than ``latency_threshold_s`` (when set) scores as
+    a failure even if it eventually succeeded: wedged-but-alive shards
+    often answer *eventually*, and latency is the only signal."""
+
+    def __init__(
+        self,
+        window: int = 20,
+        min_samples: int = 5,
+        error_threshold: float = 0.5,
+        cooldown_s: float = 1.0,
+        latency_threshold_s: Optional[float] = None,
+    ):
+        self.window = int(window)
+        self.min_samples = max(1, int(min_samples))
+        self.error_threshold = float(error_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.latency_threshold_s = latency_threshold_s
+        self._lock = threading.Lock()
+        #: (scored_ok, latency_s) per request, newest last.
+        self._samples: collections.deque = collections.deque(
+            maxlen=self.window
+        )
+        self.state = BREAKER_CLOSED
+        self._opened_at: Optional[float] = None
+        self._probe_inflight = False
+        self.trips = 0
+        self.fast_failures = 0  # requests refused while open
+
+    def allow(self) -> bool:
+        """Gate one request: True = send it, False = fail fast."""
+        with self._lock:
+            if self.state == BREAKER_CLOSED:
+                return True
+            now = time.monotonic()
+            if (self.state == BREAKER_OPEN
+                    and self._opened_at is not None
+                    and now - self._opened_at >= self.cooldown_s):
+                self.state = BREAKER_HALF_OPEN
+                self._probe_inflight = False
+            if self.state == BREAKER_HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            self.fast_failures += 1
+            return False
+
+    def record(self, ok: bool, latency_s: float) -> None:
+        scored_ok = ok and not (
+            self.latency_threshold_s is not None
+            and latency_s > self.latency_threshold_s
+        )
+        with self._lock:
+            if self.state == BREAKER_HALF_OPEN:
+                self._probe_inflight = False
+                if scored_ok:
+                    # Probe came back healthy: close and forget the bad
+                    # window (it described the wedged era).
+                    self.state = BREAKER_CLOSED
+                    self._samples.clear()
+                    self._samples.append((True, latency_s))
+                else:
+                    self.state = BREAKER_OPEN
+                    self._opened_at = time.monotonic()
+                return
+            self._samples.append((scored_ok, latency_s))
+            if self.state != BREAKER_CLOSED:
+                return
+            if len(self._samples) < self.min_samples:
+                return
+            failures = sum(1 for s_ok, _ in self._samples if not s_ok)
+            if failures / len(self._samples) >= self.error_threshold:
+                self.state = BREAKER_OPEN
+                self._opened_at = time.monotonic()
+                self.trips += 1
+                logger.warning(
+                    "circuit breaker tripped open (%d/%d recent "
+                    "requests failed)", failures, len(self._samples),
+                )
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lats = sorted(lat for _, lat in self._samples)
+            failures = sum(1 for s_ok, _ in self._samples if not s_ok)
+            return {
+                "state": _BREAKER_STATE_NAMES[self.state],
+                "samples": len(self._samples),
+                "error_rate": (
+                    failures / len(self._samples) if self._samples else 0.0
+                ),
+                "p50_latency_s": lats[len(lats) // 2] if lats else 0.0,
+                "trips": self.trips,
+                "fast_failures": self.fast_failures,
+            }
+
+
+# ---------------------------------------------------------------------------
 # router side: REST client with the embedded-store surface
 # ---------------------------------------------------------------------------
 
@@ -593,6 +819,9 @@ class ShardClient(ClusterAPIServer):
         clock: Optional[Clock] = None,
         shard: int = 0,
         qps: float = 0.0,
+        breaker: Optional[CircuitBreaker] = None,
+        request_timeout_s: Optional[float] = None,
+        metrics: Optional[Any] = None,
     ):
         # qps=0: the router must not rate-limit itself below its own
         # front door's APF admission — fairness is enforced there.
@@ -602,6 +831,56 @@ class ShardClient(ClusterAPIServer):
             clock=clock or RealClock(),
         )
         self.shard = int(shard)
+        #: Optional per-shard circuit breaker: scores every request
+        #: through this client and fails fast while open, so one wedged
+        #: shard cannot drag the router's p99 up to the request timeout.
+        self.breaker = breaker
+        self.request_timeout_s = request_timeout_s
+        self._metrics = metrics
+
+    def _set_breaker_gauge(self) -> None:
+        if self._metrics is not None and self.breaker is not None:
+            self._metrics.set(
+                f'router_breaker_state{{shard="{self.shard}"}}',
+                float(self.breaker.state),
+            )
+
+    def _request(self, method, path, body=None, query=None,
+                 content_type="application/json", timeout=None):
+        if timeout is None:
+            timeout = (30.0 if self.request_timeout_s is None
+                       else self.request_timeout_s)
+        br = self.breaker
+        if br is None:
+            return super()._request(method, path, body=body, query=query,
+                                    content_type=content_type,
+                                    timeout=timeout)
+        if not br.allow():
+            self._set_breaker_gauge()
+            raise ServerTimeoutError(
+                f"shard {self.shard} circuit breaker open "
+                f"(fail-fast, peer {self.config.server})"
+            )
+        t0 = time.monotonic()
+        try:
+            out = super()._request(method, path, body=body, query=query,
+                                   content_type=content_type,
+                                   timeout=timeout)
+        except (NotFoundError, AlreadyExistsError, ConflictError,
+                InvalidError):
+            # Application-level outcomes: the shard answered promptly
+            # and correctly — it is HEALTHY. Only transport-level
+            # failures (timeouts, refusals, 5xx) score against it.
+            br.record(True, time.monotonic() - t0)
+            self._set_breaker_gauge()
+            raise
+        except Exception:
+            br.record(False, time.monotonic() - t0)
+            self._set_breaker_gauge()
+            raise
+        br.record(True, time.monotonic() - t0)
+        self._set_breaker_gauge()
+        return out
 
     # -- surface parity with the embedded store -------------------------
 
@@ -724,9 +1003,13 @@ def _shard_debug_doc(shard_index: int, store: APIServer,
         "wal": pers.stats(),
         "wal_buffered_bytes": pers.buffered_bytes(),
         "ship_connections": ship.connections() if ship is not None else 0,
+        "generation": pers.generation,
+        "fenced": pers.fenced,
+        "fenced_appends": pers.fenced_appends,
     }
     if lease is not None:
         doc["lease"] = lease.read()
+        doc["lease_lost"] = lease.lost
     return doc
 
 
@@ -751,6 +1034,8 @@ class ShardServing:
         store: Optional[APIServer] = None,
         pers_kwargs: Optional[Dict[str, Any]] = None,
         holder: Optional[str] = None,
+        lease: Optional[LeaseFile] = None,
+        fencing: bool = True,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.telemetry import AuditJournal
@@ -763,6 +1048,7 @@ class ShardServing:
         self.metrics = metrics
         self.scheme = scheme or default_scheme()
         self.pers_kwargs = dict(pers_kwargs or {})
+        self.fencing = bool(fencing)
         # Stamp every record with this shard so wal_check(shard=i) finds
         # the continuity aggregate under the right key.
         self.audit = AuditJournal(shard=self.shard_index)
@@ -771,6 +1057,24 @@ class ShardServing:
         if metrics is not None:
             self.pers.instrument(metrics)
         self.pers.attach_audit(self.audit)
+
+        # Lease FIRST, before any durable write of this tenure: the
+        # acquired generation is the fencing epoch every WAL record and
+        # snapshot below will carry. A promoting standby hands in a
+        # pre-acquired (already bumped) lease so the zombie's epoch is
+        # dead before a single byte lands.
+        if lease is not None:
+            self.lease = lease
+        else:
+            self.lease = LeaseFile(
+                os.path.join(self.sdir, "lease.json"),
+                holder=holder or f"shard-{self.shard_index}-pid{os.getpid()}",
+                ttl_s=lease_ttl_s,
+                metrics=metrics,
+            )
+            self.lease.acquire()
+        self.pers.set_generation(self.lease.generation)
+        self.lease.on_lost = self._on_lease_lost
 
         if store is None:
             # Cold/crash boot: recover the shard dir into a fresh store.
@@ -795,12 +1099,6 @@ class ShardServing:
             self.recovered = None
 
         self.ship = WALShipServer(self.pers, host=api_host, port=ship_port)
-        self.lease = LeaseFile(
-            os.path.join(self.sdir, "lease.json"),
-            holder=holder or f"shard-{self.shard_index}-pid{os.getpid()}",
-            ttl_s=lease_ttl_s,
-        )
-        self.lease.acquire()
         self.lease.start_heartbeat()
 
         self.http = HTTPAPIServer(
@@ -820,6 +1118,16 @@ class ShardServing:
             },
         )
         self.http.start()
+
+    def _on_lease_lost(self, current: Dict[str, Any]) -> None:
+        """A renewal observed a higher generation: a standby promoted
+        while this process was wedged. Fence the persistence layer so
+        no further byte of the dead epoch can reach the shared WAL
+        inode or a snapshot (the I10 guarantee). With fencing disabled
+        (the counter-proof mode) the zombie keeps writing — and the
+        gray soak proves a stale-generation record lands."""
+        if self.fencing:
+            self.pers.fence(int((current or {}).get("generation") or 0))
 
     @property
     def api_port(self) -> int:
@@ -890,6 +1198,9 @@ class StandbyServer:
         clock: Optional[Clock] = None,
         metrics: Optional[Any] = None,
         pers_kwargs: Optional[Dict[str, Any]] = None,
+        promote_api_port: Optional[int] = None,
+        promote_ship_port: Optional[int] = None,
+        fencing: bool = True,
     ):
         self.shard_index = int(shard_index)
         self.data_dir = data_dir
@@ -897,6 +1208,17 @@ class StandbyServer:
         self.leader_host = leader_host
         self.ship_port = ship_port
         self.api_port = api_port
+        # A SIGKILLed leader frees its ports, so promotion rebinds them
+        # (default). A SIGSTOPped (gray) leader's sockets stay bound —
+        # the gray topology promotes onto alternate ports instead and
+        # lets the fencing epoch, not the address, disown the zombie.
+        self.promote_api_port = (
+            api_port if promote_api_port is None else promote_api_port
+        )
+        self.promote_ship_port = (
+            ship_port if promote_ship_port is None else promote_ship_port
+        )
+        self.fencing = bool(fencing)
         self.lease_ttl_s = lease_ttl_s
         self.token = token
         self.scheme = scheme or default_scheme()
@@ -913,6 +1235,7 @@ class StandbyServer:
             os.path.join(self.sdir, "lease.json"),
             holder=f"standby-{self.shard_index}-pid{os.getpid()}",
             ttl_s=lease_ttl_s,
+            metrics=metrics,
         )
         self.serving: Optional[ShardServing] = None
         self.promotion: Optional[Dict[str, Any]] = None
@@ -963,15 +1286,26 @@ class StandbyServer:
         promoted_state = self.replica.state()
         i6_ok = promoted_state == replay_state
 
-        # 3. Serve: the ShardServing promotion hand-off writes the
-        #    snapshot-first generation (WAL restarts empty) and binds the
-        #    dead leader's ports.
+        # 3. Bump-then-fence: take the lease over BEFORE binding ports
+        #    or writing a byte. acquire() increments the generation past
+        #    the dead (or wedged) leader's epoch, so if that leader is a
+        #    zombie that later wakes, its very first read-before-write
+        #    renewal observes the new epoch and self-demotes — and every
+        #    durable artifact this tenure writes already carries the
+        #    bumped generation.
+        self.lease.holder = f"promoted-{self.shard_index}-pid{os.getpid()}"
+        new_generation = self.lease.acquire()
+
+        # 4. Serve: the ShardServing promotion hand-off writes the
+        #    snapshot-first generation (WAL restarts empty) and binds
+        #    the promote ports (the dead leader's, unless a gray
+        #    topology chose alternates).
         self.serving = ShardServing(
             self.shard_index,
             self.data_dir,
             api_host=self.leader_host,
-            api_port=self.api_port,
-            ship_port=self.ship_port,
+            api_port=self.promote_api_port,
+            ship_port=self.promote_ship_port,
             lease_ttl_s=self.lease_ttl_s,
             token=self.token,
             scheme=self.scheme,
@@ -979,7 +1313,8 @@ class StandbyServer:
             metrics=self.metrics,
             store=self.replica.store,
             pers_kwargs=self.pers_kwargs,
-            holder=f"promoted-{self.shard_index}-pid{os.getpid()}",
+            lease=self.lease,
+            fencing=self.fencing,
         )
         duration = time.monotonic() - t0
         report = {
@@ -994,6 +1329,9 @@ class StandbyServer:
             "replayed_records": replay.wal_records_replayed,
             "follower": self.follower.stats(),
             "replica_resyncs": self.replica.resyncs,
+            "generation": new_generation,
+            "api_port": self.serving.api_port,
+            "ship_port": self.serving.ship_port,
         }
         path = os.path.join(self.sdir, f"promotion-{os.getpid()}.json")
         with open(path, "w") as f:
@@ -1032,6 +1370,9 @@ class RouterServer:
         clock: Optional[Clock] = None,
         metrics: Optional[Any] = None,
         start_watches: bool = True,
+        breakers: bool = True,
+        request_timeout_s: Optional[float] = None,
+        breaker_kwargs: Optional[Dict[str, Any]] = None,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.runtime.shard import ShardRouter
@@ -1044,6 +1385,10 @@ class RouterServer:
             self.clients.append(ShardClient(
                 server, token=peer_token, scheme=self.scheme,
                 clock=self.clock, shard=i,
+                breaker=(CircuitBreaker(**(breaker_kwargs or {}))
+                         if breakers else None),
+                request_timeout_s=request_timeout_s,
+                metrics=metrics,
             ))
         self.router = ShardRouter(self.clients)
         self.http = HTTPAPIServer(
@@ -1072,6 +1417,8 @@ class RouterServer:
     def debug_shards(self) -> Dict[str, Any]:
         shards = []
         for client in self.clients:
+            breaker = (client.breaker.stats()
+                       if client.breaker is not None else None)
             doc = client.debug_shards()
             if doc is None:
                 shards.append({
@@ -1079,12 +1426,14 @@ class RouterServer:
                     "alive": False,
                     "pid": None,
                     "peer": client.config.server,
+                    "breaker": breaker,
                 })
                 continue
             for entry in doc.get("shards") or [doc]:
                 entry = dict(entry)
                 entry.setdefault("shard", client.shard)
                 entry["peer"] = client.config.server
+                entry["breaker"] = breaker
                 shards.append(entry)
         return {
             "n_shards": len(self.clients),
@@ -1116,6 +1465,10 @@ __all__ = [
     "WALShipServer",
     "ShipFollower",
     "LeaseFile",
+    "CircuitBreaker",
+    "BREAKER_CLOSED",
+    "BREAKER_OPEN",
+    "BREAKER_HALF_OPEN",
     "ShardClient",
     "ShardServing",
     "StandbyServer",

@@ -1,0 +1,138 @@
+"""Step-progress hang watchdog: detect runs that are alive but stuck.
+
+The dominant real-world TPU failure is not a crash — it is a host
+wedged inside a collective that never errors, it just stops making
+progress (the gray-failure shape PAPER.md's operator inherits from
+fleet practice; Tenplex, arXiv 2312.05181, remediates the same class
+through elastic resume). A supervisor watching the *process* sees
+nothing wrong; only the step counter knows.
+
+:class:`StepWatchdog` is the per-run progress score. The training loop
+calls :meth:`beat` from its ``on_step`` callback — one
+``time.monotonic()`` plus a few float ops, so the healthy hot path
+pays well under a microsecond per step (gated in PERF.md). The
+executor's poll thread asks :meth:`stale`: heartbeat staleness is
+compared against a budget derived from an EMA of the run's OWN
+observed step times (``multiplier × ema``), floored by ``floor_s`` so
+bursty-but-fast runs do not flap. A slow-but-progressing run keeps
+beating and therefore keeps its budget wide; only silence past the
+budget trips the verdict.
+
+Remediation is NOT here: ``LocalExecutor`` declares ``HangDetected``
+and routes the wedged gang through the existing preempt → elastic
+resume chain (one logical run, one history entry — invariant I11),
+rather than growing a parallel recovery path.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+#: Minimum silence before a hang verdict, whatever the EMA says. First
+#: steps include XLA compile; restarts include checkpoint restore — a
+#: floor this wide never false-positives on either.
+DEFAULT_FLOOR_S = 30.0
+#: Budget = max(floor, multiplier × EMA of step time): a run must miss
+#: this many of its own typical steps before it is declared hung.
+DEFAULT_MULTIPLIER = 8.0
+#: EMA smoothing factor (weight of the newest step interval).
+DEFAULT_ALPHA = 0.2
+#: Pre-first-beat budget, as a multiple of the floor: the launch→step-1
+#: window is XLA compile (or checkpoint restore + recompile on resume),
+#: routinely an order of magnitude longer than any steady-state step.
+DEFAULT_STARTUP_GRACE_FLOORS = 8.0
+
+
+class StepWatchdog:
+    """Heartbeat + EMA step-time budget for one training run.
+
+    Not thread-safe by locking — by design: ``beat`` is called only by
+    the run's own step loop, and the poll thread only *reads* floats
+    (torn reads are impossible for CPython floats; a stale read just
+    delays the verdict by one poll)."""
+
+    def __init__(
+        self,
+        floor_s: float = DEFAULT_FLOOR_S,
+        multiplier: float = DEFAULT_MULTIPLIER,
+        alpha: float = DEFAULT_ALPHA,
+        startup_grace_s: Optional[float] = None,
+    ):
+        self.floor_s = float(floor_s)
+        self.multiplier = float(multiplier)
+        self.alpha = float(alpha)
+        self.startup_grace_s = (
+            float(startup_grace_s) if startup_grace_s is not None
+            else DEFAULT_STARTUP_GRACE_FLOORS * self.floor_s
+        )
+        self.ema_step_s: Optional[float] = None
+        self.last_beat_monotonic: Optional[float] = None
+        self.beats = 0
+
+    def start(self, now: Optional[float] = None) -> None:
+        """Arm the watchdog at run launch: a job that never reaches its
+        FIRST step (wedged in compile, a collective that never forms)
+        must still be detectable — the launch instant is beat zero."""
+        self.last_beat_monotonic = (
+            time.monotonic() if now is None else now
+        )
+
+    def beat(self, now: Optional[float] = None) -> None:
+        """Record one completed step. The healthy hot path: one clock
+        read + float math, no locks, no allocation."""
+        now = time.monotonic() if now is None else now
+        last = self.last_beat_monotonic
+        if last is not None and self.beats > 0:
+            # First interval (launch → step 1) is compile + restore, not
+            # a step time — it would poison the EMA for the whole run.
+            dt = now - last
+            ema = self.ema_step_s
+            self.ema_step_s = (
+                dt if ema is None else ema + self.alpha * (dt - ema)
+            )
+        self.last_beat_monotonic = now
+        self.beats += 1
+
+    def budget_s(self) -> float:
+        ema = self.ema_step_s
+        if ema is None:
+            # No EMA sample yet — compiling, restoring, or mid first
+            # real step. The floor describes steady-state step silence;
+            # until one observed step time exists, the wider startup
+            # grace applies so neither a long compile nor a
+            # slower-than-floor first step is a "hang".
+            return max(self.floor_s, self.startup_grace_s)
+        return max(self.floor_s, self.multiplier * ema)
+
+    def staleness_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the last beat (0.0 when never armed)."""
+        last = self.last_beat_monotonic
+        if last is None:
+            return 0.0
+        now = time.monotonic() if now is None else now
+        return max(0.0, now - last)
+
+    def stale(self, now: Optional[float] = None) -> bool:
+        """The hang verdict: armed, and silent past the budget."""
+        if self.last_beat_monotonic is None:
+            return False
+        return self.staleness_s(now) > self.budget_s()
+
+    def snapshot(self) -> dict:
+        """Forensics for the HangDetected condition / chaos report."""
+        return {
+            "beats": self.beats,
+            "ema_step_s": self.ema_step_s,
+            "budget_s": self.budget_s(),
+            "staleness_s": self.staleness_s(),
+        }
+
+
+__all__ = [
+    "StepWatchdog",
+    "DEFAULT_FLOOR_S",
+    "DEFAULT_MULTIPLIER",
+    "DEFAULT_ALPHA",
+    "DEFAULT_STARTUP_GRACE_FLOORS",
+]

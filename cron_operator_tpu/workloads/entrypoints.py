@@ -377,6 +377,22 @@ def _run(
             last_publish[0] = now
             ctx.progress["step_timeline"] = list(timeline)
             ctx.publish()
+        # Hang-watchdog heartbeat: one monotonic read + float math (the
+        # PERF.md ≤1µs/step budget); silence past the EMA budget is the
+        # executor's hang verdict.
+        # getattr: bare Ctx stubs (tests, external callers) predate both
+        # fields — a missing watchdog/hang channel means "not armed".
+        wd = getattr(ctx, "watchdog", None)
+        if wd is not None:
+            wd.beat()
+        hang = getattr(ctx, "hang", None)
+        if hang is not None and hang.is_set():
+            # Injected gray failure (FaultInjector.inject_hang): wedge
+            # cooperatively — alive, no error, no further progress —
+            # until the watchdog's preemption cancels the run. Models a
+            # host stuck in a collective that never returns.
+            ctx.progress["hang_injected_at"] = time.time()
+            ctx.cancel.wait()
 
     try:
         stats = trainer.run(

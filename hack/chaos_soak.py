@@ -3354,6 +3354,800 @@ def check_process_invariants(ev: dict) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# gray-failure leg: SIGSTOP zombies, fencing (I10), breakers, hangs (I11)
+# ---------------------------------------------------------------------------
+
+#: Healthy-shard p99 bound while a neighbor shard is SIGSTOPped behind an
+#: OPEN breaker — the fail-fast guarantee the breaker exists to give.
+GRAY_P99_BOUND_S = 1.0
+#: Once the breaker is open, a request to the wedged shard must fail in
+#: well under the wire timeout (no connection is even attempted).
+GRAY_FAILFAST_BOUND_S = 0.25
+#: Hang-leg watchdog floor: tight so the soak proves detection latency,
+#: wide enough that paced-but-healthy steps (0.05s) never false-trip.
+GRAY_WATCHDOG_FLOOR_S = 2.0
+#: PRF fraction of in-flight runs wedged per hang round.
+GRAY_HANG_FRAC = 0.6
+#: Detection-latency slack over the watchdog budget (poll quantum + the
+#: entrypoint reaching its next step boundary + status write).
+GRAY_DETECT_SLACK_S = 1.5
+
+
+def _scan_stale_generations(sdir: str) -> dict:
+    """Independent on-disk evidence for I10: read the shard's snapshot +
+    WAL and count records stamped with a generation OLDER than the
+    highest generation the dir has seen. With fencing there must be
+    zero; the --no-fencing counter-proof expects the zombie's poison
+    write to show up here."""
+    snap_gen = 0
+    try:
+        with open(os.path.join(sdir, "snapshot.json")) as f:
+            snap_gen = int((json.load(f) or {}).get("generation") or 0)
+    except (OSError, ValueError):
+        pass
+    gens: list = []
+    recs: list = []
+    corrupt = 0
+    try:
+        with open(os.path.join(sdir, "wal.jsonl"), "rb") as f:
+            for raw in f.read().split(b"\n"):
+                # A demoted writer without O_APPEND lands bytes at its own
+                # stale offset: the kernel zero-fills the gap, so the
+                # foreign record hides behind a NUL run on the same line.
+                line = raw.replace(b"\x00", b" ").strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    corrupt += 1  # overwritten/interleaved bytes — also
+                    continue      # evidence of a non-owner writer
+                g = int(rec.get("gen") or 0)
+                gens.append(g)
+                recs.append(rec)
+    except OSError:
+        pass
+    max_gen = max([snap_gen] + gens) if (gens or snap_gen) else 0
+    stale = [
+        {"gen": g, "op": r.get("op"), "rv": r.get("rv")}
+        for g, r in zip(gens, recs) if g < max_gen
+    ]
+    return {
+        "snapshot_generation": snap_gen,
+        "wal_generations": sorted(set(gens)),
+        "max_generation": max_gen,
+        "stale_records": len(stale),
+        "corrupt_lines": corrupt,
+        "stale_sample": stale[:3],
+    }
+
+
+def run_gray_soak(seed: int, rounds: int, fencing: bool = True,
+                  lease_ttl_s: float = 1.0,
+                  hang_jobs: int = 2, hang_rounds: int = 4) -> dict:
+    """The gray-failure leg: failures that leave the process ALIVE.
+
+    Three scenarios, one report:
+
+    A. **Fencing (I10)** — per round, spawn one shard leader + standby,
+       ``SIGSTOP`` the leader past its lease TTL so the standby promotes
+       (onto alternate ports — the zombie still holds its sockets), then
+       ``SIGCONT`` the zombie and send it a poison write. With fencing
+       the demoted zombie's persistence is fenced before the write
+       arrives, so the write fails closed and an independent disk scan
+       finds ZERO stale-generation records. ``fencing=False`` is the
+       counter-proof: the poison write lands in the shared WAL inode.
+
+    B. **Breakers** — two shard leaders behind a breaker-enabled router
+       with a tight wire timeout; SIGSTOP one shard mid-traffic. The
+       victim's breaker must trip open (fail-fast), healthy-shard p99
+       must stay bounded, and after SIGCONT the half-open probe must
+       close the breaker again.
+
+    C. **Hangs (I11)** — in-process elastic training runs get their step
+       loop cooperatively wedged (``FaultInjector.inject_hang``); the
+       executor's watchdog must detect each within its budget and route
+       the gang through the preempt → elastic-resume chain so every run
+       still finishes at its step target in ONE history entry.
+    """
+    import signal as _signal
+    import subprocess
+    import urllib.request
+
+    from cron_operator_tpu.runtime.transport import ShardClient
+
+    t_start = time.time()
+    base = 24480 + (seed % 17) * 64
+
+    def debug_doc(port: int, timeout: float = 1.0):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/shards",
+                    timeout=timeout) as r:
+                return json.loads(r.read())
+        except Exception:
+            return None
+
+    def shard0_doc(port: int, timeout: float = 1.0):
+        doc = debug_doc(port, timeout)
+        if doc is None:
+            return None
+        shards = doc.get("shards") or []
+        return shards[0] if shards else None
+
+    def wait_serving(port: int, deadline_s: float):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            doc = shard0_doc(port)
+            if doc is not None:
+                return doc
+            time.sleep(0.05)
+        return None
+
+    def terminate_all(procs) -> None:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(_signal.SIGCONT)  # never TERM a STOPPED pid
+                except OSError:
+                    pass
+                p.terminate()
+        deadline = time.monotonic() + 20.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # ---- scenario A: SIGSTOP the leader, fence the zombie (I10) ----------
+    fence_flag = [] if fencing else ["--no-fencing"]
+    fencing_rounds: list = []
+    for r in range(rounds):
+        data_dir = tempfile.mkdtemp(prefix=f"chaos-gray-fence-{r}-")
+        log_dir = os.path.join(data_dir, "logs")
+        os.makedirs(log_dir)
+        api = base + r * 4
+        ship = api + 1
+        papi = api + 2
+        pship = api + 3
+
+        def spawn(role_args: list, tag: str) -> subprocess.Popen:
+            log = open(os.path.join(log_dir, f"{tag}.log"), "ab")
+            return subprocess.Popen(
+                [sys.executable, "-m", "cron_operator_tpu.cli.main",
+                 "start", "--health-probe-bind-address", "0",
+                 "--lease-ttl", str(lease_ttl_s)] + role_args,
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+
+        procs: list = []
+        round_ev: dict = {"round": r}
+        try:
+            leader = spawn([
+                "--shard-role", "shard", "--shard-index", "0",
+                "--data-dir", data_dir,
+                "--serve-api", f"127.0.0.1:{api}",
+                "--ship-port", str(ship),
+            ] + fence_flag, "leader")
+            procs.append(leader)
+            doc = wait_serving(api, 30.0)
+            assert doc is not None, f"gray round {r}: leader never served"
+            leader_pid = doc["pid"]
+            round_ev["leader_generation"] = doc.get("generation")
+
+            client = ShardClient(f"http://127.0.0.1:{api}")
+            try:
+                for i in range(6):
+                    c = _proc_cron(0)
+                    c["metadata"]["name"] = f"gray-{r}-{i}"
+                    client.create(c)
+            finally:
+                client.close()
+
+            standby = spawn([
+                "--shard-role", "standby", "--shard-index", "0",
+                "--data-dir", data_dir,
+                "--serve-api", f"127.0.0.1:{api}",
+                "--ship-port", str(ship),
+                "--promote-api-port", str(papi),
+                "--promote-ship-port", str(pship),
+            ] + fence_flag, "standby")
+            procs.append(standby)
+            time.sleep(max(0.5, lease_ttl_s / 2))  # let it bootstrap
+
+            # The gray failure: the leader is STOPPED, not killed. Its
+            # sockets stay bound, its lease goes stale, and — crucially —
+            # it will wake up later believing it is still the leader.
+            os.kill(leader_pid, _signal.SIGSTOP)
+            t_stop = time.monotonic()
+            pdoc = wait_serving(papi, 30.0)
+            failover_s = time.monotonic() - t_stop
+            assert pdoc is not None, (
+                f"gray round {r}: standby never promoted")
+            promoted_gen = int(pdoc.get("generation") or 0)
+            round_ev.update({
+                "failover_s": round(failover_s, 3),
+                "promoted_generation": promoted_gen,
+                "promoted_pid": pdoc.get("pid"),
+            })
+
+            # New-epoch writes through the promoted leader, so the WAL
+            # scan has generation-N records to compare the zombie's
+            # stale-epoch bytes against.
+            pclient = ShardClient(f"http://127.0.0.1:{papi}")
+            try:
+                for i in range(2):
+                    c = _proc_cron(0)
+                    c["metadata"]["name"] = f"gray-{r}-post-{i}"
+                    pclient.create(c)
+            finally:
+                pclient.close()
+
+            # Wake the zombie. Its heartbeat deadline lapsed during the
+            # STOP, so the next renew observes the promoted generation
+            # and self-demotes (and, with fencing, fences persistence).
+            t_cont = time.monotonic()
+            os.kill(leader_pid, _signal.SIGCONT)
+            want_key = "fenced" if fencing else "lease_lost"
+            zdoc = None
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                zdoc = shard0_doc(api)
+                if zdoc is not None and zdoc.get(want_key):
+                    break
+                time.sleep(0.05)
+            assert zdoc is not None and zdoc.get(want_key), (
+                f"gray round {r}: zombie never observed demotion "
+                f"({want_key}); doc={zdoc}")
+            round_ev["demote_latency_s"] = round(
+                time.monotonic() - t_cont, 3)
+            round_ev["zombie_fenced"] = bool(zdoc.get("fenced"))
+
+            # The poison write: the zombie's front door is still up on
+            # the OLD port. Fenced, the append dies before the commit;
+            # unfenced, it lands in the WAL inode the promoted leader
+            # now owns — the split-brain byte I10 forbids.
+            zc = ShardClient(f"http://127.0.0.1:{api}")
+            poison_error = None
+            try:
+                c = _proc_cron(0)
+                c["metadata"]["name"] = f"poison-{r}"
+                zc.create(c)
+            except Exception as err:  # noqa: BLE001 — the refusal IS data
+                poison_error = f"{type(err).__name__}: {err}"
+            finally:
+                zc.close()
+            round_ev["poison_refused"] = poison_error is not None
+            round_ev["poison_error"] = poison_error
+            zdoc = shard0_doc(api)
+            round_ev["zombie_fenced_appends"] = int(
+                (zdoc or {}).get("fenced_appends") or 0)
+
+            # Disk scan BEFORE teardown: the promoted leader's graceful
+            # close would compact the WAL and destroy the counter-proof
+            # evidence.
+            round_ev["wal_scan"] = _scan_stale_generations(
+                os.path.join(data_dir, "shard-0"))
+            print(
+                f"  gray round {r}: SIGSTOP pid {leader_pid} -> promoted "
+                f"gen {promoted_gen} in {failover_s:.2f}s; zombie "
+                f"{'FENCED' if round_ev['zombie_fenced'] else 'unfenced'}, "
+                f"poison {'refused' if round_ev['poison_refused'] else 'LANDED'}, "
+                f"stale_records={round_ev['wal_scan']['stale_records']} "
+                f"corrupt_lines={round_ev['wal_scan']['corrupt_lines']}",
+                flush=True,
+            )
+        finally:
+            terminate_all(procs)
+            shutil.rmtree(data_dir, ignore_errors=True)
+        fencing_rounds.append(round_ev)
+
+    # ---- scenario B: SIGSTOP one shard behind a breaker router -----------
+    breaker_ev: dict = {}
+    if fencing:
+        from cron_operator_tpu.runtime.shard import shard_index
+
+        data_dir = tempfile.mkdtemp(prefix="chaos-gray-breaker-")
+        log_dir = os.path.join(data_dir, "logs")
+        os.makedirs(log_dir)
+        b = base + 40
+        api = {0: b, 1: b + 1}
+        ships = {0: b + 2, 1: b + 3}
+        rport = b + 4
+
+        def spawn_b(role_args: list, tag: str) -> subprocess.Popen:
+            log = open(os.path.join(log_dir, f"{tag}.log"), "ab")
+            return subprocess.Popen(
+                [sys.executable, "-m", "cron_operator_tpu.cli.main",
+                 "start", "--health-probe-bind-address", "0",
+                 "--lease-ttl", str(lease_ttl_s)] + role_args,
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+
+        procs = []
+        try:
+            for si in (0, 1):
+                procs.append(spawn_b([
+                    "--shard-role", "shard", "--shard-index", str(si),
+                    "--data-dir", data_dir,
+                    "--serve-api", f"127.0.0.1:{api[si]}",
+                    "--ship-port", str(ships[si]),
+                ], f"shard-{si}"))
+            for si in (0, 1):
+                assert wait_serving(api[si], 30.0) is not None, (
+                    f"breaker leg: shard {si} never served")
+            procs.append(spawn_b([
+                "--shard-role", "router",
+                "--serve-api", f"127.0.0.1:{rport}",
+                "--peers", f"127.0.0.1:{api[0]},127.0.0.1:{api[1]}",
+                "--router-timeout", "0.5",
+            ], "router"))
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if debug_doc(rport) is not None:
+                    break
+                time.sleep(0.05)
+            assert debug_doc(rport) is not None, "router never served"
+
+            client = ShardClient(f"http://127.0.0.1:{rport}")
+            names = [f"gray-b-{i}" for i in range(24)]
+            for n in names:
+                c = _proc_cron(0)
+                c["metadata"]["name"] = n
+                client.create(c)
+            by_shard = {0: [], 1: []}
+            for n in names:
+                by_shard[shard_index(NAMESPACE, n, 2)].append(n)
+            assert by_shard[0] and by_shard[1], "hash put all on one shard"
+
+            vdoc = shard0_doc(api[1])
+            victim_pid = vdoc["pid"]
+            os.kill(victim_pid, _signal.SIGSTOP)
+
+            def router_breaker(si: int):
+                doc = debug_doc(rport, timeout=3.0) or {}
+                for entry in doc.get("shards") or []:
+                    if entry.get("shard") == si:
+                        return entry.get("breaker") or {}
+                return {}
+
+            # Trip it: requests to the wedged shard time out at the wire
+            # (0.5s each) until the rolling error rate crosses the
+            # threshold and the breaker opens.
+            trip_latencies = []
+            opened = False
+            for n in (by_shard[1] * 3)[:20]:
+                t0 = time.monotonic()
+                try:
+                    client.get(CRON_API_VERSION, "Cron", NAMESPACE, n)
+                except Exception:  # noqa: BLE001 — timeouts are the point
+                    pass
+                trip_latencies.append(time.monotonic() - t0)
+                if router_breaker(1).get("state") == "open":
+                    opened = True
+                    break
+            breaker_ev["opened"] = opened
+            breaker_ev["requests_to_open"] = len(trip_latencies)
+
+            # Fail-fast + healthy-shard latency while the zombie shard
+            # is still STOPPED behind the open breaker.
+            healthy_lat = []
+            for n in (by_shard[0] * 5)[:40]:
+                t0 = time.monotonic()
+                client.get(CRON_API_VERSION, "Cron", NAMESPACE, n)
+                healthy_lat.append(time.monotonic() - t0)
+            fast_lat = []
+            for n in (by_shard[1] * 2)[:8]:
+                t0 = time.monotonic()
+                try:
+                    client.get(CRON_API_VERSION, "Cron", NAMESPACE, n)
+                except Exception:  # noqa: BLE001
+                    pass
+                fast_lat.append(time.monotonic() - t0)
+            healthy_lat.sort()
+            p99 = healthy_lat[int(0.99 * (len(healthy_lat) - 1))]
+            breaker_ev.update({
+                "healthy_p99_s": round(p99, 4),
+                "healthy_p99_bound_s": GRAY_P99_BOUND_S,
+                "failfast_max_s": round(max(fast_lat), 4) if fast_lat
+                else None,
+                "failfast_bound_s": GRAY_FAILFAST_BOUND_S,
+                "open_breaker": router_breaker(1),
+            })
+
+            # Recovery: SIGCONT, cooldown passes, the half-open probe
+            # succeeds and the breaker closes again.
+            os.kill(victim_pid, _signal.SIGCONT)
+            t_cont = time.monotonic()
+            recovered = False
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                try:
+                    client.get(CRON_API_VERSION, "Cron", NAMESPACE,
+                               by_shard[1][0])
+                    recovered = True
+                    break
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.2)
+            breaker_ev["recovered"] = recovered
+            breaker_ev["recovery_s"] = round(time.monotonic() - t_cont, 3)
+            deadline = time.monotonic() + 10.0
+            closed = False
+            while time.monotonic() < deadline:
+                if router_breaker(1).get("state") == "closed":
+                    closed = True
+                    break
+                try:
+                    client.get(CRON_API_VERSION, "Cron", NAMESPACE,
+                               by_shard[1][0])
+                except Exception:  # noqa: BLE001
+                    pass
+                time.sleep(0.1)
+            breaker_ev["closed_after_recovery"] = closed
+            client.close()
+            print(
+                f"  gray breaker: opened={opened} healthy_p99="
+                f"{breaker_ev['healthy_p99_s']}s failfast_max="
+                f"{breaker_ev['failfast_max_s']}s recovered={recovered} "
+                f"closed={closed}",
+                flush=True,
+            )
+        finally:
+            terminate_all(procs)
+            shutil.rmtree(data_dir, ignore_errors=True)
+
+    # ---- scenario C: cooperative hangs vs the step watchdog (I11) --------
+    hang_ev: dict = {}
+    if fencing:
+        hang_ev = run_hang_soak(seed, hang_jobs, hang_rounds)
+        print(
+            f"  gray hang leg: {len(hang_ev['hang_events'])} hang(s), "
+            f"detected={sum(1 for e in hang_ev['hang_events'] if e['detected'])}, "
+            f"latencies={[e['detection_latency_s'] for e in hang_ev['hang_events']]}",
+            flush=True,
+        )
+
+    return {
+        "mode": "gray",
+        "fencing": fencing,
+        "lease_ttl_s": lease_ttl_s,
+        "port_base": base,
+        "fencing_rounds": fencing_rounds,
+        "breaker": breaker_ev,
+        "hang": hang_ev,
+        "elapsed_s": round(time.time() - t_start, 1),
+    }
+
+
+def run_hang_soak(seed: int, n_jobs: int, rounds: int,
+                  train_timeout_s: float = 300.0) -> dict:
+    """Scenario C of the gray leg: real in-process elastic training runs
+    get their step loop cooperatively wedged — alive thread, silent step
+    counter — and ONLY the executor's step watchdog may rescue them
+    (``HangDetected`` → preempt → elastic resume). Scaffold mirrors
+    :func:`run_preempt_soak`; the storm verb is ``inject_hang``."""
+    from cron_operator_tpu.backends.local import LocalExecutor
+    from cron_operator_tpu.controller.cron_controller import CronReconciler
+    from cron_operator_tpu.runtime.faults import (
+        FaultInjector,
+        FaultPlan,
+        seeded_fraction,
+    )
+    from cron_operator_tpu.runtime.kube import APIServer
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    t0 = time.time()
+    ckpt_root = tempfile.mkdtemp(prefix="chaos-gray-hang-ckpt-")
+    clock = FakeClock()
+    store = APIServer(clock=clock)
+    metrics = Metrics()
+    injector = FaultInjector(store, FaultPlan.quiet(seed))
+    injector.instrument(metrics)
+    ex = LocalExecutor(
+        store, metrics=metrics, gang_slots=1,
+        watchdog_floor_s=GRAY_WATCHDOG_FLOOR_S,
+        watchdog_poll_s=0.1,
+    )
+    ex.start()
+    rec = CronReconciler(store, metrics=metrics)
+
+    steps_target = _elastic_steps(rounds)
+    crons = [f"elastic-{i}" for i in range(n_jobs)]
+    for i in range(n_jobs):
+        store.create(_elastic_cron(i, ckpt_root, steps_target, True))
+
+    def sweep():
+        for name in crons:
+            rec.reconcile(NAMESPACE, name)
+
+    def latest_attempt(root: str) -> str:
+        best, best_no = root, -1
+        for w in store.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+        ):
+            meta = w.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            wroot = ann.get("tpu.kubedl.io/resume-of", meta.get("name", ""))
+            if wroot != root:
+                continue
+            try:
+                no = int(ann.get("tpu.kubedl.io/resume-attempt", 0))
+            except (TypeError, ValueError):
+                no = 0
+            if no > best_no:
+                best, best_no = meta.get("name", ""), no
+        return best
+
+    clock.advance(timedelta(seconds=61))
+    sweep()
+    roots = {}
+    for w in store.list(
+        WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+    ):
+        meta = w.get("metadata") or {}
+        cron = (meta.get("labels") or {}).get(LABEL_CRON_NAME, "")
+        if cron:
+            roots[cron] = meta.get("name", "")
+    timeouts: list = []
+
+    def wait_progress(job: str, floor: int, deadline: float) -> dict:
+        while time.time() < deadline:
+            obj = store.try_get(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, job
+            )
+            if obj is None:
+                return {}
+            if _is_terminal(obj):
+                return _progress(store, job)
+            prog = _progress(store, job)
+            if int(prog.get("steps_done") or 0) >= floor:
+                return prog
+            time.sleep(0.1)
+        timeouts.append({"job": job, "waiting_for_step": floor})
+        return _progress(store, job)
+
+    events: list = []
+    for r in range(rounds):
+        floor = (ELASTIC_SAVE_EVERY + 2) * (r + 1)
+        deadline = time.time() + train_timeout_s
+        chosen = {
+            cron: seeded_fraction(seed, "gray-hang", r, roots[cron])
+            < GRAY_HANG_FRAC
+            for cron in crons if roots.get(cron)
+        }
+        if chosen and not any(chosen.values()):
+            chosen[next(iter(chosen))] = True
+        for cron in crons:
+            root = roots.get(cron)
+            if not root:
+                continue
+            job = latest_attempt(root)
+            pre = wait_progress(job, min(floor, steps_target - 2), deadline)
+            obj = store.try_get(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, job
+            )
+            if obj is None or _is_terminal(obj) or not chosen.get(cron):
+                continue
+            t_inject = time.time()
+            if not injector.inject_hang(ex, NAMESPACE, job):
+                continue  # finished under the injector — nothing to wedge
+            # The ONLY exit is detection: wait for the watchdog's verdict
+            # to land in status (the HangDetected extra), then for the
+            # remediation preemption to make the attempt terminal.
+            detect_deadline = time.time() + GRAY_WATCHDOG_FLOOR_S * 8 + 20
+            hang_doc: dict = {}
+            while time.time() < detect_deadline:
+                obj = store.try_get(
+                    WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, job
+                )
+                if obj is None:
+                    break
+                hang_doc = (obj.get("status") or {}).get("hang") or {}
+                if hang_doc:
+                    break
+                time.sleep(0.05)
+            while time.time() < detect_deadline:
+                obj = store.try_get(
+                    WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, job
+                )
+                if obj is None or _is_terminal(obj):
+                    break
+                time.sleep(0.05)
+            events.append({
+                "round": r,
+                "cron": cron,
+                "root": root,
+                "job": job,
+                "pre_steps": int(pre.get("steps_done") or 0),
+                "detected": bool(hang_doc),
+                "detection_latency_s": hang_doc.get(
+                    "detectionLatencySeconds"),
+                "budget_s": hang_doc.get("budgetSeconds"),
+                "staleness_s": hang_doc.get("stalenessSeconds"),
+                "wall_latency_s": round(time.time() - t_inject, 3),
+            })
+        sweep()
+        ex.restore_capacity()
+
+    deadline = time.time() + train_timeout_s
+    for cron in crons:
+        root = roots.get(cron)
+        if not root:
+            continue
+        job = latest_attempt(root)
+        while time.time() < deadline:
+            obj = store.try_get(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, NAMESPACE, job
+            )
+            if obj is None or _is_terminal(obj):
+                nxt = latest_attempt(root)
+                if nxt == job:
+                    break
+                job = nxt
+                continue
+            time.sleep(0.1)
+        else:
+            timeouts.append({"job": job, "waiting_for": "terminal"})
+    sweep()
+    ex.wait_idle(timeout=train_timeout_s)
+    sweep()
+
+    runs: dict = {}
+    for cron in crons:
+        root = roots.get(cron, "")
+        chain: list = []
+        for w in store.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, namespace=NAMESPACE
+        ):
+            meta = w.get("metadata") or {}
+            ann = meta.get("annotations") or {}
+            wroot = ann.get("tpu.kubedl.io/resume-of", meta.get("name", ""))
+            if wroot != root:
+                continue
+            try:
+                no = int(ann.get("tpu.kubedl.io/resume-attempt", 0))
+            except (TypeError, ValueError):
+                no = 0
+            prog = (w.get("status") or {}).get("trainingProgress") or {}
+            chain.append({
+                "attempt": no,
+                "name": meta.get("name", ""),
+                "terminal": _is_terminal(w),
+                "resumed_from_step": prog.get("resumed_from_step"),
+                "steps_done": int(prog.get("steps_done") or 0),
+            })
+        chain.sort(key=lambda a: a["attempt"])
+        cron_obj = store.get(CRON_API_VERSION, "Cron", NAMESPACE, cron)
+        hist = (cron_obj.get("status") or {}).get("history") or []
+        runs[cron] = {
+            "root": root,
+            "chain": chain,
+            "history": [
+                {
+                    "name": (h.get("object") or {}).get("name", ""),
+                    "status": h.get("status", ""),
+                    "resumes": int(h.get("resumes") or 0),
+                }
+                for h in hist
+            ],
+        }
+
+    ex.stop()
+    shutil.rmtree(ckpt_root, ignore_errors=True)
+    return {
+        "n_jobs": n_jobs,
+        "rounds": rounds,
+        "steps_target": steps_target,
+        "save_every": ELASTIC_SAVE_EVERY,
+        "watchdog_floor_s": GRAY_WATCHDOG_FLOOR_S,
+        "hang_events": events,
+        "runs": runs,
+        "timeouts": timeouts,
+        "metrics": {
+            "hangs_detected": metrics.get("watchdog_hangs_detected_total"),
+            "preemptions": metrics.get("cron_workload_preemptions_total"),
+            "resumes": metrics.get("cron_workload_resumes_total"),
+            "faults_hang": metrics.get('faults_injected_total{kind="hang"}'),
+        },
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+
+
+def check_gray_invariants(ev: dict) -> dict:
+    """I10/I11 plus the breaker fail-fast bound for the gray leg."""
+    rounds = ev["fencing_rounds"]
+    bad_i10 = [
+        r for r in rounds
+        if not r.get("zombie_fenced")
+        or not r.get("poison_refused")
+        or int((r.get("wal_scan") or {}).get("stale_records") or 0) > 0
+        or int((r.get("wal_scan") or {}).get("corrupt_lines") or 0) > 0
+    ]
+    i10 = {
+        "ok": bool(rounds) and not bad_i10,
+        "detail": (
+            f"{len(rounds)} SIGSTOP round(s): every woken zombie fenced "
+            "itself, every stale-epoch write failed closed, and the "
+            "disk scan found zero stale-generation records in any "
+            "WAL/snapshot" if rounds and not bad_i10
+            else {"rounds": len(rounds), "failed": bad_i10[:3]}
+        ),
+    }
+
+    hang = ev.get("hang") or {}
+    events = hang.get("hang_events") or []
+    problems: list = []
+    if hang.get("timeouts"):
+        problems.append({"kind": "did_not_finish",
+                         "jobs": hang["timeouts"][:5]})
+    for e in events:
+        if not e["detected"]:
+            problems.append({"kind": "hang_not_detected", "event": e})
+            continue
+        budget = float(e.get("budget_s") or 0.0)
+        lat = e.get("detection_latency_s")
+        if lat is None or float(lat) > budget + GRAY_DETECT_SLACK_S:
+            problems.append({"kind": "detection_over_budget", "event": e})
+    target = hang.get("steps_target")
+    for cron, run in (hang.get("runs") or {}).items():
+        chain = run.get("chain") or []
+        if not chain:
+            problems.append({"kind": "run_vanished", "cron": cron})
+            continue
+        final = chain[-1]
+        if final["terminal"] != "Succeeded" or final["steps_done"] != target:
+            problems.append({
+                "kind": "did_not_complete", "cron": cron, "final": final,
+            })
+        hist = run.get("history") or []
+        entries = [h for h in hist if h["name"] == run["root"]]
+        if len(hist) != 1 or len(entries) != 1:
+            problems.append({
+                "kind": "history_not_exactly_once",
+                "cron": cron,
+                "history": hist,
+            })
+    lats = [e["detection_latency_s"] for e in events if e["detected"]]
+    i11 = {
+        "ok": bool(events) and not problems,
+        "detail": problems[:6] if problems else (
+            f"{len(events)} injected hang(s): every one detected within "
+            f"budget (latencies {[round(float(x), 2) for x in lats]}s) "
+            f"and every run finished at step {target} in exactly one "
+            "history entry"
+        ),
+    }
+
+    br = ev.get("breaker") or {}
+    breaker_ok = bool(
+        br.get("opened")
+        and br.get("recovered")
+        and br.get("closed_after_recovery")
+        and br.get("healthy_p99_s") is not None
+        and br.get("healthy_p99_s") <= GRAY_P99_BOUND_S
+        and br.get("failfast_max_s") is not None
+        and br.get("failfast_max_s") <= GRAY_FAILFAST_BOUND_S
+    )
+    breaker = {
+        "ok": breaker_ok,
+        "detail": (
+            f"breaker opened on the SIGSTOPped shard; healthy-shard p99 "
+            f"{br.get('healthy_p99_s')}s <= {GRAY_P99_BOUND_S}s; "
+            f"fail-fast max {br.get('failfast_max_s')}s <= "
+            f"{GRAY_FAILFAST_BOUND_S}s; closed again "
+            f"{br.get('recovery_s')}s after SIGCONT" if breaker_ok
+            else br
+        ),
+    }
+    return {
+        "I10_no_stale_generation_writes": i10,
+        "I11_hangs_detected_within_budget": i11,
+        "breaker_failfast_bounded": breaker,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -3429,10 +4223,27 @@ def main(argv=None) -> int:
     ap.add_argument("--lease-ttl", type=float, default=1.0,
                     help="processes leg: leader lease TTL in seconds "
                          "(bounds failover detection)")
+    ap.add_argument("--gray", action="store_true", default=False,
+                    help="run ONLY the gray-failure leg: SIGSTOP a shard "
+                         "leader past its lease TTL, promote the standby, "
+                         "SIGCONT the zombie and prove its stale-epoch "
+                         "writes fail closed (I10, fencing tokens); wedge "
+                         "real training step loops and prove the watchdog "
+                         "detects each hang within budget and the run "
+                         "still finishes (I11); SIGSTOP one shard behind "
+                         "the breaker router and prove healthy-shard p99 "
+                         "stays bounded while the victim fails fast")
+    ap.add_argument("--no-fencing", action="store_true", default=False,
+                    help="run ONLY the gray fencing rounds with lease "
+                         "fencing disabled — the I10 counter-proof: the "
+                         "woken zombie's write lands in the WAL inode the "
+                         "promoted leader now owns (use with "
+                         "--expect-violation)")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
 
-    if args.preempt_storm or args.no_elastic or args.grow or args.no_grow:
+    if (args.preempt_storm or args.no_elastic or args.grow or args.no_grow
+            or args.gray):
         # The elastic leg shards real arrays over host devices; the flag
         # must be set before ANY jax import in this process.
         flags = os.environ.get("XLA_FLAGS", "")
@@ -3489,6 +4300,99 @@ def main(argv=None) -> int:
             if (isinstance(existing, dict)
                     and existing.get("mode") != "processes"):
                 existing["processes"] = report
+                existing["ok"] = bool(existing.get("ok")) and ok
+                out_doc = existing
+        except (OSError, ValueError):
+            pass
+        with open(args.out, "w") as f:
+            json.dump(out_doc, f, indent=2, default=str)
+            f.write("\n")
+        for name, v in invariants.items():
+            mark = "PASS" if v["ok"] else "FAIL"
+            print(f"  [{mark}] {name}: {v['detail']}")
+        print(f"wrote {args.out} (ok={ok})")
+        return 0 if ok else 1
+
+    if args.no_fencing:
+        # I10 counter-proof: the SAME SIGSTOP/promote/SIGCONT rounds with
+        # fencing disabled. The woken zombie still notices its lost lease
+        # (satellite demotion) but its persistence keeps accepting
+        # appends — the poison write must land as a stale-generation
+        # record in the WAL inode the promoted leader now owns.
+        rounds = max(2, min(args.rounds, 4))
+        print(
+            f"chaos soak (fencing counter-proof): seed={args.seed} "
+            f"rounds={rounds} lease_ttl={args.lease_ttl}s — fencing OFF",
+            flush=True,
+        )
+        ev = run_gray_soak(args.seed, rounds, fencing=False,
+                           lease_ttl_s=args.lease_ttl)
+        landed = [
+            r for r in ev["fencing_rounds"]
+            if not r.get("poison_refused")
+            and (int((r.get("wal_scan") or {}).get("stale_records") or 0) > 0
+                 or int((r.get("wal_scan") or {}).get("corrupt_lines") or 0)
+                 > 0)
+        ]
+        violated = bool(landed)
+        report = {
+            "seed": args.seed,
+            "mode": "no-fencing",
+            "rounds": rounds,
+            "gray_leg": ev,
+            "stale_write_rounds": [r["round"] for r in landed],
+            "violation_observed": violated,
+            "ok": not violated,
+        }
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+        print(
+            f"  stale-generation writes landed in "
+            f"{len(landed)}/{len(ev['fencing_rounds'])} round(s)"
+        )
+        print(f"wrote {args.out}")
+        if args.expect_violation:
+            if violated:
+                print("expected violation observed (I10) — without "
+                      "fencing the zombie leader's post-demotion write "
+                      "reached the shared WAL")
+                return 0
+            print("ERROR: expected an I10 violation but every poison "
+                  "write missed the WAL")
+            return 1
+        return 0 if not violated else 1
+
+    if args.gray:
+        rounds = max(4, min(args.rounds, 8))
+        print(
+            f"chaos soak (gray failures): seed={args.seed} "
+            f"rounds={rounds} lease_ttl={args.lease_ttl}s — "
+            "SIGSTOP zombies, fencing, breakers, hang watchdogs",
+            flush=True,
+        )
+        ev = run_gray_soak(args.seed, rounds, fencing=True,
+                           lease_ttl_s=args.lease_ttl)
+        invariants = check_gray_invariants(ev)
+        ok = all(v["ok"] for v in invariants.values())
+        report = {
+            "seed": args.seed,
+            "mode": "gray",
+            "rounds": rounds,
+            "gray_leg": ev,
+            "invariants": invariants,
+            "ok": ok,
+        }
+        # Fold into an existing CHAOS.json from another leg (the
+        # processes-leg idiom) so the report carries every proof.
+        out_doc = report
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+            if (isinstance(existing, dict)
+                    and existing.get("mode") != "gray"
+                    and "invariants" in existing):
+                existing["gray"] = report
                 existing["ok"] = bool(existing.get("ok")) and ok
                 out_doc = existing
         except (OSError, ValueError):

@@ -196,6 +196,30 @@ python hack/chaos_soak.py --seed 17 --crons 12 --rounds 2 --fleet-flap \
 python hack/chaos_soak.py --seed 17 --no-grow --expect-violation \
     --out /dev/null
 
+echo "==> gray-failure smoke (lease fencing, hang watchdog, shard breakers)"
+# Fixed-seed gray soak: SIGSTOP rounds freeze a live leader mid-lease
+# (a zombie, not a corpse) — the standby must promote with a bumped
+# generation and the woken zombie must fence itself before any
+# stale-epoch write commits; a byte-level scan of every WAL/snapshot
+# must find zero stale-generation records (I10). The router leg
+# SIGSTOPs one shard of two: its circuit breaker must trip open, the
+# healthy shard's p99 must stay bounded, tripped calls must fail fast,
+# and the breaker must close after SIGCONT. The hang leg wedges REAL
+# CPU-mesh training runs silently; the step watchdog must declare
+# HangDetected within its EMA budget and the elastic chain must finish
+# every run at target in exactly one history entry (I11). Full run:
+# make chaos-soak-gray (folds into CHAOS.json).
+python hack/chaos_soak.py --seed 7 --rounds 4 --gray --out /dev/null
+
+echo "==> fencing counter-proof (same SIGSTOPs, fencing off -> I10 must break)"
+# The same SIGSTOP/promote/SIGCONT schedule with fencing disabled: the
+# woken zombie's poison write must LAND as a stale-generation (or
+# zero-fill-corrupted) record in the WAL inode the promoted leader now
+# owns — proves the I10 PASS above detects the split-brain that
+# fencing exists to prevent, i.e. it is not vacuous.
+python hack/chaos_soak.py --seed 7 --rounds 2 --gray --no-fencing \
+    --expect-violation --out /dev/null
+
 echo "==> metric registry drift (every emitted family declared + typed)"
 # Explicit run of the registry drift guard: scans every metrics.inc/
 # observe/set call site AND interned-series assignment in the package,

@@ -26,11 +26,14 @@ import time
 import unittest
 
 from cron_operator_tpu.runtime.kube import APIServer
-from cron_operator_tpu.runtime.persistence import Persistence
+from cron_operator_tpu.runtime.persistence import FencedError, Persistence
 from cron_operator_tpu.runtime.shard import FollowerReplica, canonical_state
 from cron_operator_tpu.runtime.transport import (
+    BREAKER_CLOSED,
+    BREAKER_OPEN,
     FRAME_BOOT,
     FRAME_WAL,
+    CircuitBreaker,
     LeaseFile,
     ShardClient,
     ShipFollower,
@@ -351,6 +354,243 @@ class TestLeaseFile(_TmpDirTest):
         stop.set()
         t.join(timeout=5)
         self.assertEqual(torn, [])
+
+
+class TestCircuitBreaker(unittest.TestCase):
+    """Per-shard breaker state machine (gray failures: wedged-but-alive
+    shards answer slowly or never — fail fast, probe, recover)."""
+
+    def _tripped(self, **kw):
+        kw.setdefault("window", 10)
+        kw.setdefault("min_samples", 5)
+        kw.setdefault("error_threshold", 0.5)
+        kw.setdefault("cooldown_s", 60.0)
+        br = CircuitBreaker(**kw)
+        for _ in range(5):
+            br.record(False, 0.5)
+        return br
+
+    def test_trips_open_on_error_rate(self):
+        br = self._tripped()
+        self.assertEqual(br.state, BREAKER_OPEN)
+        self.assertEqual(br.trips, 1)
+        self.assertFalse(br.allow())
+        self.assertFalse(br.allow())
+        self.assertEqual(br.fast_failures, 2)
+
+    def test_min_samples_guard(self):
+        br = CircuitBreaker(min_samples=5)
+        for _ in range(4):  # 100% failure but too few samples
+            br.record(False, 0.5)
+        self.assertEqual(br.state, BREAKER_CLOSED)
+        self.assertTrue(br.allow())
+
+    def test_half_open_admits_exactly_one_probe_then_closes(self):
+        br = self._tripped(cooldown_s=0.05)
+        time.sleep(0.06)
+        self.assertTrue(br.allow())    # the probe
+        self.assertFalse(br.allow())   # everyone else still fails fast
+        br.record(True, 0.01)          # probe healthy
+        self.assertEqual(br.state, BREAKER_CLOSED)
+        self.assertTrue(br.allow())
+        # The wedged-era window is forgotten: one fresh failure must not
+        # immediately re-trip.
+        br.record(False, 0.5)
+        self.assertEqual(br.state, BREAKER_CLOSED)
+
+    def test_half_open_probe_failure_reopens(self):
+        br = self._tripped(cooldown_s=0.05)
+        time.sleep(0.06)
+        self.assertTrue(br.allow())
+        br.record(False, 0.5)
+        self.assertEqual(br.state, BREAKER_OPEN)
+        self.assertFalse(br.allow())
+
+    def test_slow_success_scores_as_failure(self):
+        # Wedged-but-alive shards often answer *eventually*: latency
+        # over the threshold is a failure even with a 2xx.
+        br = CircuitBreaker(min_samples=5, latency_threshold_s=0.1)
+        for _ in range(5):
+            br.record(True, 0.5)
+        self.assertEqual(br.state, BREAKER_OPEN)
+
+    def test_stats_surface(self):
+        br = self._tripped()
+        s = br.stats()
+        self.assertEqual(s["state"], "open")
+        self.assertEqual(s["samples"], 5)
+        self.assertEqual(s["error_rate"], 1.0)
+        self.assertEqual(s["trips"], 1)
+
+
+class TestFencing(_TmpDirTest):
+    """Lease-generation fencing tokens: the in-process seams of I10."""
+
+    def test_fenced_persistence_fails_closed_before_commit(self):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=1)
+        pers.start(store)
+        self.addCleanup(pers.close)
+        pers.set_generation(1)
+        store.create(_obj("pre"))
+        pers.flush()
+        pers.fence(2)
+        with self.assertRaises(FencedError):
+            store.create(_obj("poison"))
+        # Fail CLOSED: the append died before the in-memory commit, so
+        # neither memory nor disk saw the dead epoch's write.
+        self.assertEqual(len(store), 1)
+        self.assertGreaterEqual(pers.fenced_appends, 1)
+        replay = Persistence(self.dir).recover()
+        self.assertEqual(
+            [o["metadata"]["name"] for o in replay.objects], ["pre"])
+
+    def test_generation_stamped_and_recovered(self):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=1)
+        pers.start(store)
+        pers.set_generation(3)
+        store.create(_obj("g"))
+        pers.flush()
+        pers.close()
+        with open(os.path.join(self.dir, "wal.jsonl")) as f:
+            recs = [json.loads(l) for l in f if l.strip()]
+        self.assertTrue(all(r.get("gen") == 3 for r in recs))
+        replay = Persistence(self.dir).recover()
+        self.assertEqual(replay.generation, 3)
+
+    def test_follower_rejects_stale_generation_records(self):
+        replica = FollowerReplica(RealClock(), name="fence-test")
+        fresh = _obj("fresh")
+        stale = _obj("stale")
+        replica.apply_bytes(
+            json.dumps({"op": "put", "rv": 1, "gen": 2, "obj": fresh})
+            .encode() + b"\n")
+        self.assertEqual(replica.generation, 2)
+        # A demoted leader's record over a still-open socket: refused.
+        replica.apply_bytes(
+            json.dumps({"op": "put", "rv": 2, "gen": 1, "obj": stale})
+            .encode() + b"\n")
+        self.assertEqual(replica.records_rejected, 1)
+        self.assertEqual(
+            [o["metadata"]["name"] for o in replica.store.all_objects()],
+            ["fresh"])
+
+    def test_lease_renew_self_demotes_on_foreign_generation(self):
+        path = os.path.join(self.dir, "lease.json")
+        a = LeaseFile(path, holder="a", ttl_s=5.0)
+        a.acquire()
+        lost = []
+        a.on_lost = lost.append
+        b = LeaseFile(path, holder="b", ttl_s=5.0)
+        self.assertEqual(b.acquire(), 2)
+        # a's renew READS before writing, observes the higher
+        # generation, and demotes instead of clobbering b's tenure.
+        self.assertFalse(a.renew())
+        self.assertTrue(a.lost)
+        self.assertEqual(len(lost), 1)
+        self.assertEqual(a.read()["holder"], "b")
+        # Renewals after demotion stay refusals; b's lease is untouched.
+        self.assertFalse(a.renew())
+        self.assertEqual(a.read()["generation"], 2)
+
+
+class TestZombieLeaderFencing(_TmpDirTest):
+    """The SIGSTOP/SIGCONT gray-failure regression: a leader frozen past
+    its lease TTL wakes up as a zombie — alive, sockets bound, convinced
+    it still owns the shard — and must fence itself before a single
+    stale-epoch byte lands (invariant I10's process leg)."""
+
+    def test_sigstop_zombie_fenced_on_wake(self):
+        import signal
+        import subprocess
+        import sys
+        import urllib.request
+
+        api, ship, papi, pship = 26140, 26141, 26142, 26143
+        logd = os.path.join(self.dir, "logs")
+        os.makedirs(logd)
+
+        def spawn(role_args, tag):
+            log = open(os.path.join(logd, f"{tag}.log"), "ab")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "cron_operator_tpu.cli.main",
+                 "start", "--health-probe-bind-address", "0",
+                 "--lease-ttl", "0.5"] + role_args,
+                stdout=log, stderr=subprocess.STDOUT)
+            return p
+
+        def shard_doc(port):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debug/shards",
+                        timeout=1.0) as r:
+                    doc = json.loads(r.read())
+                return (doc.get("shards") or [None])[0]
+            except Exception:
+                return None
+
+        procs = []
+        try:
+            leader = spawn([
+                "--shard-role", "shard", "--shard-index", "0",
+                "--data-dir", self.dir,
+                "--serve-api", f"127.0.0.1:{api}",
+                "--ship-port", str(ship)], "leader")
+            procs.append(leader)
+            self.assertTrue(_wait(lambda: shard_doc(api), timeout=30))
+            pid = shard_doc(api)["pid"]
+
+            client = ShardClient(f"http://127.0.0.1:{api}")
+            client.create(_obj("pre"))
+            client.close()
+
+            standby = spawn([
+                "--shard-role", "standby", "--shard-index", "0",
+                "--data-dir", self.dir,
+                "--serve-api", f"127.0.0.1:{api}",
+                "--ship-port", str(ship),
+                "--promote-api-port", str(papi),
+                "--promote-ship-port", str(pship)], "standby")
+            procs.append(standby)
+            time.sleep(0.5)  # follower bootstrap
+
+            os.kill(pid, signal.SIGSTOP)
+            self.assertTrue(_wait(lambda: shard_doc(papi), timeout=30))
+            self.assertGreaterEqual(shard_doc(papi)["generation"], 2)
+
+            os.kill(pid, signal.SIGCONT)
+            self.assertTrue(_wait(
+                lambda: (shard_doc(api) or {}).get("fenced"), timeout=10))
+
+            # The zombie's front door is still up on the old port; its
+            # fenced persistence must refuse the write BEFORE commit.
+            zombie = ShardClient(f"http://127.0.0.1:{api}")
+            with self.assertRaises(Exception):
+                zombie.create(_obj("poison"))
+            zombie.close()
+            zdoc = shard_doc(api)
+            self.assertGreaterEqual(zdoc["fenced_appends"], 1)
+            self.assertTrue(zdoc["lease_lost"])
+
+            # The promoted leader never saw the poison name.
+            promoted = ShardClient(f"http://127.0.0.1:{papi}")
+            self.assertIsNone(promoted.get_frozen(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, "default", "poison"))
+            promoted.close()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    try:
+                        p.send_signal(signal.SIGCONT)
+                    except OSError:
+                        pass
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
 
 
 class TestShardClientSurface(unittest.TestCase):

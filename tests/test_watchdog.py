@@ -1,0 +1,115 @@
+"""Step-progress hang watchdog (runtime/watchdog.py).
+
+The verdict logic in isolation, on injected clocks (no sleeps): budget
+derivation from the EMA of observed step times, the floor for
+bursty-but-fast runs, the startup grace for compile/restore, and the
+no-false-positive guarantee for slow-but-progressing runs — the gray
+failure this exists for is silence, not slowness.
+"""
+
+import unittest
+
+from cron_operator_tpu.runtime.watchdog import (
+    DEFAULT_STARTUP_GRACE_FLOORS,
+    StepWatchdog,
+)
+
+
+class TestStepWatchdog(unittest.TestCase):
+    def _wd(self, **kw):
+        kw.setdefault("floor_s", 2.0)
+        kw.setdefault("multiplier", 8.0)
+        return StepWatchdog(**kw)
+
+    def test_unarmed_never_stale(self):
+        wd = self._wd()
+        self.assertFalse(wd.stale(now=1e9))
+        self.assertEqual(wd.staleness_s(now=1e9), 0.0)
+
+    def test_startup_grace_covers_compile_then_floor_applies(self):
+        wd = self._wd()
+        wd.start(now=0.0)
+        # Pre-first-beat budget is the startup grace (compile/restore),
+        # not the step floor: 10s of silent compile is healthy...
+        self.assertEqual(
+            wd.budget_s(), DEFAULT_STARTUP_GRACE_FLOORS * 2.0)
+        self.assertFalse(wd.stale(now=10.0))
+        # ...but a run that NEVER reaches step 1 is still detectable.
+        self.assertTrue(wd.stale(now=17.0))
+
+    def test_first_interval_excluded_from_ema(self):
+        wd = self._wd()
+        wd.start(now=0.0)
+        wd.beat(now=12.0)  # step 1 after a 12s compile
+        self.assertIsNone(wd.ema_step_s)  # compile is not a step time
+        wd.beat(now=12.5)
+        self.assertAlmostEqual(wd.ema_step_s, 0.5)
+
+    def test_budget_is_multiplier_times_ema_with_floor(self):
+        wd = self._wd(floor_s=1.0, multiplier=8.0)
+        wd.start(now=0.0)
+        wd.beat(now=1.0)
+        for i in range(2, 12):  # steady 2s steps
+            wd.beat(now=1.0 + (i - 1) * 2.0)
+        self.assertAlmostEqual(wd.ema_step_s, 2.0)
+        self.assertAlmostEqual(wd.budget_s(), 16.0)
+        # Fast steps: the floor keeps bursty runs from flapping.
+        fast = self._wd(floor_s=30.0, multiplier=8.0)
+        fast.start(now=0.0)
+        for i in range(1, 20):
+            fast.beat(now=i * 0.05)
+        self.assertEqual(fast.budget_s(), 30.0)
+
+    def test_slow_but_progressing_run_never_trips(self):
+        # Steps take 5s each — slower than the 2s floor, but every beat
+        # lands. The first real step rides the startup grace; once the
+        # EMA exists the budget (8 x 5s = 40s) dwarfs the silence.
+        wd = self._wd(floor_s=2.0)
+        wd.start(now=0.0)
+        t = 0.0
+        for i in range(1, 30):
+            t = i * 5.0
+            self.assertFalse(wd.stale(now=t - 0.001))
+            wd.beat(now=t)
+        self.assertFalse(wd.stale(now=t + 4.9))
+
+    def test_silence_past_budget_is_a_hang(self):
+        wd = self._wd(floor_s=2.0, multiplier=8.0)
+        wd.start(now=0.0)
+        for i in range(1, 11):  # 0.1s steps: budget = floor = 2.0
+            wd.beat(now=i * 0.1)
+        self.assertAlmostEqual(wd.budget_s(), 2.0)
+        self.assertFalse(wd.stale(now=1.0 + 1.9))
+        self.assertTrue(wd.stale(now=1.0 + 2.1))
+
+    def test_ema_adapts_to_regime_change(self):
+        # A run that legitimately slows (bigger batches, eval rounds)
+        # widens its own budget instead of tripping, as long as each
+        # slowdown stays inside the current budget.
+        wd = self._wd(floor_s=1.0, multiplier=8.0, alpha=0.5)
+        wd.start(now=0.0)
+        t = 0.0
+        for i in range(1, 11):
+            t = i * 0.2
+            wd.beat(now=t)
+        self.assertAlmostEqual(wd.budget_s(), 1.6)
+        for step_s in (1.5, 2.5, 3.0, 3.0, 3.0):  # gradual slowdown
+            t += step_s
+            self.assertFalse(wd.stale(now=t - 0.001))
+            wd.beat(now=t)
+        self.assertGreater(wd.budget_s(), 8.0)
+
+    def test_snapshot_forensics(self):
+        wd = self._wd()
+        wd.start(now=0.0)
+        wd.beat(now=1.0)
+        wd.beat(now=1.5)
+        snap = wd.snapshot()
+        self.assertEqual(snap["beats"], 2)
+        self.assertAlmostEqual(snap["ema_step_s"], 0.5)
+        self.assertIn("budget_s", snap)
+        self.assertIn("staleness_s", snap)
+
+
+if __name__ == "__main__":
+    unittest.main()
