@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray obs-report
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray obs-report obs-report-dist
 
 all: gate
 
@@ -183,3 +183,16 @@ chaos-soak-gray:
 obs-report:
 	python hack/obs_report.py $(if $(CHECK),--check) \
 	    $(if $(SEED),--seed $(SEED))
+
+# Cross-process distributed-tracing leg (hack/obs_report.py
+# --distributed -> BENCH_OBS_DIST.json): spawns the REAL supervisor
+# topology (router + shard leader + standby, separate OS processes),
+# POSTs a Cron through the router's front door under a driver-minted
+# traceparent, and requires ONE trace with spans from >= 3 distinct
+# processes (router, shard, runner subprocess) whose critical-path
+# decomposition (route -> admit -> commit -> fsync -> submit ->
+# first_step) reconciles with measured wall latency, I9 on the shard,
+# a zero-write debug read path, and the per-frame trace-context
+# propagation gate.
+obs-report-dist:
+	python hack/obs_report.py --distributed --out BENCH_OBS_DIST.json

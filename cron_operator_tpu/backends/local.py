@@ -311,6 +311,15 @@ class LocalExecutor:
                 staleness_s=snap.get("staleness_s"),
                 budget_s=snap.get("budget_s"),
             )
+            # Also a typed cluster event: hangs belong on the fleet-wide
+            # /debug/events timeline next to lease/fence/promotion.
+            self.audit.record(
+                "cluster", "hang_detected",
+                key=f"{av}/{kind}/{ns}/{name}",
+                trace_id=ann.get(ANNOTATION_TRACE_ID),
+                reason="StepProgressStalled",
+                staleness_s=snap.get("staleness_s"),
+            )
         try:
             self._append_condition(
                 key, "HangDetected", "StepProgressStalled",
@@ -639,6 +648,13 @@ class LocalExecutor:
                 ctx.progress.update(msg.get("progress") or {})
                 if msg.get("type") == "error":
                     error = msg
+                elif msg.get("type") == "spans":
+                    # The runner ships its own spans home over the
+                    # progress stream — adopt them (counted drops on
+                    # malformed frames) so the subprocess appears on
+                    # this process's /debug/traces as a distinct pid.
+                    if self.tracer is not None:
+                        self.tracer.ingest(msg.get("spans") or [])
                 elif ctx.publish is not None:
                     ctx.publish()
         finally:

@@ -571,7 +571,7 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             args.shard_index, args.data_dir, api_host=host, api_port=port,
             ship_port=args.ship_port, lease_ttl_s=args.lease_ttl,
             token=args.serve_api_token, scheme=scheme, metrics=metrics,
-            fencing=not args.no_fencing,
+            fencing=not args.no_fencing, tracer=tracer,
         )
         serving.audit.instrument(metrics)
         recovering = (serving.recovered is not None
@@ -614,7 +614,7 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             scheme=scheme, metrics=metrics,
             promote_api_port=args.promote_api_port,
             promote_ship_port=args.promote_ship_port,
-            fencing=not args.no_fencing,
+            fencing=not args.no_fencing, tracer=tracer,
         )
         log.info(
             "shard %d standby: following :%d, watching lease %s (pid %d)",
@@ -657,6 +657,7 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             metrics=metrics,
             breakers=not args.no_breakers,
             request_timeout_s=args.router_timeout,
+            tracer=tracer,
         )
         log.info("router serving %d shard(s) on %s:%d (pid %d)",
                  len(router.clients), host, router.port, _os.getpid())

@@ -498,14 +498,19 @@ class CronReconciler:
 
         workload = self._new_workload_from_template(cron, workload_tpl, next_run)
 
-        # The tick is firing: mint its trace id and stamp it on the workload
-        # so every downstream layer (executor thread, runner subprocess via
+        # The tick is firing: stamp its trace id on the workload so every
+        # downstream layer (executor thread, runner subprocess via
         # TPU_TRACE_ID, training loop) tags telemetry with it. Stamped before
         # inject_tpu_topology so the rendered runner env carries it too.
-        trace_id = new_trace_id()
-        workload.setdefault("metadata", {}).setdefault("annotations", {})[
-            ANNOTATION_TRACE_ID
-        ] = trace_id
+        # A trace id already on the template is ADOPTED, not replaced: a
+        # traced write at the HTTP front door pre-stamps the template
+        # annotation, and adopting it here is what joins the tick to the
+        # router-minted distributed trace. Otherwise mint fresh.
+        annotations = workload.setdefault(
+            "metadata", {}
+        ).setdefault("annotations", {})
+        trace_id = annotations.get(ANNOTATION_TRACE_ID) or new_trace_id()
+        annotations[ANNOTATION_TRACE_ID] = trace_id
         log = request_logger("cron", ns, name, trace=trace_id)
 
         # TPU admission (SURVEY.md §7 step 4b). The reference hands its

@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import copy
 import heapq
+import inspect
 import json
 import logging
 import selectors
@@ -97,6 +98,36 @@ from cron_operator_tpu.runtime.kube import (
     NotFoundError,
     WatchEvent,
 )
+from cron_operator_tpu.telemetry.trace import (
+    TRACEPARENT_HEADER,
+    TraceContext,
+    current_trace,
+    new_trace_id,
+    parse_traceparent,
+    reset_current_trace,
+    set_current_trace,
+)
+
+# Verbs whose handler commits store writes — the ones that mint a trace
+# at the front door when the caller didn't send one.
+_WRITE_VERBS = frozenset({"POST", "PUT", "PATCH", "DELETE"})
+
+
+def _call_debug_route(route, suffix, params):
+    """Invoke a debug route with as many of (suffix, params) as its
+    signature accepts — keeps the zero-arg lambdas of existing routes
+    working while letting new ones take query params and a path
+    remainder."""
+    try:
+        n = len(inspect.signature(route).parameters)
+    except (TypeError, ValueError):  # builtins / C callables
+        n = 0
+    args = []
+    if suffix is not None:
+        args.append(suffix)
+    if n > len(args):
+        args.append(params)
+    return route(*args[:n])
 
 logger = logging.getLogger("runtime.apiserver_http")
 
@@ -727,6 +758,8 @@ class HTTPAPIServer:
         durable_writes: bool = True,
         selector_watch: Optional[bool] = None,
         debug_routes: Optional[Dict[str, Any]] = None,
+        tracer=None,
+        trace_role: str = "shard",
     ):
         """``tls_ctx`` (an ``ssl.SSLContext``, e.g. from
         ``utils.tlsutil.server_context``) serves the API over HTTPS — the
@@ -752,10 +785,25 @@ class HTTPAPIServer:
         event-driven fan-out loop; default: on for plain HTTP, off for
         TLS (those streams keep a handler thread).
 
-        ``debug_routes`` maps exact GET paths (e.g. ``/debug/shards``)
-        to zero-arg callables returning a JSON-serializable object (or a
-        pre-rendered JSON string). Shard/router processes use it to
-        expose liveness, pid and lag without a second server socket."""
+        ``debug_routes`` maps GET paths to callables returning a
+        JSON-serializable object (or a pre-rendered JSON string). Shard
+        /router processes use it to expose liveness, pid and lag
+        without a second server socket. Exact keys (``/debug/shards``)
+        match the whole path; keys ending in ``/`` are prefix routes
+        (``/debug/trace/`` matches ``/debug/trace/<id>``) whose callable
+        receives the path remainder. Arity decides what a route gets:
+        0 args → ``fn()``; the last accepted arg beyond the prefix
+        remainder is the parsed query dict (``parse_qs`` shape), so
+        ``fn(params)`` and ``fn(suffix, params)`` both work.
+
+        ``tracer`` + ``trace_role`` turn on front-door trace-context
+        handling: a W3C-style ``traceparent`` header is parsed (and,
+        for write verbs, minted when absent), made ambient for the
+        handler via ``telemetry.trace.set_current_trace``, and recorded
+        as spans — one ``route`` span on a ``"router"`` process, or
+        ``admit``/``commit``/``fsync`` spans on a ``"shard"`` process.
+        Untraced reads cost nothing: no header + a read verb skips the
+        whole path."""
         # Identity check, not truthiness: APIServer defines __len__, and
         # an empty-but-live store must not be swapped for a fresh one.
         self.api = api if api is not None else APIServer()
@@ -782,6 +830,8 @@ class HTTPAPIServer:
             if metrics is not None:
                 admission.instrument(metrics)
         self.durable_writes = durable_writes
+        self.tracer = tracer
+        self.trace_role = trace_role
         self.selector_watch = (
             (not self.tls) if selector_watch is None else selector_watch
         )
@@ -865,7 +915,24 @@ class HTTPAPIServer:
         if not self.durable_writes:
             return
         fn = getattr(self.api, "wait_durable", None)
-        if fn is not None and not fn():
+        if fn is None:
+            return
+        # The barrier wait is the group-commit fsync hop of a traced
+        # write; the ambient context parents it under the commit span.
+        ctx = current_trace()
+        t0 = (
+            time.time()
+            if self.tracer is not None and ctx is not None
+            and self.trace_role == "shard"
+            else None
+        )
+        ok = fn()
+        if t0 is not None:
+            self.tracer.record(
+                "fsync", ctx.trace_id, t0, time.time(),
+                parent_id=ctx.span_id,
+            )
+        if not ok:
             raise ApiError("write committed but not durable within timeout")
 
     # ---- path mapping -----------------------------------------------------
@@ -962,6 +1029,9 @@ class HTTPAPIServer:
 
             def _dispatch(self, method: str) -> None:
                 t0 = time.monotonic()
+                # Wall-clock twin of t0: span timestamps live in the
+                # time.time domain so cross-process spans line up.
+                self._t_entry = time.time()
                 self._code = 0
                 try:
                     self._dispatch_admitted(method)
@@ -981,13 +1051,26 @@ class HTTPAPIServer:
                     return
                 parsed = urlparse(self.path)
                 route = outer.debug_routes.get(parsed.path)
+                suffix: Optional[str] = None
+                if route is None:
+                    # Prefix routes: a key ending in "/" owns every path
+                    # under it; the remainder is the route's first arg
+                    # (/debug/trace/<id> → debug_trace("<id>", params)).
+                    for key, fn in outer.debug_routes.items():
+                        if (key.endswith("/")
+                                and parsed.path.startswith(key)
+                                and len(parsed.path) > len(key)):
+                            route, suffix = fn, parsed.path[len(key):]
+                            break
                 if route is not None:
                     if method != "GET":
                         self._send_status(405, "MethodNotAllowed",
                                           "debug routes are GET-only")
                         return
                     try:
-                        payload = route()
+                        payload = _call_debug_route(
+                            route, suffix, parse_qs(parsed.query)
+                        )
                     except Exception as err:  # pragma: no cover
                         logger.exception("debug route %s failed", parsed.path)
                         self._send_status(500, "InternalError", str(err))
@@ -1025,6 +1108,52 @@ class HTTPAPIServer:
                              str(max(1, int(exc.retry_after)))},
                         )
                         return
+                # Trace context: a malformed/oversized traceparent
+                # parses to None — the request is served untraced, the
+                # connection lives. A write verb with no incoming
+                # context mints a fresh trace (this front door is where
+                # distributed traces are born).
+                tracer = outer.tracer
+                tctx = parse_traceparent(
+                    self.headers.get(TRACEPARENT_HEADER)
+                )
+                tok = None
+                live_span = None
+                if tracer is not None and (
+                    tctx is not None or method in _WRITE_VERBS
+                ):
+                    now = time.time()
+                    tid = tctx.trace_id if tctx else new_trace_id()
+                    parent = tctx.span_id if tctx else None
+                    if outer.trace_role == "router":
+                        # One span covering the whole proxied request;
+                        # its id rides the outbound traceparent so the
+                        # shard's admit span parents under it.
+                        live_span = tracer.start_span(
+                            "route", tid, self._t_entry,
+                            parent_id=parent,
+                            attrs={"verb": method, "path": parsed.path},
+                        )
+                        tok = set_current_trace(
+                            TraceContext(tid, live_span.span_id)
+                        )
+                    else:
+                        # Entry → here = auth + path + APF queueing.
+                        admit = tracer.record(
+                            "admit", tid, self._t_entry, now,
+                            parent_id=parent, attrs={"verb": method},
+                        )
+                        if method in _WRITE_VERBS:
+                            live_span = tracer.start_span(
+                                "commit", tid, now,
+                                parent_id=admit.span_id,
+                                attrs={"verb": method},
+                            )
+                        anchor = (
+                            live_span.span_id if live_span is not None
+                            else admit.span_id
+                        )
+                        tok = set_current_trace(TraceContext(tid, anchor))
                 try:
                     fn = getattr(self, f"_do_{method}")
                     fn(parsed, av, kind, ns, name, sub, q)
@@ -1045,6 +1174,11 @@ class HTTPAPIServer:
                         self._send_status(500, "InternalError", str(err))
                     except Exception:
                         pass
+                finally:
+                    if live_span is not None:
+                        tracer.finish(live_span, time.time())
+                    if tok is not None:
+                        reset_current_trace(tok)
 
             def do_GET(self):  # noqa: N802
                 self._dispatch("GET")

@@ -37,6 +37,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 from cron_operator_tpu.api.scheme import GVK, Scheme, default_scheme, parse_api_version
 from cron_operator_tpu.api.v1alpha1 import rfc3339
+from cron_operator_tpu.telemetry.trace import (
+    TRACEPARENT_HEADER,
+    current_trace,
+    format_traceparent,
+)
 from cron_operator_tpu.runtime.kube import (
     AlreadyExistsError,
     ApiError,
@@ -211,6 +216,15 @@ class ClusterAPIServer:
             req.add_header("Content-Type", content_type)
         if self.config.token:
             req.add_header("Authorization", f"Bearer {self.config.token}")
+        # Propagate the ambient trace context (set by the front door of
+        # the process making this call) so the callee's spans join the
+        # same trace — the router→shard hop of a distributed tick.
+        tctx = current_trace()
+        if tctx is not None:
+            req.add_header(
+                TRACEPARENT_HEADER,
+                format_traceparent(tctx.trace_id, tctx.span_id),
+            )
         try:
             with urllib.request.urlopen(req, context=self._ctx, timeout=timeout) as r:
                 payload = r.read()

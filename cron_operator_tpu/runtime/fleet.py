@@ -516,6 +516,11 @@ class FleetScheduler:
     def _record(self, event: str, **kw: Any) -> None:
         if self.audit is not None:
             self.audit.record("decision", event, **kw)
+            if event in ("fleet_grow", "fleet_shrink"):
+                # Elasticity reshapes are fleet-topology changes too:
+                # mirror them as typed cluster events so the router's
+                # /debug/events timeline shows them next to failovers.
+                self.audit.record("cluster", event, **kw)
 
     def _update_pending_gauge_locked(self) -> None:
         if self.metrics is None:

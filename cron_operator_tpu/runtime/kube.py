@@ -49,7 +49,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from cron_operator_tpu.api.v1alpha1 import rfc3339
 from cron_operator_tpu.runtime.frozen import freeze, freeze_delta, thaw
-from cron_operator_tpu.telemetry.trace import ANNOTATION_TRACE_ID
+from cron_operator_tpu.telemetry.trace import (
+    ANNOTATION_TRACE_ID,
+    current_trace_id,
+)
 from cron_operator_tpu.utils.clock import Clock, RealClock
 
 Unstructured = Dict[str, Any]
@@ -397,7 +400,7 @@ class APIServer:
                  f"{meta.get('namespace', '')}/{meta.get('name', '')}"),
             trace_id=(meta.get("annotations") or {}).get(
                 ANNOTATION_TRACE_ID
-            ),
+            ) or current_trace_id(),
             wal_pos=wal.records_appended if wal is not None else None,
             rv=int(meta.get("resourceVersion") or 0),
         )

@@ -332,6 +332,11 @@ _FAMILY_META: Dict[str, tuple] = {
         "gauge", "Per-shard circuit breaker state at the router client "
                  "(label shard=N): 0 closed, 1 open (fail-fast), 2 "
                  "half-open (probing)"),
+    "cluster_events_total": (
+        "counter", "Typed cluster lifecycle events written through the "
+                   "audit journal (label event=lease_lost|fenced|"
+                   "promotion_*|breaker_*|hang_detected|fleet_grow|... "
+                   "), the discrete feed behind /debug/events"),
 }
 
 

@@ -68,6 +68,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from cron_operator_tpu.runtime.kube import ApiError, object_key
+from cron_operator_tpu.telemetry.trace import current_trace_id
 
 logger = logging.getLogger("runtime.persistence")
 
@@ -537,6 +538,13 @@ class Persistence:
             # Stamp the fencing epoch. Unsharded deployments (generation
             # 0) keep the legacy record shape byte-for-byte.
             rec["gen"] = self.generation
+        tc = current_trace_id()
+        if tc is not None and "tc" not in rec:
+            # Stamp the ambient trace id, exactly like "gen": replay and
+            # followers ignore unknown keys, so legacy frames (and
+            # untraced writes — the steady state — which never pay this
+            # key) stay byte-compatible both directions.
+            rec["tc"] = tc
         line = (
             json.dumps(rec, separators=(",", ":"), default=str) + "\n"
         ).encode("utf-8")

@@ -187,10 +187,20 @@ class FollowerReplica:
     the torn record. That is what keeps the I6 equivalence exact.
     """
 
-    def __init__(self, clock: Optional[Clock] = None, name: str = "follower"):
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        name: str = "follower",
+        tracer=None,
+    ):
         self.store = APIServer(clock)
         self.name = name
         self._clock = clock
+        #: Optional Tracer: a shipped frame stamped with a ``"tc"``
+        #: trace id (see ``Persistence._append``) gets a ``wal_apply``
+        #: span here, so replication lag of a traced write is visible
+        #: on the standby's own ``/debug/traces``.
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._tail = b""
         self.records_applied = 0
@@ -293,6 +303,9 @@ class FollowerReplica:
                 )
                 return
             self.generation = gen
+        tc = rec.get("tc")
+        t_apply = time.time() if tc and self.tracer is not None else None
+        applied = self.records_applied
         if op == "put":
             obj = rec.get("obj")
             if isinstance(obj, dict):
@@ -306,6 +319,11 @@ class FollowerReplica:
                 self.store.replicate_delete(key, rv)
                 self.deleted_keys[key] = rv
                 self.records_applied += 1
+        if t_apply is not None and self.records_applied > applied:
+            self.tracer.record(
+                "wal_apply", str(tc), t_apply, time.time(),
+                attrs={"replica": self.name, "op": op},
+            )
 
     @property
     def lag_bytes(self) -> int:

@@ -75,6 +75,7 @@ from cron_operator_tpu.runtime.kube import (
     ServerTimeoutError,
 )
 from cron_operator_tpu.runtime.persistence import Persistence, RecoveredState
+from cron_operator_tpu.telemetry.trace import critical_path, stitch_trace
 from cron_operator_tpu.runtime.shard import (
     FollowerReplica,
     canonical_state,
@@ -727,56 +728,83 @@ class CircuitBreaker:
         self._probe_inflight = False
         self.trips = 0
         self.fast_failures = 0  # requests refused while open
+        #: Optional ``fn(old_state_name, new_state_name)`` fired on
+        #: every state change, OUTSIDE the breaker lock (the router
+        #: turns these into cluster audit events). Must not raise.
+        self.on_transition = None
+
+    def _notify(self, old: int, new: int) -> None:
+        cb = self.on_transition
+        if cb is None or old == new:
+            return
+        try:
+            cb(_BREAKER_STATE_NAMES[old], _BREAKER_STATE_NAMES[new])
+        except Exception:  # noqa: BLE001 — observers must not break gating
+            logger.exception("breaker on_transition callback failed")
 
     def allow(self) -> bool:
         """Gate one request: True = send it, False = fail fast."""
-        with self._lock:
-            if self.state == BREAKER_CLOSED:
-                return True
-            now = time.monotonic()
-            if (self.state == BREAKER_OPEN
-                    and self._opened_at is not None
-                    and now - self._opened_at >= self.cooldown_s):
-                self.state = BREAKER_HALF_OPEN
-                self._probe_inflight = False
-            if self.state == BREAKER_HALF_OPEN and not self._probe_inflight:
-                self._probe_inflight = True
-                return True
-            self.fast_failures += 1
-            return False
+        old = new = None
+        try:
+            with self._lock:
+                if self.state == BREAKER_CLOSED:
+                    return True
+                now = time.monotonic()
+                if (self.state == BREAKER_OPEN
+                        and self._opened_at is not None
+                        and now - self._opened_at >= self.cooldown_s):
+                    old, new = self.state, BREAKER_HALF_OPEN
+                    self.state = BREAKER_HALF_OPEN
+                    self._probe_inflight = False
+                if self.state == BREAKER_HALF_OPEN and not self._probe_inflight:
+                    self._probe_inflight = True
+                    return True
+                self.fast_failures += 1
+                return False
+        finally:
+            if old is not None:
+                self._notify(old, new)
 
     def record(self, ok: bool, latency_s: float) -> None:
         scored_ok = ok and not (
             self.latency_threshold_s is not None
             and latency_s > self.latency_threshold_s
         )
-        with self._lock:
-            if self.state == BREAKER_HALF_OPEN:
-                self._probe_inflight = False
-                if scored_ok:
-                    # Probe came back healthy: close and forget the bad
-                    # window (it described the wedged era).
-                    self.state = BREAKER_CLOSED
-                    self._samples.clear()
-                    self._samples.append((True, latency_s))
-                else:
+        old = new = None
+        try:
+            with self._lock:
+                if self.state == BREAKER_HALF_OPEN:
+                    self._probe_inflight = False
+                    if scored_ok:
+                        # Probe came back healthy: close and forget the bad
+                        # window (it described the wedged era).
+                        old, new = self.state, BREAKER_CLOSED
+                        self.state = BREAKER_CLOSED
+                        self._samples.clear()
+                        self._samples.append((True, latency_s))
+                    else:
+                        old, new = self.state, BREAKER_OPEN
+                        self.state = BREAKER_OPEN
+                        self._opened_at = time.monotonic()
+                    return
+                self._samples.append((scored_ok, latency_s))
+                if self.state != BREAKER_CLOSED:
+                    return
+                if len(self._samples) < self.min_samples:
+                    return
+                failures = sum(1 for s_ok, _ in self._samples if not s_ok)
+                if failures / len(self._samples) >= self.error_threshold:
+                    old, new = self.state, BREAKER_OPEN
                     self.state = BREAKER_OPEN
                     self._opened_at = time.monotonic()
-                return
-            self._samples.append((scored_ok, latency_s))
-            if self.state != BREAKER_CLOSED:
-                return
-            if len(self._samples) < self.min_samples:
-                return
-            failures = sum(1 for s_ok, _ in self._samples if not s_ok)
-            if failures / len(self._samples) >= self.error_threshold:
-                self.state = BREAKER_OPEN
-                self._opened_at = time.monotonic()
-                self.trips += 1
-                logger.warning(
-                    "circuit breaker tripped open (%d/%d recent "
-                    "requests failed)", failures, len(self._samples),
-                )
+                    self.trips += 1
+                    logger.warning(
+                        "circuit breaker tripped open (%d/%d recent "
+                        "requests failed)", failures, len(self._samples),
+                    )
+        finally:
+            if old is not None:
+                self._notify(old, new)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
@@ -977,6 +1005,37 @@ class ShardClient(ClusterAPIServer):
         except Exception:  # noqa: BLE001 — liveness probe, absence is data
             return None
 
+    def debug_traces(
+        self,
+        trace: Optional[str] = None,
+        limit: Optional[int] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Fetch the shard's /debug/traces (optionally one trace) — the
+        router's span fan-in for /debug/trace/<id>."""
+        query: Dict[str, str] = {}
+        if trace:
+            query["trace"] = trace
+        if limit is not None:
+            query["limit"] = str(limit)
+        try:
+            return self._request("GET", "/debug/traces",
+                                 query=query or None)
+        except Exception:  # noqa: BLE001 — observability fan-in
+            return None
+
+    def debug_events(
+        self, limit: Optional[int] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Fetch the shard's cluster-event records (/debug/events)."""
+        query: Dict[str, str] = {}
+        if limit is not None:
+            query["limit"] = str(limit)
+        try:
+            return self._request("GET", "/debug/events",
+                                 query=query or None)
+        except Exception:  # noqa: BLE001 — observability fan-in
+            return None
+
     def __len__(self) -> int:
         return 0
 
@@ -989,10 +1048,39 @@ class ShardClient(ClusterAPIServer):
 # ---------------------------------------------------------------------------
 
 
+def _latest_promotion(sdir: str) -> Optional[Dict[str, Any]]:
+    """Summary of the newest ``promotion-<pid>.json`` in a shard dir —
+    the last failover's forensics, surfaced inline on /debug/shards
+    instead of living only on disk."""
+    try:
+        paths = [
+            os.path.join(sdir, n) for n in os.listdir(sdir)
+            if n.startswith("promotion-") and n.endswith(".json")
+        ]
+    except OSError:
+        return None
+    if not paths:
+        return None
+    try:
+        newest = max(paths, key=os.path.getmtime)
+        with open(newest) as f:
+            rep = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return {
+        "pid": rep.get("pid"),
+        "duration_s": rep.get("duration_s"),
+        "i6_ok": rep.get("i6_ok"),
+        "generation": rep.get("generation"),
+        "detected_at": rep.get("detected_at"),
+    }
+
+
 def _shard_debug_doc(shard_index: int, store: APIServer,
                      pers: Persistence, role: str,
                      lease: Optional[LeaseFile] = None,
-                     ship: Optional[WALShipServer] = None) -> Dict[str, Any]:
+                     ship: Optional[WALShipServer] = None,
+                     sdir: Optional[str] = None) -> Dict[str, Any]:
     doc: Dict[str, Any] = {
         "shard": shard_index,
         "role": role,
@@ -1010,6 +1098,13 @@ def _shard_debug_doc(shard_index: int, store: APIServer,
     if lease is not None:
         doc["lease"] = lease.read()
         doc["lease_lost"] = lease.lost
+    if sdir is not None:
+        # Standby liveness: a connected ship follower IS the standby
+        # (it is the only dialer of the ship port in the topology).
+        doc["standby"] = {
+            "attached": (ship.connections() if ship is not None else 0) > 0,
+            "last_promotion": _latest_promotion(sdir),
+        }
     return doc
 
 
@@ -1036,6 +1131,7 @@ class ShardServing:
         holder: Optional[str] = None,
         lease: Optional[LeaseFile] = None,
         fencing: bool = True,
+        tracer: Optional[Any] = None,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.telemetry import AuditJournal
@@ -1049,9 +1145,14 @@ class ShardServing:
         self.scheme = scheme or default_scheme()
         self.pers_kwargs = dict(pers_kwargs or {})
         self.fencing = bool(fencing)
+        self.tracer = tracer
+        if tracer is not None:
+            # This process IS the shard leader from here on (including a
+            # standby that just promoted) — stamp its spans accordingly.
+            tracer.set_proc(role="shard", shard=self.shard_index)
         # Stamp every record with this shard so wal_check(shard=i) finds
         # the continuity aggregate under the right key.
-        self.audit = AuditJournal(shard=self.shard_index)
+        self.audit = AuditJournal(shard=self.shard_index, metrics=metrics)
 
         self.pers = Persistence(self.sdir, **self.pers_kwargs)
         if metrics is not None:
@@ -1100,7 +1201,24 @@ class ShardServing:
 
         self.ship = WALShipServer(self.pers, host=api_host, port=ship_port)
         self.lease.start_heartbeat()
+        self.audit.record(
+            "cluster", "lease_acquired", shard=self.shard_index,
+            reason="serving start",
+            generation=self.lease.generation,
+            holder=self.lease.holder,
+        )
 
+        routes: Dict[str, Any] = {
+            "/debug/shards": lambda: {
+                "n_shards": 1,
+                "pid": os.getpid(),
+                "shards": [self.debug_doc()],
+            },
+            "/debug/audit": lambda: self.audit_check(),
+            "/debug/events": self.debug_events,
+        }
+        if tracer is not None:
+            routes["/debug/traces"] = tracer.render_json
         self.http = HTTPAPIServer(
             api=self.store,
             scheme=self.scheme,
@@ -1108,14 +1226,9 @@ class ShardServing:
             port=api_port,
             token=token,
             metrics=metrics,
-            debug_routes={
-                "/debug/shards": lambda: {
-                    "n_shards": 1,
-                    "pid": os.getpid(),
-                    "shards": [self.debug_doc()],
-                },
-                "/debug/audit": lambda: self.audit_check(),
-            },
+            debug_routes=routes,
+            tracer=tracer,
+            trace_role="shard",
         )
         self.http.start()
 
@@ -1126,8 +1239,29 @@ class ShardServing:
         inode or a snapshot (the I10 guarantee). With fencing disabled
         (the counter-proof mode) the zombie keeps writing — and the
         gray soak proves a stale-generation record lands."""
+        current_gen = int((current or {}).get("generation") or 0)
+        self.audit.record(
+            "cluster", "lease_lost", shard=self.shard_index,
+            reason="foreign holder or higher generation observed",
+            generation=current_gen,
+            holder=(current or {}).get("holder"),
+        )
         if self.fencing:
-            self.pers.fence(int((current or {}).get("generation") or 0))
+            self.pers.fence(current_gen)
+            self.audit.record(
+                "cluster", "fenced", shard=self.shard_index,
+                reason="demoted: persistence fenced against stale epoch",
+                generation=current_gen,
+            )
+
+    def debug_events(
+        self, params: Optional[Dict[str, List[str]]] = None
+    ) -> str:
+        """Cluster-event slice of the audit journal (/debug/events) —
+        same query params as /debug/audit, kind pinned to cluster."""
+        p = dict(params or {})
+        p["kind"] = ["cluster"]
+        return self.audit.render_json(p)
 
     @property
     def api_port(self) -> int:
@@ -1140,7 +1274,7 @@ class ShardServing:
     def debug_doc(self) -> Dict[str, Any]:
         return _shard_debug_doc(
             self.shard_index, self.store, self.pers, role="leader",
-            lease=self.lease, ship=self.ship,
+            lease=self.lease, ship=self.ship, sdir=self.sdir,
         )
 
     def audit_check(self) -> Dict[str, Any]:
@@ -1201,6 +1335,7 @@ class StandbyServer:
         promote_api_port: Optional[int] = None,
         promote_ship_port: Optional[int] = None,
         fencing: bool = True,
+        tracer: Optional[Any] = None,
     ):
         self.shard_index = int(shard_index)
         self.data_dir = data_dir
@@ -1225,8 +1360,11 @@ class StandbyServer:
         self.clock = clock or RealClock()
         self.metrics = metrics
         self.pers_kwargs = dict(pers_kwargs or {})
+        self.tracer = tracer
+        if tracer is not None:
+            tracer.set_proc(role="standby", shard=self.shard_index)
         self.replica = FollowerReplica(
-            self.clock, name=f"standby-{self.shard_index}"
+            self.clock, name=f"standby-{self.shard_index}", tracer=tracer
         )
         self.follower = ShipFollower(
             leader_host, ship_port, self.replica, metrics=metrics
@@ -1267,6 +1405,7 @@ class StandbyServer:
         #    EOF — every byte the kernel accepted from the dead leader
         #    still arrives; only its userspace queue died with it.
         self.follower.stop()
+        t_drained = time.monotonic()
 
         # 2. I6: independent replay of the on-disk WAL is the authority.
         replay = Persistence(self.sdir, **self.pers_kwargs).recover()
@@ -1285,6 +1424,7 @@ class StandbyServer:
             self.replica.resync(replay)
         promoted_state = self.replica.state()
         i6_ok = promoted_state == replay_state
+        t_i6 = time.monotonic()
 
         # 3. Bump-then-fence: take the lease over BEFORE binding ports
         #    or writing a byte. acquire() increments the generation past
@@ -1295,6 +1435,7 @@ class StandbyServer:
         #    bumped generation.
         self.lease.holder = f"promoted-{self.shard_index}-pid{os.getpid()}"
         new_generation = self.lease.acquire()
+        t_lease = time.monotonic()
 
         # 4. Serve: the ShardServing promotion hand-off writes the
         #    snapshot-first generation (WAL restarts empty) and binds
@@ -1315,8 +1456,39 @@ class StandbyServer:
             pers_kwargs=self.pers_kwargs,
             lease=self.lease,
             fencing=self.fencing,
+            tracer=self.tracer,
         )
         duration = time.monotonic() - t0
+        # The failover as a typed timeline: one cluster event per phase
+        # (detect → I6 check → snapshot rewrite → port bind), written
+        # into the NEW tenure's journal so /debug/events fans it in.
+        # Cluster events carry no wal_pos, so I9 (audit ≡ WAL) holds.
+        j = self.serving.audit
+        j.record(
+            "cluster", "promotion_detected", shard=self.shard_index,
+            reason="leader lease expired",
+            drain_s=t_drained - t0,
+        )
+        j.record(
+            "cluster", "promotion_i6_check", shard=self.shard_index,
+            reason="independent disk replay vs replica state",
+            ok=i6_ok, duration_s=t_i6 - t_drained,
+            replica_matched_socket=replica_matched,
+        )
+        j.record(
+            "cluster", "promotion_snapshot_rewrite",
+            shard=self.shard_index,
+            reason="bump-then-fence lease + snapshot-first generation",
+            generation=new_generation, duration_s=t_lease - t_i6,
+        )
+        j.record(
+            "cluster", "promotion_port_bind", shard=self.shard_index,
+            reason="serving stack up on promote ports",
+            api_port=self.serving.api_port,
+            ship_port=self.serving.ship_port,
+            duration_s=time.monotonic() - t_lease,
+            total_s=duration,
+        )
         report = {
             "shard": self.shard_index,
             "pid": os.getpid(),
@@ -1373,24 +1545,47 @@ class RouterServer:
         breakers: bool = True,
         request_timeout_s: Optional[float] = None,
         breaker_kwargs: Optional[Dict[str, Any]] = None,
+        tracer: Optional[Any] = None,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.runtime.shard import ShardRouter
+        from cron_operator_tpu.telemetry import AuditJournal
 
         self.scheme = scheme or default_scheme()
         self.clock = clock or RealClock()
+        self.tracer = tracer
+        if tracer is not None:
+            tracer.set_proc(role="router")
+        # The router's own journal holds cluster events it witnesses
+        # (breaker flips); /debug/events merges it with every shard's.
+        self.audit = AuditJournal(metrics=metrics)
         self.clients: List[ShardClient] = []
         for i, peer in enumerate(peers):
             server = peer if "://" in peer else f"http://{peer}"
-            self.clients.append(ShardClient(
+            client = ShardClient(
                 server, token=peer_token, scheme=self.scheme,
                 clock=self.clock, shard=i,
                 breaker=(CircuitBreaker(**(breaker_kwargs or {}))
                          if breakers else None),
                 request_timeout_s=request_timeout_s,
                 metrics=metrics,
-            ))
+            )
+            if client.breaker is not None:
+                client.breaker.on_transition = (
+                    lambda old, new, s=i: self.audit.record(
+                        "cluster", f"breaker_{new}", shard=s,
+                        reason=f"transition from {old}",
+                    )
+                )
+            self.clients.append(client)
         self.router = ShardRouter(self.clients)
+        routes: Dict[str, Any] = {
+            "/debug/shards": self.debug_shards,
+            "/debug/events": self.debug_events,
+            "/debug/trace/": self.debug_trace,
+        }
+        if tracer is not None:
+            routes["/debug/traces"] = tracer.render_json
         self.http = HTTPAPIServer(
             api=self.router,
             scheme=self.scheme,
@@ -1398,7 +1593,9 @@ class RouterServer:
             port=port,
             token=token,
             metrics=metrics,
-            debug_routes={"/debug/shards": self.debug_shards},
+            debug_routes=routes,
+            tracer=tracer,
+            trace_role="router",
         )
         # The hub subscribed to the router (add_watcher fans out to every
         # client); now start each client's watch streams so shard events
@@ -1440,6 +1637,61 @@ class RouterServer:
             "mode": "processes",
             "router_pid": os.getpid(),
             "shards": shards,
+        }
+
+    def debug_trace(
+        self, trace_id: str,
+        params: Optional[Dict[str, List[str]]] = None,
+    ) -> Dict[str, Any]:
+        """Assemble ONE cross-process trace: the router's own spans
+        plus every shard's, stitched (parent ids already cross the
+        boundary via traceparent) and decomposed into the critical
+        path. The body answers: which processes took part, where did
+        the wall time go, and does the per-hop sum reconcile."""
+        span_lists: List[List[Dict[str, Any]]] = []
+        if self.tracer is not None:
+            span_lists.append(self.tracer.spans(trace_id))
+        for client in self.clients:
+            doc = client.debug_traces(trace=trace_id)
+            if not doc:
+                continue
+            for t in doc.get("traces") or []:
+                span_lists.append(t.get("spans") or [])
+        stitched = stitch_trace(span_lists, trace_id)
+        stitched["critical_path"] = critical_path(stitched["spans"])
+        return stitched
+
+    def debug_events(
+        self, params: Optional[Dict[str, List[str]]] = None
+    ) -> Dict[str, Any]:
+        """Cluster-wide event timeline: the router's own cluster
+        records merged with every shard's /debug/events, ordered by
+        wall-clock ts — one readable failover instead of N logs."""
+        p = dict(params or {})
+        p["kind"] = ["cluster"]
+        try:
+            limit = int((p.get("limit") or ["256"])[0])
+        except ValueError:
+            limit = 256
+        own = json.loads(self.audit.render_json(p))
+        events = [
+            dict(r, source="router")
+            for r in own.get("records") or []
+        ]
+        for client in self.clients:
+            doc = client.debug_events(limit=limit)
+            if not doc:
+                continue
+            for r in doc.get("records") or []:
+                events.append(dict(r, source=f"shard-{client.shard}"))
+        events.sort(key=lambda r: r.get("ts") or 0)
+        if limit >= 0:
+            events = events[-limit:]
+        return {
+            "router_pid": os.getpid(),
+            "n_sources": 1 + len(self.clients),
+            "matched": len(events),
+            "events": events,
         }
 
     def close(self) -> None:

@@ -28,11 +28,22 @@ from cron_operator_tpu.telemetry.timeseries import (
 )
 from cron_operator_tpu.telemetry.trace import (
     ANNOTATION_TRACE_ID,
+    CRITICAL_PATH_HOPS,
     ENV_TRACE_ID,
+    TRACEPARENT_HEADER,
     Span,
+    TraceContext,
     Tracer,
+    critical_path,
+    current_trace,
+    current_trace_id,
+    format_traceparent,
     new_span_id,
     new_trace_id,
+    parse_traceparent,
+    reset_current_trace,
+    set_current_trace,
+    stitch_trace,
 )
 
 __all__ = [
@@ -40,13 +51,24 @@ __all__ = [
     "AUDIT_KINDS",
     "AuditJournal",
     "AuditRecord",
+    "CRITICAL_PATH_HOPS",
     "DEFAULT_HISTORY_FAMILIES",
     "ENV_TRACE_ID",
     "FleetObservatory",
     "Span",
     "TIMESERIES_APPEND_GATE_US",
+    "TRACEPARENT_HEADER",
     "TimeSeriesStore",
+    "TraceContext",
     "Tracer",
+    "critical_path",
+    "current_trace",
+    "current_trace_id",
+    "format_traceparent",
     "new_span_id",
     "new_trace_id",
+    "parse_traceparent",
+    "reset_current_trace",
+    "set_current_trace",
+    "stitch_trace",
 ]

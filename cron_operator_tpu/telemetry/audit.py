@@ -207,6 +207,13 @@ class AuditJournal:
                 self._metrics.inc(series)
             else:  # unknown kind — format off the hot path
                 self._metrics.inc(f'audit_records_total{{kind="{kind}"}}')
+            if kind == "cluster":
+                # Cluster lifecycle events are rare (failover, breaker
+                # flips, grow/shrink) — a per-event f-string is fine
+                # off the store hot path.
+                self._metrics.inc(
+                    f'cluster_events_total{{event="{event}"}}'
+                )
         if self._observer is not None:
             try:
                 self._observer(rec)

@@ -20,18 +20,33 @@ ops under a lock, cheap enough for the reconcile hot path.
 
 from __future__ import annotations
 
+import contextvars
 import json
+import os
 import random
+import re
 import threading
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
 # Annotation on workload objects carrying the tick's trace id.
 ANNOTATION_TRACE_ID = "tpu.kubedl.io/trace-id"
 # Env var carrying the trace id into runner subprocesses / pods.
 ENV_TRACE_ID = "TPU_TRACE_ID"
+
+# HTTP header carrying the trace context between control-plane
+# processes (router → shard leader). The format follows the W3C Trace
+# Context ``traceparent`` shape — ``00-<32hex trace>-<16hex span>-01`` —
+# with our native 64-bit trace / 32-bit span ids left-zero-padded into
+# the W3C field widths on the wire and stripped back on parse.
+TRACEPARENT_HEADER = "traceparent"
+
+# Hard bound on header length before any parsing happens: the real
+# format is exactly 55 chars, so anything longer is garbage (or an
+# attack) and is rejected without allocating per-segment substrings.
+TRACEPARENT_MAX_LEN = 64
 
 # Default bound on the finished-span store. 512 spans ≈ 100+ ticks of
 # history at ~4 spans per tick; old spans are evicted FIFO.
@@ -51,6 +66,99 @@ def new_trace_id() -> str:
 
 def new_span_id() -> str:
     return f"{_rng.getrandbits(32):08x}"
+
+
+class TraceContext(NamedTuple):
+    """The two ids that cross a process boundary: which trace the
+    request belongs to, and which span on the caller's side is the
+    parent of whatever the callee records."""
+
+    trace_id: str
+    span_id: str
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """Render a context as a W3C-shaped ``traceparent`` header value.
+
+    Native 16-hex trace ids / 8-hex span ids are left-zero-padded to
+    the W3C 32/16-hex field widths; :func:`parse_traceparent` strips
+    the padding back, so the round trip is identity."""
+    return f"00-{trace_id:0>32}-{span_id:0>16}-01"
+
+
+def _strip_pad(hexs: str, native_len: int) -> str:
+    """Undo the zero-padding ``format_traceparent`` applied, without
+    ever shrinking below the native width (ids that are genuinely
+    32-hex — e.g. from a foreign W3C tracer — pass through intact)."""
+    pad = len(hexs) - native_len
+    if pad > 0 and hexs[:pad] == "0" * pad:
+        return hexs[pad:]
+    return hexs
+
+
+_HEX = set("0123456789abcdef")
+
+# One-pass structural check: version 00, lowercase-hex ids at exactly
+# the W3C widths, 2-hex flags. Compiled once — a single fullmatch is
+# ~5× cheaper than split + per-char set membership, and parse sits on
+# the per-request path of every traced frame.
+_TRACEPARENT_RE = re.compile(r"00-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}")
+
+_ZERO_TRACE = "0" * 32
+_ZERO_SPAN = "0" * 16
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[TraceContext]:
+    """Strict parse of a ``traceparent`` header value.
+
+    Returns ``None`` — never raises — on anything malformed: wrong
+    length/segment count, unknown version, non-lowercase-hex ids,
+    all-zero ids, or an oversized value (> ``TRACEPARENT_MAX_LEN``).
+    A malformed header must degrade to "no trace", not kill the
+    connection that carried it."""
+    if not value or not isinstance(value, str):
+        return None
+    if len(value) > TRACEPARENT_MAX_LEN:
+        return None
+    m = _TRACEPARENT_RE.fullmatch(value)
+    if m is None:
+        return None
+    trace_hex, span_hex = m.group(1), m.group(2)
+    if trace_hex == _ZERO_TRACE or span_hex == _ZERO_SPAN:
+        return None
+    return TraceContext(_strip_pad(trace_hex, 16), _strip_pad(span_hex, 8))
+
+
+# ---- ambient context ------------------------------------------------------
+# The front door (apiserver_http) sets the request's context here for
+# the duration of the handler, so layers with no plumbing path to the
+# request — the WAL append under the store lock, the outbound client in
+# cluster.py — can pick it up without threading a parameter through
+# every signature. contextvars (not a thread-local) so it also survives
+# executor hand-offs that copy context.
+
+_current_trace: contextvars.ContextVar[Optional[TraceContext]] = \
+    contextvars.ContextVar("cron_tpu_trace", default=None)
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The ambient trace context, if a traced request is in flight."""
+    return _current_trace.get()
+
+
+def current_trace_id() -> Optional[str]:
+    ctx = _current_trace.get()
+    return ctx.trace_id if ctx is not None else None
+
+
+def set_current_trace(ctx: Optional[TraceContext]) -> contextvars.Token:
+    """Install ``ctx`` as the ambient context; pair with
+    :func:`reset_current_trace` in a ``finally``."""
+    return _current_trace.set(ctx)
+
+
+def reset_current_trace(token: contextvars.Token) -> None:
+    _current_trace.reset(token)
 
 
 @dataclass
@@ -106,11 +214,24 @@ class Tracer:
         # much history the bounded store has already shed.
         self.spans_dropped = 0
         self._metrics = metrics
+        # Process identity stamped onto every locally finished span so
+        # fan-in can count distinct processes. Opt-in (set_proc) — the
+        # embedded single-process plane keeps its spans unadorned.
+        self._proc: Dict[str, Any] = {}
 
     def instrument(self, metrics) -> None:
         """Count evictions into a metrics registry
         (``trace_spans_dropped_total``)."""
         self._metrics = metrics
+
+    def set_proc(self, role: Optional[str] = None, **extra: Any) -> None:
+        """Stamp this process's identity (``pid`` + optional ``proc``
+        role) onto every span finished here — how ``/debug/trace/<id>``
+        proves a trace crossed process boundaries."""
+        self._proc = {"pid": os.getpid()}
+        if role:
+            self._proc["proc"] = role
+        self._proc.update(extra)
 
     def start_span(
         self,
@@ -130,6 +251,9 @@ class Tracer:
 
     def finish(self, span: Span, end_s: float) -> Span:
         span.end_s = end_s
+        if self._proc:
+            for k, v in self._proc.items():
+                span.attrs.setdefault(k, v)
         dropped = False
         with self._lock:
             if len(self._spans) == self.max_spans:
@@ -139,6 +263,61 @@ class Tracer:
         if dropped and self._metrics is not None:
             self._metrics.inc("trace_spans_dropped_total")
         return span
+
+    def ingest(self, spans: List[Dict[str, Any]]) -> int:
+        """Adopt finished spans recorded by ANOTHER process (runner
+        stdout frames, shard fan-in). Each entry must look like
+        :meth:`Span.to_dict` output; anything that doesn't — missing or
+        non-string name/ids, unfinished, non-numeric or inverted
+        timestamps — is dropped and counted
+        (``trace_spans_dropped_total{reason="ingest"}``), never raised:
+        a corrupt frame from a peer must not take down the ingester.
+        Returns the number of spans adopted."""
+        adopted = 0
+        bad = 0
+        for d in spans or ():
+            try:
+                name = d["name"]
+                tid = d["trace_id"]
+                start_s = float(d["start_s"])
+                end_s = float(d["end_s"])
+                if not (isinstance(name, str) and name
+                        and isinstance(tid, str) and tid):
+                    raise ValueError("bad name/trace_id")
+                if end_s < start_s:
+                    raise ValueError("inverted span")
+                parent = d.get("parent_id")
+                span_id = d.get("span_id")
+                attrs = d.get("attrs") or {}
+                if not isinstance(attrs, dict):
+                    raise ValueError("bad attrs")
+                span = Span(
+                    name=name, trace_id=tid,
+                    span_id=span_id if isinstance(span_id, str) and span_id
+                    else new_span_id(),
+                    parent_id=parent if isinstance(parent, str) else None,
+                    start_s=start_s, end_s=end_s, attrs=dict(attrs),
+                )
+            except (KeyError, TypeError, ValueError):
+                bad += 1
+                continue
+            dropped = False
+            with self._lock:
+                if len(self._spans) == self.max_spans:
+                    self.spans_dropped += 1
+                    dropped = True
+                self._spans.append(span)
+            if dropped and self._metrics is not None:
+                self._metrics.inc("trace_spans_dropped_total")
+            adopted += 1
+        if bad:
+            self.spans_dropped += bad
+            if self._metrics is not None:
+                for _ in range(bad):
+                    self._metrics.inc(
+                        'trace_spans_dropped_total{reason="ingest"}'
+                    )
+        return adopted
 
     def record(
         self,
@@ -204,10 +383,31 @@ class Tracer:
             out.append(entry)
         return out
 
-    def render_json(self) -> str:
-        """JSON body for the ``/debug/traces`` route."""
+    def render_json(
+        self, params: Optional[Dict[str, List[str]]] = None
+    ) -> str:
+        """JSON body for the ``/debug/traces`` route. ``params`` is a
+        parsed query string (``urllib.parse.parse_qs`` shape, same
+        contract as ``/debug/audit``): ``trace=<id>`` selects one
+        trace, ``limit=<n>`` keeps the NEWEST n traces (default 256)."""
+        params = params or {}
+
+        def one(name: str) -> Optional[str]:
+            vals = params.get(name)
+            return vals[0] if vals else None
+
+        trace_id = one("trace")
+        try:
+            limit = int(one("limit") or 256)
+        except ValueError:
+            limit = 256
+        traces = self.traces()
+        if trace_id is not None:
+            traces = [t for t in traces if t["trace_id"] == trace_id]
+        if limit >= 0:
+            traces = traces[-limit:]
         return json.dumps(
-            {"traces": self.traces(), "spans_dropped": self.spans_dropped},
+            {"traces": traces, "spans_dropped": self.spans_dropped},
             indent=2, sort_keys=False,
         )
 
@@ -243,4 +443,122 @@ def _lineage(spans: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
         "attempts": len(resumes) + 1,
         "resumes": chain,
         "wasted_steps": sum(c["wasted_steps"] for c in chain),
+    }
+
+
+# ---- cross-process assembly -----------------------------------------------
+
+#: Canonical hop order of one distributed cron tick, front door to
+#: training loop: router route → shard admission → store commit →
+#: group-commit fsync → backend submit → workload first step.
+CRITICAL_PATH_HOPS: Tuple[str, ...] = (
+    "route", "admit", "commit", "fsync", "submit", "first_step",
+)
+
+
+def stitch_trace(
+    span_lists: List[List[Dict[str, Any]]], trace_id: str
+) -> Dict[str, Any]:
+    """Merge per-process span exports into one trace.
+
+    Fan-in naturally returns overlapping copies (the router holds its
+    own spans AND polls every shard), so spans are deduped by span id;
+    parent/child links already cross process boundaries because the
+    ``traceparent`` header carries the caller's span id into the
+    callee. The result lists spans sorted by start time, the distinct
+    processes that contributed (from ``set_proc`` attrs), and spans
+    whose parent is not in the merged set (``orphans`` — a propagation
+    hole worth seeing)."""
+    seen: Dict[str, Dict[str, Any]] = {}
+    for spans in span_lists:
+        for s in spans or ():
+            if s.get("trace_id") != trace_id:
+                continue
+            sid = s.get("span_id") or f"anon-{len(seen)}"
+            seen.setdefault(sid, s)
+    spans = sorted(seen.values(), key=lambda s: s.get("start_s") or 0.0)
+    ids = set(seen)
+    procs = []
+    for s in spans:
+        a = s.get("attrs") or {}
+        ident = (a.get("pid"), a.get("proc"))
+        if ident != (None, None) and ident not in procs:
+            procs.append(ident)
+    return {
+        "trace_id": trace_id,
+        "spans": spans,
+        "processes": [
+            {"pid": pid, "proc": role} for pid, role in procs
+        ],
+        "orphans": [
+            s["span_id"] for s in spans
+            if s.get("parent_id") and s["parent_id"] not in ids
+        ],
+    }
+
+
+def critical_path(
+    spans: List[Dict[str, Any]],
+    hops: Tuple[str, ...] = CRITICAL_PATH_HOPS,
+) -> Dict[str, Any]:
+    """Decompose one trace's wall time across the named hops.
+
+    Boundary sweep: every time slice between consecutive span edges is
+    attributed to the INNERMOST active hop (latest start wins — a
+    ``commit`` running inside an ``admit`` owns its slice), and slices
+    no hop covers are attributed to ``(gap)`` explicitly rather than
+    vanishing. The attribution partitions ``[first start, last end]``,
+    so ``total_s`` reconciles with ``wall_s`` by construction up to
+    float error — ``reconciles`` is True iff that holds AND every named
+    hop actually appeared (a missing hop means the trace never crossed
+    that layer, which is a finding, not a rounding issue)."""
+    hop_spans = [
+        s for s in spans
+        if s.get("name") in hops and s.get("end_s") is not None
+    ]
+    missing = [
+        h for h in hops if not any(s["name"] == h for s in hop_spans)
+    ]
+    if not hop_spans:
+        return {
+            "hops": [], "wall_s": 0.0, "total_s": 0.0,
+            "missing": missing, "reconciles": False,
+        }
+    t0 = min(s["start_s"] for s in hop_spans)
+    t1 = max(s["end_s"] for s in hop_spans)
+    edges = sorted(
+        {t0, t1}
+        | {s["start_s"] for s in hop_spans}
+        | {s["end_s"] for s in hop_spans}
+    )
+    attributed: Dict[str, float] = {}
+    for a, b in zip(edges, edges[1:]):
+        if b <= a:
+            continue
+        mid = (a + b) / 2.0
+        active = [
+            s for s in hop_spans if s["start_s"] <= mid < s["end_s"]
+        ]
+        if active:
+            owner = max(
+                active,
+                key=lambda s: (s["start_s"], hops.index(s["name"])),
+            )["name"]
+        else:
+            owner = "(gap)"
+        attributed[owner] = attributed.get(owner, 0.0) + (b - a)
+    wall = t1 - t0
+    total = sum(attributed.values())
+    ordered = [
+        {"hop": h, "seconds": attributed[h]}
+        for h in (*hops, "(gap)") if h in attributed
+    ]
+    return {
+        "hops": ordered,
+        "wall_s": wall,
+        "total_s": total,
+        "missing": missing,
+        "reconciles": (
+            not missing and abs(total - wall) <= max(1e-6, 1e-6 * wall)
+        ),
     }

@@ -142,6 +142,9 @@ def main(argv: List[str] | None = None) -> int:
     # the PJRT client tears down cleanly (never yank a live compile).
     signal.signal(signal.SIGTERM, lambda *_: ctx.cancel.set())
 
+    import time as _time
+
+    t_run = _time.time()
     try:
         fn(ctx)
     except Exception as err:  # noqa: BLE001 — report, then non-zero exit
@@ -153,6 +156,25 @@ def main(argv: List[str] | None = None) -> int:
             "progress": ctx.progress,
         })
         return 1
+    if ctx.trace_id:
+        # Ship this process's span home over the progress stream: the
+        # executor ingests it (Tracer.ingest), making the runner the
+        # third distinct process on the tick's distributed trace.
+        from cron_operator_tpu.telemetry import new_span_id
+
+        _emit("spans", {"spans": [{
+            "name": "runner",
+            "trace_id": ctx.trace_id,
+            "span_id": new_span_id(),
+            "parent_id": None,
+            "start_s": t_run,
+            "end_s": _time.time(),
+            "attrs": {
+                "pid": os.getpid(),
+                "proc": "runner",
+                "entrypoint": entry_name,
+            },
+        }]})
     _emit("done", {"progress": ctx.progress, "cancelled": ctx.should_stop()})
     return 0
 

@@ -1,0 +1,46 @@
+"""No-JAX smoke entrypoint for control-plane end-to-end probes.
+
+Referenced as ``cron_operator_tpu.workloads.smoke:run`` (the
+``module:function`` form of the entrypoint annotation), so resolving it
+never imports :mod:`cron_operator_tpu.workloads.entrypoints` — and with
+it jax/flax — into a runner subprocess whose only job is to prove the
+control-plane path: the distributed obs_report leg runs one cron tick
+through router → shard → executor → THIS process and asserts the trace
+spans all of them.
+
+The progress contract matches the real trainers: ``started_at`` /
+``first_step_at`` / ``first_step_latency_s`` feed the executor's
+tick→first-step histogram and its ``first_step`` span, and ``step``
+beats the watchdog path exactly like a training loop would.
+"""
+
+from __future__ import annotations
+
+import time
+
+from cron_operator_tpu.backends.registry import JobContext
+
+
+def run(ctx: JobContext) -> None:
+    """Complete ``steps`` (default 3) instant steps, then return."""
+    steps = max(1, int(ctx.params.get("steps", 3) or 3))
+    t0 = time.monotonic()
+    ctx.progress["started_at"] = time.time()
+    # Give the first "step" real width (it stands in for a compile +
+    # dispatch) so the first_step hop owns a visible slice of the
+    # critical-path decomposition instead of a zero-width point.
+    time.sleep(0.02)
+    ctx.progress["first_step_at"] = time.time()
+    ctx.progress["first_step_latency_s"] = time.monotonic() - t0
+    ctx.progress["step"] = 1
+    if ctx.publish is not None:
+        ctx.publish()
+    for step in range(2, steps + 1):
+        if ctx.should_stop():
+            break
+        if ctx.watchdog is not None:
+            ctx.watchdog.beat()
+        ctx.progress["step"] = step
+    ctx.progress["steps_total"] = steps
+    if ctx.publish is not None:
+        ctx.publish()

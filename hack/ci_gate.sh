@@ -143,6 +143,18 @@ echo "==> observability report smoke (flight recorder + SLO verdict, fast legs)"
 # make obs-report (writes BENCH_OBS.json).
 python hack/obs_report.py --check --out /dev/null >/dev/null
 
+echo "==> distributed-obs smoke (one trace across router + shard + runner)"
+# Cross-process tracing leg: spawns the REAL supervisor topology (router
+# + shard leader + standby as separate OS processes), POSTs a Cron
+# through the router under a driver-minted traceparent, and requires
+# ONE trace with spans from >= 3 distinct processes whose critical-path
+# decomposition (route → admit → commit → fsync → submit → first_step)
+# reconciles against measured wall latency — plus I9 on the shard, a
+# zero-write debug read path, the cluster event fan-in, and the
+# per-frame trace-context propagation µs gate. Full artifact:
+# make obs-report-dist (writes BENCH_OBS_DIST.json).
+python hack/obs_report.py --distributed --out /dev/null
+
 echo "==> HTTP front-door smoke (fan-out encode-once, group-commit, APF fairness)"
 # Small-size run of the real front-door bench against the in-process
 # HTTPAPIServer: 100 watchers must each receive every event from ONE

@@ -451,6 +451,64 @@ def _timeseries_microbench(repeat: int = 500) -> dict:
     }
 
 
+#: Ceiling on per-frame trace-context plumbing: every traced request pays
+#: one format (client header), one parse (server front door), and one
+#: ambient set/reset round-trip, all inside the serve path — so the whole
+#: bundle is gated, not merely reported.
+TRACE_CTX_GATE_US = 5.0
+
+
+def _trace_ctx_microbench(repeat: int = 2000) -> dict:
+    """Trace-context propagation overhead: the exact per-frame work a
+    traced request adds — ``format_traceparent`` on the outbound hop,
+    ``parse_traceparent`` at the next front door, and the ambient
+    contextvar set / read / reset bracket around the handler — timed as
+    one bundle and gated at ``TRACE_CTX_GATE_US``."""
+    try:
+        from cron_operator_tpu.telemetry.trace import (
+            TraceContext,
+            current_trace_id,
+            format_traceparent,
+            new_span_id,
+            new_trace_id,
+            parse_traceparent,
+            reset_current_trace,
+            set_current_trace,
+        )
+    except ImportError:  # baseline trees predate distributed tracing
+        return {}
+
+    ctx = TraceContext(new_trace_id(), new_span_id())
+
+    def _frame_once():
+        header = format_traceparent(ctx.trace_id, ctx.span_id)
+        parsed = parse_traceparent(header)
+        token = set_current_trace(parsed)
+        current_trace_id()
+        reset_current_trace(token)
+
+    frame_us = min(_time_calls(_frame_once, repeat) for _ in range(3))
+    assert frame_us <= TRACE_CTX_GATE_US, (
+        f"trace-context propagation costs {frame_us:.2f}µs/frame "
+        f"(gate: {TRACE_CTX_GATE_US}µs)"
+    )
+
+    parse_us = min(
+        _time_calls(
+            lambda: parse_traceparent(
+                format_traceparent(ctx.trace_id, ctx.span_id)
+            ),
+            repeat,
+        )
+        for _ in range(3)
+    )
+    return {
+        "trace_ctx_frame_us": round(frame_us, 2),
+        "trace_ctx_gate_us": TRACE_CTX_GATE_US,
+        "trace_ctx_format_parse_us": round(parse_us, 2),
+    }
+
+
 def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
     from datetime import timedelta
     from cron_operator_tpu.api.scheme import GVK_CRON, default_scheme
@@ -542,6 +600,7 @@ def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
     write_us.update(_wal_microbench())
     write_us.update(_audit_microbench())
     write_us.update(_timeseries_microbench())
+    write_us.update(_trace_ctx_microbench())
     api.close()
 
     storm = storm_best_of(n_crons, sweep_timeout_s)

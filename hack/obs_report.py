@@ -520,6 +520,313 @@ def run_mfu_leg() -> dict:
     }
 
 
+#: Distributed-trace leg: hard deadline for the whole topology round
+#: trip (boot → traced POST → tick → runner → trace assembled).
+DIST_DEADLINE_S = 60.0
+
+#: Slack allowed between the trace's own wall time and the driver's
+#: measured POST→assembled latency (the trace is a strict sub-interval
+#: of the measurement, so this only absorbs clock skew between the
+#: driver's reads and the spans' wall-clock stamps).
+DIST_WALL_SLACK_S = 0.25
+
+
+def _http_json(url: str, timeout: float = 5.0):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _probe_port_base(tries: int = 40) -> int:
+    """A port base where the supervisor's deterministic layout (router
+    on base, shard api on base+1, WAL ship on base+51) is free."""
+    import random
+    import socket
+
+    for _ in range(tries):
+        base = random.randrange(20000, 55000)
+        ok = True
+        for port in (base, base + 1, base + 51):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", port))
+            except OSError:
+                ok = False
+                break
+            finally:
+                s.close()
+        if ok:
+            return base
+    raise RuntimeError("no free port window found for the topology")
+
+
+def run_distributed_leg() -> dict:
+    """ONE trace across the real multi-process topology.
+
+    Spawns the supervisor (router + shard leader + standby, real OS
+    processes), POSTs a Cron through the router's front door with a
+    driver-minted ``traceparent``, and asserts that a single cron tick
+    produced a single trace whose spans come from >= 3 distinct
+    processes (router, shard leader, runner subprocess), whose
+    critical-path decomposition (route → admit → commit → fsync →
+    submit → first_step) reconciles against the trace's wall time and
+    stays inside the driver's measured end-to-end latency; that the
+    cluster event timeline fanned in at the router saw the shard's
+    lease acquisition; that I9 (audit ≡ WAL) holds on the serving
+    shard; that the debug read path adds ZERO store/WAL writes; and
+    that per-frame trace-context propagation clears its µs gate."""
+    import signal
+    import subprocess
+
+    from cron_operator_tpu.api.scheme import default_scheme
+    from cron_operator_tpu.runtime.cluster import (
+        ClusterAPIServer,
+        ClusterConfig,
+    )
+    from cron_operator_tpu.telemetry.trace import (
+        TraceContext,
+        new_span_id,
+        new_trace_id,
+        reset_current_trace,
+        set_current_trace,
+    )
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from controlplane_bench import _trace_ctx_microbench
+
+    tmp = tempfile.mkdtemp(prefix="obs-dist-")
+    base = _probe_port_base()
+    router_url = f"http://127.0.0.1:{base}"
+    shard_url = f"http://127.0.0.1:{base + 1}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "cron_operator_tpu.cli.main", "start",
+         "--shard-role", "supervisor", "--shards", "1",
+         "--data-dir", tmp, "--port-base", str(base),
+         "--zap-log-level", "warn",
+         "--health-probe-bind-address", "0",
+         "--metrics-bind-address", "0"],
+        env=env, cwd=REPO_ROOT,
+    )
+    deadline = time.time() + DIST_DEADLINE_S
+    leg: dict = {"port_base": base, "ok": False}
+    api = ClusterAPIServer(
+        config=ClusterConfig(server=router_url, qps=0),
+        scheme=default_scheme(),
+    )
+    try:
+        # ---- wait for the router (and behind it, the shard) ---------------
+        ready = False
+        while time.time() < deadline:
+            try:
+                api.list(CRON_API_VERSION, "Cron", namespace=NAMESPACE)
+                ready = True
+                break
+            except Exception:
+                time.sleep(0.2)
+        leg["topology_ready"] = ready
+        if not ready:
+            return leg
+
+        # ---- the traced write: one Cron through the front door ------------
+        trace_id, root_span = new_trace_id(), new_span_id()
+        leg["trace_id"] = trace_id
+        cron = {
+            "apiVersion": CRON_API_VERSION,
+            "kind": "Cron",
+            "metadata": {"name": "dist-0", "namespace": NAMESPACE},
+            "spec": {
+                "schedule": "@every 1s",
+                "concurrencyPolicy": "Forbid",
+                "historyLimit": 1,
+                "template": {"workload": {
+                    "apiVersion": WORKLOAD_API_VERSION,
+                    "kind": WORKLOAD_KIND,
+                    "metadata": {"annotations": {
+                        # Pre-stamping the tick's trace id joins the
+                        # scheduled tick to THIS traced request (the
+                        # controller adopts it instead of minting).
+                        "tpu.kubedl.io/trace-id": trace_id,
+                        # Real subprocess isolation: the runner is the
+                        # third OS process on the trace.
+                        "tpu.kubedl.io/isolation": "subprocess",
+                        "tpu.kubedl.io/entrypoint":
+                            "cron_operator_tpu.workloads.smoke:run",
+                        "tpu.kubedl.io/param.steps": "2",
+                    }},
+                    "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+                }},
+            },
+        }
+        t_post = time.time()
+        token = set_current_trace(TraceContext(trace_id, root_span))
+        try:
+            api.create(cron)
+        finally:
+            reset_current_trace(token)
+
+        # ---- poll the router's cluster trace assembly ---------------------
+        trace_doc: dict = {}
+        assembled = False
+        while time.time() < deadline:
+            try:
+                trace_doc = _http_json(
+                    f"{router_url}/debug/trace/{trace_id}"
+                )
+            except Exception:
+                trace_doc = {}
+            cp = trace_doc.get("critical_path") or {}
+            pids = {
+                p.get("pid") for p in trace_doc.get("processes") or []
+                if p.get("pid") is not None
+            }
+            if cp.get("reconciles") and len(pids) >= 3:
+                assembled = True
+                break
+            time.sleep(0.25)
+        t_done = time.time()
+        cp = trace_doc.get("critical_path") or {}
+        pids = {
+            p.get("pid") for p in trace_doc.get("processes") or []
+            if p.get("pid") is not None
+        }
+        measured_e2e_s = t_done - t_post
+        leg.update({
+            "assembled": assembled,
+            "span_count": len(trace_doc.get("spans") or []),
+            "processes": trace_doc.get("processes"),
+            "distinct_pids": len(pids),
+            "orphan_spans": len(trace_doc.get("orphans") or []),
+            "critical_path": cp,
+            "measured_e2e_s": round(measured_e2e_s, 4),
+        })
+        wall_ok = (
+            assembled
+            and 0.0 < cp.get("wall_s", 0.0)
+            <= measured_e2e_s + DIST_WALL_SLACK_S
+        )
+        leg["wall_within_measured"] = wall_ok
+
+        # ---- cluster event timeline fan-in --------------------------------
+        events_doc = {}
+        try:
+            events_doc = _http_json(f"{router_url}/debug/events")
+        except Exception:
+            pass
+        events = events_doc.get("events") or []
+        lease_seen = any(
+            e.get("event") == "lease_acquired"
+            and str(e.get("source", "")).startswith("shard-")
+            for e in events
+        )
+        leg["events_total"] = len(events)
+        leg["lease_acquired_seen"] = lease_seen
+
+        # ---- standby liveness on the router's shard doc -------------------
+        standby_attached = False
+        try:
+            shards_doc = _http_json(f"{router_url}/debug/shards")
+            for doc in shards_doc.get("shards") or []:
+                standby = (doc or {}).get("standby") or {}
+                standby_attached = bool(standby.get("attached"))
+        except Exception:
+            pass
+        leg["standby_attached"] = standby_attached
+
+        # ---- quiesce: stop the ticking cron, wait for rv to settle --------
+        try:
+            api.delete(CRON_API_VERSION, "Cron", NAMESPACE, "dist-0")
+        except Exception:
+            pass
+
+        def _shard_rv_wal() -> tuple:
+            doc = _http_json(f"{shard_url}/debug/shards")
+            sd = (doc.get("shards") or [{}])[0]
+            return (
+                int(sd.get("rv") or 0),
+                int((sd.get("wal") or {}).get("records_appended") or 0),
+            )
+
+        stable_since = None
+        last = None
+        while time.time() < deadline:
+            try:
+                cur = _shard_rv_wal()
+            except Exception:
+                time.sleep(0.2)
+                continue
+            if cur != last:
+                last, stable_since = cur, time.time()
+            elif time.time() - stable_since >= 1.0:
+                break
+            time.sleep(0.2)
+
+        # ---- zero-write read path: rv + WAL bracket the debug sweep -------
+        rv_before = wal_before = rv_after = wal_after = None
+        try:
+            rv_before, wal_before = _shard_rv_wal()
+            _http_json(f"{router_url}/debug/trace/{trace_id}")
+            _http_json(f"{router_url}/debug/traces")
+            _http_json(f"{router_url}/debug/events")
+            _http_json(f"{router_url}/debug/shards")
+            _http_json(f"{shard_url}/debug/events")
+            rv_after, wal_after = _shard_rv_wal()
+        except Exception:
+            pass
+        zero_write = (
+            rv_before is not None
+            and (rv_before, wal_before) == (rv_after, wal_after)
+        )
+        leg["store_writes_during_debug"] = (
+            None if rv_after is None else rv_after - rv_before
+        )
+        leg["wal_appends_during_debug"] = (
+            None if wal_after is None else wal_after - wal_before
+        )
+        leg["zero_write_read_path"] = zero_write
+
+        # ---- I9 on the serving shard --------------------------------------
+        audit_check = {}
+        try:
+            audit_check = _http_json(f"{shard_url}/debug/audit")
+        except Exception:
+            pass
+        leg["audit_check"] = audit_check
+
+        # ---- propagation overhead gate ------------------------------------
+        try:
+            bench = _trace_ctx_microbench()
+        except AssertionError as err:
+            bench = {"error": str(err)}
+        leg["propagation"] = bench
+        bench_ok = bool(bench) and "error" not in bench
+
+        leg["ok"] = bool(
+            assembled
+            and not cp.get("missing")
+            and wall_ok
+            and lease_seen
+            and standby_attached
+            and zero_write
+            and audit_check.get("ok")
+            and bench_ok
+        )
+        return leg
+    finally:
+        if sup.poll() is None:
+            sup.send_signal(signal.SIGTERM)
+            try:
+                sup.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+                sup.wait(timeout=5)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_goodput_leg(seed: int, jobs: int, rounds: int) -> dict:
     """Real CPU-mesh training under preemption storms (the chaos soak's
     elastic leg), reduced to the goodput verdict."""
@@ -544,6 +851,13 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true", default=False,
                     help="fast legs only (no real training) — the CI "
                          "smoke; verdict still OK/REGRESSION")
+    ap.add_argument("--distributed", action="store_true", default=False,
+                    help="cross-process tracing leg only: spawn the real "
+                         "supervisor topology (router + shard + standby), "
+                         "fire one traced cron tick through the router, "
+                         "assert a single trace spanning >=3 processes "
+                         "with a reconciling critical path "
+                         "(make obs-report-dist)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--goodput-jobs", type=int, default=2,
                     help="logical training runs in the goodput leg")
@@ -554,6 +868,41 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     t0 = time.time()
+    if args.distributed:
+        print("obs report (distributed): supervisor topology, one traced "
+              "tick through the router", flush=True)
+        report = {"mode": "distributed",
+                  "distributed": run_distributed_leg()}
+        legs = [("distributed", report["distributed"])]
+        ok = all(leg["ok"] for _, leg in legs)
+        report["verdict"] = "OK" if ok else "REGRESSION"
+        report["elapsed_s"] = round(time.time() - t0, 2)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+        leg = report["distributed"]
+        cp = leg.get("critical_path") or {}
+        hops = " + ".join(
+            f"{h['hop']}={h['seconds'] * 1e3:.1f}ms"
+            for h in cp.get("hops") or []
+        )
+        mark = "PASS" if leg["ok"] else "FAIL"
+        print(
+            f"  [{mark}] distributed: {leg.get('distinct_pids', 0)} "
+            f"process(es) on trace {leg.get('trace_id')}, "
+            f"{hops or 'no hops'} "
+            f"(wall {cp.get('wall_s', 0):.3f}s, reconciles="
+            f"{cp.get('reconciles')}), measured e2e "
+            f"{leg.get('measured_e2e_s')}s, "
+            f"I9={((leg.get('audit_check') or {}).get('ok'))}, "
+            f"debug store_writes={leg.get('store_writes_during_debug')}, "
+            f"propagation "
+            f"{(leg.get('propagation') or {}).get('trace_ctx_frame_us')}"
+            f"µs/frame"
+        )
+        print(f"wrote {args.out} (verdict={report['verdict']})")
+        return 0 if ok else 1
+
     mode = "check" if args.check else "full"
     print(f"obs report ({mode}): crons={OBS_CRONS} rounds={OBS_ROUNDS}",
           flush=True)

@@ -447,7 +447,13 @@ class TestAdmissionAtTheWire:
                 status, _, _ = self._get(
                     srv, f"/apis/{CRON_AV}/namespaces/default/crons/nope")
                 assert status == 404
-            assert admission.snapshot()["workload"]["in_flight"] == 0
+            # The seat is released in _dispatch's finally, a few µs
+            # AFTER the response bytes reach the client — poll rather
+            # than race the handler thread's tail.
+            wait_for(
+                lambda: admission.snapshot()["workload"]["in_flight"] == 0,
+                message="admission seat released",
+            )
         finally:
             srv.stop()
 

@@ -58,6 +58,20 @@ class TestRegistryDrift:
         assert "controller_runtime_reconcile_total" in found      # inc
         assert "controller_runtime_reconcile_time_seconds" in found  # observe
         assert "workqueue_depth" in found                          # set
+        # the observability fan-in families: typed cluster events
+        # (audit.py) and counted span-ingest drops (telemetry/trace.py)
+        assert "cluster_events_total" in found
+        assert "trace_spans_dropped_total" in found
+
+    def test_trace_and_event_families_declared_with_types(self):
+        """The tracing/fan-in families must stay declared counters so
+        ``/metrics`` exposition keeps HELP/TYPE for them and the labeled
+        ``reason="ingest"`` / ``event=...`` series inherit headers."""
+        for family in ("cluster_events_total", "trace_spans_dropped_total"):
+            assert family in _FAMILY_META, family
+            mtype, mhelp = _FAMILY_META[family]
+            assert mtype == "counter", family
+            assert mhelp
 
     def test_every_emitted_family_is_declared(self):
         undeclared = {

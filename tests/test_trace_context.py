@@ -1,0 +1,412 @@
+"""Cross-process trace context: the W3C-shaped ``traceparent`` codec,
+the ambient contextvar plumbing, front-door span handling over real HTTP
+(router and shard roles), the ``"tc"`` stamp on WAL frames (and the
+follower applying legacy gen-only frames unchanged), and the counted
+ingest path for spans shipped home by runner subprocesses.
+
+The hard requirements pinned here:
+
+- a malformed or oversized ``traceparent`` degrades to "no trace" — the
+  request is served and the connection survives;
+- untraced reads stay exactly as cheap as before (no spans, no WAL key);
+- legacy WAL frames (gen-only, pre-trace) and traced frames both apply
+  on a follower byte-for-byte;
+- a corrupt span frame from a peer is dropped and COUNTED
+  (``trace_spans_dropped_total{reason="ingest"}``), never raised.
+"""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
+from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.runtime.persistence import Persistence
+from cron_operator_tpu.runtime.shard import FollowerReplica
+from cron_operator_tpu.telemetry.trace import (
+    CRITICAL_PATH_HOPS,
+    TRACEPARENT_HEADER,
+    TraceContext,
+    Tracer,
+    critical_path,
+    current_trace,
+    current_trace_id,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    reset_current_trace,
+    set_current_trace,
+    stitch_trace,
+)
+
+CRON_AV = "apps.kubedl.io/v1alpha1"
+
+
+def wait_for(cond, timeout=5.0):
+    """Spans that wrap the whole request (commit, route) are recorded
+    *after* the response bytes hit the socket, so a client-side
+    assertion races the handler thread's last few microseconds — poll
+    instead of asserting the instant the response lands."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def make_cron(name):
+    return {
+        "apiVersion": CRON_AV, "kind": "Cron",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"schedule": "@every 1h", "template": {"workload": {
+            "apiVersion": "kubeflow.org/v1", "kind": "JAXJob",
+            "spec": {}}}},
+    }
+
+
+class TestTraceparentCodec:
+    def test_round_trip_native_ids(self):
+        tid, sid = new_trace_id(), new_span_id()
+        header = format_traceparent(tid, sid)
+        assert len(header) == 55  # exact W3C field widths
+        assert parse_traceparent(header) == TraceContext(tid, sid)
+
+    def test_foreign_full_width_ids_pass_through(self):
+        # A genuine 32-hex trace id (from a W3C tracer) must not be
+        # shrunk by the padding strip.
+        header = f"00-{'ab' * 16}-{'cd' * 8}-01"
+        ctx = parse_traceparent(header)
+        assert ctx == TraceContext("ab" * 16, "cd" * 8)
+
+    @pytest.mark.parametrize("bad", [
+        None,
+        "",
+        123,
+        "00-" + "a" * 32 + "-" + "b" * 16,          # 3 segments
+        "01-" + "a" * 32 + "-" + "b" * 16 + "-01",  # unknown version
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+        "00-" + "a" * 32 + "-" + "b" * 15 + "-01",  # short span id
+        "00-" + "A" * 32 + "-" + "b" * 16 + "-01",  # uppercase hex
+        "00-" + "g" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # all-zero trace
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span
+        "x" * 100,                                   # oversized garbage
+        format_traceparent("a" * 16, "b" * 8) + "-extra-tail",
+    ])
+    def test_malformed_returns_none(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_ambient_set_get_reset(self):
+        assert current_trace() is None
+        assert current_trace_id() is None
+        ctx = TraceContext(new_trace_id(), new_span_id())
+        token = set_current_trace(ctx)
+        try:
+            assert current_trace() == ctx
+            assert current_trace_id() == ctx.trace_id
+        finally:
+            reset_current_trace(token)
+        assert current_trace() is None
+
+
+class TestFrontDoorPropagation:
+    """Trace context over real HTTP framing, shard and router roles."""
+
+    def _post(self, srv, name, headers=None):
+        conn = http.client.HTTPConnection(
+            srv._server.server_address[0], srv.port, timeout=10)
+        try:
+            conn.request(
+                "POST", f"/apis/{CRON_AV}/namespaces/default/crons",
+                body=json.dumps(make_cron(name)).encode(),
+                headers=headers or {},
+            )
+            resp = conn.getresponse()
+            body = resp.read()
+            return resp.status, body
+        finally:
+            conn.close()
+
+    def _get(self, srv, path, headers=None):
+        conn = http.client.HTTPConnection(
+            srv._server.server_address[0], srv.port, timeout=10)
+        try:
+            conn.request("GET", path, headers=headers or {})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def test_shard_spans_and_wal_tc_stamp(self, tmp_path):
+        api = APIServer()
+        wal = Persistence(str(tmp_path), flush_interval_s=0)
+        wal.open()
+        api.attach_persistence(wal)
+        tracer = Tracer()
+        srv = HTTPAPIServer(api=api, tracer=tracer, trace_role="shard")
+        srv.start()
+        try:
+            tid, caller_span = new_trace_id(), new_span_id()
+            status, _ = self._post(srv, "traced", headers={
+                TRACEPARENT_HEADER: format_traceparent(tid, caller_span),
+            })
+            assert status == 201
+            assert wait_for(lambda: {"admit", "commit", "fsync"} <= {
+                s["name"] for s in tracer.spans(tid)})
+            spans = {s["name"]: s for s in tracer.spans(tid)}
+            # Parent/child crosses the process boundary via the header.
+            assert spans["admit"]["parent_id"] == caller_span
+            assert spans["commit"]["parent_id"] == spans["admit"]["span_id"]
+            assert spans["fsync"]["parent_id"] == spans["commit"]["span_id"]
+        finally:
+            srv.stop()
+            wal.close()
+        # The committed WAL record carries the trace id next to "gen".
+        with open(wal._wal_path) as f:
+            recs = [json.loads(ln) for ln in f if ln.strip()]
+        assert any(r.get("tc") == tid for r in recs)
+
+    def test_write_without_header_mints_trace_on_shard(self):
+        tracer = Tracer()
+        srv = HTTPAPIServer(api=APIServer(), tracer=tracer,
+                            trace_role="shard")
+        srv.start()
+        try:
+            status, _ = self._post(srv, "minted")
+            assert status == 201
+            assert wait_for(lambda: {"admit", "commit"} <= {
+                s["name"] for s in tracer.spans()})
+        finally:
+            srv.stop()
+
+    @pytest.mark.parametrize("bad_header", [
+        "not-a-traceparent",
+        "00-zzzz-1-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+        "00-" + "a" * 200 + "-" + "b" * 16 + "-01",  # oversized
+    ])
+    def test_malformed_header_served_untraced(self, bad_header):
+        """A garbage traceparent must not kill the request, the
+        connection, or adopt a bogus trace — it degrades to the
+        front-door-minted trace a headerless write gets."""
+        tracer = Tracer()
+        srv = HTTPAPIServer(api=APIServer(), tracer=tracer,
+                            trace_role="shard")
+        srv.start()
+        try:
+            status, _ = self._post(
+                srv, "survives", headers={TRACEPARENT_HEADER: bad_header})
+            assert status == 201
+            # No span adopted the (unparseable) caller context.
+            assert all(
+                s["parent_id"] is None or s["parent_id"] != bad_header
+                for s in tracer.spans()
+            )
+            assert all(s["name"] != "route" for s in tracer.spans())
+            # The connection machinery survived: a second request works.
+            status, _ = self._post(srv, "survives-2")
+            assert status == 201
+        finally:
+            srv.stop()
+
+    def test_untraced_read_records_nothing(self):
+        tracer = Tracer()
+        srv = HTTPAPIServer(api=APIServer(), tracer=tracer,
+                            trace_role="shard")
+        srv.start()
+        try:
+            status, _ = self._get(
+                srv, f"/apis/{CRON_AV}/namespaces/default/crons")
+            assert status == 200
+            assert tracer.spans() == []  # zero-cost steady state
+        finally:
+            srv.stop()
+
+    def test_traced_read_records_admit_only(self):
+        tracer = Tracer()
+        srv = HTTPAPIServer(api=APIServer(), tracer=tracer,
+                            trace_role="shard")
+        srv.start()
+        try:
+            tid = new_trace_id()
+            status, _ = self._get(
+                srv, f"/apis/{CRON_AV}/namespaces/default/crons",
+                headers={TRACEPARENT_HEADER:
+                         format_traceparent(tid, new_span_id())})
+            assert status == 200
+            assert wait_for(lambda: tracer.spans(tid))
+            assert [s["name"] for s in tracer.spans(tid)] == ["admit"]
+        finally:
+            srv.stop()
+
+    def test_router_role_records_one_route_span(self):
+        tracer = Tracer()
+        tracer.set_proc(role="router")
+        srv = HTTPAPIServer(api=APIServer(), tracer=tracer,
+                            trace_role="router")
+        srv.start()
+        try:
+            tid = new_trace_id()
+            status, _ = self._post(srv, "via-router", headers={
+                TRACEPARENT_HEADER: format_traceparent(tid, new_span_id()),
+            })
+            assert status == 201
+            assert wait_for(lambda: tracer.spans(tid))
+            spans = tracer.spans(tid)
+            assert [s["name"] for s in spans] == ["route"]
+            assert spans[0]["attrs"]["proc"] == "router"
+        finally:
+            srv.stop()
+
+
+class TestFollowerFrames:
+    """WAL-ship wire compatibility: gen-only (legacy) and tc-stamped
+    frames both apply; corrupt frames are counted, not fatal."""
+
+    def _frame(self, rec):
+        return (json.dumps(rec, separators=(",", ":")) + "\n").encode()
+
+    def _put_rec(self, name, rv, **extra):
+        return dict({
+            "op": "put",
+            "obj": {"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": name, "namespace": "default",
+                                 "resourceVersion": str(rv)}},
+        }, **extra)
+
+    def test_legacy_gen_only_frame_applies(self):
+        follower = FollowerReplica()
+        follower.apply_bytes(self._frame(self._put_rec("legacy", 1, gen=3)))
+        assert follower.records_applied == 1
+        assert follower.generation == 3
+
+    def test_tc_frame_applies_and_records_wal_apply_span(self):
+        tracer = Tracer()
+        follower = FollowerReplica(tracer=tracer)
+        tid = new_trace_id()
+        follower.apply_bytes(
+            self._frame(self._put_rec("traced", 1, gen=1, tc=tid)))
+        assert follower.records_applied == 1
+        spans = tracer.spans(tid)
+        assert [s["name"] for s in spans] == ["wal_apply"]
+        assert spans[0]["attrs"]["op"] == "put"
+
+    def test_tc_frame_without_tracer_still_applies(self):
+        follower = FollowerReplica()
+        follower.apply_bytes(
+            self._frame(self._put_rec("traced", 1, tc=new_trace_id())))
+        assert follower.records_applied == 1
+
+    def test_corrupt_frame_counted_not_fatal(self):
+        follower = FollowerReplica(tracer=Tracer())
+        follower.apply_bytes(b'{"op": "put", "obj": \n')
+        follower.apply_bytes(self._frame(self._put_rec("after", 2)))
+        assert follower.records_dropped == 1
+        assert follower.records_applied == 1
+
+
+class TestIngest:
+    def _span(self, **over):
+        base = {
+            "name": "runner", "trace_id": new_trace_id(),
+            "span_id": new_span_id(), "parent_id": None,
+            "start_s": 100.0, "end_s": 101.0,
+            "attrs": {"pid": 4242, "proc": "runner"},
+        }
+        base.update(over)
+        return base
+
+    def test_valid_spans_adopted_with_origin_attrs(self):
+        metrics = Metrics()
+        tracer = Tracer(metrics=metrics)
+        tracer.set_proc(role="shard")  # must NOT restamp ingested spans
+        good = self._span()
+        assert tracer.ingest([good]) == 1
+        (span,) = tracer.spans(good["trace_id"])
+        assert span["attrs"]["pid"] == 4242  # origin identity kept
+        assert span["attrs"]["proc"] == "runner"
+        assert tracer.spans_dropped == 0
+
+    @pytest.mark.parametrize("bad", [
+        {"trace_id": "t"},                            # no name
+        {"name": "", "trace_id": "t", "start_s": 1, "end_s": 2},
+        {"name": "x", "trace_id": "", "start_s": 1, "end_s": 2},
+        {"name": "x", "trace_id": "t", "start_s": 2, "end_s": 1},
+        {"name": "x", "trace_id": "t", "start_s": "nan?", "end_s": 2},
+        {"name": "x", "trace_id": "t", "start_s": 1, "end_s": 2,
+         "attrs": "not-a-dict"},
+        "not even a dict",
+        None,
+    ])
+    def test_bad_frames_dropped_and_counted(self, bad):
+        metrics = Metrics()
+        tracer = Tracer(metrics=metrics)
+        assert tracer.ingest([bad]) == 0
+        assert tracer.spans_dropped == 1
+        assert metrics.get(
+            'trace_spans_dropped_total{reason="ingest"}') == 1
+        assert tracer.spans() == []
+
+    def test_mixed_batch_counts_only_bad(self):
+        metrics = Metrics()
+        tracer = Tracer(metrics=metrics)
+        assert tracer.ingest([self._span(), {"junk": 1}, self._span()]) == 2
+        assert tracer.spans_dropped == 1
+        assert len(tracer.spans()) == 2
+
+
+class TestAssembly:
+    def _hop(self, name, t0, t1, tid, parent=None, **attrs):
+        return {"name": name, "trace_id": tid, "span_id": new_span_id(),
+                "parent_id": parent, "start_s": t0, "end_s": t1,
+                "attrs": attrs}
+
+    def test_stitch_dedupes_and_counts_processes(self):
+        tid = new_trace_id()
+        a = self._hop("route", 0.0, 1.0, tid, pid=1, proc="router")
+        b = self._hop("admit", 0.1, 0.2, tid, parent=a["span_id"],
+                      pid=2, proc="shard")
+        # The router fan-in naturally sees its own copy of a twice.
+        doc = stitch_trace([[a, b], [a]], tid)
+        assert len(doc["spans"]) == 2
+        assert doc["processes"] == [
+            {"pid": 1, "proc": "router"}, {"pid": 2, "proc": "shard"}]
+        assert doc["orphans"] == []
+
+    def test_stitch_flags_orphans(self):
+        tid = new_trace_id()
+        lost = self._hop("commit", 0.0, 1.0, tid, parent="dead-beef")
+        doc = stitch_trace([[lost]], tid)
+        assert doc["orphans"] == [lost["span_id"]]
+
+    def test_critical_path_partitions_wall_with_gap(self):
+        tid = new_trace_id()
+        spans = [
+            self._hop("route", 0.0, 1.0, tid),
+            self._hop("admit", 0.1, 0.9, tid),    # inner hop owns slice
+            self._hop("commit", 0.2, 0.5, tid),   # innermost wins
+            self._hop("fsync", 0.5, 0.6, tid),
+            self._hop("submit", 2.0, 2.5, tid),   # 1.0→2.0 is a gap
+            self._hop("first_step", 2.5, 3.0, tid),
+        ]
+        cp = critical_path(spans)
+        assert cp["missing"] == []
+        assert cp["reconciles"] is True
+        by_hop = {h["hop"]: h["seconds"] for h in cp["hops"]}
+        assert by_hop["(gap)"] == pytest.approx(1.0)
+        assert by_hop["commit"] == pytest.approx(0.3)
+        assert sum(by_hop.values()) == pytest.approx(cp["wall_s"])
+        # Canonical order, gap last.
+        order = [h["hop"] for h in cp["hops"]]
+        assert order == [*CRITICAL_PATH_HOPS, "(gap)"]
+
+    def test_critical_path_missing_hop_fails_reconcile(self):
+        tid = new_trace_id()
+        cp = critical_path([self._hop("route", 0.0, 1.0, tid)])
+        assert "first_step" in cp["missing"]
+        assert cp["reconciles"] is False
