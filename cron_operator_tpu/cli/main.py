@@ -340,29 +340,53 @@ def build_parser() -> argparse.ArgumentParser:
                        help="multi-PROCESS control plane role (see README "
                             "'Scale-out'). 'shard': one shard backend "
                             "process (store + WAL + Manager pool + WAL "
-                            "ship socket + lease heartbeat); 'standby' "
-                            "(alias 'follower'): the shard's socket-fed "
-                            "replica that self-promotes on lease expiry; "
+                            "ship socket + lease heartbeat); 'standby': "
+                            "the shard's socket-fed replica that "
+                            "self-promotes on lease expiry (add "
+                            "--serve-reads to also serve the read plane); "
+                            "'follower': a NON-promoting socket-fed "
+                            "replica serving read-only list/watch on "
+                            "--serve-api (scale reads by adding more); "
                             "'router': the consistent-hash front door over "
-                            "--peers; 'supervisor': spawn the whole "
+                            "--peers (add --read-peers for follower read "
+                            "routing); 'supervisor': spawn the whole "
                             "topology as child processes (dev mode)")
     start.add_argument("--shard-index", type=int, default=0, metavar="I",
                        help="shard/standby roles: which shard this process "
                             "serves (owns <data-dir>/shard-I)")
     start.add_argument("--ship-port", type=int, default=0, metavar="PORT",
                        help="shard role: WAL ship socket port (0 = "
-                            "ephemeral); standby role: the leader's ship "
-                            "port to subscribe to")
+                            "ephemeral); standby/follower roles: the "
+                            "leader's ship port to subscribe to")
     start.add_argument("--peers", default=None, metavar="HOST:PORT,...",
                        help="router role: comma-separated shard API "
                             "addresses in shard-index order")
+    start.add_argument("--serve-reads", type=int, default=None,
+                       metavar="PORT",
+                       help="standby role: also bind a follower read "
+                            "door on PORT (0 = ephemeral) serving "
+                            "read-only list/watch from the replica — the "
+                            "read plane's attached mode. The door stays "
+                            "up across promotion (the replica store "
+                            "becomes the leader store)")
+    start.add_argument("--read-peers", default=None,
+                       metavar="H:P,H:P;H:P,...",
+                       help="router role: follower read endpoints per "
+                            "shard — shards separated by ';' in "
+                            "shard-index order, each a comma-separated "
+                            "endpoint list (empty = no read plane for "
+                            "that shard). Collection reads and watch "
+                            "subscriptions round-robin across them with "
+                            "read-your-writes rv barriers; writes and "
+                            "consistency=strong reads ride the leader")
     start.add_argument("--lease-ttl", type=float, default=2.0, metavar="S",
                        help="shard/standby roles: leader lease TTL in "
                             "seconds (heartbeat renews at TTL/4; a standby "
                             "treats a lease older than TTL as leader death)")
     start.add_argument("--port-base", type=int, default=18080, metavar="P",
                        help="supervisor role: router serves on P, shard i "
-                            "API on P+1+i, shard i WAL ship on P+51+i")
+                            "API on P+1+i, shard i WAL ship on P+51+i, "
+                            "shard i standby read door on P+101+i")
     start.add_argument("--no-fencing", action="store_true", default=False,
                        help="shard/standby roles: do NOT fence the "
                             "persistence layer when the lease is lost to "
@@ -542,13 +566,14 @@ def cmd_start_process(args: argparse.Namespace) -> int:
     from cron_operator_tpu.api.scheme import default_scheme
     from cron_operator_tpu.runtime.manager import Metrics
     from cron_operator_tpu.runtime.transport import (
+        FollowerReadServer,
         RouterServer,
         ShardServing,
         StandbyServer,
     )
     from cron_operator_tpu.telemetry import AuditJournal, Tracer
 
-    role = "standby" if args.shard_role == "follower" else args.shard_role
+    role = args.shard_role
     scheme = default_scheme()
     stop = threading.Event()
     if threading.current_thread() is threading.main_thread():
@@ -615,10 +640,14 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             promote_api_port=args.promote_api_port,
             promote_ship_port=args.promote_ship_port,
             fencing=not args.no_fencing, tracer=tracer,
+            serve_reads=args.serve_reads is not None,
+            read_port=args.serve_reads or 0,
         )
         log.info(
-            "shard %d standby: following :%d, watching lease %s (pid %d)",
+            "shard %d standby: following :%d, watching lease %s%s (pid %d)",
             args.shard_index, args.ship_port, standby.lease.path,
+            (f", read door :{standby.read_door.port}"
+             if standby.read_door is not None else ""),
             _os.getpid(),
         )
         report = standby.run(stop, max_wait_s=args.run_for)
@@ -646,10 +675,40 @@ def cmd_start_process(args: argparse.Namespace) -> int:
         standby.close()
         return 0
 
+    if role == "follower":
+        if not args.ship_port:
+            log.error("--shard-role follower requires --ship-port "
+                      "(the leader's WAL ship socket)")
+            return 2
+        door = FollowerReadServer(
+            args.shard_index, leader_host=host, ship_port=args.ship_port,
+            host=host, port=port, token=args.serve_api_token,
+            scheme=scheme, metrics=metrics, tracer=tracer,
+        )
+        door.audit.instrument(metrics)
+        log.info(
+            "shard %d follower: read door %s:%d over WAL ship :%d (pid %d)",
+            args.shard_index, host, door.port, args.ship_port,
+            _os.getpid(),
+        )
+        stop.wait(timeout=args.run_for)
+        log.info("shard %d follower shutting down", args.shard_index)
+        door.close()
+        return 0
+
     if role == "router":
         if not args.peers:
             log.error("--shard-role router requires --peers")
             return 2
+        read_peers = None
+        if args.read_peers:
+            # ';' separates shards (shard-index order), ',' separates a
+            # shard's follower endpoints; an empty segment leaves that
+            # shard on the plain leader-only client.
+            read_peers = [
+                [e.strip() for e in seg.split(",") if e.strip()]
+                for seg in args.read_peers.split(";")
+            ]
         router = RouterServer(
             [p.strip() for p in args.peers.split(",") if p.strip()],
             host=host, port=port, token=args.serve_api_token,
@@ -658,6 +717,7 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             breakers=not args.no_breakers,
             request_timeout_s=args.router_timeout,
             tracer=tracer,
+            read_peers=read_peers,
         )
         log.info("router serving %d shard(s) on %s:%d (pid %d)",
                  len(router.clients), host, router.port, _os.getpid())
@@ -695,9 +755,12 @@ def _run_supervisor(args: argparse.Namespace, stop: threading.Event,
 
     procs = []
     peers = []
+    read_peers = []
     for i in range(n):
         api_port, ship_port = base + 1 + i, base + 51 + i
+        read_port = base + 101 + i
         peers.append(f"127.0.0.1:{api_port}")
+        read_peers.append(f"127.0.0.1:{read_port}")
         procs.append(spawn([
             "--shard-role", "shard", "--shard-index", str(i),
             "--data-dir", args.data_dir,
@@ -709,16 +772,18 @@ def _run_supervisor(args: argparse.Namespace, stop: threading.Event,
             "--data-dir", args.data_dir,
             "--serve-api", f"127.0.0.1:{api_port}",
             "--ship-port", str(ship_port),
+            "--serve-reads", str(read_port),
         ]))
     procs.append(spawn([
         "--shard-role", "router",
         "--serve-api", f"127.0.0.1:{base}",
         "--peers", ",".join(peers),
+        "--read-peers", ";".join(read_peers),
     ]))
     log.info(
-        "supervisor: %d shard(s) + standbys + router on ports %d..%d "
-        "(router %d); SIGINT/SIGTERM tears the topology down",
-        n, base, base + 51 + n - 1, base,
+        "supervisor: %d shard(s) + read-serving standbys + router on "
+        "ports %d..%d (router %d); SIGINT/SIGTERM tears the topology "
+        "down", n, base, base + 101 + n - 1, base,
     )
     try:
         stop.wait(timeout=args.run_for)

@@ -94,9 +94,14 @@ from cron_operator_tpu.runtime.kube import (
     ApiError,
     APIServer,
     ConflictError,
+    FollowerBehindError,
     InvalidError,
     NotFoundError,
     WatchEvent,
+)
+from cron_operator_tpu.runtime.readroute import (
+    MIN_READ_RV,
+    READ_CONSISTENCY,
 )
 from cron_operator_tpu.telemetry.trace import (
     TRACEPARENT_HEADER,
@@ -387,6 +392,37 @@ class _WatchHub:
         metrics = self._metrics
         if metrics is not None:
             metrics.set("http_watch_connections", float(self._nconns))
+
+    def expire_streams(self, min_rv: int) -> None:
+        """Expire every attached stream whose horizon predates
+        ``min_rv`` — the follower-resync poke. A replica store swap
+        (``FollowerReplica.resync``) may lose events between the old
+        stream and the new bootstrap, so streams behind the bootstrap
+        rv must 410 and re-list rather than silently skip. Implemented
+        as per-kind eviction markers (the same signal ring churn uses),
+        deliberately NOT ``_oldest_evicted_rv``: fresh attaches against
+        the re-bootstrapped store must keep working."""
+        min_rv = int(min_rv)
+        if min_rv <= 0:
+            return
+        wake = False
+        with self._cond:
+            for key, subs in self._subs.items():
+                if min_rv > self._evicted_by_kind.get(key, 0):
+                    self._evicted_by_kind[key] = min_rv
+                for conn in subs:
+                    if conn.closed or conn.horizon >= min_rv:
+                        continue
+                    if conn.mode == "thread":
+                        if conn.cv is not None:
+                            conn.cv.notify_all()
+                    elif not conn.dirty:
+                        conn.dirty = True
+                        self._loop_dirty.append(conn)
+                        wake = True
+            self._cond.notify_all()
+        if wake:
+            self._wake_loop()
 
     def _encode_locked(self, entry: _Entry) -> bytes:
         frame = entry.frame
@@ -760,6 +796,7 @@ class HTTPAPIServer:
         debug_routes: Optional[Dict[str, Any]] = None,
         tracer=None,
         trace_role: str = "shard",
+        read_source: Optional[str] = None,
     ):
         """``tls_ctx`` (an ``ssl.SSLContext``, e.g. from
         ``utils.tlsutil.server_context``) serves the API over HTTPS — the
@@ -803,7 +840,20 @@ class HTTPAPIServer:
         as spans — one ``route`` span on a ``"router"`` process, or
         ``admit``/``commit``/``fsync`` spans on a ``"shard"`` process.
         Untraced reads cost nothing: no header + a read verb skips the
-        whole path."""
+        whole path.
+
+        ``read_source`` ("leader" | "follower" | None) marks which side
+        of the read plane this door serves: reads answered here count
+        into ``http_reads_served_total{source=...}``, and a "leader"
+        door stamps its committed collection rv onto DELETE Status
+        responses so router-proxied deletes barrier follower reads the
+        same way creates/updates do. A "follower" door (serving a
+        :class:`runtime.readroute.FollowerReadAPI`) additionally honors
+        ``minResourceVersion`` rv barriers on GETs — blocking reads
+        until the replica catches up, 504 ``FollowerBehind`` on
+        timeout — and wires the watch hub to the replica's resync
+        expiry. ``None`` (the router) leaves counting to the read-plane
+        client, which knows which backend actually served."""
         # Identity check, not truthiness: APIServer defines __len__, and
         # an empty-but-live store must not be swapped for a fresh one.
         self.api = api if api is not None else APIServer()
@@ -832,6 +882,7 @@ class HTTPAPIServer:
         self.durable_writes = durable_writes
         self.tracer = tracer
         self.trace_role = trace_role
+        self.read_source = read_source
         self.selector_watch = (
             (not self.tls) if selector_watch is None else selector_watch
         )
@@ -841,6 +892,11 @@ class HTTPAPIServer:
             self._kinds[(gvk.group, gvk.version, plural)] = gvk.kind
         self.hub = _WatchHub(metrics=metrics)
         self.api.add_watcher(self.hub.publish)
+        # A FollowerReadAPI expires this hub's streams on replica resync
+        # (the store swap invalidates stream horizons).
+        attach_hub = getattr(self.api, "attach_hub", None)
+        if attach_hub is not None:
+            attach_hub(self.hub)
         self._server = _FrontDoorServer((host, port), self._make_handler())
         if tls_ctx is not None:
             self._server.socket = tls_ctx.wrap_socket(
@@ -934,6 +990,22 @@ class HTTPAPIServer:
             )
         if not ok:
             raise ApiError("write committed but not durable within timeout")
+
+    def _barrier_min_rv(self, min_rv: int) -> None:
+        """rv barrier for follower reads: block until the replica has
+        replayed up to ``min_rv`` (``FollowerReadAPI.wait_min_rv``,
+        which raises :class:`FollowerBehindError` → 504 on timeout). A
+        leader store has no ``wait_min_rv`` — reads there are trivially
+        fresh for any rv it handed out — so this is a no-op."""
+        fn = getattr(self.api, "wait_min_rv", None)
+        if fn is not None:
+            fn(min_rv)
+
+    def _count_read(self) -> None:
+        src = self.read_source
+        metrics = self.metrics
+        if src is not None and metrics is not None:
+            metrics.inc(f'http_reads_served_total{{source="{src}"}}')
 
     # ---- path mapping -----------------------------------------------------
 
@@ -1165,6 +1237,11 @@ class HTTPAPIServer:
                     self._send_status(409, "Conflict", str(err))
                 except InvalidError as err:
                     self._send_status(422, "Invalid", str(err))
+                except FollowerBehindError as err:
+                    # Barriered follower read timed out waiting for its
+                    # replayed rv; the router catches this to fall back
+                    # to the leader (reason="lag").
+                    self._send_status(504, "FollowerBehind", str(err))
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 except Exception as err:  # pragma: no cover
@@ -1198,24 +1275,57 @@ class HTTPAPIServer:
             # -- verbs -----------------------------------------------------
 
             def _do_GET(self, parsed, av, kind, ns, name, sub, q) -> None:
-                if name is not None:
-                    self._send_json(200, outer.api.get(av, kind, ns or "", name))
-                    return
-                sel = _parse_selector(q.get("labelSelector", [None])[0])
-                if q.get("watch") == ["true"]:
-                    self._serve_watch(av, kind, ns, sel, q)
-                    return
-                # Label-selector LISTs route to the store's label indexes
-                # (list_with_rv narrowest-index routing), not post-filter.
-                items, rv = outer.api.list_with_rv(
-                    av, kind, namespace=ns, label_selector=sel
-                )
-                self._send_json(200, {
-                    "kind": f"{kind}List",
-                    "apiVersion": av,
-                    "metadata": {"resourceVersion": rv},
-                    "items": items,
-                })
+                # Read-plane params: minResourceVersion is the rv
+                # barrier (read-your-writes across followers),
+                # consistency=strong pins the read to the leader. Both
+                # ride the request as ambient context so the router's
+                # FollowerReadClient sees them under ShardRouter's
+                # fixed call signatures.
+                try:
+                    min_rv = int(
+                        q.get("minResourceVersion", ["0"])[0] or 0)
+                except ValueError:
+                    raise InvalidError("minResourceVersion must be an "
+                                       "integer") from None
+                consistency = q.get("consistency", [None])[0]
+                tok_rv = MIN_READ_RV.set(min_rv) if min_rv else None
+                tok_c = (READ_CONSISTENCY.set(consistency)
+                         if consistency else None)
+                try:
+                    if min_rv:
+                        # On a follower door this blocks (bounded) until
+                        # the replica replays past min_rv; elsewhere a
+                        # no-op (the contextvar still reaches the router
+                        # read plane below).
+                        outer._barrier_min_rv(min_rv)
+                    if name is not None:
+                        obj = outer.api.get(av, kind, ns or "", name)
+                        outer._count_read()
+                        self._send_json(200, obj)
+                        return
+                    sel = _parse_selector(
+                        q.get("labelSelector", [None])[0])
+                    if q.get("watch") == ["true"]:
+                        self._serve_watch(av, kind, ns, sel, q)
+                        return
+                    # Label-selector LISTs route to the store's label
+                    # indexes (list_with_rv narrowest-index routing),
+                    # not post-filter.
+                    items, rv = outer.api.list_with_rv(
+                        av, kind, namespace=ns, label_selector=sel
+                    )
+                    outer._count_read()
+                    self._send_json(200, {
+                        "kind": f"{kind}List",
+                        "apiVersion": av,
+                        "metadata": {"resourceVersion": rv},
+                        "items": items,
+                    })
+                finally:
+                    if tok_rv is not None:
+                        MIN_READ_RV.reset(tok_rv)
+                    if tok_c is not None:
+                        READ_CONSISTENCY.reset(tok_c)
 
             def _do_POST(self, parsed, av, kind, ns, name, sub, q) -> None:
                 obj = self._body() or {}
@@ -1266,7 +1376,17 @@ class HTTPAPIServer:
                 outer.api.delete(av, kind, ns or "", name,
                                  propagation=propagation)
                 outer._barrier_durable()
-                self._send_json(200, {"kind": "Status", "status": "Success"})
+                status = {"kind": "Status", "status": "Success"}
+                if outer.read_source == "leader":
+                    # Deletes must barrier follower reads like any other
+                    # write (a stale list still showing the deleted
+                    # object breaks read-your-writes), so the leader
+                    # door stamps its committed rv on the Status.
+                    status["metadata"] = {
+                        "resourceVersion": int(
+                            getattr(outer.api, "_rv", 0) or 0),
+                    }
+                self._send_json(200, status)
 
             # -- watch -----------------------------------------------------
 

@@ -46,8 +46,10 @@ from cron_operator_tpu.runtime.kube import (
     AlreadyExistsError,
     ApiError,
     ConflictError,
+    FollowerBehindError,
     InvalidError,
     NotFoundError,
+    ServerTimeoutError,
     WatchEvent,
     make_event_object,
 )
@@ -156,6 +158,18 @@ def _status_error(code: int, body: str) -> ApiError:
         return ConflictError(body)
     if code in (400, 422):
         return InvalidError(body)
+    if code == 504:
+        # Gateway timeouts: a follower door answers 504 "FollowerBehind"
+        # when a barriered read timed out waiting for its replayed rv —
+        # the router's read plane catches that to fall back to the
+        # leader. Any other 504 is a generic server-side timeout.
+        try:
+            reason = json.loads(body).get("reason", "")
+        except Exception:
+            reason = ""
+        if reason == "FollowerBehind":
+            return FollowerBehindError(body)
+        return ServerTimeoutError(body)
     return ApiError(f"HTTP {code}: {body[:500]}")
 
 

@@ -87,6 +87,15 @@ class InvalidError(ApiError):
     pass
 
 
+class FollowerBehindError(ServerTimeoutError):
+    """A barriered follower read timed out waiting for its replayed rv
+    to reach the requested ``minResourceVersion`` (HTTP 504 on the
+    follower front door). Subclasses :class:`ServerTimeoutError` so
+    generic retry paths treat it as transient; the router's read plane
+    catches it specifically to fall back to the leader and count the
+    fallback as ``reason="lag"``."""
+
+
 @dataclass
 class Event:
     """A recorded event (corev1.Event analog)."""
@@ -1015,6 +1024,7 @@ __all__ = [
     "AlreadyExistsError",
     "ConflictError",
     "ServerTimeoutError",
+    "FollowerBehindError",
     "InvalidError",
     "Event",
     "WatchEvent",

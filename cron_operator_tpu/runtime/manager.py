@@ -335,8 +335,26 @@ _FAMILY_META: Dict[str, tuple] = {
     "cluster_events_total": (
         "counter", "Typed cluster lifecycle events written through the "
                    "audit journal (label event=lease_lost|fenced|"
-                   "promotion_*|breaker_*|hang_detected|fleet_grow|... "
-                   "), the discrete feed behind /debug/events"),
+                   "promotion_*|breaker_*|hang_detected|fleet_grow|"
+                   "follower_resync|...), the discrete feed behind "
+                   "/debug/events"),
+    "http_reads_served_total": (
+        "counter", "Reads answered by the read plane, split by which "
+                   "side served (label source=leader|follower): shard "
+                   "and follower front doors count reads they answer, "
+                   "the router counts by the backend its read routing "
+                   "actually picked"),
+    "follower_read_barrier_wait_seconds": (
+        "histogram", "Seconds a barriered follower read "
+                     "(minResourceVersion) blocked waiting for the "
+                     "replica's replayed rv to catch up — the "
+                     "replication-lag tax of read-your-writes; timeouts "
+                     "observe the full bound and 504"),
+    "follower_read_fallbacks_total": (
+        "counter", "Follower reads the router re-issued against the "
+                   "leader (label reason=lag|unhealthy): lag = the rv "
+                   "barrier 504'd (FollowerBehind), unhealthy = the "
+                   "follower endpoint failed or its breaker is open"),
 }
 
 

@@ -389,6 +389,10 @@ class Persistence:
         # computed against these (runtime/shard.py).
         self.bytes_appended = 0
         self.last_append_monotonic: Optional[float] = None
+        #: Highest resourceVersion stamped on any appended record — the
+        #: leader-side rv high-water mark a follower's replayed rv is
+        #: compared against (read-plane freshness on /debug/shards).
+        self.last_rv = 0
         os.makedirs(data_dir, exist_ok=True)
 
     # ---- lifecycle --------------------------------------------------------
@@ -586,6 +590,10 @@ class Persistence:
             self.records_appended += 1
             self.bytes_appended += len(line)
             self.last_append_monotonic = time.monotonic()
+            try:
+                self.last_rv = max(self.last_rv, int(rec.get("rv") or 0))
+            except (TypeError, ValueError):
+                pass
             self._since_snapshot += 1
             self._count(f'wal_records_total{{op="{rec["op"]}"}}')
             # Serialize+buffer latency only; the group-commit fsync has
@@ -988,6 +996,7 @@ class Persistence:
             return {
                 "records_appended": self.records_appended,
                 "bytes_appended": self.bytes_appended,
+                "last_rv": self.last_rv,
                 "fsyncs": self.fsyncs,
                 "snapshots_written": self.snapshots_written,
                 "buffered": len(self._buf),

@@ -1,0 +1,571 @@
+"""Follower read plane: scale the read path with replica count.
+
+The WAL-shipped hot standbys (``FollowerReplica`` fed by
+``ShipFollower``) are replay-equivalent and watch-event-firing, but
+until this module they served zero traffic — every list and every watch
+stream rode the shard *leader*, so read capacity was capped by leader
+count. This module is the two halves that turn replicas into a read
+plane (the classic follower-read design: etcd/Kubernetes "serializable"
+reads, Raft follower reads with read-index barriers, Raft §6.4):
+
+- :class:`FollowerReadAPI` — the follower-process half. A read-only
+  APIServer facade over a **live** :class:`~runtime.shard.FollowerReplica`
+  that an :class:`~runtime.apiserver_http.HTTPAPIServer` front door can
+  serve. "Live" matters: ``FollowerReplica.resync`` swaps in a fresh
+  store on every ship (re)connect, so this facade re-fetches
+  ``replica.store`` per call instead of capturing it once, re-subscribes
+  its watch hub on every swap, and expires attached watch streams past
+  the new bootstrap rv (the per-kind 410/replay machinery then makes
+  clients re-list — a resync must never silently drop events
+  mid-stream). Reads can carry an rv **barrier**: ``wait_min_rv`` blocks
+  (bounded) until the replayed rv catches up to the caller's
+  ``minResourceVersion``, then the read proceeds; a timeout raises
+  :class:`~runtime.kube.FollowerBehindError` (HTTP 504 on the wire).
+
+- :class:`FollowerReadClient` — the router-process half. Wraps one
+  shard's leader :class:`~runtime.transport.ShardClient` plus that
+  shard's follower-endpoint clients; collection reads (list) and watch
+  streams fan out round-robin across the followers while every write —
+  and any read marked ``consistency=strong`` — keeps riding the leader.
+  Read-your-writes is an rv barrier stamped by the router: write
+  responses carry the committed shard rv, the client remembers the
+  highest one it proxied, and every follower read sends it as
+  ``minResourceVersion`` (a conservative, per-router superset of
+  per-connection tracking). A follower read that times out on its
+  barrier (504 → :class:`FollowerBehindError`) falls back to the leader
+  and counts ``follower_read_fallbacks_total{reason="lag"}``; any other
+  follower failure (breaker open, refused, timeout) falls back as
+  ``reason="unhealthy"`` — per-endpoint health reuses each follower
+  client's own :class:`~runtime.transport.CircuitBreaker`.
+
+Layering: this module imports only :mod:`runtime.kube` and
+:mod:`telemetry.trace`; both ``apiserver_http`` (query-param plumbing
+via the context vars below) and ``transport`` (role runners) import it.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from cron_operator_tpu.runtime.kube import (
+    ApiError,
+    FollowerBehindError,
+    InvalidError,
+)
+from cron_operator_tpu.telemetry.trace import current_trace
+
+logger = logging.getLogger("runtime.readroute")
+
+#: Ambient read preference for the current request, set by the HTTP
+#: front door from the ``consistency`` query param before it calls into
+#: the (Shard)Router api. ``"strong"`` forces the leader.
+READ_CONSISTENCY: contextvars.ContextVar[Optional[str]] = (
+    contextvars.ContextVar("read_consistency", default=None)
+)
+
+#: Ambient client-requested rv barrier for the current request, set by
+#: the HTTP front door from the ``minResourceVersion`` query param. The
+#: router's read plane takes the max of this and its own last-proxied
+#: write rv when barriering a follower read.
+MIN_READ_RV: contextvars.ContextVar[int] = (
+    contextvars.ContextVar("min_read_rv", default=0)
+)
+
+#: Default bounded wait for an rv barrier before 504 / leader fallback.
+DEFAULT_BARRIER_TIMEOUT_S = 2.0
+
+#: Barrier waits are replication lag: usually ~0 (the follower applies
+#: within one ship flush), occasionally an fsync-group behind.
+BARRIER_WAIT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5)
+
+_READ_ONLY_MSG = (
+    "follower replica is read-only: writes must go to the shard leader "
+    "(route through the router front door)"
+)
+
+
+class FollowerReadAPI:
+    """Read-only APIServer facade over a live :class:`FollowerReplica`.
+
+    Hand this to an ``HTTPAPIServer`` (``durable_writes=False``,
+    ``read_source="follower"``) and the follower process grows its own
+    front door: lists and watches are served from the replica store at
+    local-read cost, write verbs answer 422, and barriered reads block
+    in :meth:`wait_min_rv` until the replayed rv catches up.
+
+    Registers itself as a resync listener on the replica so the watch
+    hub survives store swaps (re-subscribe + expire streams past the
+    new bootstrap rv)."""
+
+    def __init__(
+        self,
+        replica: Any,
+        metrics: Optional[Any] = None,
+        tracer: Optional[Any] = None,
+        barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
+        shard: int = 0,
+    ):
+        self.replica = replica
+        self.metrics = metrics
+        self.tracer = tracer
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self.shard = int(shard)
+        self._lock = threading.Lock()
+        self._watchers: List[Tuple[Callable, bool]] = []
+        self._hub: Optional[Any] = None
+        self.reads_served = 0
+        self.barrier_waits = 0        # barriers that actually blocked
+        self.barrier_timeouts = 0
+        self._started_monotonic = time.monotonic()
+        # (monotonic, reads_served) at the previous debug_doc scrape —
+        # read QPS on /debug/shards is the delta rate between scrapes.
+        self._qps_probe = (self._started_monotonic, 0)
+        add_listener = getattr(replica, "add_resync_listener", None)
+        if add_listener is not None:
+            add_listener(self._on_store_swapped)
+
+    # -- live store indirection -----------------------------------------
+
+    def _store(self) -> Any:
+        # Never capture: resync() swaps replica.store wholesale.
+        return self.replica.store
+
+    def attach_hub(self, hub: Any) -> None:
+        """Wire the front door's watch hub so a resync can expire its
+        streams (they re-sync via the existing 410 → re-list path)."""
+        self._hub = hub
+
+    def _on_store_swapped(self) -> None:
+        """Resync listener: the replica swapped in a fresh store. Events
+        between the old stream and the new bootstrap may be lost to the
+        hub, so (1) re-subscribe every watcher on the new store and
+        (2) expire attached streams whose horizon predates the bootstrap
+        rv — their clients re-list against the fresh store."""
+        with self._lock:
+            watchers = list(self._watchers)
+        store = self._store()
+        for fn, coalesce in watchers:
+            try:
+                store.add_watcher(fn, coalesce=coalesce)
+            except Exception:  # noqa: BLE001 — read plane must survive
+                logger.exception("follower read plane re-subscribe failed")
+        hub = self._hub
+        if hub is not None:
+            expire = getattr(hub, "expire_streams", None)
+            if expire is not None:
+                expire(int(getattr(self.replica, "bootstrap_rv", 0) or 0))
+
+    # -- read surface (what HTTPAPIServer._do_GET touches) ---------------
+
+    def _note_read(self) -> None:
+        with self._lock:
+            self.reads_served += 1
+
+    def get(self, api_version: str, kind: str, namespace: str,
+            name: str) -> Dict[str, Any]:
+        self._note_read()
+        return self._store().get(api_version, kind, namespace, name)
+
+    def try_get(self, api_version: str, kind: str, namespace: str,
+                name: str) -> Optional[Dict[str, Any]]:
+        self._note_read()
+        return self._store().try_get(api_version, kind, namespace, name)
+
+    def get_frozen(self, api_version: str, kind: str, namespace: str,
+                   name: str) -> Optional[Dict[str, Any]]:
+        return self._store().get_frozen(api_version, kind, namespace, name)
+
+    def list(self, api_version: str, kind: str,
+             namespace: Optional[str] = None,
+             label_selector: Optional[Dict[str, str]] = None,
+             owner_uid: Optional[str] = None) -> List[Dict[str, Any]]:
+        self._note_read()
+        return self._store().list(api_version, kind, namespace=namespace,
+                                  label_selector=label_selector,
+                                  owner_uid=owner_uid)
+
+    def list_with_rv(self, api_version: str, kind: str,
+                     namespace: Optional[str] = None,
+                     label_selector: Optional[Dict[str, str]] = None,
+                     owner_uid: Optional[str] = None):
+        self._note_read()
+        return self._store().list_with_rv(
+            api_version, kind, namespace=namespace,
+            label_selector=label_selector, owner_uid=owner_uid,
+        )
+
+    def all_objects(self) -> List[Dict[str, Any]]:
+        return self._store().all_objects()
+
+    def events(self, reason=None, involved_name=None) -> List[Any]:
+        return self._store().events(reason=reason,
+                                    involved_name=involved_name)
+
+    def add_watcher(self, fn: Callable, coalesce: bool = False) -> None:
+        with self._lock:
+            self._watchers.append((fn, coalesce))
+        self._store().add_watcher(fn, coalesce=coalesce)
+
+    # -- rv barrier ------------------------------------------------------
+
+    def wait_min_rv(self, min_rv: int,
+                    timeout_s: Optional[float] = None) -> float:
+        """Block (bounded) until the replayed rv reaches ``min_rv``;
+        returns the seconds waited. Raises
+        :class:`FollowerBehindError` on timeout — the HTTP layer
+        answers 504, the router falls back to the leader.
+
+        A barrier that actually blocks is a ``follower_wait`` span in
+        the active trace's critical path (the replication-lag hop of a
+        barriered read)."""
+        min_rv = int(min_rv)
+        if min_rv <= 0:
+            return 0.0
+        metrics = self.metrics
+        current = int(getattr(self._store(), "_rv", 0))
+        if current >= min_rv:
+            if metrics is not None:
+                metrics.observe("follower_read_barrier_wait_seconds", 0.0,
+                                buckets=BARRIER_WAIT_BUCKETS)
+            return 0.0
+        timeout = (self.barrier_timeout_s if timeout_s is None
+                   else float(timeout_s))
+        t0 = time.monotonic()
+        t0_wall = time.time()
+        deadline = t0 + timeout
+        with self._lock:
+            self.barrier_waits += 1
+        ok = True
+        while True:
+            if int(getattr(self._store(), "_rv", 0)) >= min_rv:
+                break
+            now = time.monotonic()
+            if now >= deadline:
+                ok = False
+                break
+            time.sleep(min(0.002, deadline - now))
+        waited = time.monotonic() - t0
+        if metrics is not None:
+            metrics.observe("follower_read_barrier_wait_seconds", waited,
+                            buckets=BARRIER_WAIT_BUCKETS)
+        tracer = self.tracer
+        ctx = current_trace()
+        if tracer is not None and ctx is not None:
+            tracer.record(
+                "follower_wait", ctx.trace_id, t0_wall, time.time(),
+                parent_id=ctx.span_id,
+                attrs={"min_rv": min_rv, "shard": self.shard,
+                       "timed_out": not ok},
+            )
+        if not ok:
+            with self._lock:
+                self.barrier_timeouts += 1
+            raise FollowerBehindError(
+                f"follower rv {int(getattr(self._store(), '_rv', 0))} "
+                f"did not reach minResourceVersion {min_rv} "
+                f"within {timeout:.3f}s"
+            )
+        return waited
+
+    # -- write surface: refuse ------------------------------------------
+
+    def create(self, obj):  # noqa: D102
+        raise InvalidError(_READ_ONLY_MSG)
+
+    def update(self, obj):  # noqa: D102
+        raise InvalidError(_READ_ONLY_MSG)
+
+    def patch_status(self, api_version, kind, namespace, name, status):
+        raise InvalidError(_READ_ONLY_MSG)
+
+    def delete(self, api_version, kind, namespace, name,
+               propagation="Background"):
+        raise InvalidError(_READ_ONLY_MSG)
+
+    def record_event(self, involved, etype, reason, message):
+        raise InvalidError(_READ_ONLY_MSG)
+
+    # -- barrier no-ops / parity ----------------------------------------
+
+    def wait_durable(self, timeout: float = 5.0) -> bool:
+        return True
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        return True
+
+    def watch_backlog(self) -> int:
+        return 0
+
+    def close(self) -> None:
+        # The replica owns the store (and survives this facade — a
+        # promoting standby hands it to the new leader's serving stack).
+        pass
+
+    @property
+    def _rv(self) -> int:
+        return int(getattr(self._store(), "_rv", 0))
+
+    def __len__(self) -> int:
+        return len(self._store())
+
+    def __bool__(self) -> bool:
+        return True
+
+    # -- observability ---------------------------------------------------
+
+    def debug_doc(self) -> Dict[str, Any]:
+        """Follower read-plane self-report for /debug/shards: applied
+        rv vs bootstrap, replay-lag freshness (seconds since the last
+        applied byte run), and read QPS since the previous scrape."""
+        now = time.monotonic()
+        with self._lock:
+            reads = self.reads_served
+            prev_t, prev_reads = self._qps_probe
+            self._qps_probe = (now, reads)
+            waits = self.barrier_waits
+            timeouts = self.barrier_timeouts
+        dt = max(now - prev_t, 1e-9)
+        last_apply = getattr(self.replica, "last_apply_monotonic", None)
+        return {
+            "rv": self._rv,
+            "objects": len(self._store()),
+            "bootstrap_rv": int(getattr(self.replica, "bootstrap_rv", 0)),
+            "resyncs": int(getattr(self.replica, "resyncs", 0)),
+            "records_applied": int(
+                getattr(self.replica, "records_applied", 0)),
+            "lag_bytes": int(getattr(self.replica, "lag_bytes", 0)),
+            "staleness_s": (
+                None if last_apply is None else round(now - last_apply, 6)
+            ),
+            "reads_served": reads,
+            "read_qps": round((reads - prev_reads) / dt, 3),
+            "barrier_waits": waits,
+            "barrier_timeouts": timeouts,
+        }
+
+
+class FollowerReadClient:
+    """Router-side read plane for ONE shard: leader client + that
+    shard's follower-endpoint clients, presenting the leader client's
+    surface to :class:`~runtime.shard.ShardRouter`.
+
+    Collection reads round-robin across followers with the router's rv
+    barrier stamped on; writes (and ``consistency=strong`` reads) ride
+    the leader; watch streams subscribe on a follower so watch fan-out
+    scales with replicas. Unknown attributes delegate to the leader
+    client, so the router's debug/peer plumbing is unchanged."""
+
+    def __init__(
+        self,
+        leader: Any,
+        followers: List[Any],
+        shard: int = 0,
+        metrics: Optional[Any] = None,
+        on_fallback: Optional[Callable[[str, str], None]] = None,
+    ):
+        self.leader = leader
+        self.followers = list(followers)
+        self.shard = int(shard)
+        self.metrics = metrics
+        #: Called as ``fn(reason, detail)`` on every leader fallback
+        #: (the router records a cluster event through this).
+        self.on_fallback = on_fallback
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._last_write_rv = 0
+        self.reads_leader = 0
+        self.reads_follower = 0
+        self.fallbacks: Dict[str, int] = {"lag": 0, "unhealthy": 0}
+        # Watch streams pin one follower (all kinds on one replica keep
+        # event order identical to the leader's WAL order).
+        self.watch_source = self.followers[0] if self.followers else leader
+
+    # -- attribute passthrough (debug plumbing, config, breaker, ...) ----
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self.leader, item)
+
+    # -- rv stamping ------------------------------------------------------
+
+    @property
+    def last_write_rv(self) -> int:
+        with self._lock:
+            return self._last_write_rv
+
+    def _note_write(self, obj: Any) -> None:
+        try:
+            rv = int(((obj or {}).get("metadata") or {})
+                     .get("resourceVersion") or 0)
+        except (TypeError, ValueError, AttributeError):
+            rv = 0
+        if rv:
+            with self._lock:
+                if rv > self._last_write_rv:
+                    self._last_write_rv = rv
+
+    def _count_read(self, source: str) -> None:
+        with self._lock:
+            if source == "leader":
+                self.reads_leader += 1
+            else:
+                self.reads_follower += 1
+        metrics = self.metrics
+        if metrics is not None:
+            metrics.inc(f'http_reads_served_total{{source="{source}"}}')
+
+    def _count_fallback(self, reason: str, err: Exception) -> None:
+        with self._lock:
+            self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+        metrics = self.metrics
+        if metrics is not None:
+            metrics.inc(
+                f'follower_read_fallbacks_total{{reason="{reason}"}}'
+            )
+        cb = self.on_fallback
+        if cb is not None:
+            try:
+                cb(reason, str(err))
+            except Exception:  # noqa: BLE001 — observers must not break reads
+                logger.exception("read-fallback observer failed")
+        logger.debug("shard %d follower read fell back to leader (%s): %s",
+                     self.shard, reason, err)
+
+    # -- write verbs: leader, stamping the committed rv -------------------
+
+    def create(self, obj):
+        out = self.leader.create(obj)
+        self._note_write(out)
+        return out
+
+    def update(self, obj):
+        out = self.leader.update(obj)
+        self._note_write(out)
+        return out
+
+    def patch_status(self, api_version, kind, namespace, name, status):
+        out = self.leader.patch_status(api_version, kind, namespace, name,
+                                       status)
+        self._note_write(out)
+        return out
+
+    def delete(self, api_version, kind, namespace, name,
+               propagation="Background"):
+        # ShardClient.delete returns the shard door's Status, which the
+        # leader stamps with its post-delete collection rv — deletes
+        # barrier follower reads too (a stale read showing a deleted
+        # object violates read-your-writes just as much).
+        out = self.leader.delete(api_version, kind, namespace, name,
+                                 propagation=propagation)
+        self._note_write(out)
+        return None
+
+    # -- read verbs: follower round-robin with barrier + fallback ---------
+
+    def _pick_follower(self) -> Optional[Any]:
+        if not self.followers:
+            return None
+        if READ_CONSISTENCY.get() == "strong":
+            return None
+        with self._lock:
+            idx = self._rr
+            self._rr = (self._rr + 1) % len(self.followers)
+        return self.followers[idx]
+
+    def _barrier_rv(self) -> int:
+        return max(self.last_write_rv, int(MIN_READ_RV.get() or 0))
+
+    def list_with_rv(self, api_version, kind, namespace=None,
+                     label_selector=None, owner_uid=None):
+        target = self._pick_follower()
+        if target is None:
+            self._count_read("leader")
+            return self.leader.list_with_rv(
+                api_version, kind, namespace=namespace,
+                label_selector=label_selector, owner_uid=owner_uid,
+            )
+        try:
+            out = target.list_with_rv(
+                api_version, kind, namespace=namespace,
+                label_selector=label_selector, owner_uid=owner_uid,
+                min_rv=self._barrier_rv(),
+            )
+        except FollowerBehindError as err:
+            self._count_fallback("lag", err)
+        except ApiError as err:
+            self._count_fallback("unhealthy", err)
+        except OSError as err:
+            self._count_fallback("unhealthy", err)
+        else:
+            self._count_read("follower")
+            return out
+        self._count_read("leader")
+        return self.leader.list_with_rv(
+            api_version, kind, namespace=namespace,
+            label_selector=label_selector, owner_uid=owner_uid,
+        )
+
+    def list(self, api_version, kind, namespace=None, label_selector=None,
+             owner_uid=None):
+        items, _ = self.list_with_rv(
+            api_version, kind, namespace=namespace,
+            label_selector=label_selector, owner_uid=owner_uid,
+        )
+        return items
+
+    # -- point reads: authoritative, ride the leader ----------------------
+    # (get/try_get/get_frozen delegate via __getattr__; only collection
+    # reads and watches scale out — the documented consistency model.)
+
+    # -- watches: scale with replicas -------------------------------------
+
+    def add_watcher(self, fn, coalesce: bool = False) -> None:
+        self.watch_source.add_watcher(fn, coalesce=coalesce)
+
+    def start_watches(self, gvks=None, namespace=None) -> None:
+        self.watch_source.start_watches(gvks=gvks, namespace=namespace)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self) -> None:
+        # Followers first (their watch streams are the live ones), then
+        # the leader — mirrors the router's clients-before-http ordering.
+        for client in self.followers:
+            try:
+                client.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                logger.exception("follower read client stop failed")
+        self.leader.stop()
+
+    def close(self) -> None:
+        self.stop()
+
+    def read_stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "followers": len(self.followers),
+                "reads_leader": self.reads_leader,
+                "reads_follower": self.reads_follower,
+                "fallbacks": dict(self.fallbacks),
+                "last_write_rv": self._last_write_rv,
+            }
+
+    def __len__(self) -> int:
+        return 0
+
+    def __bool__(self) -> bool:
+        return True
+
+
+__all__ = [
+    "READ_CONSISTENCY",
+    "MIN_READ_RV",
+    "DEFAULT_BARRIER_TIMEOUT_S",
+    "BARRIER_WAIT_BUCKETS",
+    "FollowerReadAPI",
+    "FollowerReadClient",
+]

@@ -225,6 +225,14 @@ class FollowerReplica:
         #: Keys whose last shipped record was a ``del`` — the follower's
         #: running equivalent of ``RecoveredState.wal_deleted_keys``.
         self.deleted_keys: Dict[tuple, int] = {}
+        #: Called (no args) after every :meth:`resync` store swap, outside
+        #: the lock. The read plane hangs off these: re-subscribe
+        #: watchers on the fresh store, expire watch streams, surface the
+        #: resync as a typed cluster event.
+        self._resync_listeners: List[Callable[[], None]] = []
+
+    def add_resync_listener(self, fn: Callable[[], None]) -> None:
+        self._resync_listeners.append(fn)
 
     def bootstrap(self, state: RecoveredState) -> None:
         if not state.empty:
@@ -266,6 +274,11 @@ class FollowerReplica:
             old.close()
         except Exception:  # pragma: no cover - teardown best-effort
             logger.exception("follower old store close failed")
+        for fn in list(self._resync_listeners):
+            try:
+                fn()
+            except Exception:  # pragma: no cover - observers must not break
+                logger.exception("follower resync listener failed")
 
     def apply_bytes(self, data: bytes) -> None:
         """Consume a shipped byte run; applies every COMPLETE line."""

@@ -40,8 +40,12 @@ process boundary:
   :class:`StandbyServer` is the follower process that promotes itself
   (per-shard I6 check against an independent on-disk WAL replay before
   serving, written to a ``promotion-*.json`` the chaos harness reads);
-  :class:`RouterServer` is the front-door process. The CLI wires these
-  behind ``start --shard-role router|shard|standby|supervisor``.
+  :class:`FollowerReadServer` is a follower's own front door (the read
+  plane: barriered follower reads + watch fan-out, standalone or
+  attached to a standby via ``--serve-reads``); :class:`RouterServer`
+  is the front-door process, optionally read-routing to follower doors
+  (``read_peers``). The CLI wires these behind ``start --shard-role
+  router|shard|standby|follower|supervisor``.
 
 Survivability contract (what ``chaos_soak --processes`` proves): after a
 literal ``SIGKILL`` of a shard leader mid-storm, the standby observes
@@ -75,6 +79,11 @@ from cron_operator_tpu.runtime.kube import (
     ServerTimeoutError,
 )
 from cron_operator_tpu.runtime.persistence import Persistence, RecoveredState
+from cron_operator_tpu.runtime.readroute import (
+    DEFAULT_BARRIER_TIMEOUT_S,
+    FollowerReadAPI,
+    FollowerReadClient,
+)
 from cron_operator_tpu.telemetry.trace import critical_path, stitch_trace
 from cron_operator_tpu.runtime.shard import (
     FollowerReplica,
@@ -925,12 +934,22 @@ class ShardClient(ClusterAPIServer):
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
         owner_uid: Optional[str] = None,
+        min_rv: Optional[int] = None,
+        consistency: Optional[str] = None,
     ) -> Tuple[List[Dict[str, Any]], str]:
         query: Dict[str, str] = {}
         if label_selector:
             query["labelSelector"] = ",".join(
                 f"{k}={v}" for k, v in sorted(label_selector.items())
             )
+        # Read-plane params: min_rv is the read-your-writes barrier a
+        # follower door blocks on (504 FollowerBehind on timeout);
+        # consistency=strong asks any read plane downstream to pin the
+        # read to the leader. Omitted → legacy wire shape, byte-for-byte.
+        if min_rv:
+            query["minResourceVersion"] = str(int(min_rv))
+        if consistency:
+            query["consistency"] = consistency
         result = self._request(
             "GET",
             self._resource_path(api_version, kind, namespace),
@@ -973,6 +992,28 @@ class ShardClient(ClusterAPIServer):
 
     def events(self, reason=None, involved_name=None) -> List[Any]:
         return []  # events live on the shard; not fanned in
+
+    def delete(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        propagation: str = "Background",
+    ) -> Optional[Dict[str, Any]]:
+        # The base client discards the response; return the Status body
+        # instead — a leader door stamps its committed rv on it, and the
+        # read plane needs that rv to barrier follower reads past the
+        # delete (read-your-writes covers deletions too).
+        return self._request(
+            "DELETE",
+            self._resource_path(api_version, kind, namespace, name),
+            body={
+                "kind": "DeleteOptions",
+                "apiVersion": "v1",
+                "propagationPolicy": propagation,
+            },
+        )
 
     # -- barriers: the shard's front door already enforced them ----------
 
@@ -1229,6 +1270,7 @@ class ShardServing:
             debug_routes=routes,
             tracer=tracer,
             trace_role="shard",
+            read_source="leader",
         )
         self.http.start()
 
@@ -1311,6 +1353,164 @@ class ShardServing:
             self.pers.close_shippers()
 
 
+class FollowerReadServer:
+    """A shard follower's HTTP front door: the read plane's serving half.
+
+    Binds an :class:`~runtime.apiserver_http.HTTPAPIServer`
+    (``read_source="follower"``, shared-encode watch hub and all) over a
+    :class:`FollowerReadAPI` facade on a WAL-shipped
+    :class:`FollowerReplica` — lists and watch streams are served from
+    the replica at local cost, writes answer 422, and
+    ``minResourceVersion`` reads block on the rv barrier (504
+    ``FollowerBehind`` past the bound).
+
+    Two attachments:
+
+    - **Standalone** (the ``follower`` CLI role, no ``replica`` passed):
+      owns its replica + :class:`ShipFollower` dialing the leader's ship
+      port. This role never promotes — it holds no lease — so its door
+      survives leader failover: the ship stream reconnects to whoever
+      serves the ship port next, the resync expires its watch streams
+      past the new bootstrap rv, and clients re-sync through the
+      existing 410 → re-list path. Scale reads by running more of
+      these.
+    - **Attached** (``StandbyServer(serve_reads=True)`` passes its
+      ``replica``/``follower``): the standby's replica serves double
+      duty. On promotion the door stays up — the replica store IS the
+      new leader's store, so its streams keep flowing (that is how an
+      attached door's watches survive the failover of its own process).
+
+    Every re-bootstrap after the first surfaces as a typed
+    ``follower_resync`` cluster event on this door's ``/debug/events``
+    (fanned in by the router), so a resync storm — flapping ship socket,
+    leader-side queue overflow — is diagnosable instead of silent."""
+
+    def __init__(
+        self,
+        shard_index: int,
+        leader_host: str = "127.0.0.1",
+        ship_port: int = 0,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        token: Optional[str] = None,
+        scheme: Optional[Scheme] = None,
+        clock: Optional[Clock] = None,
+        metrics: Optional[Any] = None,
+        tracer: Optional[Any] = None,
+        replica: Optional[FollowerReplica] = None,
+        follower: Optional[ShipFollower] = None,
+        barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
+    ):
+        from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
+        from cron_operator_tpu.telemetry import AuditJournal
+
+        self.shard_index = int(shard_index)
+        self.metrics = metrics
+        self.tracer = tracer
+        self._closed = False
+        self._owns_stream = replica is None
+        if self._owns_stream:
+            if tracer is not None:
+                tracer.set_proc(role="follower", shard=self.shard_index)
+            replica = FollowerReplica(
+                clock, name=f"follower-{self.shard_index}", tracer=tracer
+            )
+            follower = ShipFollower(
+                leader_host, ship_port, replica, metrics=metrics
+            )
+        self.replica = replica
+        self.follower = follower
+        self.audit = AuditJournal(shard=self.shard_index, metrics=metrics)
+        self.read_api = FollowerReadAPI(
+            replica, metrics=metrics, tracer=tracer,
+            barrier_timeout_s=barrier_timeout_s, shard=self.shard_index,
+        )
+        # Registered AFTER the read api's own listener, so by the time
+        # the event lands the hub has already been expired/re-subscribed
+        # — the event describes a completed resync, not one in flight.
+        replica.add_resync_listener(self._on_resync)
+        routes: Dict[str, Any] = {
+            "/debug/shards": lambda: {
+                "n_shards": 1,
+                "pid": os.getpid(),
+                "shards": [self.debug_doc()],
+            },
+            "/debug/events": self.debug_events,
+        }
+        if tracer is not None:
+            routes["/debug/traces"] = tracer.render_json
+        self.http = HTTPAPIServer(
+            api=self.read_api,
+            scheme=scheme or default_scheme(),
+            host=host,
+            port=port,
+            token=token,
+            metrics=metrics,
+            durable_writes=False,
+            debug_routes=routes,
+            tracer=tracer,
+            trace_role="shard",
+            read_source="follower",
+        )
+        self.http.start()
+
+    def _on_resync(self) -> None:
+        """Resync listener: surface a mid-stream re-bootstrap (socket
+        reconnect, ship queue overflow) as a typed cluster event. The
+        FIRST bootstrap of an owned stream is normal startup, not a
+        resync — at listener time ``ShipFollower.bootstraps`` is still 0
+        for it (the counter increments after ``resync`` returns)."""
+        if self._closed:
+            return
+        f = self.follower
+        if f is not None and f.bootstraps < 1:
+            return
+        self.audit.record(
+            "cluster", "follower_resync", shard=self.shard_index,
+            reason="ship stream re-bootstrap swapped the replica store",
+            bootstrap_rv=int(getattr(self.replica, "bootstrap_rv", 0)),
+            resyncs=int(getattr(self.replica, "resyncs", 0)),
+            reconnects=int(getattr(f, "reconnects", 0)) if f else 0,
+        )
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    def debug_doc(self) -> Dict[str, Any]:
+        doc: Dict[str, Any] = {
+            "shard": self.shard_index,
+            "role": "follower",
+            "pid": os.getpid(),
+            "alive": True,
+            "objects": len(self.replica.store),
+            "rv": int(getattr(self.replica.store, "_rv", 0)),
+            "reads": self.read_api.debug_doc(),
+        }
+        if self.follower is not None:
+            doc["follower"] = self.follower.stats()
+        return doc
+
+    def debug_events(
+        self, params: Optional[Dict[str, List[str]]] = None
+    ) -> str:
+        p = dict(params or {})
+        p["kind"] = ["cluster"]
+        return self.audit.render_json(p)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._owns_stream and self.follower is not None:
+            # Stream before door (the PR 13 clients-before-http shape):
+            # stop feeding the replica, then tear the streams down —
+            # the hub close flushes terminal chunks so follower-served
+            # watchers end cleanly instead of mid-frame.
+            self.follower.stop()
+        self.http.stop()
+        if self._owns_stream:
+            self.replica.store.close()
+
+
 class StandbyServer:
     """The standby process for one shard: a socket-fed replica plus a
     lease watcher. On lease expiry it self-promotes — per-shard I6
@@ -1336,6 +1536,9 @@ class StandbyServer:
         promote_ship_port: Optional[int] = None,
         fencing: bool = True,
         tracer: Optional[Any] = None,
+        serve_reads: bool = False,
+        read_port: int = 0,
+        barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
     ):
         self.shard_index = int(shard_index)
         self.data_dir = data_dir
@@ -1377,6 +1580,26 @@ class StandbyServer:
         )
         self.serving: Optional[ShardServing] = None
         self.promotion: Optional[Dict[str, Any]] = None
+        # --serve-reads: the standby's replica serves double duty as a
+        # read-plane follower door. Attached mode: the door borrows the
+        # replica/follower and stays up across promotion (the replica
+        # store becomes the new leader's store, so its streams and
+        # reads keep flowing through the failover).
+        self.read_door: Optional[FollowerReadServer] = None
+        if serve_reads:
+            self.read_door = FollowerReadServer(
+                self.shard_index,
+                host=leader_host,
+                port=read_port,
+                token=token,
+                scheme=self.scheme,
+                clock=self.clock,
+                metrics=metrics,
+                tracer=tracer,
+                replica=self.replica,
+                follower=self.follower,
+                barrier_timeout_s=barrier_timeout_s,
+            )
 
     def run(self, stop: threading.Event,
             max_wait_s: Optional[float] = None) -> Optional[Dict[str, Any]]:
@@ -1516,6 +1739,11 @@ class StandbyServer:
         return report
 
     def close(self) -> None:
+        # Read door first (clients-before-http shape): its hub close
+        # flushes terminal chunks to follower-served watchers before the
+        # store they ride on goes away below.
+        if self.read_door is not None:
+            self.read_door.close()
         self.follower.stop()
         if self.serving is not None:
             self.serving.close()
@@ -1529,7 +1757,18 @@ class RouterServer:
     consistent hash by ``shard_index``; cross-shard list/watch fan-in
     rides each client's streaming watch into the shared-encode hub;
     ``/debug/shards`` fans in every backend's self-report (pid,
-    liveness, follower lag)."""
+    liveness, follower lag).
+
+    ``read_peers`` (one endpoint list per shard, parallel to ``peers``)
+    turns on the read plane: that shard's client is wrapped in a
+    :class:`~runtime.readroute.FollowerReadClient` — collection reads
+    and watch subscriptions fan out round-robin across the follower
+    doors (each behind its own circuit breaker) with the router's
+    read-your-writes rv barrier stamped on, while writes and
+    ``consistency=strong`` reads keep riding the leader. A barrier
+    timeout or follower failure falls back to the leader and counts
+    ``follower_read_fallbacks_total``. Shards with no read peers keep
+    the plain client — behavior is unchanged unless opted in."""
 
     def __init__(
         self,
@@ -1546,6 +1785,7 @@ class RouterServer:
         request_timeout_s: Optional[float] = None,
         breaker_kwargs: Optional[Dict[str, Any]] = None,
         tracer: Optional[Any] = None,
+        read_peers: Optional[List[List[str]]] = None,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.runtime.shard import ShardRouter
@@ -1559,7 +1799,9 @@ class RouterServer:
         # The router's own journal holds cluster events it witnesses
         # (breaker flips); /debug/events merges it with every shard's.
         self.audit = AuditJournal(metrics=metrics)
-        self.clients: List[ShardClient] = []
+        # Per shard: a ShardClient, or its FollowerReadClient wrapper
+        # when the shard has read peers (same surface either way).
+        self.clients: List[Any] = []
         for i, peer in enumerate(peers):
             server = peer if "://" in peer else f"http://{peer}"
             client = ShardClient(
@@ -1576,6 +1818,26 @@ class RouterServer:
                         "cluster", f"breaker_{new}", shard=s,
                         reason=f"transition from {old}",
                     )
+                )
+            followers = (read_peers[i]
+                         if read_peers and i < len(read_peers) else None)
+            if followers:
+                fclients = []
+                for fpeer in followers:
+                    fserver = (fpeer if "://" in fpeer
+                               else f"http://{fpeer}")
+                    fclients.append(ShardClient(
+                        fserver, token=peer_token, scheme=self.scheme,
+                        clock=self.clock, shard=i,
+                        breaker=(CircuitBreaker(**(breaker_kwargs or {}))
+                                 if breakers else None),
+                        request_timeout_s=request_timeout_s,
+                        # No metrics: the per-shard breaker-state gauge
+                        # belongs to the leader client; follower
+                        # endpoint health shows up as fallback counts.
+                    ))
+                client = FollowerReadClient(
+                    client, fclients, shard=i, metrics=metrics,
                 )
             self.clients.append(client)
         self.router = ShardRouter(self.clients)
@@ -1616,6 +1878,9 @@ class RouterServer:
         for client in self.clients:
             breaker = (client.breaker.stats()
                        if client.breaker is not None else None)
+            read_plane = (client.read_stats()
+                          if isinstance(client, FollowerReadClient)
+                          else None)
             doc = client.debug_shards()
             if doc is None:
                 shards.append({
@@ -1624,14 +1889,35 @@ class RouterServer:
                     "pid": None,
                     "peer": client.config.server,
                     "breaker": breaker,
+                    "read_plane": read_plane,
                 })
-                continue
-            for entry in doc.get("shards") or [doc]:
-                entry = dict(entry)
-                entry.setdefault("shard", client.shard)
-                entry["peer"] = client.config.server
-                entry["breaker"] = breaker
-                shards.append(entry)
+            else:
+                for entry in doc.get("shards") or [doc]:
+                    entry = dict(entry)
+                    entry.setdefault("shard", client.shard)
+                    entry["peer"] = client.config.server
+                    entry["breaker"] = breaker
+                    entry["read_plane"] = read_plane
+                    shards.append(entry)
+            # Follower doors fan in too: their self-reports carry the
+            # read-plane freshness (read QPS, replay staleness, barrier
+            # waits) this document is the one-stop view of.
+            for fclient in getattr(client, "followers", []) or []:
+                fdoc = fclient.debug_shards()
+                if fdoc is None:
+                    shards.append({
+                        "shard": client.shard,
+                        "role": "follower",
+                        "alive": False,
+                        "pid": None,
+                        "peer": fclient.config.server,
+                    })
+                    continue
+                for entry in fdoc.get("shards") or [fdoc]:
+                    entry = dict(entry)
+                    entry.setdefault("shard", client.shard)
+                    entry["peer"] = fclient.config.server
+                    shards.append(entry)
         return {
             "n_shards": len(self.clients),
             "mode": "processes",
@@ -1652,11 +1938,15 @@ class RouterServer:
         if self.tracer is not None:
             span_lists.append(self.tracer.spans(trace_id))
         for client in self.clients:
-            doc = client.debug_traces(trace=trace_id)
-            if not doc:
-                continue
-            for t in doc.get("traces") or []:
-                span_lists.append(t.get("spans") or [])
+            # Leader first, then any follower doors: a barriered read's
+            # follower_wait span lives on the follower's tracer.
+            sources = [client] + list(getattr(client, "followers", []) or [])
+            for source in sources:
+                doc = source.debug_traces(trace=trace_id)
+                if not doc:
+                    continue
+                for t in doc.get("traces") or []:
+                    span_lists.append(t.get("spans") or [])
         stitched = stitch_trace(span_lists, trace_id)
         stitched["critical_path"] = critical_path(stitched["spans"])
         return stitched
@@ -1680,10 +1970,18 @@ class RouterServer:
         ]
         for client in self.clients:
             doc = client.debug_events(limit=limit)
-            if not doc:
-                continue
-            for r in doc.get("records") or []:
-                events.append(dict(r, source=f"shard-{client.shard}"))
+            if doc:
+                for r in doc.get("records") or []:
+                    events.append(dict(r, source=f"shard-{client.shard}"))
+            # Follower doors carry the follower_resync events.
+            for j, fclient in enumerate(
+                    getattr(client, "followers", []) or []):
+                fdoc = fclient.debug_events(limit=limit)
+                if not fdoc:
+                    continue
+                for r in fdoc.get("records") or []:
+                    events.append(dict(
+                        r, source=f"follower-{client.shard}.{j}"))
         events.sort(key=lambda r: r.get("ts") or 0)
         if limit >= 0:
             events = events[-limit:]
@@ -1724,5 +2022,6 @@ __all__ = [
     "ShardClient",
     "ShardServing",
     "StandbyServer",
+    "FollowerReadServer",
     "RouterServer",
 ]

@@ -3012,6 +3012,12 @@ def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
     before it serves, from its ``promotion-*.json``), the storm's writes
     must survive via retry, and every generation that shuts down
     gracefully must prove I9 (audit ≡ WAL) in its ``audit-check`` file.
+
+    Every standby also binds a follower read door (``--serve-reads``):
+    it must keep serving bounded-stale lists through the dark window
+    between ``kill -9`` and promotion, and after promotion the same
+    door — now fronting the leader store in the promoted process — must
+    agree exactly with the promoted front door.
     """
     import random
     import signal as _signal
@@ -3051,12 +3057,19 @@ def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
             "--ship-port", str(base + 64 + si),
         ], f"shard-{si}-leader")
 
+    def read_door_port(si: int, gen: int) -> int:
+        # A promoted standby keeps its read door bound for the rest of
+        # the soak, so each generation needs its own door port; 16 per
+        # shard covers any sane --rounds.
+        return base + 96 + si * 16 + (gen % 16)
+
     def spawn_standby(si: int, gen: int) -> subprocess.Popen:
         return spawn([
             "--shard-role", "standby", "--shard-index", str(si),
             "--data-dir", data_dir,
             "--serve-api", f"127.0.0.1:{base + 1 + si}",
             "--ship-port", str(base + 64 + si),
+            "--serve-reads", str(read_door_port(si, gen)),
         ], f"shard-{si}-standby-{gen}")
 
     def debug_doc(port: int, timeout: float = 1.0):
@@ -3079,6 +3092,7 @@ def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
 
     serving: dict = {}   # shard -> its current serving Popen
     standbys: dict = {}  # shard -> its current standby Popen
+    doors: dict = {}     # shard -> current standby's read-door port
     everyone: list = []
     for si in range(shards):
         serving[si] = spawn_leader(si)
@@ -3088,6 +3102,7 @@ def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
         assert doc is not None, f"shard {si} never served"
     for si in range(shards):
         standbys[si] = spawn_standby(si, 0)
+        doors[si] = read_door_port(si, 0)
         everyone.append(standbys[si])
     router = spawn([
         "--shard-role", "router",
@@ -3097,6 +3112,22 @@ def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
     ], "router")
     everyone.append(router)
     assert wait_serving(base, 30.0) is not None, "router never served"
+
+    for si in range(shards):
+        assert wait_serving(doors[si], 30.0) is not None, (
+            f"shard {si} standby read door never served")
+
+    def door_names(port: int):
+        """LIST at a follower read door; the door serves from its
+        WAL-shipped replica with no leader round-trip."""
+        c = ShardClient(f"http://127.0.0.1:{port}")
+        try:
+            return {o["metadata"]["name"]
+                    for o in c.list(CRON_API_VERSION, "Cron")}
+        except Exception:
+            return None
+        finally:
+            c.close()
 
     client = ShardClient(f"http://127.0.0.1:{base}")
     expected: dict = {}  # name -> True (live crons by the storm's book)
@@ -3183,6 +3214,11 @@ def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
             t_kill = time.monotonic()
             serving[victim].wait(timeout=10)
 
+            # Dark window: the leader is gone, promotion has not landed
+            # yet — the victim's follower read door must keep serving
+            # (bounded-stale) lists from its replica the whole time.
+            dark_reads = door_names(doors[victim])
+
             # The storm keeps going while the standby promotes: writes
             # to other shards proceed; victim-shard writes retry.
             for op, name in ops[12:]:
@@ -3206,12 +3242,32 @@ def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
             with open(prom_path) as f:
                 promotion = json.load(f)
 
+            # The read door the promoted standby brought with it now
+            # fronts the LEADER store (same process, same port) — it
+            # must still serve, and must agree exactly with the
+            # promoted front door at this quiet instant.
+            promoted_door = doors[victim]
+            door_after = door_names(promoted_door)
+            leader_after = door_names(base + 1 + victim)
+            door = {
+                "port": promoted_door,
+                "dark_window_reads": (len(dark_reads)
+                                      if dark_reads is not None else None),
+                "dark_window_ok": dark_reads is not None,
+                "survived_promotion": door_after is not None,
+                "matches_promoted_leader": (
+                    door_after is not None and door_after == leader_after),
+            }
+
             # The promoted process is the new leader; arm a fresh
             # standby behind it (spawned only now — two armed standbys
             # would race each other to the same ports).
             serving[victim] = standbys[victim]
             standbys[victim] = spawn_standby(victim, r + 1)
+            doors[victim] = read_door_port(victim, r + 1)
             everyone.append(standbys[victim])
+            assert wait_serving(doors[victim], 30.0) is not None, (
+                f"round {r}: fresh standby read door never served")
 
             kills.append({
                 "round": r,
@@ -3225,11 +3281,14 @@ def run_process_soak(seed: int, n_crons: int, rounds: int, shards: int,
                     promotion["replica_matched_socket"]),
                 "objects": promotion["objects"],
                 "rv": promotion["rv"],
+                "read_door": door,
             })
             print(
                 f"  round {r}: SIGKILL shard {victim} pid {victim_pid} "
                 f"-> promoted pid {promoted_pid} in {failover_s:.2f}s "
-                f"(i6_ok={promotion['i6_ok']})",
+                f"(i6_ok={promotion['i6_ok']}, "
+                f"door dark_ok={door['dark_window_ok']} "
+                f"post_ok={door['matches_promoted_leader']})",
                 flush=True,
             )
 
@@ -3346,11 +3405,29 @@ def check_process_invariants(ev: dict) -> dict:
             "bound_s": 15.0,
         },
     }
+    door_rounds = [k.get("read_door") or {} for k in kills]
+    bad_doors = [
+        {"round": k["round"], "door": d}
+        for k, d in zip(kills, door_rounds)
+        if not (d.get("dark_window_ok") and d.get("survived_promotion")
+                and d.get("matches_promoted_leader"))
+    ]
+    follower_reads = {
+        "ok": bool(kills) and not bad_doors,
+        "detail": (
+            f"{len(kills)} round(s): every standby read door served "
+            "through the kill->promotion dark window and, post-"
+            "promotion, agreed exactly with the promoted front door"
+            if kills and not bad_doors
+            else {"kill_rounds": len(kills), "failed": bad_doors}
+        ),
+    }
     return {
         "I6_recovered_equals_wal_replay": i6,
         "I9_audit_equals_wal": i9,
         "surface_consistent": surface,
         "failover_bounded": bounded,
+        "follower_reads_across_promotion": follower_reads,
     }
 
 

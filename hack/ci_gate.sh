@@ -166,6 +166,17 @@ echo "==> HTTP front-door smoke (fan-out encode-once, group-commit, APF fairness
 # (updates BENCH_HTTP.json; BASELINE=<ref> adds the >= 5x fan-out A/B).
 python hack/http_bench.py --check --stdout >/dev/null
 
+echo "==> follower-read smoke (rv barriers, leader fallback, watch across kill -9)"
+# Mechanism-only asserts for the follower read plane: a barriered read
+# against a lagging replica must block and resume exactly at the
+# barrier rv (timeout -> 504 FollowerBehind -> counted leader
+# fallback), write-then-list through the router must never observe the
+# pre-write state, and a follower-served watch stream must deliver the
+# full event sequence across a kill -9 promotion. Capacity RATIOS
+# (>= 3x per replica, writes within 5%) stay full-run claims:
+# make bench-http (follower_fanout leg of BENCH_HTTP.json).
+python -m pytest tests/test_follower_reads.py -q
+
 echo "==> fleet scheduler smoke (makespan A/B, fairness, p50, zero-write)"
 # Small-size run of the fleet bench (hack/fleet_bench.py): a 600-job
 # storm over the mixed v5e/v4/cpu pool must beat the FIFO/first-fit

@@ -39,6 +39,14 @@ and ``http.client``, not mocks:
   in from the shards, and the routed closed-loop durable-create
   aggregate must stay within 20% of the shared-nothing sum (the same
   load driven directly at every shard concurrently, rates summed).
+- **follower fan-out**: one shard leader, R follower read doors over
+  its WAL ship, and the router fronting all of them. Per-door LIST and
+  watch capacity is measured in isolation and summed (single-core
+  host — see the leg docstring), gated at >= R x the leader-only door;
+  1k write-then-list pairs through the router must see zero stale
+  reads (rv barriers); the leader's durable write rate with replicas
+  attached and point-read trickle live must hold within 5% of its
+  no-replica baseline.
 
 Writes ``BENCH_HTTP.json`` with per-scenario OK/REGRESSION verdicts and
 an overall verdict; ``--check`` exits non-zero on REGRESSION and is the
@@ -83,6 +91,13 @@ FAIRNESS_P99_FLOOR_MS = 2.0
 # the noisy tenant must land at least this many requests per quiet one.
 FAIRNESS_MIN_RATE_RATIO = 50.0
 FANOUT_MIN_SPEEDUP = 5.0
+# Follower read plane: with R added replicas the read path's aggregate
+# capacity (leader door + R follower doors, each measured at full tilt)
+# must clear R x the leader-only door, and the leader's durable write
+# throughput must stay within this tolerance of its no-replica baseline
+# while the doors serve reads.
+FOLLOWER_MIN_READ_SCALE = 3.0
+FOLLOWER_WRITE_TOLERANCE = 0.05
 
 
 def _cron(name: str, schedule: str = "@every 1h") -> dict:
@@ -143,11 +158,11 @@ def _git_ref(tree: str) -> str:
 # Scenario 1: watch fan-out
 # ---------------------------------------------------------------------------
 
-def _open_watch_socket(host: str, port: int) -> socket.socket:
+def _open_watch_socket(host: str, port: int, rv: int = 0) -> socket.socket:
     s = socket.create_connection((host, port), timeout=30)
     req = (
         f"GET /apis/{CRON_AV}/namespaces/default/crons"
-        f"?watch=true&resourceVersion=0 HTTP/1.1\r\n"
+        f"?watch=true&resourceVersion={rv} HTTP/1.1\r\n"
         f"Host: {host}\r\nAuthorization: Bearer {TOKEN}\r\n\r\n"
     )
     s.sendall(req.encode())
@@ -739,17 +754,22 @@ def _drive_creates(host: str, port: int, names, threads_n: int, errors):
 
 
 def _routed_watch(host: str, port: int, watchers: int, events: int,
-                  names, timeout_s: float) -> dict:
-    """W watch streams on the ROUTER's front door; E creates spread
-    across the shard processes underneath. Every frame crosses two
-    sockets (shard -> router watch stream -> hub -> client) and must
-    still arrive exactly once per watcher."""
+                  names, timeout_s: float, rv: int = 0,
+                  write_port: int | None = None) -> dict:
+    """W watch streams on one front door; E creates driven at
+    ``write_port`` (default: the same door). Streams attach at ``rv``
+    so non-empty stores replay no backlog and the expected frame count
+    stays exactly ``watchers * events``. On the router every frame
+    crosses two sockets (shard -> router watch stream -> hub -> client)
+    and must still arrive exactly once per watcher; on a follower door
+    it additionally rides the WAL ship hop first."""
     import http.client
 
     socks = []
     t0 = time.perf_counter()
     try:
-        pairs = [_open_watch_socket(host, port) for _ in range(watchers)]
+        pairs = [_open_watch_socket(host, port, rv=rv)
+                 for _ in range(watchers)]
         socks = [s for s, _ in pairs]
         establish_s = time.perf_counter() - t0
 
@@ -760,7 +780,9 @@ def _routed_watch(host: str, port: int, watchers: int, events: int,
             sel.register(s, selectors.EVENT_READ,
                          carry[-(len(ADDED_MARKER) - 1):])
 
-        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn = http.client.HTTPConnection(
+            host, write_port if write_port is not None else port,
+            timeout=30)
         path = f"/apis/{CRON_AV}/namespaces/default/crons"
         expected = watchers * events
         delivered = sum(counts.values())
@@ -997,6 +1019,417 @@ def _distributed_verdict(leg: dict, check_mode: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Follower read plane (leader + R follower doors behind the router)
+# ---------------------------------------------------------------------------
+
+def _closed_loop_list(host: str, port: int, duration_s: float,
+                      conns: int, errors) -> dict:
+    """Closed-loop full-collection LIST drive: ``conns`` keep-alive
+    connections GET the crons list as fast as 200s come back for
+    ``duration_s``. Returns the sustained lists/s of ONE front door."""
+    import http.client
+
+    path = f"/apis/{CRON_AV}/namespaces/default/crons"
+    done = [0] * conns
+    gate = threading.Barrier(conns + 1)
+    deadline_box: list = [0.0]
+
+    def worker(idx: int) -> None:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            gate.wait()
+            while time.perf_counter() < deadline_box[0]:
+                conn.request("GET", path, headers={
+                    "Authorization": f"Bearer {TOKEN}"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 200:
+                    done[idx] += 1
+                else:
+                    errors.append(f"list@{port}: HTTP {resp.status}")
+        except Exception as exc:  # pragma: no cover — surfaced in artifact
+            errors.append(f"list@{port}: {exc!r}")
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(conns)]
+    for t in threads:
+        t.start()
+    gate.wait()
+    t0 = time.perf_counter()
+    deadline_box[0] = t0 + duration_s
+    for t in threads:
+        t.join(timeout=duration_s + 60.0)
+    elapsed = time.perf_counter() - t0
+    total = sum(done)
+    return {
+        "lists": total,
+        "elapsed_s": round(elapsed, 3),
+        "lists_per_s": round(total / elapsed, 1) if elapsed else 0.0,
+    }
+
+
+def follower_fanout_leg(replicas: int, fleet: int, pairs: int,
+                        watchers: int, events: int, list_secs: float,
+                        write_creates: int, timeout_s: float) -> dict:
+    """Spawn the follower read plane as real processes — one shard
+    leader, ``replicas`` socket-fed follower doors over its WAL ship,
+    and the router fronting all of them — then measure the scale-out
+    claim three ways:
+
+    - **read capacity**: closed-loop LISTs and full watch fan-out
+      delivery, each front door measured AT FULL TILT IN ISOLATION and
+      the rates summed. This host has one CPU core, so driving all
+      doors concurrently can never show aggregate scaling — capacity
+      per endpoint is the honest unit; the sum is what a multi-core
+      deployment buys. Gate: (leader + sum of followers) >=
+      ``FOLLOWER_MIN_READ_SCALE`` x leader alone, for lists and for
+      delivered watch events/s.
+    - **read-your-writes**: ``pairs`` write-then-list pairs through the
+      router; every list must contain the cron the immediately
+      preceding write created (rv barrier, not luck). Gate: zero stale
+      reads, and the follower plane (not leader fallback) serves the
+      bulk of them.
+    - **leader write cost**: the leader's closed-loop durable create
+      rate with the replicas attached and a paced point-read trickle at
+      every follower door must stay within
+      ``FOLLOWER_WRITE_TOLERANCE`` of its no-replica baseline.
+    """
+    import http.client
+    import shutil as _shutil
+    import signal as _signal
+    import urllib.parse
+    import urllib.request
+
+    data_dir = tempfile.mkdtemp(prefix="httpbench-follower-")
+    log_dir = os.path.join(data_dir, "logs")
+    os.makedirs(log_dir)
+    base = 25480 + (os.getpid() % 13) * 64
+    leader_api = base + 1
+    leader_ship = base + 51
+    follower_ports = [base + 11 + i for i in range(replicas)]
+    procs: list = []
+    errors: list = []
+    leg: dict = {"replicas": replicas, "port_base": base,
+                 "spawn_ok": False}
+    list_path = f"/apis/{CRON_AV}/namespaces/default/crons"
+
+    def spawn(role_args, tag):
+        log = open(os.path.join(log_dir, f"{tag}.log"), "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m", "cron_operator_tpu.cli.main", "start",
+             "--health-probe-bind-address", "0",
+             "--serve-api-token", TOKEN] + role_args,
+            stdout=log, stderr=subprocess.STDOUT, cwd=_TREE,
+        )
+
+    def get_json(port, path, timeout=5.0):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            headers={"Authorization": f"Bearer {TOKEN}"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def debug_doc(port, timeout=1.0):
+        try:
+            return get_json(port, "/debug/shards", timeout=timeout)
+        except Exception:
+            return None
+
+    def wait_serving(port, deadline_s):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            doc = debug_doc(port)
+            if doc is not None:
+                return doc
+            time.sleep(0.05)
+        return None
+
+    def collection_rv(port) -> int:
+        doc = get_json(port, list_path)
+        return int(doc.get("metadata", {}).get("resourceVersion", 0) or 0)
+
+    def follower_rv(port) -> int:
+        doc = debug_doc(port)
+        try:
+            return int(doc["shards"][0]["rv"])
+        except (TypeError, KeyError, IndexError, ValueError):
+            return -1
+
+    def wait_caught_up(ports, min_rv, deadline_s) -> bool:
+        deadline = time.monotonic() + deadline_s
+        pending = list(ports)
+        while pending and time.monotonic() < deadline:
+            pending = [p for p in pending if follower_rv(p) < min_rv]
+            if pending:
+                time.sleep(0.02)
+        return not pending
+
+    def write_best_of(prefix, rounds, threads_n, port) -> dict:
+        """Best-of-N closed-loop create rounds: the max rate of the
+        rounds, so one scheduler hiccup on this single-core host does
+        not poison a 5% comparison."""
+        rates = []
+        for r in range(rounds):
+            names = [f"{prefix}{r}-{j}" for j in range(write_creates)]
+            completed, elapsed = _drive_creates(
+                "127.0.0.1", port, names, threads_n, errors)
+            if completed != len(names):
+                errors.append(
+                    f"{prefix}{r}: {completed}/{len(names)} completed")
+            rates.append(round(completed / elapsed, 1) if elapsed else 0.0)
+        return {"rounds": rates, "writes_per_s": max(rates)}
+
+    try:
+        procs.append(spawn([
+            "--shard-role", "shard", "--shard-index", "0",
+            "--data-dir", data_dir,
+            "--serve-api", f"127.0.0.1:{leader_api}",
+            "--ship-port", str(leader_ship),
+        ], "leader"))
+        if wait_serving(leader_api, 30.0) is None:
+            raise RuntimeError("leader shard never served")
+
+        # Phase 1: leader write baseline with NO replicas attached.
+        leg["write_alone"] = write_best_of("fwa", 2, 4, leader_api)
+
+        # Phase 2: follower doors over the leader's WAL ship.
+        for i, fport in enumerate(follower_ports):
+            procs.append(spawn([
+                "--shard-role", "follower", "--shard-index", "0",
+                "--ship-port", str(leader_ship),
+                "--serve-api", f"127.0.0.1:{fport}",
+            ], f"follower-{i}"))
+        for fport in follower_ports:
+            if wait_serving(fport, 30.0) is None:
+                raise RuntimeError(f"follower :{fport} never served")
+        if not wait_caught_up(follower_ports, collection_rv(leader_api),
+                              30.0):
+            raise RuntimeError("followers never replayed the bootstrap")
+
+        # Phase 3: router fronting the leader, read plane fanned out.
+        procs.append(spawn([
+            "--shard-role", "router",
+            "--serve-api", f"127.0.0.1:{base}",
+            "--peers", f"127.0.0.1:{leader_api}",
+            "--read-peers", ",".join(f"127.0.0.1:{p}"
+                                     for p in follower_ports),
+        ], "router"))
+        if wait_serving(base, 30.0) is None:
+            raise RuntimeError("router never served")
+        leg["spawn_ok"] = True
+
+        # Phase 4: seed a fleet through the router so capacity phases
+        # list/watch a realistically sized collection.
+        fleet_names = [f"ffleet-{j}" for j in range(fleet)]
+        completed, elapsed = _drive_creates(
+            "127.0.0.1", base, fleet_names, 4, errors)
+        leg["fleet"] = {"size": completed,
+                        "elapsed_s": round(elapsed, 3)}
+
+        # Phase 5: read-your-writes — write through the router, list
+        # through the router, every pair must see its own write.
+        doc_before = debug_doc(base, timeout=5.0)
+        stale = 0
+        conn = http.client.HTTPConnection("127.0.0.1", base, timeout=30)
+        t0 = time.perf_counter()
+        try:
+            for i in range(pairs):
+                name = f"fpair-{i}"
+                obj = _cron(name, schedule=DIST_SCHEDULE)
+                obj["metadata"]["labels"] = {"bench-pair": f"p{i}"}
+                status = _post_json(conn, list_path, obj)
+                if status != 201:
+                    errors.append(f"{name}: HTTP {status}")
+                    continue
+                sel = urllib.parse.quote(f"bench-pair=p{i}")
+                conn.request(
+                    "GET", f"{list_path}?labelSelector={sel}",
+                    headers={"Authorization": f"Bearer {TOKEN}"})
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    errors.append(f"list {name}: HTTP {resp.status}")
+                    stale += 1
+                    continue
+                items = json.loads(body).get("items", [])
+                if not any(it.get("metadata", {}).get("name") == name
+                           for it in items):
+                    stale += 1
+        finally:
+            conn.close()
+        ryw_elapsed = time.perf_counter() - t0
+        doc_after = debug_doc(base, timeout=5.0)
+
+        def _plane(doc):
+            for sh in (doc or {}).get("shards", []):
+                if isinstance(sh.get("read_plane"), dict):
+                    return sh["read_plane"]
+            return {}
+
+        before_f = int(_plane(doc_before).get("reads_follower", 0) or 0)
+        after_plane = _plane(doc_after)
+        reads_follower = int(after_plane.get("reads_follower", 0) or 0) \
+            - before_f
+        leg["read_your_writes"] = {
+            "pairs": pairs,
+            "stale": stale,
+            "elapsed_s": round(ryw_elapsed, 3),
+            "pairs_per_s": round(pairs / ryw_elapsed, 1)
+            if ryw_elapsed else 0.0,
+            "served_by_follower": reads_follower,
+            "follower_share": round(reads_follower / pairs, 3)
+            if pairs else None,
+            "read_plane": after_plane,
+        }
+
+        # Phase 6: LIST capacity per front door, sequentially (see
+        # docstring: single-core host, so isolation-then-sum is the
+        # honest aggregate).
+        lists: dict = {"leader": _closed_loop_list(
+            "127.0.0.1", leader_api, list_secs, 2, errors)}
+        for i, fport in enumerate(follower_ports):
+            lists[f"follower-{i}"] = _closed_loop_list(
+                "127.0.0.1", fport, list_secs, 2, errors)
+        leader_lps = lists["leader"]["lists_per_s"]
+        agg_lps = round(sum(d["lists_per_s"] for d in lists.values()), 1)
+        leg["list_capacity"] = {
+            "per_endpoint": lists,
+            "aggregate_lists_per_s": agg_lps,
+            "scale": round(agg_lps / leader_lps, 2) if leader_lps else 0.0,
+        }
+
+        # Phase 7: watch fan-out capacity per front door. Events are
+        # always written at the LEADER (follower doors receive them via
+        # the WAL ship); each door must deliver every frame to every
+        # watcher. Streams attach at the door's current rv so the frame
+        # count is exact; the door is first waited level with the
+        # leader so no earlier phase's tail inflates it.
+        watch: dict = {}
+        rv = collection_rv(leader_api)
+        watch["leader"] = _routed_watch(
+            "127.0.0.1", leader_api, watchers, events,
+            [f"fev-l-{j}" for j in range(events)], timeout_s, rv=rv)
+        for i, fport in enumerate(follower_ports):
+            if not wait_caught_up([fport], collection_rv(leader_api),
+                                  20.0):
+                errors.append(f"follower-{i} lagged before watch phase")
+            watch[f"follower-{i}"] = _routed_watch(
+                "127.0.0.1", fport, watchers, events,
+                [f"fev-{i}-{j}" for j in range(events)], timeout_s,
+                rv=follower_rv(fport), write_port=leader_api)
+        leader_eps = watch["leader"]["events_per_s"]
+        agg_eps = round(sum(d["events_per_s"] for d in watch.values()), 1)
+        leg["watch_capacity"] = {
+            "per_endpoint": watch,
+            "aggregate_events_per_s": agg_eps,
+            "scale": round(agg_eps / leader_eps, 2) if leader_eps else 0.0,
+            "timed_out": any(d["timed_out"] for d in watch.values()),
+        }
+
+        # Phase 8: leader write rate with the replicas attached and a
+        # paced point-read trickle live at every follower door.
+        stop = threading.Event()
+        trickle_ms: list = []
+        trickle_threads = [
+            threading.Thread(
+                target=_paced_get,
+                args=("127.0.0.1", fport, f"{list_path}/ffleet-0",
+                      TOKEN, 100000, 0.1, trickle_ms, stop))
+            for fport in follower_ports
+        ]
+        for t in trickle_threads:
+            t.start()
+        try:
+            leg["write_with_replicas"] = write_best_of(
+                "fww", 2, 4, leader_api)
+        finally:
+            stop.set()
+            for t in trickle_threads:
+                t.join(timeout=30.0)
+        alone = leg["write_alone"]["writes_per_s"]
+        with_r = leg["write_with_replicas"]["writes_per_s"]
+        leg["write_ratio"] = round(with_r / alone, 3) if alone else None
+        leg["trickle_reads"] = len(trickle_ms)
+
+        leg["methodology"] = (
+            "single-core host: each front door's read capacity is "
+            "measured in isolation and the aggregate is the sum — "
+            "concurrent aggregate scaling needs at least one core per "
+            "endpoint, which this box cannot exhibit")
+        leg["errors"] = errors[:5]
+        leg["errors_total"] = len(errors)
+        leg["debug_router"] = doc_after
+        leg["debug_followers"] = [debug_doc(p) for p in follower_ports]
+    except Exception as exc:
+        leg["error"] = repr(exc)
+        leg.setdefault("errors", errors[:5])
+        leg.setdefault("errors_total", len(errors))
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(_signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + 20.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        _shutil.rmtree(data_dir, ignore_errors=True)
+    return leg
+
+
+def _follower_fanout_verdict(leg: dict, check_mode: bool) -> dict:
+    ryw = leg.get("read_your_writes") or {}
+    lists = leg.get("list_capacity") or {}
+    watch = leg.get("watch_capacity") or {}
+    ratio = leg.get("write_ratio")
+    stale = ryw.get("stale")
+    share = ryw.get("follower_share")
+    mech_ok = (leg.get("spawn_ok") and "error" not in leg
+               and leg.get("errors_total", 1) == 0
+               and stale == 0
+               and not watch.get("timed_out", True)
+               and ryw.get("served_by_follower", 0) >= 1)
+    if check_mode:
+        # Smoke: gate the mechanism (plane up, rv barriers hold — zero
+        # stale read-your-writes pairs, full watch delivery at every
+        # door, at least one follower-served read); capacity scale and
+        # the write tolerance are reported, not gated.
+        ok = bool(mech_ok)
+        gate = "mechanism only (--check)"
+    else:
+        ok = bool(mech_ok
+                  and (lists.get("scale") or 0) >= FOLLOWER_MIN_READ_SCALE
+                  and (watch.get("scale") or 0) >= FOLLOWER_MIN_READ_SCALE
+                  and share is not None and share >= 0.8
+                  and ratio is not None
+                  and abs(ratio - 1.0) <= FOLLOWER_WRITE_TOLERANCE)
+        gate = (f"scale >= {FOLLOWER_MIN_READ_SCALE}, write ratio "
+                f"within {FOLLOWER_WRITE_TOLERANCE:.0%}")
+    return {
+        "status": "OK" if ok else "REGRESSION",
+        "list_scale": lists.get("scale"),
+        "watch_scale": watch.get("scale"),
+        "write_ratio": ratio,
+        "stale_reads": stale,
+        "summary": (
+            f"{'OK' if ok else 'REGRESSION'}: follower read plane "
+            f"({leg.get('replicas')} replicas) lists x{lists.get('scale')} "
+            f"watch x{watch.get('scale')} vs leader alone (gate {gate}); "
+            f"{stale} stale of {ryw.get('pairs')} write-then-read pairs "
+            f"through the router ({ryw.get('served_by_follower')} "
+            f"follower-served); leader writes with replicas+read trickle "
+            f"at {ratio} of baseline"
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Baseline A/B (fan-out only: the one scenario the old server can run)
 # ---------------------------------------------------------------------------
 
@@ -1101,6 +1534,21 @@ def main() -> int:
     p.add_argument("--dist-watchers", type=int, default=200)
     p.add_argument("--dist-events", type=int, default=10)
     p.add_argument("--dist-timeout", type=float, default=120.0)
+    p.add_argument("--follower-replicas", type=int, default=3)
+    p.add_argument("--follower-fleet", type=int, default=150,
+                   help="crons seeded before the follower capacity "
+                        "phases so lists/watches see a real collection")
+    p.add_argument("--follower-pairs", type=int, default=1000,
+                   help="write-then-list read-your-writes pairs driven "
+                        "through the router (gate: zero stale)")
+    p.add_argument("--follower-watchers", type=int, default=100)
+    p.add_argument("--follower-events", type=int, default=25)
+    p.add_argument("--follower-list-secs", type=float, default=4.0,
+                   help="closed-loop LIST drive per front door")
+    p.add_argument("--follower-write-creates", type=int, default=300,
+                   help="creates per write round in the leader "
+                        "write-cost comparison")
+    p.add_argument("--follower-timeout", type=float, default=180.0)
     p.add_argument("--stdout", action="store_true",
                    help="print the artifact JSON to stdout only")
     p.add_argument("--check", action="store_true",
@@ -1122,6 +1570,12 @@ def main() -> int:
         args.dist_creates = 10
         args.dist_watchers = 25
         args.dist_events = 5
+        args.follower_fleet = 40
+        args.follower_pairs = 60
+        args.follower_watchers = 25
+        args.follower_events = 5
+        args.follower_list_secs = 1.0
+        args.follower_write_creates = 60
 
     if args.role == "fanout-only":
         result = fanout_leg(args.watchers, args.events, args.fanout_timeout)
@@ -1149,6 +1603,12 @@ def main() -> int:
         args.dist_shards, args.dist_writers, args.dist_creates,
         args.dist_watchers, args.dist_events, args.dist_timeout)
     distributed_v = _distributed_verdict(distributed, args.check)
+    follower = follower_fanout_leg(
+        args.follower_replicas, args.follower_fleet, args.follower_pairs,
+        args.follower_watchers, args.follower_events,
+        args.follower_list_secs, args.follower_write_creates,
+        args.follower_timeout)
+    follower_v = _follower_fanout_verdict(follower, args.check)
 
     verdicts = {
         "fanout": fanout_v,
@@ -1156,6 +1616,7 @@ def main() -> int:
         "fairness": fairness["verdict"],
         "zero_steady_state": writes["zero_steady_state"]["verdict"],
         "distributed": distributed_v,
+        "follower_fanout": follower_v,
     }
     ok = all(v["status"] == "OK" for v in verdicts.values())
     artifact = {
@@ -1167,6 +1628,8 @@ def main() -> int:
         "fairness": fairness,
         "distributed": distributed,
         "distributed_verdict": distributed_v,
+        "follower_fanout": follower,
+        "follower_fanout_verdict": follower_v,
         "verdict": {
             "status": "OK" if ok else "REGRESSION",
             "summary": "; ".join(v["summary"] for v in verdicts.values()),

@@ -1,0 +1,559 @@
+"""Follower read plane (runtime/readroute.py + transport role runners).
+
+The read-path scaling seams:
+
+- rv barriers under injected follower lag: a barriered read against a
+  stalled replica BLOCKS, resumes exactly when the replayed rv catches
+  up, and a barrier that times out surfaces as HTTP 504
+  ``FollowerBehind`` — which the router's read plane converts into a
+  counted leader fallback (``reason="lag"``);
+- read-your-writes through the router: a write proxied by the router
+  stamps its committed rv onto every subsequent follower read, so
+  write-then-list through the front door can never observe the
+  pre-write state, without the client sending any rv itself;
+- ``consistency=strong`` pins reads to the leader (the escape hatch in
+  the documented consistency model);
+- a mid-stream ship re-bootstrap (socket reconnect) re-syncs attached
+  watch streams via the per-kind 410 → re-list machinery — no silently
+  dropped events — and surfaces as a typed
+  ``cluster_events_total{event="follower_resync"}`` event;
+- a follower-served watch stream delivers the same event set as the
+  leader's across a ``kill -9`` promotion (the standby's attached read
+  door stays up while its replica store becomes the new leader store);
+- teardown ordering: router stops before the follower door, door
+  before the leader serving — no ERROR logs (the PR 13 de-flake shape).
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import unittest
+import urllib.request
+
+from cron_operator_tpu.api.scheme import GVK_JAXJOB
+from cron_operator_tpu.runtime.kube import (
+    APIServer,
+    FollowerBehindError,
+    InvalidError,
+)
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.runtime.readroute import (
+    READ_CONSISTENCY,
+    FollowerReadAPI,
+    FollowerReadClient,
+)
+from cron_operator_tpu.runtime.shard import FollowerReplica
+from cron_operator_tpu.runtime.transport import (
+    FollowerReadServer,
+    RouterServer,
+    ShardClient,
+    ShardServing,
+    WALShipServer,
+)
+from cron_operator_tpu.runtime.persistence import Persistence
+from cron_operator_tpu.utils.clock import FakeClock, RealClock
+
+WORKLOAD_API_VERSION = "kubeflow.org/v1"
+WORKLOAD_KIND = "JAXJob"
+
+
+def _obj(name: str, ns: str = "default", labels=None) -> dict:
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = dict(labels)
+    return {
+        "apiVersion": WORKLOAD_API_VERSION,
+        "kind": WORKLOAD_KIND,
+        "metadata": meta,
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+    }
+
+
+def _wait(predicate, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _feed(replica: FollowerReplica, rv: int, name: str) -> None:
+    """Apply one WAL put record to a manually-fed replica (the unit
+    analog of one shipped flush). The object carries the leader-assigned
+    resourceVersion — replicate_put mints nothing."""
+    obj = _obj(name)
+    obj["metadata"]["resourceVersion"] = rv
+    replica.apply_bytes(
+        json.dumps({"op": "put", "rv": rv, "obj": obj}).encode() + b"\n"
+    )
+
+
+class TestRvBarrier(unittest.TestCase):
+    """wait_min_rv over a real front door: block, resume at rv, 504."""
+
+    def setUp(self):
+        self.replica = FollowerReplica(RealClock(), name="barrier-test")
+        self.metrics = Metrics()
+        self.read_api = FollowerReadAPI(
+            self.replica, metrics=self.metrics, barrier_timeout_s=0.25
+        )
+        from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
+        self.http = HTTPAPIServer(
+            api=self.read_api, durable_writes=False, read_source="follower"
+        )
+        self.http.start()
+        self.addCleanup(self.http.stop)
+        self.client = ShardClient(f"http://127.0.0.1:{self.http.port}")
+        self.addCleanup(self.client.close)
+
+    def test_satisfied_barrier_is_fast_path(self):
+        _feed(self.replica, 1, "w-0")
+        items, rv = self.client.list_with_rv(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, min_rv=1
+        )
+        self.assertEqual(len(items), 1)
+        self.assertGreaterEqual(int(rv), 1)
+        # Fast path: the barrier never blocked, but the wait histogram
+        # still saw a (zero) sample — lag stays observable at p50 too.
+        self.assertEqual(self.read_api.barrier_waits, 0)
+        self.assertGreaterEqual(self.metrics._hists[
+            "follower_read_barrier_wait_seconds"]["count"], 1)
+
+    def test_blocked_read_resumes_exactly_at_rv(self):
+        _feed(self.replica, 1, "w-0")
+        got = {}
+
+        def barriered_read():
+            got["result"] = self.client.list_with_rv(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, min_rv=3
+            )
+
+        t = threading.Thread(target=barriered_read)
+        t.start()
+        # The read is parked on the barrier while the replica lags.
+        time.sleep(0.08)
+        self.assertTrue(t.is_alive())
+        self.assertEqual(self.read_api.barrier_waits, 1)
+        _feed(self.replica, 2, "w-1")
+        _feed(self.replica, 3, "w-2")
+        t.join(timeout=5)
+        self.assertFalse(t.is_alive())
+        items, rv = got["result"]
+        # Resumed exactly at the barrier rv: all three writes visible.
+        self.assertEqual(
+            sorted(i["metadata"]["name"] for i in items),
+            ["w-0", "w-1", "w-2"],
+        )
+        self.assertGreaterEqual(int(rv), 3)
+        self.assertEqual(self.read_api.barrier_timeouts, 0)
+
+    def test_barrier_timeout_maps_to_follower_behind(self):
+        _feed(self.replica, 1, "w-0")
+        t0 = time.monotonic()
+        with self.assertRaises(FollowerBehindError):
+            self.client.list_with_rv(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND, min_rv=99
+            )
+        # Bounded wait: the 504 came at the configured timeout, not the
+        # client's socket timeout.
+        self.assertLess(time.monotonic() - t0, 2.0)
+        self.assertEqual(self.read_api.barrier_timeouts, 1)
+
+    def test_write_verbs_refused(self):
+        with self.assertRaises(InvalidError):
+            self.client.create(_obj("nope"))
+        self.assertEqual(len(self.replica.store), 0)
+
+
+class TestRouterReadPlane(unittest.TestCase):
+    """FollowerReadClient: round-robin, barriers, fallbacks, strong."""
+
+    def setUp(self):
+        self.metrics = Metrics()
+        self.store = APIServer(clock=FakeClock())
+        self.addCleanup(self.store.close)
+        from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
+        self.leader_http = HTTPAPIServer(
+            api=self.store, durable_writes=False, read_source="leader"
+        )
+        self.leader_http.start()
+        self.addCleanup(self.leader_http.stop)
+        # A follower whose ship stream is STALLED: nothing ever feeds
+        # the replica, so every barriered read times out.
+        self.replica = FollowerReplica(RealClock(), name="stalled")
+        self.read_api = FollowerReadAPI(self.replica,
+                                        barrier_timeout_s=0.15)
+        self.follower_http = HTTPAPIServer(
+            api=self.read_api, durable_writes=False, read_source="follower"
+        )
+        self.follower_http.start()
+        self.addCleanup(self.follower_http.stop)
+
+        leader = ShardClient(f"http://127.0.0.1:{self.leader_http.port}")
+        follower = ShardClient(
+            f"http://127.0.0.1:{self.follower_http.port}")
+        self.client = FollowerReadClient(
+            leader, [follower], metrics=self.metrics
+        )
+        self.addCleanup(self.client.stop)
+
+    def test_lagging_follower_falls_back_to_leader(self):
+        out = self.client.create(_obj("w-0"))
+        self.assertGreaterEqual(self.client.last_write_rv, 1)
+        self.assertEqual(
+            int(out["metadata"]["resourceVersion"]),
+            self.client.last_write_rv,
+        )
+        items = self.client.list(WORKLOAD_API_VERSION, WORKLOAD_KIND)
+        # Read-your-writes held — served by the LEADER because the
+        # stalled follower blew its barrier.
+        self.assertEqual([i["metadata"]["name"] for i in items], ["w-0"])
+        stats = self.client.read_stats()
+        self.assertEqual(stats["fallbacks"]["lag"], 1)
+        self.assertEqual(stats["reads_leader"], 1)
+        self.assertEqual(stats["reads_follower"], 0)
+        self.assertEqual(self.metrics.counters.get(
+            'follower_read_fallbacks_total{reason="lag"}'), 1)
+        self.assertEqual(self.metrics.counters.get(
+            'http_reads_served_total{source="leader"}'), 1)
+
+    def test_caught_up_follower_serves_the_read(self):
+        out = self.client.create(_obj("w-0"))
+        rv = int(out["metadata"]["resourceVersion"])
+        _feed(self.replica, rv, "w-0")
+        items = self.client.list(WORKLOAD_API_VERSION, WORKLOAD_KIND)
+        self.assertEqual([i["metadata"]["name"] for i in items], ["w-0"])
+        stats = self.client.read_stats()
+        self.assertEqual(stats["reads_follower"], 1)
+        self.assertEqual(stats["fallbacks"]["lag"], 0)
+        self.assertEqual(self.metrics.counters.get(
+            'http_reads_served_total{source="follower"}'), 1)
+
+    def test_strong_consistency_pins_the_leader(self):
+        self.client.create(_obj("w-0"))
+        token = READ_CONSISTENCY.set("strong")
+        try:
+            self.client.list(WORKLOAD_API_VERSION, WORKLOAD_KIND)
+        finally:
+            READ_CONSISTENCY.reset(token)
+        stats = self.client.read_stats()
+        # Never even dialed the follower: no fallback, a leader read.
+        self.assertEqual(stats["reads_leader"], 1)
+        self.assertEqual(stats["fallbacks"]["lag"], 0)
+
+    def test_dead_follower_counts_unhealthy(self):
+        self.client.create(_obj("w-0"))
+        self.follower_http.stop()
+        items = self.client.list(WORKLOAD_API_VERSION, WORKLOAD_KIND)
+        self.assertEqual(len(items), 1)
+        stats = self.client.read_stats()
+        self.assertEqual(stats["fallbacks"]["unhealthy"], 1)
+        self.assertEqual(self.metrics.counters.get(
+            'follower_read_fallbacks_total{reason="unhealthy"}'), 1)
+
+    def test_deletes_barrier_follower_reads_too(self):
+        self.client.create(_obj("w-0"))
+        rv_before = self.client.last_write_rv
+        self.client.delete(WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                           "default", "w-0")
+        # The delete's Status carried the post-delete collection rv —
+        # a follower still showing the object can never satisfy it.
+        self.assertGreater(self.client.last_write_rv, rv_before)
+
+
+class TestReadYourWritesThroughRouter(unittest.TestCase):
+    """End-to-end: real shard leader + real ship-fed follower door +
+    router with read_peers; write-then-list through the router's own
+    front door never observes the pre-write state."""
+
+    def setUp(self):
+        self.dir = tempfile.mkdtemp(prefix="follower-reads-")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+        self.metrics = Metrics()
+        self.serving = ShardServing(0, data_dir=self.dir,
+                                    metrics=self.metrics)
+        self.door = FollowerReadServer(
+            0, ship_port=self.serving.ship_port, metrics=self.metrics
+        )
+        self.assertTrue(self.door.follower.wait_connected(5.0))
+        self.router = RouterServer(
+            peers=[f"127.0.0.1:{self.serving.api_port}"],
+            read_peers=[[f"127.0.0.1:{self.door.port}"]],
+            metrics=self.metrics,
+        )
+        # Teardown mirrors the de-flake ordering: router (client
+        # streams) first, then the follower door, then the leader.
+        self.addCleanup(self.serving.close)
+        self.addCleanup(self.door.close)
+        self.addCleanup(self.router.close)
+        self.front = ShardClient(f"http://127.0.0.1:{self.router.port}")
+        self.addCleanup(self.front.close)
+
+    def test_write_then_list_is_never_stale(self):
+        stale = 0
+        for i in range(30):
+            name = f"ryw-{i}"
+            self.front.create(_obj(name, labels={"pair": str(i)}))
+            items = self.front.list(
+                WORKLOAD_API_VERSION, WORKLOAD_KIND,
+                label_selector={"pair": str(i)},
+            )
+            if [x["metadata"]["name"] for x in items] != [name]:
+                stale += 1
+        self.assertEqual(stale, 0)
+        stats = self.router.clients[0].read_stats()
+        # The reads were actually follower-served, not leader reads
+        # that would hold RYW trivially.
+        self.assertGreaterEqual(stats["reads_follower"], 25)
+        self.assertEqual(stats["last_write_rv"], 30)
+
+    def test_explicit_min_rv_and_strong_params(self):
+        out = self.front.create(_obj("explicit-0"))
+        rv = int(out["metadata"]["resourceVersion"])
+        items, got_rv = self.front.list_with_rv(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, min_rv=rv
+        )
+        self.assertEqual(len(items), 1)
+        self.assertGreaterEqual(int(got_rv), rv)
+        before = self.router.clients[0].read_stats()["reads_leader"]
+        items, _ = self.front.list_with_rv(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND, consistency="strong"
+        )
+        self.assertEqual(len(items), 1)
+        self.assertEqual(
+            self.router.clients[0].read_stats()["reads_leader"],
+            before + 1,
+        )
+
+    def test_debug_shards_carries_read_plane(self):
+        self.front.create(_obj("dbg-0"))
+        self.front.list(WORKLOAD_API_VERSION, WORKLOAD_KIND)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{self.router.port}/debug/shards",
+                timeout=2.0) as r:
+            doc = json.loads(r.read())
+        roles = {}
+        for entry in doc["shards"]:
+            roles.setdefault(entry.get("role", "leader"), []).append(entry)
+        # The leader entry carries the router-side read-plane stats;
+        # the follower door fans in its own freshness self-report.
+        leader = [e for e in doc["shards"] if "read_plane" in e]
+        self.assertTrue(leader)
+        self.assertGreaterEqual(
+            leader[0]["read_plane"]["reads_follower"], 1)
+        followers = roles.get("follower") or []
+        self.assertTrue(followers)
+        reads = followers[0]["reads"]
+        for key in ("rv", "staleness_s", "read_qps", "reads_served",
+                    "barrier_waits"):
+            self.assertIn(key, reads)
+
+
+class TestFollowerResyncEvent(unittest.TestCase):
+    """A mid-stream ship reconnect re-bootstraps the replica: attached
+    watch streams re-sync through 410 → re-list (no dropped events) and
+    the resync lands as a typed cluster event — while the FIRST
+    bootstrap (normal startup) emits nothing."""
+
+    def setUp(self):
+        self.metrics = Metrics()
+        self.store = APIServer(clock=FakeClock())
+        self.addCleanup(self.store.close)
+        self.dir = tempfile.mkdtemp(prefix="resync-evt-")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+        self.pers = Persistence(self.dir, fsync_every=1)
+        self.pers.start(self.store)
+        self.addCleanup(self.pers.close)
+        self.ship = WALShipServer(self.pers)
+        self.addCleanup(self.ship.close)
+        self.door = FollowerReadServer(
+            0, ship_port=self.ship.port, metrics=self.metrics
+        )
+        self.addCleanup(self.door.close)
+        self.assertTrue(self.door.follower.wait_connected(5.0))
+
+    def _events(self):
+        doc = json.loads(self.door.debug_events())
+        return [r["event"] for r in doc["records"]]
+
+    def test_rebootstrap_emits_event_and_resyncs_streams(self):
+        # Startup bootstrap: replica synced, NO resync event.
+        self.store.create(_obj("pre-0"))
+        self.pers.flush()
+        self.assertTrue(
+            _wait(lambda: len(self.door.replica.store) == 1))
+        self.assertNotIn("follower_resync", self._events())
+        self.assertIsNone(self.metrics.counters.get(
+            'cluster_events_total{event="follower_resync"}'))
+
+        # A live watch stream on the door, then a severed ship socket
+        # with writes landing during the dark window.
+        seen = []
+        watcher = ShardClient(f"http://127.0.0.1:{self.door.port}")
+        self.addCleanup(watcher.close)
+        watcher.add_watcher(lambda evt: seen.append(
+            (evt.type, evt.object["metadata"]["name"])))
+        watcher.start_watches(gvks=[GVK_JAXJOB])
+        self.assertTrue(_wait(
+            lambda: ("ADDED", "pre-0") in seen, timeout=10))
+
+        for conn in list(self.ship._conns):
+            conn.close()
+        self.store.create(_obj("dark-0"))
+        self.pers.flush()
+
+        # Reconnect → re-bootstrap → typed event (exactly the resyncs
+        # past the first), and the dark-window write reaches the
+        # stream via the 410 → re-list path.
+        self.assertTrue(_wait(
+            lambda: self.door.follower.bootstraps >= 2, timeout=10))
+        self.assertTrue(_wait(
+            lambda: "follower_resync" in self._events(), timeout=5))
+        self.assertGreaterEqual(self.metrics.counters.get(
+            'cluster_events_total{event="follower_resync"}', 0), 1)
+        self.assertTrue(_wait(
+            lambda: ("ADDED", "dark-0") in seen, timeout=10))
+
+
+class TestWatchAcrossPromotion(unittest.TestCase):
+    """A follower-served watch stream delivers every event across a
+    ``kill -9`` leader death: the standby's attached read door stays
+    up through promotion (its replica store becomes the leader store),
+    so watchers riding the door see the full sequence."""
+
+    def setUp(self):
+        self.dir = tempfile.mkdtemp(prefix="promo-watch-")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+
+    def test_stream_survives_kill9_promotion(self):
+        api, ship, door = 26150, 26151, 26152
+        logd = os.path.join(self.dir, "logs")
+        os.makedirs(logd)
+
+        def spawn(role_args, tag):
+            log = open(os.path.join(logd, f"{tag}.log"), "ab")
+            return subprocess.Popen(
+                [sys.executable, "-m", "cron_operator_tpu.cli.main",
+                 "start", "--health-probe-bind-address", "0",
+                 "--lease-ttl", "0.5"] + role_args,
+                stdout=log, stderr=subprocess.STDOUT)
+
+        def shard_doc(port):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debug/shards",
+                        timeout=1.0) as r:
+                    return (json.loads(r.read()).get("shards")
+                            or [None])[0]
+            except Exception:
+                return None
+
+        procs = []
+        try:
+            leader = spawn([
+                "--shard-role", "shard", "--shard-index", "0",
+                "--data-dir", self.dir,
+                "--serve-api", f"127.0.0.1:{api}",
+                "--ship-port", str(ship)], "leader")
+            procs.append(leader)
+            self.assertTrue(_wait(lambda: shard_doc(api), timeout=30))
+            leader_pid = shard_doc(api)["pid"]
+
+            standby = spawn([
+                "--shard-role", "standby", "--shard-index", "0",
+                "--data-dir", self.dir,
+                "--serve-api", f"127.0.0.1:{api}",
+                "--ship-port", str(ship),
+                "--serve-reads", str(door)], "standby")
+            procs.append(standby)
+            self.assertTrue(_wait(lambda: shard_doc(door), timeout=30))
+
+            seen = []
+            watcher = ShardClient(f"http://127.0.0.1:{door}")
+            self.addCleanup(watcher.close)
+            watcher.add_watcher(lambda evt: seen.append(
+                (evt.type, evt.object["metadata"]["name"])))
+            watcher.start_watches(gvks=[GVK_JAXJOB])
+
+            writer = ShardClient(f"http://127.0.0.1:{api}")
+            pre = [f"pre-{i}" for i in range(5)]
+            for name in pre:
+                writer.create(_obj(name))
+            writer.close()
+            self.assertTrue(_wait(
+                lambda: all(("ADDED", n) in seen for n in pre),
+                timeout=15))
+
+            os.kill(leader_pid, signal.SIGKILL)
+            # Promotion rebinds the SAME api port (a SIGKILLed leader
+            # frees it), so the new leader shows a different pid there.
+            self.assertTrue(_wait(
+                lambda: (shard_doc(api) or {}).get("pid")
+                not in (None, leader_pid),
+                timeout=30))
+
+            post = [f"post-{i}" for i in range(5)]
+            writer = ShardClient(f"http://127.0.0.1:{api}")
+            for name in post:
+                writer.create(_obj(name))
+            # The follower-served stream delivers the full sequence —
+            # pre-kill AND post-promotion — matching the leader's view.
+            self.assertTrue(_wait(
+                lambda: all(("ADDED", n) in seen for n in pre + post),
+                timeout=30))
+            leader_names = sorted(
+                i["metadata"]["name"] for i in writer.list(
+                    WORKLOAD_API_VERSION, WORKLOAD_KIND))
+            writer.close()
+            door_names = sorted(
+                i["metadata"]["name"] for i in ShardClient(
+                    f"http://127.0.0.1:{door}").list(
+                        WORKLOAD_API_VERSION, WORKLOAD_KIND))
+            self.assertEqual(door_names, leader_names)
+            self.assertEqual(door_names, sorted(pre + post))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+class TestTeardownOrdering(unittest.TestCase):
+    """De-flake: RouterServer.stop() before the follower front door —
+    follower-served streams end cleanly, no ERROR tracebacks."""
+
+    def test_router_stops_before_follower_door_cleanly(self):
+        d = tempfile.mkdtemp(prefix="teardown-")
+        self.addCleanup(shutil.rmtree, d, ignore_errors=True)
+        serving = ShardServing(0, data_dir=d)
+        door = FollowerReadServer(0, ship_port=serving.ship_port)
+        self.assertTrue(door.follower.wait_connected(5.0))
+        router = RouterServer(
+            peers=[f"127.0.0.1:{serving.api_port}"],
+            read_peers=[[f"127.0.0.1:{door.port}"]],
+        )
+        front = ShardClient(f"http://127.0.0.1:{router.port}")
+        front.create(_obj("t-0"))
+        self.assertEqual(len(front.list(
+            WORKLOAD_API_VERSION, WORKLOAD_KIND)), 1)
+        front.close()
+        with self.assertNoLogs(level="ERROR"):
+            router.close()   # read-plane watch streams stop first
+            door.close()     # then the follower front door
+            serving.close()  # leader last
+            time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    unittest.main()
