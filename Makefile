@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray obs-report obs-report-dist
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray chaos-soak-split obs-report obs-report-dist
 
 all: gate
 
@@ -173,6 +173,27 @@ chaos-soak-gray:
 	python hack/chaos_soak.py --seed $(or $(SEED),7) \
 	    --rounds 2 --gray --no-fencing --expect-violation \
 	    --out /dev/null
+
+# Live shard-split soak (hack/chaos_soak.py --split -> CHAOS_SPLIT.json):
+# live 1->N keyspace splits under a concurrent write storm, with a
+# PRF-chosen round that kills the parent's persistence mid-dark-window
+# and restarts the whole plane from disk. Every split must hold I6
+# (child ≡ filtered replay of the shipped WAL at cutover), I9
+# (audit ≡ WAL per shard, including across the kill), I10 (zero
+# stale-generation bytes in any WAL/snapshot), S1 (every key has
+# exactly ONE owner after each split and after crash-restart — the map
+# rename on disk is the commit point), and S2 (no acked write lost).
+# Then the counter-proof: the same storm with range fencing OFF must
+# ACK a poison write on the demoted parent during the dark window and
+# erase it at cutover — proof S2 detects the lost-ack split-brain that
+# fencing prevents.
+chaos-soak-split:
+	python hack/chaos_soak.py --split --seed $(or $(SEED),3) \
+	    --crons $(or $(CRONS),60) --rounds $(or $(ROUNDS),3) \
+	    --out CHAOS_SPLIT.json
+	python hack/chaos_soak.py --split --no-fencing \
+	    --seed $(or $(SEED),3) --crons $(or $(CRONS),60) --rounds 2 \
+	    --expect-violation --out /dev/null
 
 # Observability / SLO report (hack/obs_report.py -> BENCH_OBS.json): the
 # flight-recorder scenario (audit ≡ WAL cross-check, lineage traces,

@@ -22,7 +22,7 @@ import signal
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 from urllib.parse import parse_qs
 
 from cron_operator_tpu import __version__
@@ -307,6 +307,24 @@ def build_parser() -> argparse.ArgumentParser:
                             "replay the shard's WAL byte stream "
                             "continuously and are promotable on leader "
                             "failure; requires --data-dir")
+    start.add_argument("--split", action="append", default=[],
+                       metavar="shard=K",
+                       help="embedded sharded mode with --data-dir only: "
+                            "after startup, live-split shard K — carve "
+                            "its widest owned hash range in half onto a "
+                            "brand-new child shard while serving "
+                            "(repeatable; see README 'Scale-out')")
+    start.add_argument("--auto-split-p99", type=float, default=None,
+                       metavar="S",
+                       help="auto-split: when a shard's durable-write "
+                            "p99 (group-commit fsync histogram) stays "
+                            "above S seconds across two consecutive "
+                            "probe windows, split it live. Requires "
+                            "sharded embedded mode with --data-dir")
+    start.add_argument("--auto-split-max", type=int, default=8,
+                       metavar="N",
+                       help="auto-split ceiling: never grow past N "
+                            "total shards (default 8)")
     start.add_argument("--fleet-pool", default=None, metavar="POOL",
                        help="enable the heterogeneity-aware fleet "
                             "scheduler over a pool of named slice types, "
@@ -838,7 +856,18 @@ def cmd_start(args: argparse.Namespace) -> int:
     else:
         api = APIServer()
 
-    sharded = args.shards > 1 or args.replicas > 0
+    # Live splits force the sharded plane even at --shards 1 (a split's
+    # child needs the per-shard dir layout), and a data dir that has
+    # LIVED through splits (ownership.json present) must come back up
+    # sharded regardless of flags — the root-level single-store layout
+    # cannot serve shard-i dirs.
+    wants_split = bool(args.split) or args.auto_split_p99 is not None
+    has_ownership = bool(
+        args.data_dir
+        and _os.path.exists(_os.path.join(args.data_dir, "ownership.json"))
+    )
+    sharded = (args.shards > 1 or args.replicas > 0
+               or wants_split or has_ownership)
     if args.api_server == "cluster" and (args.shards != 1 or args.replicas):
         log.error("--shards/--replicas apply to the embedded control "
                   "plane only; a real cluster scales out via "
@@ -847,6 +876,18 @@ def cmd_start(args: argparse.Namespace) -> int:
     if args.shards < 1:
         log.error("--shards must be >= 1, got %d", args.shards)
         return 2
+    if wants_split and (args.api_server == "cluster" or not args.data_dir):
+        log.error("--split/--auto-split-p99 require the embedded "
+                  "control plane with --data-dir (the WAL byte stream "
+                  "is the split handoff medium)")
+        return 2
+    split_targets: List[int] = []
+    for spec in args.split:
+        try:
+            split_targets.append(int(spec.split("=", 1)[-1]))
+        except ValueError:
+            log.error("--split expects shard=K, got %r", spec)
+            return 2
     fleet = None
     fleet_matrix_path = None
     if args.fleet_pool and (args.api_server == "cluster" or sharded):
@@ -916,10 +957,13 @@ def cmd_start(args: argparse.Namespace) -> int:
             ]
             log.warning("CHAOS MODE: injecting seeded faults (seed=%d) "
                         "into all %d shards", args.chaos_seed, args.shards)
-        api = ShardRouter(shard_backends)
+        api = ShardRouter(shard_backends, ownership=plane.ownership,
+                          metrics=shared_metrics)
         log.info(
-            "sharded control plane: %d shard(s), %d hot-standby "
-            "replica(s) per shard%s", args.shards, args.replicas,
+            "sharded control plane: %d shard(s) (%d at boot, ownership "
+            "epoch %d), %d hot-standby replica(s) per shard%s",
+            plane.n_shards, plane.n_boot, plane.ownership.epoch,
+            args.replicas,
             f", data dir {args.data_dir}" if args.data_dir else "",
         )
         if args.backend is None:
@@ -1389,6 +1433,102 @@ def cmd_start(args: argparse.Namespace) -> int:
         from cron_operator_tpu.api.scheme import GVK_CRON as _cron_gvk
 
         api.start_watches([_cron_gvk] + scheme.workload_kinds())
+
+    # -- live shard splitting (admin trigger + auto-split monitor) --------
+
+    def _wire_split_child() -> None:
+        """Start the serving stack of the newest split child: the CLI's
+        router gains the backend + the new ownership map, and a fresh
+        Manager + reconciler lead the child exactly like a boot shard."""
+        child = plane.shards[-1]
+        backend = child.store
+        api.add_shard(backend)
+        api.set_ownership(plane.ownership)
+        m = Manager(
+            backend,
+            max_concurrent_reconciles=args.max_concurrent_reconciles,
+            leader_elect=args.leader_elect,
+            recovering=True,  # inherited objects get a catch-up pass
+            metrics=ShardMetrics(shared_metrics, child.index),
+            audit=journal.shard_view(child.index),
+        )
+        child.leader = m.identity
+        rec = CronReconciler(backend, metrics=m.metrics, tracer=tracer,
+                             audit=journal.shard_view(child.index))
+        m.add_controller("cron", rec.reconcile, for_gvk=GVK_CRON,
+                         owns=scheme.workload_kinds())
+        managers.append(m)
+        m.start()
+        log.info("shard %d: split child serving (manager %s)",
+                 child.index, m.identity)
+
+    def _run_split(index: int) -> bool:
+        try:
+            report = plane.split_shard(index)
+        except Exception:
+            log.exception("live split of shard %d failed", index)
+            return False
+        _wire_split_child()
+        log.info(
+            "live split: shard %d -> child %d at epoch %d (moved=%d, "
+            "dark window %.3fs)", report["parent"], report["child"],
+            report["epoch"], report["moved"], report["dark_window_s"],
+        )
+        return True
+
+    def _auto_split_monitor() -> None:
+        """Sample each shard's group-commit fsync histogram every probe
+        window; two CONSECUTIVE windows with a delta p99 above the
+        threshold (and enough writes to mean it) split the hottest
+        shard live, up to --auto-split-max total shards."""
+        probe_s = 5.0
+        min_samples = 32
+        prev: Dict[int, Any] = {}
+        streak: Dict[int, int] = {}
+        while not stop.wait(probe_s):
+            if plane.n_shards >= max(2, args.auto_split_max):
+                return
+            hottest = None  # (p99, shard index)
+            for s in list(plane.shards):
+                h = ShardMetrics(shared_metrics, s.index).histogram(
+                    "wal_fsync_seconds")
+                if h is None:
+                    continue
+                last = prev.get(s.index)
+                prev[s.index] = h
+                if last is None:
+                    continue
+                delta = [a - b for a, b in zip(h["counts"], last["counts"])]
+                n = h["count"] - last["count"]
+                if n < min_samples:
+                    streak[s.index] = 0
+                    continue
+                p99 = _histogram_quantile(h["buckets"], delta, 0.99)
+                if p99 is not None and p99 > args.auto_split_p99:
+                    streak[s.index] = streak.get(s.index, 0) + 1
+                    if hottest is None or p99 > hottest[0]:
+                        hottest = (p99, s.index)
+                else:
+                    streak[s.index] = 0
+            if hottest is not None and streak.get(hottest[1], 0) >= 2:
+                index = hottest[1]
+                streak[index] = 0
+                log.warning(
+                    "auto-split: shard %d durable-write p99 %.4fs > "
+                    "%.4fs for two consecutive windows — splitting live",
+                    index, hottest[0], args.auto_split_p99,
+                )
+                _run_split(index)
+                prev.clear()
+
+    if plane is not None:
+        for split_index in split_targets:
+            _run_split(split_index)
+        if args.auto_split_p99 is not None:
+            threading.Thread(
+                target=_auto_split_monitor, name="auto-split", daemon=True
+            ).start()
+
     stop.wait(timeout=args.run_for)
 
     log.info("shutting down")
@@ -1419,6 +1559,23 @@ def cmd_start(args: argparse.Namespace) -> int:
     for s in servers:
         s.shutdown()
     return 0
+
+
+def _histogram_quantile(buckets, counts, q: float) -> Optional[float]:
+    """Bucket-resolution quantile over per-bucket counts (the last
+    count is the +Inf overflow bucket). Returns the upper edge of the
+    bucket holding the q-rank sample — the same conservative estimate
+    Prometheus histogram_quantile gives at bucket granularity."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= rank:
+            return float(buckets[i]) if i < len(buckets) else float("inf")
+    return float("inf")
 
 
 def _age(creation_ts: Optional[str], now=None) -> str:

@@ -99,6 +99,7 @@ from cron_operator_tpu.runtime.kube import (
     NotFoundError,
     WatchEvent,
 )
+from cron_operator_tpu.runtime.persistence import WrongShardError
 from cron_operator_tpu.runtime.readroute import (
     MIN_READ_RV,
     READ_CONSISTENCY,
@@ -1237,6 +1238,20 @@ class HTTPAPIServer:
                     self._send_status(409, "Conflict", str(err))
                 except InvalidError as err:
                     self._send_status(422, "Invalid", str(err))
+                except WrongShardError as err:
+                    # A write raced a live split: this backend no longer
+                    # owns the key's hash range. 421 Misdirected Request
+                    # with the new owner + map epoch as routing hints —
+                    # the router re-routes, bounded (see ShardRouter).
+                    self._send_json(421, {
+                        "kind": "Status", "apiVersion": "v1",
+                        "status": "Failure", "reason": "WrongShard",
+                        "message": str(err), "code": 421,
+                        "details": {
+                            "owner": err.owner,
+                            "mapEpoch": err.map_epoch,
+                        },
+                    })
                 except FollowerBehindError as err:
                     # Barriered follower read timed out waiting for its
                     # replayed rv; the router catches this to fall back

@@ -53,6 +53,7 @@ from cron_operator_tpu.runtime.kube import (
     WatchEvent,
     make_event_object,
 )
+from cron_operator_tpu.runtime.persistence import WrongShardError
 from cron_operator_tpu.utils.clock import Clock, RealClock
 
 logger = logging.getLogger("runtime.cluster")
@@ -158,6 +159,18 @@ def _status_error(code: int, body: str) -> ApiError:
         return ConflictError(body)
     if code in (400, 422):
         return InvalidError(body)
+    if code == 421:
+        # Misdirected Request: the backend no longer owns the key's hash
+        # range (a live split moved it). Reconstruct the typed error with
+        # its routing hints so ShardRouter can chase the new owner.
+        owner = epoch = None
+        try:
+            details = json.loads(body).get("details") or {}
+            owner = details.get("owner")
+            epoch = details.get("mapEpoch")
+        except Exception:
+            pass
+        return WrongShardError(body, owner=owner, map_epoch=epoch)
     if code == 504:
         # Gateway timeouts: a follower door answers 504 "FollowerBehind"
         # when a barriered read timed out waiting for its replayed rv —

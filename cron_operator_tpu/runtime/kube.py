@@ -360,6 +360,20 @@ class APIServer:
             self._evict(key)
             self._notify("DELETED", final)
 
+    def evict_for_split(self, keys: List[Key]) -> int:
+        """Drop objects whose keyspace range moved to a child shard in a
+        live split. No watch events fire (the objects did not change —
+        they live on, verbatim, on the child shard) and no WAL ``del``
+        records are written (the caller makes the drop durable by
+        writing a fresh parent snapshot that excludes these keys, the
+        split's compaction step). Returns the number evicted."""
+        with self._lock:
+            n = 0
+            for key in keys:
+                if self._evict(tuple(key)) is not None:
+                    n += 1
+            return n
+
     def _persist_put(self, verb: str, committed: Unstructured) -> None:
         """WAL hook for create/update/patch_status. Called with the store
         lock held, BEFORE the in-memory commit: if the append dies at a

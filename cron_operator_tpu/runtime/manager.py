@@ -220,6 +220,31 @@ _FAMILY_META: Dict[str, tuple] = {
                      "detected -> follower promoted -> catch-up "
                      "verified -> serving (label shard=N); the phase "
                      "breakdown is recorded as failover trace spans"),
+    "shard_splits_total": (
+        "counter", "Live shard splits by outcome (label outcome: ok, "
+                   "aborted) — a hot shard's keyspace range carved in "
+                   "half onto a new child shard "
+                   "(runtime/shard.py split_shard)"),
+    "shard_split_duration_seconds": (
+        "histogram", "End-to-end live split timeline: child attach -> "
+                     "WAL catch-up -> dark window -> materialize -> "
+                     "ownership publish; phase breakdown rides the "
+                     "shard_split trace spans"),
+    "shard_split_dark_window_seconds": (
+        "histogram", "Split dark window: how long writes on the moving "
+                     "hash range were refused (fence armed -> new "
+                     "ownership map published); the bench gates this "
+                     "at <= 2s"),
+    "router_wrong_shard_retries_total": (
+        "counter", "Writes re-routed after a WrongShardError (HTTP "
+                   "421): the request raced a live split's cutover and "
+                   "chased the raised owner hint / republished "
+                   "ownership map"),
+    "router_probe_fallbacks_total": (
+        "counter", "Single-object lookups that missed the ownership-map "
+                   "home shard and probed the others (owner-co-located "
+                   "children live on their owner's shard; a hot probe "
+                   "path is an anti-affinity smell, not free routing)"),
     "shard_follower_stalls_total": (
         "counter", "Follower ship-queue overflows: the bounded async "
                    "send queue to one follower filled (wedged socket / "

@@ -122,6 +122,23 @@ class FencedError(ApiError):
     snapshot (chaos invariant I10)."""
 
 
+class WrongShardError(FencedError):
+    """A write targeted a keyspace range this shard no longer owns (a
+    live split moved it to a child shard at a newer ownership-map
+    epoch). Subclasses :class:`FencedError` because the mechanism is the
+    same fail-closed discipline — the append raises BEFORE the in-memory
+    commit, so the old owner can never land a moved-range record — but
+    the verdict is retriable: the router catches it, re-consults the
+    ownership map (``owner``/``map_epoch`` are routing hints) and
+    re-routes the request to the new owner (HTTP 421 on the wire)."""
+
+    def __init__(self, message: str, owner: Optional[int] = None,
+                 map_epoch: Optional[int] = None):
+        super().__init__(message)
+        self.owner = owner
+        self.map_epoch = map_epoch
+
+
 @dataclass
 class RecoveredState:
     """Result of replaying a data dir: the objects and counters a fresh
@@ -364,6 +381,15 @@ class Persistence:
         self.generation = 0
         self._fenced = False
         self.fenced_appends = 0
+        # Range fence (live shard splits): unlike the full fence above,
+        # only appends whose key falls inside a MOVED hash range are
+        # refused (WrongShardError, raised before the in-memory commit
+        # via the _persist_put ordering) — the retained keyspace keeps
+        # writing. (pred(namespace, name) -> bool, owner, map_epoch).
+        self._range_fence: Optional[Tuple[Callable[[str, str], bool],
+                                          Optional[int],
+                                          Optional[int]]] = None
+        self.range_fenced_appends = 0
         # Group-commit state (wait_durable): sequence numbers partition
         # the append stream into buffered / written-to-file / fsynced.
         # records_appended counts appends, _written_seq the prefix that
@@ -454,6 +480,48 @@ class Persistence:
                 "persistence fenced at generation %d (observed %s)",
                 self.generation, observed_generation,
             )
+
+    def fence_range(
+        self,
+        pred: Callable[[str, str], bool],
+        owner: Optional[int] = None,
+        map_epoch: Optional[int] = None,
+    ) -> None:
+        """Fail-close appends for keys inside a moving hash range.
+
+        Armed by the split coordinator at the start of the dark window
+        (and kept armed after cutover — the range is gone for good):
+        ``pred(namespace, name)`` selects the moved keys, ``owner`` and
+        ``map_epoch`` ride the raised :class:`WrongShardError` as
+        routing hints. Appends outside the range are untouched, so the
+        parent keeps serving its retained keyspace throughout."""
+        with self._lock:
+            self._range_fence = (pred, owner, map_epoch)
+
+    def lift_range_fence(self) -> None:
+        """Disarm the range fence (split abort: the parent owns the
+        whole range again)."""
+        with self._lock:
+            self._range_fence = None
+
+    @property
+    def range_fenced(self) -> bool:
+        return self._range_fence is not None
+
+    @staticmethod
+    def _rec_ns_name(rec: Dict[str, Any]) -> Optional[Tuple[str, str]]:
+        """(namespace, name) of a put/del record, for the range fence."""
+        if rec.get("op") == "put":
+            obj = rec.get("obj")
+            if isinstance(obj, dict):
+                meta = obj.get("metadata") or {}
+                return (meta.get("namespace", "") or "",
+                        meta.get("name", "") or "")
+        elif rec.get("op") == "del":
+            key = rec.get("key") or ()
+            if len(key) == 4:
+                return str(key[2]), str(key[3])
+        return None
 
     def open(self) -> None:
         """Open the WAL for appending (creating it if absent) and start
@@ -560,6 +628,22 @@ class Persistence:
                     "persistence layer is fenced: a higher lease "
                     "generation exists (this holder was demoted)"
                 )
+            rf = self._range_fence
+            if rf is not None:
+                ns_name = self._rec_ns_name(rec)
+                if ns_name is not None and rf[0](*ns_name):
+                    # Moved-range write during/after a split: refuse it
+                    # BEFORE the store's in-memory commit (the
+                    # _persist_put hook ordering), so the old owner
+                    # never applies a byte the child shard will miss.
+                    self.range_fenced_appends += 1
+                    self._count("wal_fenced_appends_total")
+                    raise WrongShardError(
+                        f"key {ns_name[0]}/{ns_name[1]} is in a keyspace "
+                        f"range this shard no longer owns (moved to "
+                        f"shard {rf[1]} at ownership-map epoch {rf[2]})",
+                        owner=rf[1], map_epoch=rf[2],
+                    )
             if self._dead:
                 raise SimulatedCrash("persistence layer is dead (kill-point fired)")
             if self._f is None:
@@ -790,6 +874,18 @@ class Persistence:
             self._shippers.append(sink)
         return sink
 
+    def detach_follower(self, follower) -> None:
+        """Unsubscribe a follower previously attached with
+        :meth:`attach_follower` (split cutover: the child has its own
+        Persistence from here; split abort: the child is discarded)."""
+        with self._lock:
+            victims = [s for s in self._shippers
+                       if s.send == follower.apply_bytes]
+            for sink in victims:
+                self._shippers.remove(sink)
+        for sink in victims:
+            sink.close()
+
     def detach_sink(self, sink: "_ShipSink") -> None:
         with self._lock:
             try:
@@ -965,15 +1061,31 @@ class Persistence:
             with open(self._wal_path, "r+b") as f:
                 f.truncate(good_end)
 
-    def start(self, api) -> RecoveredState:
+    def start(self, api, keep=None) -> RecoveredState:
         """Recover this data dir into ``api``, compact, and attach.
 
         The boot sequence of ``--data-dir``: snapshot load → WAL tail
         replay → install objects + restore the rv counter → write a fresh
         compacted snapshot (so the next crash replays a short WAL) →
         hook every future commit. Returns the recovered state so the
-        caller can log it / gate readiness on the catch-up reconcile."""
+        caller can log it / gate readiness on the catch-up reconcile.
+
+        ``keep(obj) -> bool`` filters the recovered objects before they
+        are installed (the sharded plane passes its ownership-map test):
+        a crash between a split's ownership cutover and the parent's
+        compaction snapshot leaves moved keys in the parent's WAL, and
+        this is where they are dropped — the compacted snapshot written
+        below then makes the drop durable."""
         state = self.recover()
+        if keep is not None and state.objects:
+            kept = [o for o in state.objects if keep(o)]
+            if len(kept) != len(state.objects):
+                logger.info(
+                    "recovery dropped %d object(s) outside this shard's "
+                    "owned ranges (post-split boot filter)",
+                    len(state.objects) - len(kept),
+                )
+            state.objects = kept
         if not state.empty:
             api.restore_state(state.objects, state.rv)
         self.open()
@@ -1003,6 +1115,8 @@ class Persistence:
                 "generation": self.generation,
                 "fenced": int(self._fenced),
                 "fenced_appends": self.fenced_appends,
+                "range_fenced": int(self._range_fence is not None),
+                "range_fenced_appends": self.range_fenced_appends,
             }
 
     def buffered_bytes(self) -> int:
@@ -1017,6 +1131,7 @@ __all__ = [
     "RecoveredState",
     "SimulatedCrash",
     "FencedError",
+    "WrongShardError",
     "DEFAULT_FSYNC_EVERY",
     "DEFAULT_SNAPSHOT_EVERY",
     "DEFAULT_SHIP_QUEUE_BYTES",

@@ -140,6 +140,22 @@ class FollowerReadAPI:
         streams (they re-sync via the existing 410 → re-list path)."""
         self._hub = hub
 
+    def rehome(self, replica: Any) -> None:
+        """Point this door at a DIFFERENT replica (live shard split: a
+        follower door serving the parent re-homes to the child's ship
+        stream once the child shard owns the moved range).
+
+        Same recovery discipline as a resync — the old and new replicas
+        share no stream position, so every watcher re-subscribes on the
+        new store and attached watch streams expire past its bootstrap
+        rv (clients re-list through the 410/replay path; a re-home must
+        never silently drop events mid-stream)."""
+        add_listener = getattr(replica, "add_resync_listener", None)
+        if add_listener is not None:
+            add_listener(self._on_store_swapped)
+        self.replica = replica
+        self._on_store_swapped()
+
     def _on_store_swapped(self) -> None:
         """Resync listener: the replica swapped in a fresh store. Events
         between the old stream and the new bootstrap may be lost to the
@@ -522,6 +538,17 @@ class FollowerReadClient:
     # reads and watches scale out — the documented consistency model.)
 
     # -- watches: scale with replicas -------------------------------------
+
+    def add_follower(self, client: Any) -> None:
+        """Grow the read plane with another follower endpoint (live
+        shard split: the child shard's follower door joins the rotation
+        once the child serves). Round-robin picks it up on the next
+        read; the watch pin stays where it is — moving live watch
+        streams is the hub's 410/re-list job, not a silent re-point."""
+        with self._lock:
+            self.followers.append(client)
+        if self.watch_source is self.leader:
+            self.watch_source = client
 
     def add_watcher(self, fn, coalesce: bool = False) -> None:
         self.watch_source.add_watcher(fn, coalesce=coalesce)

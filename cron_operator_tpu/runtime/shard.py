@@ -36,10 +36,13 @@ an on-disk format.
 
 from __future__ import annotations
 
+import dataclasses
+import functools
 import hashlib
 import json
 import logging
 import os
+import shutil
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -49,9 +52,14 @@ from cron_operator_tpu.runtime.kube import (
     NotFoundError,
     Unstructured,
     WatchEvent,
+    controller_owner,
     object_key,
 )
-from cron_operator_tpu.runtime.persistence import Persistence, RecoveredState
+from cron_operator_tpu.runtime.persistence import (
+    Persistence,
+    RecoveredState,
+    WrongShardError,
+)
 from cron_operator_tpu.telemetry.trace import new_trace_id
 from cron_operator_tpu.utils.clock import Clock, RealClock
 
@@ -74,6 +82,48 @@ FAILOVER_BUCKETS = (
 )
 
 
+#: The keyspace: every object hashes to a point in ``[0, 2**64)``.
+HASH_SPACE = 1 << 64
+
+#: Cluster-wide keyspace ownership map file, directly under
+#: ``--data-dir`` (beside the ``shard-i`` directories). Its atomic
+#: rename is the commit point of a live split.
+OWNERSHIP_FILE = "ownership.json"
+
+#: Bucket ladder for ``shard_split_duration_seconds`` — a split is a
+#: filtered bootstrap + WAL catch-up + two snapshots, so it stretches
+#: the failover ladder toward minutes for big shards.
+SPLIT_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+#: Bucket ladder for ``shard_split_dark_window_seconds`` — the gate is
+#: <= 2s, so the ladder resolves finely below a second.
+DARK_WINDOW_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.0, 5.0,
+)
+
+
+@functools.lru_cache(maxsize=65536)
+def key_hash64(namespace: str, name: str) -> int:
+    """The 64-bit keyspace point of ``(namespace, name)``.
+
+    Part of the on-disk format twice over: :func:`shard_index` is this
+    modulo N, and the ownership map's range cut points are coordinates
+    in this hash space. Pinned by vector tests; must never change.
+
+    Memoized (bounded): one routed write hashes the same key several
+    times — router locate, the parent's range-fence predicate, split
+    membership — and the digest of an immutable key never changes.
+    """
+    h = hashlib.blake2b(
+        f"{namespace}/{name}".encode("utf-8"), digest_size=8, key=_HASH_KEY
+    )
+    return int.from_bytes(h.digest(), "big")
+
+
 def shard_index(namespace: str, name: str, n_shards: int) -> int:
     """Stable shard assignment for ``(namespace, name)``.
 
@@ -84,14 +134,249 @@ def shard_index(namespace: str, name: str, n_shards: int) -> int:
     """
     if n_shards <= 1:
         return 0
-    h = hashlib.blake2b(
-        f"{namespace}/{name}".encode("utf-8"), digest_size=8, key=_HASH_KEY
-    )
-    return int.from_bytes(h.digest(), "big") % n_shards
+    return key_hash64(namespace, name) % n_shards
+
+
+def split_key(obj: Unstructured) -> Tuple[str, str]:
+    """The ``(namespace, name)`` whose hash decides where ``obj`` lives
+    under an ownership map: its controller OWNER's coordinates when it
+    has a controller ownerReference, its own otherwise.
+
+    Splits move whole owner families: a reconciler-created child sits on
+    its Cron's shard (co-location, see module docstring), so membership
+    in a moving range must be judged by the root's hash — otherwise a
+    split would tear children away from their owner and break the
+    owner-UID index and cascade delete. ownerReferences are same-
+    namespace by construction, so the owner's namespace is the child's.
+    """
+    _, _, ns, name = object_key(obj)
+    ref = controller_owner(obj)
+    if ref is not None and ref.get("name"):
+        return ns, str(ref["name"])
+    return ns, name
+
+
+class OwnershipMap:
+    """Keyspace ownership: contiguous hash ranges → shard id, versioned
+    by a map epoch.
+
+    Layout is *per residue class* of the boot-time shard count: an
+    object first falls in class ``c = key_hash64 % n_boot`` (exactly the
+    boot-time :func:`shard_index`), and within each class a sorted list
+    of ``(start_hash, owner)`` cut points partitions ``[0, 2**64)``. The
+    boot map has one segment per class — ``classes[c] = [(0, c)]`` —
+    which makes epoch-0 routing *identical* to the fixed modulo hash, so
+    existing on-disk shard dirs load unchanged.
+
+    A split carves the widest segment a shard owns at its midpoint and
+    assigns the upper half to a brand-new shard id (``n_shards``), so
+    boot shards never change id and every epoch's map is a refinement of
+    the previous one. Cut points are part of the on-disk format
+    (``ownership.json``), pinned by vector tests like the hash itself.
+    """
+
+    def __init__(
+        self,
+        n_boot: int,
+        classes: List[List[Tuple[int, int]]],
+        epoch: int = 0,
+    ):
+        if n_boot < 1 or len(classes) != n_boot:
+            raise ValueError(
+                f"ownership map needs one segment list per boot class "
+                f"(n_boot={n_boot}, got {len(classes)})"
+            )
+        for c, segs in enumerate(classes):
+            if not segs or segs[0][0] != 0:
+                raise ValueError(f"class {c} does not start at hash 0")
+            if any(segs[i][0] >= segs[i + 1][0] for i in range(len(segs) - 1)):
+                raise ValueError(f"class {c} cut points not increasing")
+            if any(not (0 <= s < HASH_SPACE) for s, _ in segs):
+                raise ValueError(f"class {c} cut point outside hash space")
+        self.n_boot = n_boot
+        self.classes: List[List[Tuple[int, int]]] = [
+            [(int(s), int(o)) for s, o in segs] for segs in classes
+        ]
+        self.epoch = int(epoch)
+
+    @classmethod
+    def boot(cls, n_boot: int) -> "OwnershipMap":
+        """Epoch-0 map: one full-range segment per class — routing is
+        byte-for-byte the fixed modulo hash."""
+        return cls(n_boot, [[(0, c)] for c in range(n_boot)], epoch=0)
+
+    @property
+    def n_shards(self) -> int:
+        """Total shards the map routes to (1 + highest owner id)."""
+        return 1 + max(o for segs in self.classes for _, o in segs)
+
+    # -- lookup -------------------------------------------------------------
+
+    def owner_of_hash(self, h: int) -> int:
+        segs = self.classes[h % self.n_boot]
+        owner = segs[0][1]
+        for start, o in segs:
+            if start > h:
+                break
+            owner = o
+        return owner
+
+    def owner(self, namespace: str, name: str) -> int:
+        return self.owner_of_hash(key_hash64(namespace, name))
+
+    def owner_of(self, obj: Unstructured) -> int:
+        """Owning shard of an OBJECT — judged by :func:`split_key`, so
+        co-located children follow their controller root."""
+        return self.owner(*split_key(obj))
+
+    # -- topology -----------------------------------------------------------
+
+    def segments(self):
+        """Yield ``(class_id, start, end, owner)`` for every segment."""
+        for c, segs in enumerate(self.classes):
+            for i, (start, owner) in enumerate(segs):
+                end = segs[i + 1][0] if i + 1 < len(segs) else HASH_SPACE
+                yield c, start, end, owner
+
+    def ranges(self) -> List[Dict[str, Any]]:
+        """Debug/vector-test view: every segment with hex cut points."""
+        return [
+            {
+                "class": c,
+                "start": f"0x{start:016x}",
+                "end": f"0x{end:016x}",
+                "owner": owner,
+            }
+            for c, start, end, owner in self.segments()
+        ]
+
+    def ranges_for(self, index: int) -> List[Dict[str, Any]]:
+        return [r for r in self.ranges() if r["owner"] == index]
+
+    # -- split --------------------------------------------------------------
+
+    def split(self, parent: int) -> Tuple["OwnershipMap", Dict[str, Any]]:
+        """Plan a split of ``parent``'s widest owned segment.
+
+        Returns ``(new_map, plan)``: the epoch+1 map where the upper
+        half of that segment belongs to a NEW shard id, and the plan
+        dict (``class_id``/``start``/``mid``/``end``/``parent``/
+        ``child``/``epoch``/``n_boot``) that :func:`split_pred` turns
+        into the moved-range membership test. ``self`` is not mutated —
+        the caller publishes the new map only at cutover.
+        """
+        best = None  # (width, class_id, start, end) — widest wins, ties low
+        for c, start, end, owner in self.segments():
+            if owner != parent:
+                continue
+            width = end - start
+            if best is None or width > best[0]:
+                best = (width, c, start, end)
+        if best is None:
+            raise ValueError(f"shard {parent} owns no keyspace range")
+        width, c, start, end = best
+        if width < 2:
+            raise ValueError(
+                f"shard {parent}'s widest range [{start}, {end}) is too "
+                f"narrow to split"
+            )
+        mid = start + width // 2
+        child = self.n_shards
+        classes = [list(segs) for segs in self.classes]
+        segs = classes[c]
+        at = next(i for i, (s, _) in enumerate(segs) if s == start)
+        segs.insert(at + 1, (mid, child))
+        new_map = OwnershipMap(self.n_boot, classes, epoch=self.epoch + 1)
+        plan = {
+            "epoch": new_map.epoch,
+            "n_boot": self.n_boot,
+            "class_id": c,
+            "start": start,
+            "mid": mid,
+            "end": end,
+            "parent": parent,
+            "child": child,
+        }
+        return new_map, plan
+
+    # -- persistence --------------------------------------------------------
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {
+            "version": 1,
+            "epoch": self.epoch,
+            "n_boot": self.n_boot,
+            "classes": [
+                [[f"0x{start:016x}", owner] for start, owner in segs]
+                for segs in self.classes
+            ],
+        }
+
+    @classmethod
+    def from_doc(cls, doc: Dict[str, Any]) -> "OwnershipMap":
+        if int(doc.get("version", 0)) != 1:
+            raise ValueError(
+                f"unknown ownership map version {doc.get('version')!r}"
+            )
+        classes = [
+            [(int(str(start), 16), int(owner)) for start, owner in segs]
+            for segs in doc["classes"]
+        ]
+        return cls(int(doc["n_boot"]), classes, epoch=int(doc["epoch"]))
+
+    def save(self, path: str) -> None:
+        """Durably publish the map: tmp write + fsync + atomic rename +
+        dir fsync. The rename is a live split's commit point — recovery
+        resolves ownership of moved keys by whichever map is on disk."""
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_doc(), f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+    @classmethod
+    def load(cls, path: str) -> Optional["OwnershipMap"]:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return cls.from_doc(json.load(f))
+        except FileNotFoundError:
+            return None
+
+
+def split_pred(plan: Dict[str, Any]) -> Callable[[str, str], bool]:
+    """The moved-range membership test of a split plan:
+    ``pred(namespace, name)`` is True iff those coordinates hash into
+    ``[mid, end)`` of the plan's residue class. Callers decide WHICH
+    coordinates to test — :func:`split_key` for whole objects, the key's
+    own for bare WAL delete records."""
+    n_boot = int(plan["n_boot"])
+    class_id = int(plan["class_id"])
+    mid = int(plan["mid"])
+    end = int(plan["end"])
+
+    def pred(namespace: str, name: str) -> bool:
+        h = key_hash64(namespace, name)
+        return h % n_boot == class_id and mid <= h < end
+
+    return pred
 
 
 def shard_dir(data_dir: str, index: int) -> str:
     return os.path.join(data_dir, SHARD_DIR_FMT.format(index))
+
+
+def canonical_objects(objects: Sequence[Dict[str, Any]]) -> str:
+    """Canonical JSON of an object SET (no rv) — the split-time I6
+    check: the child store must equal a filtered independent replay of
+    the parent's WAL, but the two sides legitimately disagree on rv
+    (the child's counter only advances on in-range records)."""
+    return json.dumps(sorted(json.dumps(o, sort_keys=True) for o in objects))
 
 
 def canonical_state(objects: Sequence[Dict[str, Any]], rv: int) -> str:
@@ -357,6 +642,86 @@ class FollowerReplica:
         )
 
 
+class RangeFilteredFollower(FollowerReplica):
+    """A follower that materializes only the keys inside a moving hash
+    range — the split coordinator's child-side state builder.
+
+    Attached to the PARENT's Persistence like any follower (atomic
+    bootstrap + live WAL shipping), but both the bootstrap state and
+    every shipped record pass a membership test first:
+
+    - whole objects (bootstrap, resync, ``put`` records) are judged by
+      :func:`split_key`, so owner families move together;
+    - bare ``del`` records carry only a key — the delete applies when
+      the key is already in this store (it got here via its owner's
+      hash) or when its OWN hash is in range.
+
+    Everything else — torn-tail handling, generation fencing, counters —
+    is inherited, so the child is promotable by the exact machinery a
+    failover uses. The store's rv is seeded at the parent's FULL rv (not
+    a filtered one): clients that bracketed rvs against the parent never
+    see the moved keys travel backwards in time.
+    """
+
+    def __init__(
+        self,
+        pred: Callable[[str, str], bool],
+        clock: Optional[Clock] = None,
+        name: str = "split-child",
+        tracer=None,
+    ):
+        super().__init__(clock, name=name, tracer=tracer)
+        self._pred = pred
+        #: Shipped records outside the moving range, skipped without
+        #: touching the store (NOT an error — the parent keeps serving
+        #: its retained keyspace while the child catches up).
+        self.records_filtered = 0
+
+    def _filter_state(self, state: RecoveredState) -> RecoveredState:
+        kept = [o for o in state.objects if self._pred(*split_key(o))]
+        dels = [
+            k for k in state.wal_deleted_keys
+            if len(k) == 4 and self._pred(str(k[2]), str(k[3]))
+        ]
+        return dataclasses.replace(state, objects=kept, wal_deleted_keys=dels)
+
+    def bootstrap(self, state: RecoveredState) -> None:
+        super().bootstrap(self._filter_state(state))
+
+    def resync(self, state: RecoveredState) -> None:
+        super().resync(self._filter_state(state))
+
+    def _apply_line(self, line: bytes) -> None:
+        try:
+            rec = json.loads(line)
+            op = rec.get("op")
+        except (ValueError, TypeError):
+            rec, op = None, None
+        if rec is not None:
+            skip = False
+            if op == "put":
+                obj = rec.get("obj")
+                if isinstance(obj, dict) and not self._pred(*split_key(obj)):
+                    skip = True
+            elif op == "del":
+                key = tuple(rec.get("key") or ())
+                if len(key) == 4:
+                    in_store = self.store.get_frozen(*key) is not None
+                    if not in_store and not self._pred(str(key[2]), str(key[3])):
+                        skip = True
+            if skip:
+                self.records_filtered += 1
+                # Generation still advances on filtered records: the
+                # fencing watermark is a property of the STREAM, and a
+                # later in-range record from a demoted leader must be
+                # rejected against the highest generation ever shipped.
+                gen = int(rec.get("gen") or 0)
+                if gen > self.generation:
+                    self.generation = gen
+                return
+        super()._apply_line(line)
+
+
 # ---------------------------------------------------------------------------
 # shard bundle + router
 # ---------------------------------------------------------------------------
@@ -440,19 +805,61 @@ class ShardRouter:
     posture, where a list spanning resource types is not a snapshot
     either. Each individual object keeps full optimistic-concurrency
     semantics on its home shard.
+
+    Routing consults the keyspace :class:`OwnershipMap` (epoch 0 is
+    byte-identical to the fixed modulo hash), and write verbs re-route
+    on :class:`WrongShardError` — a write that raced a live split's
+    cutover chases the raised owner hint / republished map, bounded by
+    ``WRONG_SHARD_RETRY_DEADLINE_S``.
     """
 
-    def __init__(self, stores: Sequence[Any]):
+    #: How long a write chases a moving range before giving up. Covers
+    #: a full split dark window (gated <= 2s) with room to spare.
+    WRONG_SHARD_RETRY_DEADLINE_S = 5.0
+    #: Pause between re-route attempts while the new map is unpublished.
+    WRONG_SHARD_RETRY_SLEEP_S = 0.02
+
+    def __init__(
+        self,
+        stores: Sequence[Any],
+        ownership: Optional[OwnershipMap] = None,
+        metrics: Optional[Any] = None,
+    ):
         if not stores:
             raise ValueError("ShardRouter needs at least one shard store")
         self._stores: List[Any] = list(stores)
         self.n_shards = len(self._stores)
+        self._ownership = (
+            ownership if ownership is not None
+            else OwnershipMap.boot(self.n_shards)
+        )
+        self._metrics = metrics
+        self._watchers: List[Tuple[Callable[[WatchEvent], None], bool]] = []
+        #: Writes re-routed after a WrongShardError (split cutover race).
+        self.wrong_shard_retries = 0
+        #: Single-object lookups that missed the ownership-map home and
+        #: probed the other shards (owner-co-located children).
+        self.probe_fallbacks = 0
+
+    def _count(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name)
 
     # -- topology -----------------------------------------------------------
 
     @property
     def clock(self) -> Clock:
         return self._stores[0].clock
+
+    @property
+    def ownership(self) -> OwnershipMap:
+        return self._ownership
+
+    def set_ownership(self, ownership: OwnershipMap) -> None:
+        """Publish a new ownership map (split cutover). A single
+        reference swap — requests in flight route by whichever map they
+        already read, and chase a WrongShardError if they chose stale."""
+        self._ownership = ownership
 
     def store(self, index: int) -> Any:
         return self._stores[index]
@@ -464,23 +871,40 @@ class ShardRouter:
         """Swap a shard's backend (failover promotion)."""
         self._stores[index] = store
 
+    def add_shard(self, store: Any) -> int:
+        """Append a brand-new shard backend (split cutover) and replay
+        every recorded watcher subscription onto it, so merged watch
+        streams keep flowing across the topology change."""
+        self._stores.append(store)
+        self.n_shards = len(self._stores)
+        for fn, coalesce in self._watchers:
+            store.add_watcher(fn, coalesce)
+        return self.n_shards - 1
+
     def shard_for(self, namespace: str, name: str) -> int:
-        return shard_index(namespace, name, self.n_shards)
+        return self._ownership.owner(namespace, name)
 
     def _home(self, namespace: str, name: str) -> Any:
-        return self._stores[shard_index(namespace, name, self.n_shards)]
+        return self._stores[self._ownership.owner(namespace, name)]
 
     def _locate(
         self, api_version: str, kind: str, namespace: str, name: str
     ) -> Any:
-        """Shard holding the object: hash home, else probe. Falls back to
-        the hash home when absent everywhere so the verb raises the same
+        """Shard holding the object: ownership-map home first, probe as
+        the counted fallback. The probe exists for owner-co-located
+        children (they live on their OWNER's shard, and a bare key does
+        not name the owner); every fallback increments
+        ``router_probe_fallbacks_total`` so a hot probe path shows up
+        instead of hiding as silent O(N) fan-out. Falls back to the
+        hash home when absent everywhere so the verb raises the same
         NotFoundError a single store would."""
         home = self._home(namespace, name)
         if self.n_shards == 1:
             return home
         if home.get_frozen(api_version, kind, namespace, name) is not None:
             return home
+        self.probe_fallbacks += 1
+        self._count("router_probe_fallbacks_total")
         for s in self._stores:
             if s is home:
                 continue
@@ -488,11 +912,44 @@ class ShardRouter:
                 return s
         return home
 
+    def _dispatch_write(
+        self,
+        call: Callable[[Any], Any],
+        relocate: Callable[[], Any],
+    ) -> Any:
+        """Run ``call`` against ``relocate()``'s pick, chasing
+        WrongShardError re-routes (bounded): during a split's dark
+        window the parent refuses the moving range, and the raised owner
+        hint names a shard the router may not serve yet — retry against
+        the hint when addressable, else re-resolve until the new map is
+        published or the deadline passes."""
+        target = relocate()
+        deadline = time.monotonic() + self.WRONG_SHARD_RETRY_DEADLINE_S
+        while True:
+            try:
+                return call(target)
+            except WrongShardError as err:
+                self.wrong_shard_retries += 1
+                self._count("router_wrong_shard_retries_total")
+                if time.monotonic() >= deadline:
+                    raise
+                owner = getattr(err, "owner", None)
+                nxt = None
+                if owner is not None and 0 <= int(owner) < len(self._stores):
+                    nxt = self._stores[int(owner)]
+                if nxt is None or nxt is target:
+                    nxt = relocate()
+                if nxt is target:
+                    time.sleep(self.WRONG_SHARD_RETRY_SLEEP_S)
+                target = nxt
+
     # -- single-object verbs -------------------------------------------------
 
     def create(self, obj: Unstructured) -> Unstructured:
         _, _, ns, name = object_key(obj)
-        return self._home(ns, name).create(obj)
+        return self._dispatch_write(
+            lambda s: s.create(obj), lambda: self._home(ns, name)
+        )
 
     def get(self, api_version: str, kind: str, namespace: str, name: str):
         return self._locate(api_version, kind, namespace, name).get(
@@ -511,7 +968,10 @@ class ShardRouter:
 
     def update(self, obj: Unstructured) -> Unstructured:
         av, kind, ns, name = object_key(obj)
-        return self._locate(av, kind, ns, name).update(obj)
+        return self._dispatch_write(
+            lambda s: s.update(obj),
+            lambda: self._locate(av, kind, ns, name),
+        )
 
     def patch_status(
         self,
@@ -521,8 +981,11 @@ class ShardRouter:
         name: str,
         status: Dict[str, Any],
     ) -> Unstructured:
-        return self._locate(api_version, kind, namespace, name).patch_status(
-            api_version, kind, namespace, name, status
+        return self._dispatch_write(
+            lambda s: s.patch_status(
+                api_version, kind, namespace, name, status
+            ),
+            lambda: self._locate(api_version, kind, namespace, name),
         )
 
     def delete(
@@ -533,8 +996,11 @@ class ShardRouter:
         name: str,
         propagation: str = "Background",
     ) -> None:
-        self._locate(api_version, kind, namespace, name).delete(
-            api_version, kind, namespace, name, propagation=propagation
+        self._dispatch_write(
+            lambda s: s.delete(
+                api_version, kind, namespace, name, propagation=propagation
+            ),
+            lambda: self._locate(api_version, kind, namespace, name),
         )
 
     def record_event(
@@ -543,8 +1009,9 @@ class ShardRouter:
         _, _, ns, name = object_key(involved)
         av = involved.get("apiVersion", "")
         kind = involved.get("kind", "")
-        self._locate(av, kind, ns, name).record_event(
-            involved, etype, reason, message
+        self._dispatch_write(
+            lambda s: s.record_event(involved, etype, reason, message),
+            lambda: self._locate(av, kind, ns, name),
         )
 
     # -- fan-out reads -------------------------------------------------------
@@ -607,6 +1074,10 @@ class ShardRouter:
     def add_watcher(
         self, fn: Callable[[WatchEvent], None], coalesce: bool = False
     ) -> None:
+        # Recorded so add_shard() can replay the subscription onto a
+        # split child — router-level watchers span the whole keyspace,
+        # topology changes included.
+        self._watchers.append((fn, coalesce))
         for s in self._stores:
             s.add_watcher(fn, coalesce)
 
@@ -705,7 +1176,7 @@ class ShardedControlPlane:
                 "shard's WAL byte stream, which only exists with "
                 "durability enabled"
             )
-        self.n_shards = n_shards
+        self.n_boot = n_shards
         self.replicas = replicas
         self.data_dir = data_dir
         self.clock = clock if clock is not None else RealClock()
@@ -720,8 +1191,33 @@ class ShardedControlPlane:
         if flush_interval_s is not None:
             self._pers_kwargs["flush_interval_s"] = flush_interval_s
 
+        # Keyspace ownership: the on-disk map outranks the boot count —
+        # a restart after live splits must serve every shard the map
+        # names, not just the boot-time N. A child dir WITHOUT a map
+        # naming it (a split that died before its commit rename) is
+        # ignored: the parent still owns the whole range.
+        self.ownership = OwnershipMap.boot(n_shards)
+        self._ownership_path: Optional[str] = None
+        if data_dir:
+            self._ownership_path = os.path.join(data_dir, OWNERSHIP_FILE)
+            loaded = OwnershipMap.load(self._ownership_path)
+            if loaded is not None:
+                if loaded.n_boot != n_shards:
+                    raise ValueError(
+                        f"ownership map at {self._ownership_path} was laid "
+                        f"out over {loaded.n_boot} boot shard(s); "
+                        f"--shards {n_shards} cannot load it"
+                    )
+                self.ownership = loaded
+        if data_dir:
+            self._adopt_single_store_layout(data_dir)
+        self.n_shards = self.ownership.n_shards
+        self.splits = 0
+        self._split_lock = threading.Lock()
+        self._split_progress: Optional[Dict[str, Any]] = None
+
         self.shards: List[Shard] = []
-        for i in range(n_shards):
+        for i in range(self.n_shards):
             store = APIServer(self.clock)
             shard_audit = audit.shard_view(i) if audit is not None else None
             pers: Optional[Persistence] = None
@@ -737,7 +1233,7 @@ class ShardedControlPlane:
                     # Before start(): recovery itself is an audited
                     # cluster event (crash_recovery, stamped per shard).
                     pers.attach_audit(shard_audit)
-                recovered = pers.start(store)
+                recovered = pers.start(store, keep=self._keep_fn(i))
                 if replicas:
                     follower = FollowerReplica(self.clock)
                     pers.attach_follower(follower)
@@ -748,7 +1244,11 @@ class ShardedControlPlane:
             self.shards.append(
                 Shard(i, store, pers, follower, sdir, recovered)
             )
-        self.router = ShardRouter([s.store for s in self.shards])
+        self.router = ShardRouter(
+            [s.store for s in self.shards],
+            ownership=self.ownership,
+            metrics=metrics,
+        )
 
     @property
     def recovered_any(self) -> bool:
@@ -756,6 +1256,406 @@ class ShardedControlPlane:
             s.recovered is not None and not s.recovered.empty
             for s in self.shards
         )
+
+    def _adopt_single_store_layout(self, data_dir: str) -> None:
+        """Adopt a root-level single-store layout (``<data_dir>/wal.jsonl``
+        + ``snapshot.json`` — what an unsharded deployment writes) into
+        shard 0's directory, so growing an unsharded data dir into the
+        sharded plane (``--shards 1 --split shard=0``) carries the data
+        along instead of silently booting an empty shard 0 beside it.
+
+        Only the 1-shard boot layout is adoptable: modulo-1 homes every
+        key on shard 0, so two renames migrate the store exactly.
+        Booting N>1 shards over a root layout would strand most keys on
+        the wrong modulo — refuse loudly instead. A data dir carrying
+        BOTH layouts keeps the sharded one (the root files can only be
+        a pre-migration leftover; adoption renames them away, so a
+        normal life cycle never produces both)."""
+        root = {
+            name: os.path.join(data_dir, name)
+            for name in ("wal.jsonl", "snapshot.json")
+        }
+        present = {n: p for n, p in root.items() if os.path.exists(p)}
+        if not present:
+            return
+        sdir = shard_dir(data_dir, 0)
+        if any(
+            os.path.exists(os.path.join(sdir, n))
+            for n in ("wal.jsonl", "snapshot.json")
+        ):
+            return
+        if self.n_boot != 1:
+            raise ValueError(
+                f"{data_dir} holds a single-store layout "
+                f"({', '.join(sorted(present))}); --shards "
+                f"{self.n_boot} cannot adopt it (keys would land on the "
+                f"wrong modulo). Boot with --shards 1 and grow with "
+                f"--split shard=0."
+            )
+        os.makedirs(sdir, exist_ok=True)
+        for name, src in present.items():
+            os.replace(src, os.path.join(sdir, name))
+        logger.info(
+            "adopted single-store layout at %s into %s (epoch-0 "
+            "ownership of 1 shard is the identity map)", data_dir, sdir,
+        )
+
+    def _keep_fn(self, index: int) -> Optional[Callable[[Dict[str, Any]], bool]]:
+        """Boot-time recovery filter for shard ``index``: keep an object
+        iff the ownership map homes its :func:`split_key` here.
+
+        This is the crash-after-commit half of split recovery: a death
+        between the ownership rename and the parent's compaction
+        snapshot leaves moved keys in the parent's WAL, and this filter
+        drops them on the next boot (``Persistence.start`` then compacts
+        the drop durable). At epoch 0 the map IS the modulo hash and the
+        filter would keep everything — skip the overhead."""
+        if self.ownership.epoch == 0:
+            return None
+
+        def keep(obj: Dict[str, Any], _i: int = index) -> bool:
+            return self.ownership.owner(*split_key(obj)) == _i
+
+        return keep
+
+    # -- live split ----------------------------------------------------------
+
+    #: Catch-up budget before a split aborts (the parent keeps serving
+    #: the full range the whole time, so aborting is cheap and safe).
+    SPLIT_CATCHUP_TIMEOUT_S = 30.0
+
+    def _split_catch_up(
+        self,
+        pers: Persistence,
+        follower: "RangeFilteredFollower",
+        progress: Dict[str, Any],
+        timeout: float,
+    ) -> int:
+        """Drive the parent→child ship backlog toward zero. Returns the
+        residual byte lag at exit — 0, or the point where another pass
+        stopped helping (a live write load keeps appending; the dark
+        window's post-fence drain settles the remainder)."""
+        deadline = time.monotonic() + timeout
+        last: Optional[int] = None
+        while True:
+            pers.flush()
+            pers.drain_shippers(
+                timeout=max(0.1, deadline - time.monotonic())
+            )
+            lag = max(0, pers.bytes_appended - follower.bytes_applied)
+            progress["records_shipped"] = (
+                follower.records_applied + follower.records_filtered
+            )
+            progress["lag_bytes"] = lag
+            if lag == 0 or (last is not None and lag >= last):
+                return lag
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"split catch-up timed out with {lag} bytes of ship lag"
+                )
+            last = lag
+
+    def split_shard(
+        self,
+        index: int,
+        fence: bool = True,
+        dark_window_hook: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ) -> Dict[str, Any]:
+        """Carve shard ``index``'s widest owned hash range in half, LIVE.
+
+        The child is built by the replication machinery failovers
+        already trust, with a range filter in front of it:
+
+        1. **attach** — a :class:`RangeFilteredFollower` bootstraps from
+           the parent's durable state (atomically, under the WAL lock)
+           and consumes the live ship stream, keeping only moved keys.
+        2. **catch_up** — flush + drain until the backlog stops
+           shrinking; the parent serves the FULL range throughout.
+        3. **dark window** — the parent's lease generation is bumped and
+           the moving range is fenced (``Persistence.fence_range``):
+           in-range appends now raise :class:`WrongShardError` BEFORE
+           commit, carrying the child id + new epoch as routing hints.
+           One final drain makes the child byte-exact, checked against
+           an independent filtered WAL replay (the split-time I6).
+        4. **materialize** — the child store gets its own Persistence
+           over ``shard-<child>`` (snapshot-first, like a promotion),
+           plus a hot-standby follower when ``replicas`` is on.
+        5. **commit** — the new ownership map's atomic rename. Crash
+           BEFORE: the map still says parent-owns-all, the child dir is
+           unowned garbage (cleared on the next split attempt). Crash
+           AFTER: the map says child-owns-range, and the parent's boot
+           keep-filter drops its stale copies. Never two owners, never
+           zero.
+        6. **cleanup** — the parent evicts the moved keys (no watch
+           events, no WAL deletes — the keys MOVED, they didn't end)
+           and compacts, making the eviction durable.
+        7. **publish** — the router gains the child backend and the new
+           map; refused writes that were chasing the fence re-route and
+           land. The dark window ends here.
+
+        Any failure before commit aborts cleanly: the fence lifts, the
+        child detaches and is discarded, the parent owns the whole range
+        as if nothing happened. ``fence=False`` (chaos counter-proof
+        ONLY) runs the same protocol without step 3's fail-close, which
+        is exactly the lost-update hole the fence exists to plug.
+        ``dark_window_hook(plan)`` fires inside the dark window after
+        the child detaches — the soak's probe point.
+        """
+        if not self.data_dir or self._ownership_path is None:
+            raise RuntimeError(
+                "live splits require --data-dir: the WAL byte stream is "
+                "the handoff medium"
+            )
+        if not self._split_lock.acquire(blocking=False):
+            raise RuntimeError("a split is already in progress")
+        try:
+            return self._split_locked(index, fence, dark_window_hook)
+        finally:
+            self._split_lock.release()
+
+    def _split_locked(
+        self,
+        index: int,
+        fence: bool,
+        dark_window_hook: Optional[Callable[[Dict[str, Any]], None]],
+    ) -> Dict[str, Any]:
+        shard = self.shards[index]
+        pers = shard.persistence
+        if pers is None or pers.dead or pers.fenced:
+            raise RuntimeError(f"shard {index} has no live persistence to split")
+        new_map, plan = self.ownership.split(index)
+        child_index = plan["child"]
+        pred = split_pred(plan)
+        t0_mono = time.monotonic()
+        t_start = time.time()
+        progress: Dict[str, Any] = {
+            "phase": "attach",
+            "parent": index,
+            "child": child_index,
+            "epoch": plan["epoch"],
+            "range": {
+                "class": plan["class_id"],
+                "start": f"0x{plan['mid']:016x}",
+                "end": f"0x{plan['end']:016x}",
+            },
+            "started_unix": t_start,
+            "records_shipped": 0,
+            "lag_bytes": None,
+        }
+        self._split_progress = progress
+        if self.audit is not None:
+            self.audit.record(
+                "cluster", "split_started", shard=index, child=child_index,
+                epoch=plan["epoch"], hash_class=plan["class_id"],
+                start=f"0x{plan['mid']:016x}", end=f"0x{plan['end']:016x}",
+                fenced=fence,
+            )
+        child_follower = RangeFilteredFollower(
+            pred, self.clock, name=f"split-child-{child_index}",
+            tracer=self.tracer,
+        )
+        committed = False
+        t_fence_mono: Optional[float] = None
+        t_attached = t_caught_up = t_dark_done = t_materialized = t_start
+        try:
+            # 1 — attach (atomic filtered bootstrap + live shipping)
+            pers.attach_follower(child_follower)
+            t_attached = time.time()
+            # 2 — catch up under live load
+            progress["phase"] = "catch_up"
+            self._split_catch_up(
+                pers, child_follower, progress, self.SPLIT_CATCHUP_TIMEOUT_S
+            )
+            t_caught_up = time.time()
+            # 3 — dark window: fail-close the moving range, final drain
+            progress["phase"] = "dark_window"
+            t_fence_mono = time.monotonic()
+            if fence:
+                pers.set_generation(pers.generation + 1)
+                pers.fence_range(
+                    pred, owner=child_index, map_epoch=plan["epoch"]
+                )
+            pers.flush()
+            if not pers.drain_shippers(timeout=10.0):
+                raise RuntimeError("split final drain timed out")
+            progress["records_shipped"] = (
+                child_follower.records_applied + child_follower.records_filtered
+            )
+            progress["lag_bytes"] = 0
+            # Split-time I6: the child must equal an INDEPENDENT replay
+            # of the parent's on-disk WAL, filtered by the same
+            # membership test. Only enforceable when the range is
+            # fenced — un-fenced (counter-proof) writes keep racing.
+            replay = Persistence(shard.data_dir, **self._pers_kwargs).recover()
+            replay_kept = [o for o in replay.objects if pred(*split_key(o))]
+            i6_ok = (
+                canonical_objects(child_follower.store.all_objects())
+                == canonical_objects(replay_kept)
+            )
+            if fence and not i6_ok:
+                raise RuntimeError(
+                    f"split child state diverged from filtered WAL replay "
+                    f"(shard {index} -> {child_index})"
+                )
+            pers.detach_follower(child_follower)
+            if dark_window_hook is not None:
+                dark_window_hook(dict(plan))
+            t_dark_done = time.time()
+            # 4 — materialize the child slice
+            progress["phase"] = "materialize"
+            child_dir = shard_dir(self.data_dir, child_index)
+            if os.path.isdir(child_dir):
+                # A split that died before its commit rename left this
+                # dir behind; the map never named it, so it is unowned
+                # garbage by construction.
+                logger.warning(
+                    "split: clearing stray child dir %s", child_dir
+                )
+                shutil.rmtree(child_dir)
+            child_store = child_follower.store
+            child_pers = Persistence(child_dir, **self._pers_kwargs)
+            if self.metrics is not None:
+                child_pers.instrument(ShardMetrics(self.metrics, child_index))
+            if self.audit is not None:
+                child_pers.attach_audit(self.audit.shard_view(child_index))
+            child_pers.set_generation(child_follower.generation + 1)
+            child_pers.open()
+            child_pers.write_snapshot(
+                child_store.all_objects(),
+                int(getattr(child_store, "_rv", 0)),
+            )
+            child_store.attach_persistence(child_pers)
+            if self.metrics is not None:
+                child_store.instrument(ShardMetrics(self.metrics, child_index))
+            if self.audit is not None:
+                child_store.attach_audit(self.audit.shard_view(child_index))
+            child_replica: Optional[FollowerReplica] = None
+            if self.replicas:
+                child_replica = FollowerReplica(self.clock)
+                child_pers.attach_follower(child_replica)
+            t_materialized = time.time()
+            # 5 — commit (atomic ownership rename)
+            progress["phase"] = "commit"
+            new_map.save(self._ownership_path)
+            committed = True
+            # 6 — parent cleanup BEFORE publish: evict + compact first,
+            # so fan-out reads never see a moved key on two shards.
+            moved_keys = [
+                object_key(o) for o in shard.store.all_objects()
+                if pred(*split_key(o))
+            ]
+            evicted = shard.store.evict_for_split(moved_keys)
+            pers.write_snapshot(
+                shard.store.all_objects(),
+                int(getattr(shard.store, "_rv", 0)),
+            )
+            # 7 — publish: router serves the child; dark window ends.
+            progress["phase"] = "publish"
+            new_shard = Shard(
+                child_index, child_store, child_pers, child_replica,
+                child_dir, None,
+            )
+            self.shards.append(new_shard)
+            self.router.add_shard(child_store)
+            self.ownership = new_map
+            self.router.set_ownership(new_map)
+            self.n_shards = len(self.shards)
+            dark_window_s = time.monotonic() - (t_fence_mono or t0_mono)
+            t_published = time.time()
+        except Exception:
+            self._split_progress = None
+            if not committed:
+                # Clean abort: parent owns the whole range again.
+                try:
+                    pers.lift_range_fence()
+                except Exception:  # pragma: no cover - best-effort unwind
+                    logger.exception("split abort: lift_range_fence failed")
+                try:
+                    pers.detach_follower(child_follower)
+                except Exception:  # pragma: no cover
+                    logger.exception("split abort: detach_follower failed")
+                try:
+                    child_follower.store.close()
+                except Exception:  # pragma: no cover
+                    logger.exception("split abort: child store close failed")
+            if self.metrics is not None:
+                self.metrics.inc('shard_splits_total{outcome="aborted"}')
+            if self.audit is not None:
+                self.audit.record(
+                    "cluster", "split_aborted", shard=index,
+                    child=child_index, epoch=plan["epoch"],
+                    committed=committed,
+                )
+            logger.exception(
+                "split of shard %d aborted (committed=%s)", index, committed
+            )
+            raise
+        # -- success bookkeeping ------------------------------------------
+        duration = time.monotonic() - t0_mono
+        self.splits += 1
+        self._split_progress = None
+        if self.metrics is not None:
+            self.metrics.inc('shard_splits_total{outcome="ok"}')
+            self.metrics.observe(
+                "shard_split_duration_seconds", duration,
+                buckets=SPLIT_BUCKETS,
+            )
+            self.metrics.observe(
+                "shard_split_dark_window_seconds", dark_window_s,
+                buckets=DARK_WINDOW_BUCKETS,
+            )
+            self._refresh_lag_gauges(shard)
+            self._refresh_lag_gauges(new_shard)
+        if self.tracer is not None:
+            tid = new_trace_id()
+            attrs = {
+                "parent": index, "child": child_index,
+                "epoch": plan["epoch"], "moved": evicted, "i6_ok": i6_ok,
+            }
+            root = self.tracer.record(
+                "shard_split", tid, t_start, t_published, attrs=attrs
+            )
+            for name, a, b in (
+                ("attach", t_start, t_attached),
+                ("catch_up", t_attached, t_caught_up),
+                ("dark_window", t_caught_up, t_dark_done),
+                ("materialize", t_dark_done, t_materialized),
+                ("publish", t_materialized, t_published),
+            ):
+                self.tracer.record(
+                    name, tid, a, b, parent_id=root.span_id, attrs=attrs
+                )
+        if self.audit is not None:
+            self.audit.record(
+                "cluster", "split_cutover", shard=index, child=child_index,
+                epoch=plan["epoch"], moved=evicted, i6_ok=i6_ok,
+                fenced=fence,
+                dark_window_s=round(dark_window_s, 6),
+                duration_s=round(duration, 6),
+                records_shipped=child_follower.records_applied,
+                child_objects=len(child_store),
+                parent_objects=len(shard.store),
+            )
+        logger.info(
+            "shard %d split -> child %d at epoch %d (moved=%d, "
+            "dark_window=%.3fs, i6_ok=%s)",
+            index, child_index, plan["epoch"], evicted, dark_window_s, i6_ok,
+        )
+        return {
+            "parent": index,
+            "child": child_index,
+            "epoch": plan["epoch"],
+            "moved": evicted,
+            "i6_ok": i6_ok,
+            "fenced": fence,
+            "dark_window_s": dark_window_s,
+            "duration_s": duration,
+            "records_shipped": child_follower.records_applied,
+            "records_filtered": child_follower.records_filtered,
+            "child_objects": len(child_store),
+            "parent_objects": len(shard.store),
+            "plan": plan,
+        }
 
     # -- failover ------------------------------------------------------------
 
@@ -927,6 +1827,7 @@ class ShardedControlPlane:
                 "failovers": s.failovers,
                 "leader": s.leader,
                 "data_dir": s.data_dir,
+                "ranges": self.ownership.ranges_for(s.index),
             }
             if s.persistence is not None:
                 entry["wal"] = s.persistence.stats()
@@ -944,12 +1845,26 @@ class ShardedControlPlane:
                 }
             shards.append(entry)
         self.refresh_lag_gauges()
+        split = self._split_progress
         return {
             "n_shards": self.n_shards,
+            "n_boot": self.n_boot,
             "replicas": self.replicas,
             "pid": os.getpid(),
             "composite_rv": int(self.router._rv),
             "objects": len(self.router),
+            "ownership": {
+                "epoch": self.ownership.epoch,
+                "n_boot": self.ownership.n_boot,
+                "n_shards": self.ownership.n_shards,
+                "ranges": self.ownership.ranges(),
+            },
+            "splits": self.splits,
+            "split_in_progress": dict(split) if split else None,
+            "router": {
+                "wrong_shard_retries": self.router.wrong_shard_retries,
+                "probe_fallbacks": self.router.probe_fallbacks,
+            },
             "shards": shards,
         }
 
@@ -988,9 +1903,19 @@ class ShardedControlPlane:
 
 __all__ = [
     "shard_index",
+    "key_hash64",
+    "split_key",
+    "split_pred",
     "shard_dir",
     "canonical_state",
+    "canonical_objects",
+    "OwnershipMap",
+    "RangeFilteredFollower",
     "FAILOVER_BUCKETS",
+    "SPLIT_BUCKETS",
+    "DARK_WINDOW_BUCKETS",
+    "HASH_SPACE",
+    "OWNERSHIP_FILE",
     "ShardMetrics",
     "FollowerReplica",
     "Shard",

@@ -78,7 +78,11 @@ from cron_operator_tpu.runtime.kube import (
     NotFoundError,
     ServerTimeoutError,
 )
-from cron_operator_tpu.runtime.persistence import Persistence, RecoveredState
+from cron_operator_tpu.runtime.persistence import (
+    Persistence,
+    RecoveredState,
+    WrongShardError,
+)
 from cron_operator_tpu.runtime.readroute import (
     DEFAULT_BARRIER_TIMEOUT_S,
     FollowerReadAPI,
@@ -904,10 +908,13 @@ class ShardClient(ClusterAPIServer):
                                    content_type=content_type,
                                    timeout=timeout)
         except (NotFoundError, AlreadyExistsError, ConflictError,
-                InvalidError):
+                InvalidError, WrongShardError):
             # Application-level outcomes: the shard answered promptly
-            # and correctly — it is HEALTHY. Only transport-level
-            # failures (timeouts, refusals, 5xx) score against it.
+            # and correctly — it is HEALTHY (WrongShard included: a 421
+            # during a live split is the shard fencing correctly, and
+            # tripping the breaker on it would fail-fast the very
+            # retries that resolve it). Only transport-level failures
+            # (timeouts, refusals, 5xx) score against it.
             br.record(True, time.monotonic() - t0)
             self._set_breaker_gauge()
             raise
@@ -1786,6 +1793,7 @@ class RouterServer:
         breaker_kwargs: Optional[Dict[str, Any]] = None,
         tracer: Optional[Any] = None,
         read_peers: Optional[List[List[str]]] = None,
+        ownership: Optional[Any] = None,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.runtime.shard import ShardRouter
@@ -1840,7 +1848,13 @@ class RouterServer:
                     client, fclients, shard=i, metrics=metrics,
                 )
             self.clients.append(client)
-        self.router = ShardRouter(self.clients)
+        # ownership: a keyspace OwnershipMap loaded from the data dir's
+        # ownership.json — REQUIRED for a topology that has lived
+        # through splits (the boot map only routes the boot-time
+        # modulo layout). Default: epoch-0 boot map over the peers.
+        self.router = ShardRouter(
+            self.clients, ownership=ownership, metrics=metrics
+        )
         routes: Dict[str, Any] = {
             "/debug/shards": self.debug_shards,
             "/debug/events": self.debug_events,
@@ -1918,10 +1932,21 @@ class RouterServer:
                     entry.setdefault("shard", client.shard)
                     entry["peer"] = fclient.config.server
                     shards.append(entry)
+        ownership = self.router.ownership
         return {
             "n_shards": len(self.clients),
             "mode": "processes",
             "router_pid": os.getpid(),
+            "ownership": {
+                "epoch": ownership.epoch,
+                "n_boot": ownership.n_boot,
+                "n_shards": ownership.n_shards,
+                "ranges": ownership.ranges(),
+            },
+            "router": {
+                "wrong_shard_retries": self.router.wrong_shard_retries,
+                "probe_fallbacks": self.router.probe_fallbacks,
+            },
             "shards": shards,
         }
 

@@ -3432,6 +3432,351 @@ def check_process_invariants(ev: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# live-split leg: keyspace splits under a write storm (I6/I9/I10 + S1/S2)
+# ---------------------------------------------------------------------------
+
+def run_split_soak(seed: int, n_crons: int, rounds: int,
+                   fencing: bool = True) -> dict:
+    """Live shard splits under a write storm (``--split``): start at ONE
+    boot shard, split the hottest shard every round while closed-loop
+    writer threads keep creating and patching through the router, and
+    prove the handoff invariants each time:
+
+    - **I6** (split edition): at cutover the child store must equal an
+      independent *filtered* replay of the parent's WAL (checked inside
+      ``split_shard``).
+    - **I9**: audit ≡ WAL record-for-record per shard, including the
+      shard whose persistence is SIGKILLed mid-split.
+    - **I10**: a byte-level scan of every shard dir for
+      stale-generation records (the fence bumps the parent's
+      generation; no demoted-range write may land after it).
+    - **S1 exactly-one-owner**: after every round — and after a
+      parent-kill-mid-split crash restart — every acked key is readable
+      on the shard the ownership map names and NOWHERE else.
+    - **S2 no-acked-write-lost**: the storm goes through the router
+      (which retries ``WrongShardError`` refusals), so zero
+      client-visible errors and zero acked-then-vanished writes.
+
+    One PRF-chosen round kills the parent's durability layer INSIDE the
+    dark window: the split must abort cleanly and a full restart from
+    disk must resolve to exactly one owner per key (the map on disk is
+    the commit point — whichever side of the rename the crash landed
+    on, no key may be served twice or not at all).
+
+    ``fencing=False`` is the counter-proof: the dark window writes one
+    poison record straight at the demoted parent; without the range
+    fence the parent ACKS it, the detached child never sees it, and the
+    eviction erases it — an acked write demonstrably lost from the
+    routed surface (use with ``--expect-violation``).
+    """
+    from cron_operator_tpu.runtime.kube import AlreadyExistsError
+    from cron_operator_tpu.runtime.faults import seeded_fraction
+    from cron_operator_tpu.runtime.shard import ShardedControlPlane
+    from cron_operator_tpu.telemetry.audit import AuditJournal
+
+    data_dir = tempfile.mkdtemp(prefix="chaos-soak-split-")
+    t0 = time.monotonic()
+    journal = AuditJournal()
+    plane = ShardedControlPlane(n_shards=1, data_dir=data_dir,
+                                flush_interval_s=0, audit=journal)
+    gvk = (CRON_API_VERSION, "Cron")
+    acked: list = []
+    storm_errors: list = []
+    splits: list = []
+    ownership_checks: list = []
+    audit_checks: list = []
+    kill_evidence: dict = {}
+    poison: dict = {}
+    kill_round = int(seeded_fraction(seed, "splitkill") * rounds)
+
+    for i in range(n_crons):
+        plane.router.create(_cron(i))
+        acked.append(f"chaos-{i}")
+    for s in plane.shards:
+        s.persistence.flush()
+
+    def _storm(r: int, t: int, stop: threading.Event) -> None:
+        i = 0
+        while not stop.is_set():
+            name = f"storm-{r}-{t}-{i}"
+            try:
+                plane.router.create(_cron(0) | {
+                    "metadata": {"name": name, "namespace": NAMESPACE},
+                })
+                acked.append(name)
+                # every third write also exercises the update path on a
+                # key that may be mid-move
+                if i % 3 == 0:
+                    plane.router.patch_status(
+                        *gvk, NAMESPACE, name, {"round": r})
+            except Exception as exc:
+                # Client-visible failure. Expected ONLY in the kill
+                # round, where the parent's durability layer is dead by
+                # design — everywhere else this is an S2 violation.
+                storm_errors.append({"round": r, "name": name,
+                                     "error": repr(exc)})
+            i += 1
+            time.sleep(0.001)
+
+    def _check_ownership(tag: str) -> dict:
+        lost, doubled = [], []
+        for name in acked:
+            owner = plane.ownership.owner(NAMESPACE, name)
+            if plane.shards[owner].store.get_frozen(
+                    *gvk, NAMESPACE, name) is None:
+                lost.append(name)
+            for s in plane.shards:
+                if s.index != owner and s.store.get_frozen(
+                        *gvk, NAMESPACE, name) is not None:
+                    doubled.append(name)
+        check = {"tag": tag, "n_shards": plane.n_shards,
+                 "keys": len(acked), "lost": lost[:5],
+                 "lost_total": len(lost), "doubled": doubled[:5],
+                 "doubled_total": len(doubled)}
+        ownership_checks.append(check)
+        return check
+
+    def _hottest() -> int:
+        return max(plane.shards, key=lambda s: len(s.store)).index
+
+    try:
+        for r in range(rounds):
+            stop = threading.Event()
+            threads = [
+                threading.Thread(target=_storm, args=(r, t, stop),
+                                 daemon=True)
+                for t in range(2)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            parent = _hottest()
+            if r == kill_round:
+                # SIGKILL-the-parent analog: the durability layer dies
+                # inside the dark window, after the fence is armed.
+                def _kill(plan):
+                    plane.shards[parent].persistence.kill(
+                        f"mid-split/{r}")
+
+                err = None
+                try:
+                    plane.split_shard(parent, fence=fencing,
+                                      dark_window_hook=_kill)
+                except Exception as exc:
+                    err = repr(exc)
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30.0)
+                # Dying generation's I9 verdict (crash_tail covers a
+                # record on disk whose verb never committed).
+                for s in plane.shards:
+                    if s.persistence is not None:
+                        audit_checks.append({
+                            "round": r, "shard": s.index,
+                            **journal.wal_check(
+                                s.persistence.records_appended,
+                                shard=s.index, crash_tail=1),
+                        })
+                n_before = plane.n_shards
+                plane.close()
+                # Full restart from disk: whichever side of the commit
+                # rename the crash landed on, the map decides ownership.
+                journal = AuditJournal()
+                plane = ShardedControlPlane(
+                    n_shards=1, data_dir=data_dir,
+                    flush_interval_s=0, audit=journal)
+                # The storm races the kill, so writes acked after the
+                # last flush may not be durable — drop those from the
+                # acked book (the single-store soak's suffix-loss
+                # semantics), then require exactly-one-owner for all
+                # DURABLE acks.
+                durable = [
+                    n for n in acked
+                    if any(s.store.get_frozen(*gvk, NAMESPACE, n)
+                           is not None for s in plane.shards)
+                ]
+                suffix_lost = len(acked) - len(durable)
+                acked[:] = durable
+                check = _check_ownership(f"restart-after-kill/{r}")
+                kill_evidence = {
+                    "round": r,
+                    "parent": parent,
+                    "split_error": err,
+                    "aborted_cleanly": err is not None,
+                    "n_shards_before_restart": n_before,
+                    "n_shards_after_restart": plane.n_shards,
+                    "map_epoch_after_restart": plane.ownership.epoch,
+                    "storm_suffix_lost": suffix_lost,
+                    "one_owner_after_restart":
+                        check["lost_total"] == 0
+                        and check["doubled_total"] == 0,
+                }
+                continue
+            if not fencing and not poison:
+                name = None
+
+                def _poison(plan):
+                    # find a moved-range name and write it straight at
+                    # the demoted parent — no fence, so it ACKS
+                    from cron_operator_tpu.runtime.shard import (
+                        split_pred,
+                    )
+                    nonlocal name
+                    pred = split_pred(plan)
+                    j = 0
+                    while not pred(NAMESPACE, f"poison-{j}"):
+                        j += 1
+                    name = f"poison-{j}"
+                    plane.shards[plan["parent"]].store.create(_cron(0) | {
+                        "metadata": {"name": name,
+                                     "namespace": NAMESPACE},
+                    })
+
+                report = plane.split_shard(parent, fence=False,
+                                           dark_window_hook=_poison)
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30.0)
+                poison.update({
+                    "round": r,
+                    "name": name,
+                    "acked": True,
+                    "visible_after": plane.router.try_get(
+                        *gvk, NAMESPACE, name) is not None,
+                })
+            else:
+                report = plane.split_shard(parent, fence=fencing)
+                stop.set()
+                for t in threads:
+                    t.join(timeout=30.0)
+            for s in plane.shards:
+                s.persistence.flush()
+            splits.append({
+                "round": r,
+                "parent": report["parent"],
+                "child": report["child"],
+                "epoch": report["epoch"],
+                "moved": report["moved"],
+                "i6_child_equals_filtered_replay": report["i6_ok"],
+                "fenced": report["fenced"],
+                "dark_window_s": round(report["dark_window_s"], 4),
+                "records_shipped": report["records_shipped"],
+                "records_filtered": report["records_filtered"],
+                "wrong_shard_retries": plane.router.wrong_shard_retries,
+            })
+            _check_ownership(f"post-split/{r}")
+
+        # clean end: I9 per surviving shard, I10 scan per shard dir
+        for s in plane.shards:
+            if s.persistence is not None:
+                audit_checks.append({
+                    "round": rounds, "shard": s.index,
+                    **journal.wal_check(
+                        s.persistence.records_appended, shard=s.index,
+                        crash_tail=0),
+                })
+        for s in plane.shards:
+            s.persistence.flush()
+        wal_scans = {
+            str(s.index): _scan_stale_generations(s.data_dir)
+            for s in plane.shards
+        }
+        debug = plane.debug_shards()
+    finally:
+        plane.close()
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+    return {
+        "seed": seed,
+        "fencing": fencing,
+        "rounds": rounds,
+        "kill_round": kill_round,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "n_shards_final": debug["n_shards"],
+        "map_epoch_final": debug["ownership"]["epoch"],
+        "acked_writes": len(acked),
+        "storm_errors": storm_errors[:5],
+        "storm_errors_total": len(storm_errors),
+        "storm_errors_outside_kill_round": len(
+            [e for e in storm_errors if e["round"] != kill_round]),
+        "splits": splits,
+        "ownership_checks": ownership_checks,
+        "kill_mid_split": kill_evidence,
+        "poison": poison,
+        "audit_checks": audit_checks,
+        "wal_scans": wal_scans,
+        "debug_shards": debug,
+    }
+
+
+def check_split_invariants(ev: dict) -> dict:
+    """I6/I9/I10 plus the split-specific S1/S2 for the live-split leg."""
+    splits = ev.get("splits") or []
+    i6_bad = [s["round"] for s in splits
+              if not s["i6_child_equals_filtered_replay"]]
+    i6 = {
+        "ok": not i6_bad and bool(splits),
+        "detail": (f"{len(splits)} live splits, child ≡ filtered WAL "
+                   f"replay at every cutover"
+                   if splits and not i6_bad else
+                   f"violations in rounds {i6_bad}" if i6_bad else
+                   "no splits ran"),
+    }
+    bad_audit = [a for a in ev.get("audit_checks", []) if not a["ok"]]
+    i9 = {
+        "ok": not bad_audit and bool(ev.get("audit_checks")),
+        "detail": (f"{len(ev.get('audit_checks', []))} audit≡WAL checks "
+                   f"across split handoffs and the mid-split kill"
+                   if not bad_audit else f"failed: {bad_audit[:2]}"),
+    }
+    scans = ev.get("wal_scans") or {}
+    stale = {si: s for si, s in scans.items()
+             if s["stale_records"] or s["corrupt_lines"]}
+    i10 = {
+        "ok": not stale and bool(scans),
+        "detail": (f"{len(scans)} shard dirs scanned, zero "
+                   f"stale-generation bytes"
+                   if not stale else f"stale bytes: {stale}"),
+    }
+    bad_own = [c for c in ev.get("ownership_checks", [])
+               if c["lost_total"] or c["doubled_total"]]
+    kill = ev.get("kill_mid_split") or {}
+    s1 = {
+        "ok": (not bad_own and bool(ev.get("ownership_checks"))
+               and kill.get("one_owner_after_restart", False)),
+        "detail": (f"{len(ev.get('ownership_checks', []))} "
+                   f"exactly-one-owner sweeps over "
+                   f"{ev.get('acked_writes')} keys (incl. restart after "
+                   f"the round-{kill.get('round')} mid-split kill)"
+                   if not bad_own and kill.get("one_owner_after_restart")
+                   else f"violations: {bad_own[:2]} kill={kill}"),
+    }
+    poison = ev.get("poison") or {}
+    poison_lost = bool(poison) and not poison.get("visible_after", True)
+    errs = ev.get("storm_errors_outside_kill_round", 1)
+    s2 = {
+        "ok": errs == 0 and not poison_lost,
+        "detail": (f"{ev.get('acked_writes')} storm-acked writes, zero "
+                   f"client-visible errors outside the kill round, zero "
+                   f"acked-then-lost"
+                   if errs == 0 and not poison_lost else
+                   f"errors={ev.get('storm_errors')} "
+                   f"poison_lost={poison_lost} ({poison.get('name')})"),
+    }
+    invariants = {
+        "I6_child_equals_filtered_replay": i6,
+        "I9_audit_equals_wal": i9,
+        "I10_no_stale_generation_writes": i10,
+        "S1_exactly_one_owner": s1,
+        "S2_no_acked_write_lost": s2,
+    }
+    return {
+        "invariants": invariants,
+        "ok": all(v["ok"] for v in invariants.values()),
+    }
+
+
+# ---------------------------------------------------------------------------
 # gray-failure leg: SIGSTOP zombies, fencing (I10), breakers, hangs (I11)
 # ---------------------------------------------------------------------------
 
@@ -4316,6 +4661,17 @@ def main(argv=None) -> int:
                          "woken zombie's write lands in the WAL inode the "
                          "promoted leader now owns (use with "
                          "--expect-violation)")
+    ap.add_argument("--split", action="store_true", default=False,
+                    help="run ONLY the live-split leg: start at one boot "
+                         "shard and split the hottest shard every round "
+                         "while a write storm runs through the router — "
+                         "I6 (child ≡ filtered WAL replay at cutover), "
+                         "I9, I10, exactly-one-owner after every round "
+                         "AND after a parent kill inside the dark "
+                         "window, zero acked writes lost; with "
+                         "--no-fencing the dark-window poison write is "
+                         "ACKED then erased — the counter-proof (use "
+                         "with --expect-violation)")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
 
@@ -4388,6 +4744,56 @@ def main(argv=None) -> int:
             mark = "PASS" if v["ok"] else "FAIL"
             print(f"  [{mark}] {name}: {v['detail']}")
         print(f"wrote {args.out} (ok={ok})")
+        return 0 if ok else 1
+
+    if args.split:
+        fencing = not args.no_fencing
+        rounds = max(2, args.rounds)
+        mode = "split" if fencing else "split counter-proof (fencing OFF)"
+        print(
+            f"chaos soak ({mode}): seed={args.seed} crons={args.crons} "
+            f"rounds={rounds}",
+            flush=True,
+        )
+        ev = run_split_soak(args.seed, args.crons, rounds, fencing=fencing)
+        check = check_split_invariants(ev)
+        invariants = check["invariants"]
+        ok = check["ok"]
+        report = {
+            "seed": args.seed,
+            "mode": "split" if fencing else "split-no-fencing",
+            "rounds": rounds,
+            "fencing": fencing,
+            "split_leg": ev,
+            "invariants": invariants,
+            "ok": ok,
+        }
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+            f.write("\n")
+        print(
+            f"  {len(ev['splits'])} live splits -> "
+            f"{ev['n_shards_final']} shards at map epoch "
+            f"{ev['map_epoch_final']}; {ev['acked_writes']} acked "
+            f"writes; mid-split kill in round "
+            f"{ev['kill_mid_split'].get('round')}"
+        )
+        for name, v in invariants.items():
+            mark = "PASS" if v["ok"] else "FAIL"
+            print(f"  [{mark}] {name}: {v['detail']}")
+        print(f"wrote {args.out} (ok={ok})")
+        if args.expect_violation:
+            poison = ev.get("poison") or {}
+            lost = bool(poison) and not poison.get("visible_after", True)
+            if lost:
+                print("expected violation observed — without range "
+                      f"fencing the demoted parent ACKED "
+                      f"{poison.get('name')} during the dark window and "
+                      "the split erased it from the routed surface")
+                return 0
+            print("ERROR: expected an acked-write-lost violation but "
+                  "the poison write survived (or was refused)")
+            return 1
         return 0 if ok else 1
 
     if args.no_fencing:

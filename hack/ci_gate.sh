@@ -243,6 +243,27 @@ echo "==> fencing counter-proof (same SIGSTOPs, fencing off -> I10 must break)"
 python hack/chaos_soak.py --seed 7 --rounds 2 --gray --no-fencing \
     --expect-violation --out /dev/null
 
+echo "==> live-split smoke (1->2 split under storm, fencing + crash resolution)"
+# Fixed-seed split soak: live 1->N shard splits under a concurrent write
+# storm, including a PRF-chosen round that SIGKILLs the parent's
+# persistence mid-dark-window and restarts the whole plane. Every split
+# must hold I6 (child == filtered replay of the shipped WAL), I9
+# (audit == WAL per shard), I10 (zero stale-generation records on
+# disk), S1 (every key has exactly one owner after each split AND after
+# the crash-restart), and S2 (no acked write lost). Full run:
+# make chaos-soak-split (writes CHAOS_SPLIT.json).
+python hack/chaos_soak.py --split --seed 3 --crons 40 --rounds 2 \
+    --out /dev/null
+
+echo "==> split counter-proof (same storm, fencing off -> acked write must vanish)"
+# The same split schedule with range fencing disabled: a poison write
+# routed to the demoted parent during the dark window must be ACKED and
+# then erased from the routed surface by the cutover — proves the S2
+# PASS above detects the lost-ack split-brain that range fencing
+# exists to prevent, i.e. it is not vacuous.
+python hack/chaos_soak.py --split --no-fencing --seed 3 --crons 40 \
+    --rounds 2 --expect-violation --out /dev/null
+
 echo "==> metric registry drift (every emitted family declared + typed)"
 # Explicit run of the registry drift guard: scans every metrics.inc/
 # observe/set call site AND interned-series assignment in the package,

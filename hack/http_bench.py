@@ -48,6 +48,12 @@ and ``http.client``, not mocks:
   attached and point-read trickle live must hold within 5% of its
   no-replica baseline.
 
+- **live shard split**: durable-write throughput on one boot shard,
+  then a LIVE 1->2 keyspace split under a write storm (dark window and
+  zero lost/double-applied acked writes measured), then the summed
+  per-shard post-split rate — gated >= 1.8x the pre-split rate with a
+  <= 2s dark window.
+
 Writes ``BENCH_HTTP.json`` with per-scenario OK/REGRESSION verdicts and
 an overall verdict; ``--check`` exits non-zero on REGRESSION and is the
 CI smoke leg (small sizes, no baseline worktree).
@@ -56,6 +62,7 @@ CI smoke leg (small sizes, no baseline worktree).
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
 import selectors
@@ -98,6 +105,13 @@ FANOUT_MIN_SPEEDUP = 5.0
 # while the doors serve reads.
 FOLLOWER_MIN_READ_SCALE = 3.0
 FOLLOWER_WRITE_TOLERANCE = 0.05
+# Live shard split: after a 1->2 split the summed per-shard durable
+# write rate (sequential, shared-nothing projection — same methodology
+# as make bench-shards) must clear this multiple of the pre-split
+# single-shard rate, and the split's dark window (fence -> publish)
+# must stay under the bound.
+SPLIT_MIN_SCALEUP = 1.8
+SPLIT_MAX_DARK_WINDOW_S = 2.0
 
 
 def _cron(name: str, schedule: str = "@every 1h") -> dict:
@@ -1430,6 +1444,330 @@ def _follower_fanout_verdict(leg: dict, check_mode: bool) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Scenario 7: live shard split (write-path scale-out past boot shards)
+# ---------------------------------------------------------------------------
+
+def split_leg(pre_writes: int, storm_secs: float,
+              post_writes_per_shard: int, batch: int = 25) -> dict:
+    """Live 1->2 shard split: the write path scales past the boot-time
+    shard count WITHOUT a restart.
+
+    - **pre-split**: closed-loop durable creates (flush per ``batch``)
+      against the single boot shard through the router.
+    - **live split**: the same write storm keeps running through the
+      router while ``split_shard(0)`` carves the keyspace; the dark
+      window (fence -> publish) and any lost/double-applied acked write
+      are measured. The router retries ``WrongShardError`` refusals, so
+      the storm must see zero client-visible errors.
+    - **post-split**: each shard's owned keyspace driven at full tilt
+      in isolation and the rates summed — the shared-nothing scale-out
+      projection, same methodology as ``make bench-shards`` (this host
+      has one core; concurrent driving cannot show aggregate scaling).
+      The denominator is a **contemporaneous control**: a fresh
+      single-shard plane (the boot configuration) whose rounds are
+      interleaved with the per-shard rounds in the same clock window.
+      Comparing against the historical phase-1 rate instead puts any
+      slow drift across the leg (CPU frequency, allocator/GC growth)
+      straight into the ratio — measured swings of +-25% on this host
+      — while interleaved control rounds see the same machine state.
+      The phase-1 rate is still reported as context.
+
+    Gates: aggregate >= ``SPLIT_MIN_SCALEUP`` x the interleaved
+    single-shard control, dark window <= ``SPLIT_MAX_DARK_WINDOW_S``,
+    zero lost or double-applied acked writes.
+    """
+    from cron_operator_tpu.runtime.shard import ShardedControlPlane
+
+    gvk = (CRON_AV, "Cron")
+    data_dir = tempfile.mkdtemp(prefix="httpbench-split-")
+    control_dir = tempfile.mkdtemp(prefix="httpbench-splitctl-")
+    leg: dict = {"pre_writes": pre_writes,
+                 "post_writes_per_shard": post_writes_per_shard,
+                 "batch": batch}
+    plane = ShardedControlPlane(
+        n_shards=1, data_dir=data_dir, flush_interval_s=0)
+    control = None
+
+    def _bench_cron(name):
+        return {
+            "apiVersion": CRON_AV, "kind": "Cron",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"schedule": "@every 1h"},
+        }
+
+    def drive(names, shards_to_flush, cleanup=False, router=None):
+        router = router or plane.router
+        # A cyclic collector pause inside a ~30ms timed window distorts
+        # that round by 30-50%, and the allocation pattern is periodic
+        # enough to hit the same phase position repeatedly — collect
+        # OUTSIDE the window, then keep the collector off inside it.
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            for i, name in enumerate(names):
+                router.create(_bench_cron(name))
+                if (i + 1) % batch == 0:
+                    for s in shards_to_flush:
+                        s.persistence.flush()
+            for s in shards_to_flush:
+                s.persistence.flush()
+            elapsed = time.perf_counter() - t0
+        finally:
+            gc.enable()
+        if cleanup:
+            # Untimed: return the store to its pre-round population.
+            # Commit cost grows with resident objects, so every measured
+            # round — on BOTH sides of the ratio — must run at the same
+            # store size; without this, phase 3 gets billed for phase
+            # 1's and the storm's leftovers and the ratio reads low.
+            for name in names:
+                router.delete(CRON_AV, "Cron", "default", name)
+            for s in shards_to_flush:
+                s.persistence.flush()
+        return round(len(names) / elapsed, 1) if elapsed else 0.0
+
+    # Interpreter warm-up and scheduler noise swamp a single round at
+    # these sizes, so each phase drives ROUNDS rounds (each cleaned up
+    # to the same resident store size) and takes the MEDIAN — the same
+    # estimator on both sides of the ratio. Best-of overestimates
+    # whichever side has noisier rounds; the median is robust to a
+    # single stalled or lucky round without that bias.
+    ROUNDS = 5
+
+    def _median(rates):
+        s = sorted(rates)
+        return s[len(s) // 2]
+
+    def best_rate(round_names, shards_to_flush):
+        rates = []
+        for r in range(ROUNDS):
+            rates.append(drive(round_names(r), shards_to_flush,
+                               cleanup=True))
+        return _median(rates), rates
+
+    driven: list = []
+
+    def tracked(names):
+        driven.extend(names)
+        return names
+
+    try:
+        # Phase 1: single-shard durable-write baseline (after an
+        # unmeasured warm-up round).
+        drive(tracked([f"warm-{i}"
+                       for i in range(min(200, pre_writes))]),
+              [plane.shards[0]])
+        pre_rate, pre_rounds = best_rate(
+            lambda r: [f"pre{r}-{i}" for i in range(pre_writes)],
+            [plane.shards[0]])
+        leg["pre_split_writes_per_s"] = pre_rate
+        leg["pre_split_rounds"] = pre_rounds
+
+        # Phase 2: split LIVE under a write storm through the router.
+        stop = threading.Event()
+        acked: list = []
+        storm_errors: list = []
+
+        def storm():
+            i = 0
+            while not stop.is_set():
+                name = f"storm-{i}"
+                try:
+                    plane.router.create(_bench_cron(name))
+                    acked.append(name)
+                except Exception as exc:  # client-visible failure
+                    storm_errors.append(f"{name}: {exc!r}")
+                i += 1
+                time.sleep(0.001)
+
+        storm_t = threading.Thread(target=storm, daemon=True)
+        storm_t.start()
+        time.sleep(storm_secs / 2)
+        report = plane.split_shard(0)
+        time.sleep(storm_secs / 2)
+        stop.set()
+        storm_t.join(timeout=30.0)
+
+        # Zero lost / double-applied: every acked name readable on its
+        # map home and ONLY there.
+        lost, doubled = [], []
+        for name in acked + driven:
+            owner = plane.ownership.owner("default", name)
+            on_home = plane.shards[owner].store.get_frozen(
+                gvk[0], gvk[1], "default", name) is not None
+            off_home = any(
+                s.store.get_frozen(gvk[0], gvk[1], "default", name)
+                is not None
+                for s in plane.shards if s.index != owner)
+            if not on_home:
+                lost.append(name)
+            if off_home:
+                doubled.append(name)
+        leg["split"] = {
+            "i6_ok": report["i6_ok"],
+            "epoch": report["epoch"],
+            "moved": report["moved"],
+            "dark_window_s": round(report["dark_window_s"], 4),
+            "duration_s": round(report["duration_s"], 3),
+            "storm_acked": len(acked),
+            "storm_errors": storm_errors[:5],
+            "storm_errors_total": len(storm_errors),
+            "lost_writes": len(lost),
+            "double_applied": len(doubled),
+            "wrong_shard_retries": plane.router.wrong_shard_retries,
+        }
+
+        # Untimed: clear the storm's residue (checked above) so phase
+        # 3's rounds run at the same resident population as phase 1's —
+        # the storm count varies run to run and commit cost tracks
+        # store size, which would put per-run jitter into the ratio.
+        for name in acked:
+            try:
+                plane.router.delete(CRON_AV, "Cron", "default", name)
+            except Exception:
+                pass  # a lost write already failed the gate above
+        for s in plane.shards:
+            s.persistence.flush()
+
+        # Phase 3: per-shard post-split rates vs a contemporaneous
+        # single-shard control, rounds interleaved (control, shard 0,
+        # shard 1, repeat) so both sides of the ratio sample the same
+        # machine state.
+        needed = post_writes_per_shard
+
+        def owned_names(si, r):
+            out, i = [], 0
+            while len(out) < needed:
+                name = f"post{r}-{i}"
+                if plane.ownership.owner("default", name) == si:
+                    out.append(name)
+                i += 1
+            return out
+
+        control = ShardedControlPlane(
+            n_shards=1, data_dir=control_dir, flush_interval_s=0)
+        # same warm-up discipline (and resident population) as the
+        # split plane got before its phase-1 rounds
+        drive([f"cwarm-{i}" for i in range(min(200, pre_writes))],
+              [control.shards[0]], router=control.router)
+        rounds_by = {"control": [], "0": [], "1": []}
+        for r in range(ROUNDS):
+            rounds_by["control"].append(drive(
+                [f"ctl{r}-{i}" for i in range(needed)],
+                [control.shards[0]], cleanup=True,
+                router=control.router))
+            for si in (0, 1):
+                rounds_by[str(si)].append(drive(
+                    owned_names(si, r), [plane.shards[si]],
+                    cleanup=True))
+        control_rate = _median(rounds_by["control"])
+        per_shard = {
+            str(si): {"writes_per_s": _median(rounds_by[str(si)]),
+                      "rounds": rounds_by[str(si)]}
+            for si in (0, 1)
+        }
+        agg = round(sum(d["writes_per_s"] for d in per_shard.values()), 1)
+        leg.update({
+            "post_split_per_shard": per_shard,
+            "post_split_sum_writes_per_s": agg,
+            "control_single_shard_writes_per_s": control_rate,
+            "control_rounds": rounds_by["control"],
+            "scaleup": (round(agg / control_rate, 3)
+                        if control_rate else None),
+            "scaleup_vs_pre_split": (round(agg / pre_rate, 3)
+                                     if pre_rate else None),
+        })
+    except Exception as exc:
+        leg["error"] = repr(exc)
+    finally:
+        plane.close()
+        if control is not None:
+            control.close()
+        shutil.rmtree(data_dir, ignore_errors=True)
+        shutil.rmtree(control_dir, ignore_errors=True)
+    return leg
+
+
+def _run_split_leg_isolated(pre_writes: int, storm_secs: float,
+                            post_writes_per_shard: int) -> dict:
+    """Full-run split leg in a FRESH interpreter (``--role split-only``,
+    same idiom as the baseline A/B worktree run). The scale-up ratio
+    compares allocation-heavy closed-loop phases, and by the time the
+    full sweep reaches this leg the process carries every prior leg's
+    heap (GC scans grow with live objects), which depresses the
+    post-split phases 15-20% and flakes the >= 1.8x gate. A clean
+    process measures the mechanism, not the bench's own garbage.
+    Falls back to in-process on spawn failure."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--role", "split-only",
+             "--split-pre-writes", str(pre_writes),
+             "--split-storm-secs", str(storm_secs),
+             "--split-post-writes", str(post_writes_per_shard),
+             "--stdout"],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=600,
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"split-only run failed rc={out.returncode}: "
+                f"{out.stderr[-800:]}")
+        leg = json.loads(out.stdout.strip().splitlines()[-1])
+        leg["isolated_process"] = True
+        return leg
+    except Exception as exc:
+        leg = split_leg(pre_writes, storm_secs, post_writes_per_shard)
+        leg["isolated_process"] = False
+        leg["isolation_fallback"] = repr(exc)
+        return leg
+
+
+def _split_verdict(leg: dict, check_mode: bool) -> dict:
+    split = leg.get("split") or {}
+    scaleup = leg.get("scaleup")
+    dark = split.get("dark_window_s")
+    mech_ok = ("error" not in leg
+               and split.get("i6_ok") is True
+               and split.get("lost_writes") == 0
+               and split.get("double_applied") == 0
+               and split.get("storm_errors_total", 1) == 0
+               and dark is not None
+               and dark <= SPLIT_MAX_DARK_WINDOW_S)
+    if check_mode:
+        # Smoke: gate the mechanism (clean cutover, zero loss, dark
+        # window bound); the scale-up ratio is reported, not gated.
+        ok = bool(mech_ok)
+        gate = "mechanism only (--check)"
+    else:
+        ok = bool(mech_ok and scaleup is not None
+                  and scaleup >= SPLIT_MIN_SCALEUP)
+        gate = (f"sum >= {SPLIT_MIN_SCALEUP}x interleaved single-shard "
+                f"control, dark window <= {SPLIT_MAX_DARK_WINDOW_S}s")
+    return {
+        "status": "OK" if ok else "REGRESSION",
+        "scaleup": scaleup,
+        "dark_window_s": dark,
+        "lost_writes": split.get("lost_writes"),
+        "double_applied": split.get("double_applied"),
+        "summary": (
+            f"{'OK' if ok else 'REGRESSION'}: live 1->2 split "
+            f"{leg.get('control_single_shard_writes_per_s')} -> "
+            f"{leg.get('post_split_sum_writes_per_s')} durable writes/s "
+            f"aggregate (x{scaleup} vs contemporaneous single-shard "
+            f"control; pre-split measured "
+            f"{leg.get('pre_split_writes_per_s')}), dark window {dark}s, "
+            f"{split.get('lost_writes')} lost / "
+            f"{split.get('double_applied')} double-applied of "
+            f"{split.get('storm_acked')} storm-acked writes "
+            f"({split.get('wrong_shard_retries')} wrong-shard retries) "
+            f"(gate {gate})"
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Baseline A/B (fan-out only: the one scenario the old server can run)
 # ---------------------------------------------------------------------------
 
@@ -1549,12 +1887,21 @@ def main() -> int:
                    help="creates per write round in the leader "
                         "write-cost comparison")
     p.add_argument("--follower-timeout", type=float, default=180.0)
+    p.add_argument("--split-pre-writes", type=int, default=600,
+                   help="durable creates in the single-shard baseline "
+                        "phase of the live-split leg")
+    p.add_argument("--split-storm-secs", type=float, default=4.0,
+                   help="write-storm duration bracketing the live "
+                        "1->2 split")
+    p.add_argument("--split-post-writes", type=int, default=600,
+                   help="durable creates per shard in the post-split "
+                        "sequential sweep")
     p.add_argument("--stdout", action="store_true",
                    help="print the artifact JSON to stdout only")
     p.add_argument("--check", action="store_true",
                    help="smoke mode: small sizes unless overridden, and "
                         "exit non-zero on any REGRESSION verdict")
-    p.add_argument("--role", choices=["full", "fanout-only"],
+    p.add_argument("--role", choices=["full", "fanout-only", "split-only"],
                    default="full", help=argparse.SUPPRESS)
     args = p.parse_args()
 
@@ -1576,9 +1923,19 @@ def main() -> int:
         args.follower_events = 5
         args.follower_list_secs = 1.0
         args.follower_write_creates = 60
+        args.split_pre_writes = 150
+        args.split_storm_secs = 1.5
+        args.split_post_writes = 150
 
     if args.role == "fanout-only":
         result = fanout_leg(args.watchers, args.events, args.fanout_timeout)
+        print(json.dumps(result))
+        return 0
+
+    if args.role == "split-only":
+        result = split_leg(
+            args.split_pre_writes, args.split_storm_secs,
+            args.split_post_writes)
         print(json.dumps(result))
         return 0
 
@@ -1609,6 +1966,17 @@ def main() -> int:
         args.follower_list_secs, args.follower_write_creates,
         args.follower_timeout)
     follower_v = _follower_fanout_verdict(follower, args.check)
+    if args.check:
+        # Smoke: in-process is fine — the mechanism gate (clean
+        # cutover, zero loss, dark-window bound) is noise-immune.
+        split = split_leg(
+            args.split_pre_writes, args.split_storm_secs,
+            args.split_post_writes)
+    else:
+        split = _run_split_leg_isolated(
+            args.split_pre_writes, args.split_storm_secs,
+            args.split_post_writes)
+    split_v = _split_verdict(split, args.check)
 
     verdicts = {
         "fanout": fanout_v,
@@ -1617,6 +1985,7 @@ def main() -> int:
         "zero_steady_state": writes["zero_steady_state"]["verdict"],
         "distributed": distributed_v,
         "follower_fanout": follower_v,
+        "split_leg": split_v,
     }
     ok = all(v["status"] == "OK" for v in verdicts.values())
     artifact = {
@@ -1630,6 +1999,8 @@ def main() -> int:
         "distributed_verdict": distributed_v,
         "follower_fanout": follower,
         "follower_fanout_verdict": follower_v,
+        "split_leg": split,
+        "split_leg_verdict": split_v,
         "verdict": {
             "status": "OK" if ok else "REGRESSION",
             "summary": "; ".join(v["summary"] for v in verdicts.values()),

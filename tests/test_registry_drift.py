@@ -73,6 +73,27 @@ class TestRegistryDrift:
             assert mtype == "counter", family
             assert mhelp
 
+    def test_split_families_declared_with_types(self):
+        """The live-split observability families: split outcomes and
+        latency distributions (shard.py) plus the router's wrong-shard
+        retry / probe-fallback counters. All must be scanned AND
+        declared so ``/debug/shards`` graphs have headered series."""
+        found = _emitted_families()
+        expected = {
+            "shard_splits_total": "counter",
+            "shard_split_duration_seconds": "histogram",
+            "shard_split_dark_window_seconds": "histogram",
+            "router_wrong_shard_retries_total": "counter",
+            "router_probe_fallbacks_total": "counter",
+            "wal_fenced_appends_total": "counter",
+        }
+        for family, want_type in expected.items():
+            assert family in found, family
+            assert family in _FAMILY_META, family
+            mtype, mhelp = _FAMILY_META[family]
+            assert mtype == want_type, family
+            assert mhelp
+
     def test_every_emitted_family_is_declared(self):
         undeclared = {
             family: sites

@@ -10,13 +10,17 @@ from cron_operator_tpu.runtime.kube import APIServer, NotFoundError
 from cron_operator_tpu.runtime.manager import Metrics
 from cron_operator_tpu.runtime.persistence import Persistence
 from cron_operator_tpu.runtime.shard import (
+    HASH_SPACE,
     FollowerReplica,
+    OwnershipMap,
     ShardedControlPlane,
     ShardMetrics,
     ShardRouter,
     canonical_state,
+    key_hash64,
     shard_dir,
     shard_index,
+    split_key,
 )
 from cron_operator_tpu.utils.clock import FakeClock
 
@@ -75,6 +79,123 @@ class TestShardIndexPinned:
             for i in range(64)
         )
         assert hits < 64
+
+
+class TestOwnershipMapPinned:
+    """Ownership-map cut points are an ON-DISK FORMAT (ownership.json
+    names them; shard dirs are routed by them). Like the hash vectors
+    above, these layouts must never change: a drift re-homes keys away
+    from the shard dir that durably holds them."""
+
+    PAIRS = TestShardIndexPinned.PAIRS
+
+    HASHES = [
+        0x4EA79E3EE3FC529C, 0x463382BB1554A144, 0x21993EEE1BC2B1A2,
+        0x8B7073C7B8E9CF04, 0x056E9AAF8C452CB8, 0xDF83A9A244534F0D,
+        0x5B2C26EEF198F593, 0xBBC9D66882B43A02, 0x35CA6884642C067C,
+        0xED0D0303ECD6E85B,
+    ]
+
+    def test_pinned_key_hashes(self):
+        assert [key_hash64(ns, n) for ns, n in self.PAIRS] == self.HASHES
+
+    @pytest.mark.parametrize("n", [1, 4, 16])
+    def test_boot_map_is_exactly_the_modulo_hash(self, n):
+        m = OwnershipMap.boot(n)
+        assert m.epoch == 0 and m.n_shards == n
+        for ns, name in self.PAIRS:
+            assert m.owner(ns, name) == shard_index(ns, name, n)
+        for i in range(200):
+            assert m.owner("default", f"obj-{i}") == shard_index(
+                "default", f"obj-{i}", n
+            )
+
+    def test_pinned_split_1_to_2_layout(self):
+        m, plan = OwnershipMap.boot(1).split(0)
+        assert plan["mid"] == 0x8000000000000000
+        assert plan["end"] == HASH_SPACE
+        assert (plan["parent"], plan["child"], plan["epoch"]) == (0, 1, 1)
+        assert m.epoch == 1 and m.n_shards == 2
+        assert [m.owner(ns, n) for ns, n in self.PAIRS] == [
+            0, 0, 0, 1, 0, 1, 0, 1, 0, 1,
+        ]
+
+    def test_pinned_second_split_layouts(self):
+        two, _ = OwnershipMap.boot(1).split(0)
+        # splitting the PARENT again quarters the lower half...
+        three, plan = two.split(0)
+        assert plan["mid"] == 0x4000000000000000 and plan["child"] == 2
+        assert [three.owner(ns, n) for ns, n in self.PAIRS] == [
+            2, 2, 0, 1, 0, 1, 2, 1, 0, 1,
+        ]
+        # ...splitting the CHILD quarters the upper half instead.
+        threeb, planb = two.split(1)
+        assert planb["mid"] == 0xC000000000000000 and planb["child"] == 2
+        assert [threeb.owner(ns, n) for ns, n in self.PAIRS] == [
+            0, 0, 0, 1, 0, 2, 0, 1, 0, 2,
+        ]
+
+    def test_pinned_boot4_split_touches_one_class_only(self):
+        m, plan = OwnershipMap.boot(4).split(2)
+        assert plan["class_id"] == 2 and plan["child"] == 4
+        assert plan["mid"] == 0x8000000000000000
+        got = [m.owner(ns, n) for ns, n in self.PAIRS]
+        assert got == [0, 0, 2, 0, 0, 1, 3, 4, 0, 3]
+        # every key OUTSIDE class 2 still routes by the modulo hash
+        for ns, name in self.PAIRS:
+            if key_hash64(ns, name) % 4 != 2:
+                assert m.owner(ns, name) == shard_index(ns, name, 4)
+        assert m.ranges_for(4) == [{
+            "class": 2,
+            "start": "0x8000000000000000",
+            "end": "0x10000000000000000",
+            "owner": 4,
+        }]
+
+    def test_doc_roundtrip_and_save_load(self, tmp_path):
+        m, _ = OwnershipMap.boot(4).split(2)
+        m2, _ = m.split(4)
+        doc = m2.to_doc()
+        assert doc["version"] == 1
+        back = OwnershipMap.from_doc(json.loads(json.dumps(doc)))
+        assert back.classes == m2.classes
+        assert back.epoch == m2.epoch and back.n_boot == m2.n_boot
+        path = str(tmp_path / "ownership.json")
+        assert OwnershipMap.load(path) is None
+        m2.save(path)
+        loaded = OwnershipMap.load(path)
+        assert loaded is not None and loaded.classes == m2.classes
+
+    def test_split_key_follows_controller_owner(self):
+        child = _cron("etl-hourly-28916560-abc12", ns="prod")
+        child["metadata"]["ownerReferences"] = [{
+            "apiVersion": "cron.tpu.example.com/v1alpha1",
+            "kind": "TpuCronJob", "name": "etl-hourly", "uid": "u-1",
+            "controller": True,
+        }]
+        assert split_key(child) == ("prod", "etl-hourly")
+        assert split_key(_cron("standalone")) == ("default", "standalone")
+        m, _ = OwnershipMap.boot(1).split(0)
+        # the root hashes into the moved range; the child's OWN hash
+        # does not — yet both must land on the new shard together.
+        assert key_hash64("prod", "etl-hourly-28916560-abc12") < (
+            0x8000000000000000
+        )
+        assert m.owner_of(child) == 1 == m.owner("prod", "etl-hourly")
+
+    def test_validation_rejects_malformed_layouts(self):
+        with pytest.raises(ValueError):
+            OwnershipMap(2, [[(0, 0)]])  # class count mismatch
+        with pytest.raises(ValueError):
+            OwnershipMap(1, [[(1, 0)]])  # does not start at 0
+        with pytest.raises(ValueError):
+            OwnershipMap(1, [[(0, 0), (5, 1), (5, 2)]])  # not increasing
+        with pytest.raises(ValueError):
+            OwnershipMap(1, [[(0, 0), (HASH_SPACE, 1)]])  # out of space
+        with pytest.raises(ValueError):
+            OwnershipMap.from_doc({"version": 9})
+        with pytest.raises(ValueError):
+            OwnershipMap.boot(2).split(7)  # owns no range
 
 
 class TestShardRouter:

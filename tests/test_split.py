@@ -1,0 +1,525 @@
+"""Live shard splitting (runtime/shard.py): ownership-map cutover,
+range fencing during the dark window, router wrong-shard retries, and
+crash resolution to exactly one owner per key."""
+
+import json
+import os
+import shutil
+import threading
+
+import pytest
+
+from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.runtime.manager import Metrics
+from cron_operator_tpu.runtime.persistence import Persistence, WrongShardError
+from cron_operator_tpu.runtime.shard import (
+    OWNERSHIP_FILE,
+    OwnershipMap,
+    RangeFilteredFollower,
+    ShardedControlPlane,
+    ShardRouter,
+    key_hash64,
+    shard_dir,
+    split_pred,
+)
+from cron_operator_tpu.telemetry.audit import AuditJournal
+from cron_operator_tpu.utils.clock import FakeClock
+
+CRON_GVK = ("cron.tpu.example.com/v1alpha1", "TpuCronJob")
+
+#: 1->2 split cut point: upper half of the single boot class moves.
+MID = 0x8000000000000000
+
+
+def _cron(name, ns="default", spec=None):
+    return {
+        "apiVersion": "cron.tpu.example.com/v1alpha1",
+        "kind": "TpuCronJob",
+        "metadata": {"namespace": ns, "name": name},
+        "spec": spec or {"schedule": "* * * * *"},
+    }
+
+
+def _moved(ns, name):
+    return key_hash64(ns, name) >= MID
+
+
+def _names(n=40):
+    return [f"c-{i}" for i in range(n)]
+
+
+def _plane(tmp_path, **kw):
+    kw.setdefault("n_shards", 1)
+    kw.setdefault("clock", FakeClock())
+    kw.setdefault("flush_interval_s", 0)
+    return ShardedControlPlane(data_dir=str(tmp_path), **kw)
+
+
+class TestLiveSplit:
+    def test_split_1_to_2_end_to_end(self, tmp_path):
+        m = Metrics()
+        plane = _plane(tmp_path, metrics=m)
+        try:
+            for name in _names():
+                plane.router.create(_cron(name))
+            plane.router.patch_status(
+                *CRON_GVK, "default", "c-0", {"phase": "Active"}
+            )
+            report = plane.split_shard(0)
+            assert report["i6_ok"] is True
+            assert (report["parent"], report["child"]) == (0, 1)
+            assert report["epoch"] == 1 and report["fenced"] is True
+            moved = [n for n in _names() if _moved("default", n)]
+            assert report["moved"] == len(moved) > 0
+            assert report["child_objects"] == len(moved)
+            assert report["parent_objects"] == 40 - len(moved)
+            # exactly-once: every key readable through the router, on
+            # the shard the new map names, and nowhere else.
+            assert len(plane.router) == 40
+            for name in _names():
+                owner = plane.ownership.owner("default", name)
+                assert owner == (1 if _moved("default", name) else 0)
+                assert plane.shards[owner].store.get_frozen(
+                    *CRON_GVK, "default", name
+                ) is not None
+                assert plane.shards[1 - owner].store.get_frozen(
+                    *CRON_GVK, "default", name
+                ) is None
+            # the split must not lose a status write
+            keeper = plane.ownership.owner("default", "c-0")
+            assert plane.shards[keeper].store.get_frozen(
+                *CRON_GVK, "default", "c-0"
+            )["status"] == {"phase": "Active"}
+            # durable commit point on disk
+            saved = OwnershipMap.load(
+                os.path.join(str(tmp_path), OWNERSHIP_FILE)
+            )
+            assert saved is not None and saved.epoch == 1
+            assert m.get('shard_splits_total{outcome="ok"}') == 1.0
+            assert m.histogram("shard_split_duration_seconds")["count"] == 1
+            assert m.histogram(
+                "shard_split_dark_window_seconds"
+            )["count"] == 1
+        finally:
+            plane.close()
+
+    def test_dark_window_fences_moved_range_with_owner_hints(self, tmp_path):
+        plane = _plane(tmp_path)
+        probes = {}
+
+        def hook(plan):
+            pred = split_pred(plan)
+            assert pred("prod", "etl-hourly")  # sanity: in moved range
+            try:
+                plane.shards[0].store.create(_cron("etl-hourly", ns="prod"))
+                probes["refused"] = False
+            except WrongShardError as err:
+                probes["refused"] = True
+                probes["owner"] = err.owner
+                probes["epoch"] = err.map_epoch
+
+        try:
+            for name in _names(10):
+                plane.router.create(_cron(name))
+            plane.split_shard(0, dark_window_hook=hook)
+            assert probes == {"refused": True, "owner": 1, "epoch": 1}
+            # the fence stays armed after cutover: a write raced to the
+            # OLD owner still refuses instead of forking the key.
+            with pytest.raises(WrongShardError):
+                plane.shards[0].store.create(_cron("etl-hourly", ns="prod"))
+            # while the router, holding the new map, serves it fine.
+            plane.router.create(_cron("etl-hourly", ns="prod"))
+            assert plane.shards[1].store.get_frozen(
+                *CRON_GVK, "prod", "etl-hourly"
+            ) is not None
+        finally:
+            plane.close()
+
+    def test_router_retries_wrong_shard_with_stale_map(self, tmp_path):
+        m = Metrics()
+        plane = _plane(tmp_path, metrics=m)
+        try:
+            for name in _names(10):
+                plane.router.create(_cron(name))
+            plane.split_shard(0)
+            # A router still holding the epoch-0 map (a raced client):
+            # its home pick hits the fenced parent, which answers with
+            # the owner hint; one bounded retry lands the write.
+            stale = ShardRouter(
+                [s.store for s in plane.shards],
+                ownership=OwnershipMap.boot(1),
+                metrics=m,
+            )
+            stale.create(_cron("etl-hourly", ns="prod"))
+            assert stale.wrong_shard_retries == 1
+            assert m.get("router_wrong_shard_retries_total") == 1.0
+            assert plane.shards[1].store.get_frozen(
+                *CRON_GVK, "prod", "etl-hourly"
+            ) is not None
+        finally:
+            plane.close()
+
+    def test_split_under_concurrent_writes_loses_nothing(self, tmp_path):
+        plane = _plane(tmp_path)
+        stop = threading.Event()
+        acked, refused = [], []
+
+        def storm():
+            i = 0
+            while not stop.is_set():
+                name = f"storm-{i}"
+                try:
+                    plane.router.create(_cron(name))
+                    acked.append(name)
+                except Exception:
+                    refused.append(name)
+                i += 1
+
+        t = threading.Thread(target=storm, daemon=True)
+        try:
+            for name in _names(20):
+                plane.router.create(_cron(name))
+            t.start()
+            report = plane.split_shard(0)
+            stop.set()
+            t.join(timeout=10.0)
+            assert report["i6_ok"] is True
+            # every acked write readable exactly once, on its map home
+            for name in acked + _names(20):
+                owner = plane.ownership.owner("default", name)
+                assert plane.shards[owner].store.get_frozen(
+                    *CRON_GVK, "default", name
+                ) is not None, name
+                assert plane.shards[1 - owner].store.get_frozen(
+                    *CRON_GVK, "default", name
+                ) is None, name
+            assert len(plane.router) == 20 + len(acked)
+            # the router retried through the dark window: nothing the
+            # client saw acked may be missing, and nothing was refused
+            # (the storm goes through the router, which re-routes).
+            assert refused == []
+        finally:
+            stop.set()
+            plane.close()
+
+    def test_unfenced_split_loses_acked_write_counterproof(self, tmp_path):
+        plane = _plane(tmp_path)
+        acked = {}
+
+        def poison(plan):
+            # Without the fence the demoted parent happily acks a write
+            # on the moved range DURING the dark window...
+            plane.shards[0].store.create(_cron("etl-hourly", ns="prod"))
+            acked["ok"] = True
+
+        try:
+            for name in _names(10):
+                plane.router.create(_cron(name))
+            plane.split_shard(0, fence=False, dark_window_hook=poison)
+            assert acked.get("ok") is True
+            # ...and the split erases it: the child never saw it (the
+            # shipper was already detached) and the parent evicted the
+            # moved range. A durably-acked write is GONE — this is the
+            # violation range fencing exists to prevent.
+            assert plane.router.try_get(
+                *CRON_GVK, "prod", "etl-hourly"
+            ) is None
+        finally:
+            plane.close()
+
+    def test_abort_lifts_fence_and_keeps_epoch(self, tmp_path):
+        m = Metrics()
+        plane = _plane(tmp_path, metrics=m)
+
+        def boom(plan):
+            raise RuntimeError("operator pulled the plug")
+
+        try:
+            for name in _names(10):
+                plane.router.create(_cron(name))
+            with pytest.raises(RuntimeError, match="pulled the plug"):
+                plane.split_shard(0, dark_window_hook=boom)
+            # clean unwind: map unchanged, parent serves the full range
+            assert plane.ownership.epoch == 0 and plane.n_shards == 1
+            assert plane._split_progress is None
+            plane.router.create(_cron("etl-hourly", ns="prod"))
+            assert len(plane.router) == 11
+            assert m.get('shard_splits_total{outcome="aborted"}') == 1.0
+            # and the next attempt succeeds despite the stray child dir
+            report = plane.split_shard(0)
+            assert report["i6_ok"] is True and plane.n_shards == 2
+        finally:
+            plane.close()
+
+    def test_second_split_scales_1_to_3(self, tmp_path):
+        plane = _plane(tmp_path)
+        try:
+            for name in _names(60):
+                plane.router.create(_cron(name))
+            plane.split_shard(0)
+            plane.split_shard(1)
+            assert plane.n_shards == 3 and plane.ownership.epoch == 2
+            assert len(plane.router) == 60
+            for name in _names(60):
+                owner = plane.ownership.owner("default", name)
+                for i, s in enumerate(plane.shards):
+                    present = s.store.get_frozen(
+                        *CRON_GVK, "default", name
+                    ) is not None
+                    assert present == (i == owner), (name, i)
+        finally:
+            plane.close()
+
+    def test_owner_family_moves_as_one(self, tmp_path):
+        plane = _plane(tmp_path)
+        try:
+            root = _cron("etl-hourly", ns="prod")  # hash in moved range
+            child = _cron("etl-hourly-28916560-abc12", ns="prod")
+            child["metadata"]["ownerReferences"] = [{
+                "apiVersion": CRON_GVK[0], "kind": CRON_GVK[1],
+                "name": "etl-hourly", "uid": "u-1", "controller": True,
+            }]
+            assert not _moved("prod", "etl-hourly-28916560-abc12")
+            plane.router.create(root)
+            plane.shards[0].store.create(child)  # co-located with owner
+            plane.split_shard(0)
+            # both live on the child shard: the family did not tear
+            for name in ("etl-hourly", "etl-hourly-28916560-abc12"):
+                assert plane.shards[1].store.get_frozen(
+                    *CRON_GVK, "prod", name
+                ) is not None, name
+                assert plane.shards[0].store.get_frozen(
+                    *CRON_GVK, "prod", name
+                ) is None, name
+        finally:
+            plane.close()
+
+    def test_audit_and_debug_surface_the_split(self, tmp_path):
+        audit = AuditJournal()
+        plane = _plane(tmp_path, audit=audit)
+        try:
+            for name in _names(10):
+                plane.router.create(_cron(name))
+            plane.split_shard(0)
+            events = [r["event"] for r in audit.records(kind="cluster")]
+            assert "split_started" in events
+            assert "split_cutover" in events
+            dbg = plane.debug_shards()
+            assert dbg["ownership"]["epoch"] == 1
+            assert dbg["ownership"]["n_shards"] == 2
+            assert dbg["splits"] == 1 and dbg["split_in_progress"] is None
+            assert {r["owner"] for r in dbg["ownership"]["ranges"]} == {0, 1}
+            assert dbg["shards"][1]["ranges"] == [{
+                "class": 0,
+                "start": "0x8000000000000000",
+                "end": "0x10000000000000000",
+                "owner": 1,
+            }]
+            assert json.loads(plane.render_debug_json())
+        finally:
+            plane.close()
+
+
+class TestSplitCrashResolution:
+    def test_restart_after_commit_serves_every_key_once(self, tmp_path):
+        plane = _plane(tmp_path)
+        for name in _names(30):
+            plane.router.create(_cron(name))
+        plane.router.patch_status(
+            *CRON_GVK, "default", "c-1", {"phase": "Active"}
+        )
+        plane.split_shard(0)
+        plane.router.create(_cron("post-split"))
+        plane.close()
+
+        reopened = _plane(tmp_path)  # n_shards=1 arg; the map wins
+        try:
+            assert reopened.n_shards == 2
+            assert reopened.ownership.epoch == 1
+            assert len(reopened.router) == 31
+            for name in _names(30) + ["post-split"]:
+                owner = reopened.ownership.owner("default", name)
+                assert reopened.shards[owner].store.get_frozen(
+                    *CRON_GVK, "default", name
+                ) is not None, name
+                assert reopened.shards[1 - owner].store.get_frozen(
+                    *CRON_GVK, "default", name
+                ) is None, name
+            keeper = reopened.ownership.owner("default", "c-1")
+            assert reopened.shards[keeper].store.get_frozen(
+                *CRON_GVK, "default", "c-1"
+            )["status"] == {"phase": "Active"}
+        finally:
+            reopened.close()
+
+    def test_crash_before_rename_leaves_parent_sole_owner(self, tmp_path):
+        plane = _plane(tmp_path)
+        for name in _names(20):
+            plane.router.create(_cron(name))
+        for s in plane.shards:
+            s.persistence.flush()
+        plane.close()
+        # A split that died mid-materialize: the child dir exists with a
+        # full copy, but the commit rename never happened.
+        shutil.copytree(
+            shard_dir(str(tmp_path), 0), shard_dir(str(tmp_path), 1)
+        )
+        reopened = _plane(tmp_path)
+        try:
+            assert reopened.n_shards == 1  # the map never named shard 1
+            assert len(reopened.router) == 20
+        finally:
+            reopened.close()
+
+    def test_crash_after_rename_keep_filter_drops_stale_copies(
+        self, tmp_path
+    ):
+        plane = _plane(tmp_path)
+        for name in _names(20):
+            plane.router.create(_cron(name))
+        for s in plane.shards:
+            s.persistence.flush()
+        plane.close()
+        # A crash between the commit rename and the parent's eviction:
+        # both dirs hold the moved keys, the map says the child owns
+        # them. Boot must resolve to EXACTLY one owner.
+        shutil.copytree(
+            shard_dir(str(tmp_path), 0), shard_dir(str(tmp_path), 1)
+        )
+        new_map, _ = OwnershipMap.boot(1).split(0)
+        new_map.save(os.path.join(str(tmp_path), OWNERSHIP_FILE))
+        reopened = _plane(tmp_path)
+        try:
+            assert reopened.n_shards == 2
+            assert len(reopened.router) == 20  # no double-applied keys
+            for name in _names(20):
+                owner = reopened.ownership.owner("default", name)
+                assert reopened.shards[owner].store.get_frozen(
+                    *CRON_GVK, "default", name
+                ) is not None, name
+                assert reopened.shards[1 - owner].store.get_frozen(
+                    *CRON_GVK, "default", name
+                ) is None, name
+        finally:
+            reopened.close()
+
+
+class TestSingleStoreAdoption:
+    """Growing an UNSHARDED data dir (root-level wal.jsonl/snapshot.json)
+    into the sharded plane: `--shards 1` adopts it into shard-0 (the
+    modulo-1 epoch-0 map homes every key there), N>1 refuses loudly."""
+
+    def _seed_single_store(self, tmp_path, n=12):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(str(tmp_path), flush_interval_s=0)
+        pers.start(store)
+        for name in [f"solo-{i}" for i in range(n)]:
+            store.create(_cron(name))
+        pers.flush()
+        pers.close()
+        assert os.path.exists(os.path.join(str(tmp_path), "wal.jsonl"))
+
+    def test_one_shard_boot_adopts_root_layout(self, tmp_path):
+        self._seed_single_store(tmp_path)
+        plane = _plane(tmp_path)
+        try:
+            assert len(plane.router) == 12
+            assert not os.path.exists(
+                os.path.join(str(tmp_path), "wal.jsonl"))
+            # and the adopted store is splittable like any other
+            plane.split_shard(0)
+            for i in range(12):
+                owner = plane.ownership.owner("default", f"solo-{i}")
+                assert plane.shards[owner].store.get_frozen(
+                    *CRON_GVK, "default", f"solo-{i}"
+                ) is not None
+        finally:
+            plane.close()
+
+    def test_multi_shard_boot_over_root_layout_refuses(self, tmp_path):
+        self._seed_single_store(tmp_path)
+        with pytest.raises(ValueError, match="single-store layout"):
+            _plane(tmp_path, n_shards=2)
+
+    def test_sharded_layout_wins_over_stale_root_files(self, tmp_path):
+        plane = _plane(tmp_path)
+        plane.router.create(_cron("real"))
+        plane.close()
+        # a stray pre-migration leftover must not clobber shard-0
+        with open(os.path.join(str(tmp_path), "wal.jsonl"), "w") as f:
+            f.write("")
+        reopened = _plane(tmp_path)
+        try:
+            assert reopened.router.try_get(
+                *CRON_GVK, "default", "real") is not None
+        finally:
+            reopened.close()
+
+
+class TestRangeFilteredFollower:
+    def test_ships_only_moved_range(self, tmp_path):
+        _, plan = OwnershipMap.boot(1).split(0)
+        pred = split_pred(plan)
+        api = APIServer(FakeClock())
+        pers = Persistence(str(tmp_path), flush_interval_s=0)
+        pers.start(api)
+        follower = RangeFilteredFollower(pred, FakeClock())
+        pers.attach_follower(follower)
+        names = _names(30)
+        for name in names:
+            api.create(_cron(name))
+        api.delete(*CRON_GVK, "default", names[0])
+        pers.flush()
+        moved = [n for n in names[1:] if _moved("default", n)]
+        assert len(follower.store) == len(moved)
+        for name in moved:
+            assert follower.store.get_frozen(
+                *CRON_GVK, "default", name
+            ) is not None
+        assert follower.records_filtered > 0
+        assert follower.lag_bytes == 0
+        pers.close()
+        api.close()
+        follower.store.close()
+
+    def test_bootstrap_filters_recovered_state(self, tmp_path):
+        api = APIServer(FakeClock())
+        pers = Persistence(str(tmp_path), flush_interval_s=0)
+        pers.start(api)
+        for name in _names(30):
+            api.create(_cron(name))
+        pers.flush()
+        pers.close()
+        api.close()
+        _, plan = OwnershipMap.boot(1).split(0)
+        follower = RangeFilteredFollower(split_pred(plan), FakeClock())
+        follower.bootstrap(Persistence(str(tmp_path)).recover())
+        moved = [n for n in _names(30) if _moved("default", n)]
+        assert len(follower.store) == len(moved)
+        follower.store.close()
+
+
+class TestOwnershipRouting:
+    def test_locate_consults_map_before_probing(self, tmp_path):
+        m = Metrics()
+        plane = _plane(tmp_path, metrics=m)
+        try:
+            for name in _names(20):
+                plane.router.create(_cron(name))
+            plane.split_shard(0)
+            before = plane.router.probe_fallbacks
+            for name in _names(20):
+                assert plane.router.get(*CRON_GVK, "default", name)
+            # map-directed lookups never probe
+            assert plane.router.probe_fallbacks == before
+            # an off-home co-located child still found, via fallback
+            # ("probe-1" hashes below the cut, so its map home is the
+            # parent; planting it on the unfenced child makes it
+            # findable only by probing)
+            assert plane.ownership.owner("default", "probe-1") == 0
+            plane.shards[1].store.create(_cron("probe-1"))
+            assert plane.router.get(*CRON_GVK, "default", "probe-1")
+            assert plane.router.probe_fallbacks == before + 1
+            assert m.get("router_probe_fallbacks_total") == 1.0
+        finally:
+            plane.close()
