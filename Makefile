@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray chaos-soak-split obs-report obs-report-dist
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray chaos-soak-split chaos-soak-disk obs-report obs-report-dist
 
 all: gate
 
@@ -193,6 +193,25 @@ chaos-soak-split:
 	    --out CHAOS_SPLIT.json
 	python hack/chaos_soak.py --split --no-fencing \
 	    --seed $(or $(SEED),3) --crons $(or $(CRONS),60) --rounds 2 \
+	    --expect-violation --out /dev/null
+
+# Disk-fault soak (hack/chaos_soak.py --disk, invariant I12): cycles
+# every DiskFaultInjector kind against one store + data dir — seeded
+# bit-flips and mid-file torn writes applied to the closed WAL between
+# generations, EIO/ENOSPC injected into append/fsync/rename through the
+# syscall seam mid-storm. Proves no corrupted (or never-acked) record is
+# ever applied (recovery lands on a verifiable prefix of the acked
+# ledger), damage is detected and quarantined with offset/CRC forensics
+# plus a scrubber finding on latent cold-segment rot, and injected
+# errors fail closed into metrics-visible, probe-healed degraded mode.
+# Folds into CHAOS.json; then the counter-proof re-runs the same seeded
+# bit-flip with checksums OFF and requires the silent-application
+# violation — proof I12a detects what the CRCs exist to catch.
+chaos-soak-disk:
+	python hack/chaos_soak.py --disk --seed $(or $(SEED),42) \
+	    --rounds $(or $(ROUNDS),6) --out CHAOS.json
+	python hack/chaos_soak.py --disk --no-checksums \
+	    --seed $(or $(SEED),42) --rounds $(or $(ROUNDS),6) \
 	    --expect-violation --out /dev/null
 
 # Observability / SLO report (hack/obs_report.py -> BENCH_OBS.json): the

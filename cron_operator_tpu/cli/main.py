@@ -325,6 +325,21 @@ def build_parser() -> argparse.ArgumentParser:
                        metavar="N",
                        help="auto-split ceiling: never grow past N "
                             "total shards (default 8)")
+    start.add_argument("--scrub-interval", type=float, default=30.0,
+                       metavar="S",
+                       help="embedded sharded mode with --data-dir: "
+                            "background integrity-scrub cadence in "
+                            "seconds — re-verify sealed WAL segment "
+                            "CRCs, snapshot digests and leader/follower "
+                            "agreement while cold (0 disables; findings "
+                            "land on /debug/shards and as "
+                            "corruption_detected events)")
+    start.add_argument("--no-checksums", action="store_true", default=False,
+                       help="DANGEROUS: disable per-record WAL CRC32C "
+                            "stamping and verification (and with it the "
+                            "corruption-aware recovery guarantees). "
+                            "Exists for the chaos counter-proof and A/B "
+                            "overhead measurement only")
     start.add_argument("--fleet-pool", default=None, metavar="POOL",
                        help="enable the heterogeneity-aware fleet "
                             "scheduler over a pool of named slice types, "
@@ -931,6 +946,8 @@ def cmd_start(args: argparse.Namespace) -> int:
                 n_shards=args.shards, replicas=args.replicas,
                 data_dir=args.data_dir, metrics=shared_metrics,
                 audit=journal, tracer=tracer,
+                checksums=not args.no_checksums,
+                scrub_interval_s=max(0.0, args.scrub_interval),
             )
         except ValueError as err:
             log.error("%s", err)
@@ -942,6 +959,13 @@ def cmd_start(args: argparse.Namespace) -> int:
                     "from %s", s.index, len(s.recovered.objects),
                     s.recovered.rv, s.data_dir,
                 )
+            if s.recovered is not None and s.recovered.integrity:
+                verdict = s.recovered.integrity.get("verdict")
+                if verdict not in (None, "clean", "verified"):
+                    log.warning(
+                        "integrity: shard %d recovery verdict %s: %s",
+                        s.index, verdict, s.recovered.integrity,
+                    )
         shard_backends = [s.store for s in plane.shards]
         if args.chaos_seed is not None:
             from cron_operator_tpu.runtime.faults import (

@@ -99,7 +99,10 @@ from cron_operator_tpu.runtime.kube import (
     NotFoundError,
     WatchEvent,
 )
-from cron_operator_tpu.runtime.persistence import WrongShardError
+from cron_operator_tpu.runtime.persistence import (
+    StorageDegradedError,
+    WrongShardError,
+)
 from cron_operator_tpu.runtime.readroute import (
     MIN_READ_RV,
     READ_CONSISTENCY,
@@ -1252,6 +1255,13 @@ class HTTPAPIServer:
                             "mapEpoch": err.map_epoch,
                         },
                     })
+                except StorageDegradedError as err:
+                    # The shard's disk refused a write (EIO/ENOSPC): the
+                    # write failed BEFORE commit and the shard is
+                    # read-only degraded until a probe append succeeds.
+                    # 507 Insufficient Storage — the router's breakers
+                    # observe it like any other backend error.
+                    self._send_status(507, "StorageDegraded", str(err))
                 except FollowerBehindError as err:
                     # Barriered follower read timed out waiting for its
                     # replayed rv; the router catches this to fall back

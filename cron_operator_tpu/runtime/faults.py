@@ -182,8 +182,17 @@ class FaultPlan:
 #: an op the crashed process never acknowledged).  ``torn_tail``: death
 #: mid-write leaves a half-record at the end of the log (recovery must
 #: truncate it).  ``mid_snapshot``: death after writing the snapshot temp
-#: file but before the atomic rename (recovery must ignore the orphan).
-KILL_POINTS = ("before_append", "after_append", "torn_tail", "mid_snapshot")
+#: file but before any rename (recovery must ignore the orphan).
+#: ``mid_rotate_demote``: death after the previous snapshot was demoted
+#: to ``snapshot.json.1`` but before the new one was installed — NO
+#: primary snapshot exists on disk; recovery must chain from the demoted
+#: one plus both WAL segments.  ``mid_rotate_wal``: death after the new
+#: snapshot was installed but before the WAL segment it compacted was
+#: rotated aside — recovery must rv-skip the stale records.  (The last
+#: two are the rotate-phase extension of the PR 5 kill-point table; see
+#: ``Persistence.write_snapshot`` for the phase diagram.)
+KILL_POINTS = ("before_append", "after_append", "torn_tail", "mid_snapshot",
+               "mid_rotate_demote", "mid_rotate_wal")
 
 
 class KillSwitch:
@@ -233,6 +242,214 @@ class KillSwitch:
             "kill_at": self.kill_at,
             "fired": self.fired,
         }
+
+
+#: Disk-fault kinds the ``--disk`` soak cycles through. The first two are
+#: OFFLINE mutations (applied to the closed files between rounds — the
+#: model is latent media corruption discovered at the next read); the
+#: rest are ONLINE errno injections surfaced through the persistence
+#: layer's ``_disk_check`` seam (the model is the device refusing a
+#: syscall mid-flight).
+DISK_FAULT_KINDS = (
+    "bit_flip",        # JSON-preserving digit flip inside a record value
+    "torn_midfile",    # a mid-file record loses its tail (lost sector)
+    "eio_append",      # EIO from the WAL append/write path
+    "enospc_append",   # ENOSPC from the WAL append/write path
+    "eio_fsync",       # EIO from fsync (append or rotation)
+    "eio_rename",      # EIO from the rotation renames
+)
+
+
+class DiskFaultInjector:
+    """Seeded disk-fault source for the persistence layer (I12 harness).
+
+    Two delivery modes, both pure functions of ``(seed, round)``:
+
+    * **Online errno faults** — the persistence layer consults
+      :meth:`check` through its ``_disk_check(op)`` seam immediately
+      before the real syscall (``op`` in ``append`` / ``fsync`` /
+      ``rename``); an armed fault returns the planned :class:`OSError`
+      there, indistinguishable from the device raising it. Arm with
+      :meth:`arm_errno` (tests) or :meth:`arm_planned` (the soak's
+      PRF-chosen round plan).
+    * **Offline media corruption** — :meth:`flip_value_digit` and
+      :meth:`tear_midfile` mutate a closed WAL segment between rounds
+      the way latent sector damage would: :meth:`flip_value_digit`
+      XORs the low bit of a PRF-chosen digit byte (digit ``XOR 0x01``
+      maps digit→digit, so the line stays VALID JSON — exactly the
+      corruption only a checksum can catch, which is what the
+      ``--no-checksums`` counter-proof demonstrates); the flip never
+      lands inside a record's own CRC stamp region, so with checksums
+      ON the damaged *value* is what trips the mismatch.
+      :meth:`tear_midfile` removes the tail of a PRF-chosen NON-final
+      record (its newline included), merging it into its successor —
+      mid-file damage that must quarantine, not truncate-as-torn-tail.
+    """
+
+    def __init__(self, seed: int, round_idx: int = 0):
+        self.seed = seed
+        self.round_idx = round_idx
+        self.kind = self.choose_kind(seed, round_idx)
+        self._lock = threading.Lock()
+        self._armed: Dict[str, List[OSError]] = {}
+        self._checks: Dict[str, int] = {}
+        self.injected: List[Dict[str, object]] = []
+
+    @staticmethod
+    def choose_kind(seed: int, round_idx: int) -> str:
+        return DISK_FAULT_KINDS[
+            int(seeded_fraction(seed, "diskkind", round_idx)
+                * len(DISK_FAULT_KINDS))
+        ]
+
+    # ---- online errno faults ----------------------------------------------
+
+    def arm_errno(self, op: str, err_no: int, count: int = 1) -> None:
+        """Arm the next ``count`` ``check(op)`` calls to raise
+        ``OSError(err_no)``."""
+        import errno as _errno
+
+        with self._lock:
+            q = self._armed.setdefault(op, [])
+            for _ in range(max(1, count)):
+                q.append(OSError(
+                    err_no,
+                    _errno.errorcode.get(err_no, str(err_no)).lower()
+                    + " (injected)",
+                ))
+
+    def arm_planned(self, count: int = 1) -> str | None:
+        """Arm this round's PRF-chosen kind, when it is an errno kind.
+        Returns the op armed (``None`` for the offline kinds, which the
+        harness applies between rounds instead)."""
+        import errno as _errno
+
+        table = {
+            "eio_append": ("append", _errno.EIO),
+            "enospc_append": ("append", _errno.ENOSPC),
+            "eio_fsync": ("fsync", _errno.EIO),
+            "eio_rename": ("rename", _errno.EIO),
+        }
+        planned = table.get(self.kind)
+        if planned is None:
+            return None
+        op, err_no = planned
+        self.arm_errno(op, err_no, count=count)
+        return op
+
+    def check(self, op: str) -> OSError | None:
+        """Consulted by ``Persistence._disk_check`` before each syscall of
+        kind ``op``. Returns the armed error to raise, or ``None``."""
+        with self._lock:
+            self._checks[op] = self._checks.get(op, 0) + 1
+            q = self._armed.get(op)
+            if not q:
+                return None
+            err = q.pop(0)
+            self.injected.append({
+                "kind": self.kind, "op": op, "errno": err.errno,
+                "check": self._checks[op],
+            })
+        logger.debug("injected disk fault on %s: %s", op, err)
+        return err
+
+    # ---- offline media corruption -----------------------------------------
+
+    def flip_value_digit(self, path: str) -> int | None:
+        """Flip the low bit of one PRF-chosen digit byte of ``path``,
+        skipping every record's trailing CRC stamp (so with checksums ON
+        the corrupted *value* is what the CRC catches). Digit ``XOR
+        0x01`` maps digit→digit, so the damaged line stays valid JSON —
+        silent without a checksum. Returns the flipped byte offset, or
+        ``None`` when the file has no eligible digit."""
+        from cron_operator_tpu.runtime.persistence import split_crc
+
+        try:
+            with open(path, "rb") as f:
+                data = bytearray(f.read())
+        except OSError:
+            return None
+        eligible: List[int] = []
+        pos = 0
+        while pos < len(data):
+            nl = data.find(b"\n", pos)
+            end = len(data) if nl < 0 else nl
+            line = bytes(data[pos:end])
+            body, crc = split_crc(line)
+            # Stamp region = everything from the spliced-in ',"c":' on;
+            # a flip there would be caught, but as a stamp failure, not
+            # as the value corruption this fault models.
+            value_end = pos + (len(body) - 1 if crc is not None else len(line))
+            for i in range(pos, value_end):
+                if not 0x30 <= data[i] <= 0x39:
+                    continue
+                if (data[i] == 0x31  # '1' -> '0'
+                        and not 0x30 <= data[i - 1] <= 0x39
+                        and i + 1 < len(data)
+                        and 0x30 <= data[i + 1] <= 0x39):
+                    # Flipping a LEADING 1 of a multi-digit number makes
+                    # a leading-zero literal — invalid JSON, detectable
+                    # by the parser alone. This fault models the silent
+                    # kind only a checksum catches.
+                    continue
+                eligible.append(i)
+            if nl < 0:
+                break
+            pos = nl + 1
+        if not eligible:
+            return None
+        offset = eligible[
+            int(seeded_fraction(self.seed, "diskflip", self.round_idx,
+                                len(eligible)) * len(eligible))
+        ]
+        data[offset] ^= 0x01
+        with open(path, "r+b") as f:
+            f.write(data)
+        self.injected.append({
+            "kind": "bit_flip", "path": path, "offset": offset,
+        })
+        logger.debug("flipped digit at offset %d of %s", offset, path)
+        return offset
+
+    def tear_midfile(self, path: str) -> int | None:
+        """Remove the tail (newline included) of a PRF-chosen NON-final
+        record, merging it into its successor — mid-file damage. Returns
+        the byte offset of the tear, or ``None`` when the file has fewer
+        than two records."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        starts: List[int] = [0]
+        idx = data.find(b"\n")
+        while idx >= 0 and idx + 1 < len(data):
+            starts.append(idx + 1)
+            idx = data.find(b"\n", idx + 1)
+        if len(starts) < 2:
+            return None
+        k = int(seeded_fraction(self.seed, "disktear", self.round_idx)
+                * (len(starts) - 1))
+        line_start = starts[k]
+        line_end = data.find(b"\n", line_start)
+        cut = line_start + max(1, (line_end - line_start) // 2)
+        with open(path, "wb") as f:
+            f.write(data[:cut] + data[line_end + 1:])
+        self.injected.append({
+            "kind": "torn_midfile", "path": path, "offset": cut,
+        })
+        logger.debug("tore record %d mid-file at offset %d of %s",
+                     k, cut, path)
+        return cut
+
+    def describe(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "round": self.round_idx,
+                "kind": self.kind,
+                "injected": list(self.injected),
+                "checks": dict(self._checks),
+            }
 
 
 @dataclass

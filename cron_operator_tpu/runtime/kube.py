@@ -379,7 +379,14 @@ class APIServer:
         lock held, BEFORE the in-memory commit: if the append dies at a
         kill-point, memory never applied the write the WAL may or may not
         carry — recovery then lands on a prefix-consistent state either
-        way (see runtime/persistence.py module docstring)."""
+        way (see runtime/persistence.py module docstring).
+
+        The same ordering is what makes disk-fault degraded mode fail
+        CLOSED: an EIO/ENOSPC on the append raises StorageDegradedError
+        from this line, so the in-memory commit below never applies and
+        the client's 507 means the write exists NOWHERE — no
+        acked-but-lost window, no memory/disk divergence to reconcile
+        when the probe heals the layer (invariant I12)."""
         wal = self._wal
         if wal is not None:
             wal.append_put(verb, committed)

@@ -380,6 +380,51 @@ _FAMILY_META: Dict[str, tuple] = {
                    "leader (label reason=lag|unhealthy): lag = the rv "
                    "barrier 504'd (FollowerBehind), unhealthy = the "
                    "follower endpoint failed or its breaker is open"),
+    "wal_crc_failures_total": (
+        "counter", "WAL records whose per-record CRC32C did not match "
+                   "(label site=recovery|follower|frame|scrub): where in "
+                   "the pipeline the corruption was caught — replay at "
+                   "boot, follower apply, ship-frame verify, or the "
+                   "background scrubber (invariant I12: none of these "
+                   "records is ever applied)"),
+    "wal_records_quarantined_total": (
+        "counter", "WAL records moved to wal.quarantine/ by "
+                   "corruption-aware recovery — the unverifiable suffix "
+                   "of a segment, preserved with offset/CRC forensics "
+                   "instead of being replayed or silently dropped"),
+    "storage_degraded": (
+        "gauge", "1 while the shard's persistence layer is in read-only "
+                 "degraded mode after a disk fault (EIO/ENOSPC on "
+                 "append/fsync/rename), 0 when healthy; writes fail "
+                 "closed (HTTP 507) until a probe append succeeds"),
+    "wal_degraded_refused_total": (
+        "counter", "Writes refused fail-closed (StorageDegraded, HTTP "
+                   "507) while the persistence layer was in degraded "
+                   "mode — each one was rejected BEFORE commit, so no "
+                   "acked-but-lost window exists"),
+    "scrub_passes_total": (
+        "counter", "Background integrity scrubber passes completed "
+                   "(sealed-segment CRC sweep + snapshot digest checks + "
+                   "leader/follower divergence probe)"),
+    "scrub_records_verified_total": (
+        "counter", "WAL records whose CRC the background scrubber "
+                   "re-verified while the segment was cold"),
+    "scrub_corruptions_found_total": (
+        "counter", "Latent corruption findings raised by the background "
+                   "scrubber (CRC mismatch in a sealed segment, snapshot "
+                   "digest mismatch, or leader/follower state divergence "
+                   "at equal rv) — each also emits a corruption_detected "
+                   "cluster event"),
+    "shard_follower_records_rejected_total": (
+        "counter", "Shipped WAL records the follower refused to apply "
+                   "(label reason=crc|stale_generation): crc = the "
+                   "record failed checksum verification at apply time, "
+                   "stale_generation = it carried a fenced leader epoch"),
+    "workload_checkpoint_fallbacks_total": (
+        "counter", "Checkpoint restores served from an older retained "
+                   "step because the newest one was unreadable "
+                   "(truncated async save at preemption time, or disk "
+                   "fault under the checkpoint root)"),
 }
 
 

@@ -41,8 +41,31 @@ Recovery tolerates a **torn tail**: a record whose final line is
 truncated or corrupt (the classic crash-during-append artifact, and one
 of the seeded kill-points in :mod:`runtime.faults`) is dropped and the
 file is truncated back to the last intact record.  Records at or below
-the snapshot rv are skipped on replay, which makes the
-snapshot-then-truncate rotation crash-safe at every intermediate step.
+the snapshot rv are skipped on replay, which makes the snapshot rotation
+crash-safe at every intermediate step.
+
+Integrity — the format is **self-verifying**: every record carries a
+CRC32C over its serialized payload (the ``"c"`` field, stamped last,
+next to the ``"gen"`` fencing epoch and the ``"tc"`` trace id; legacy
+un-checksummed records are still accepted), and snapshots carry a
+whole-file digest in a one-line trailer.  Recovery *verifies as it
+replays*: a bad record mid-file (silent corruption, not a torn tail)
+stops replay at the last verifiable prefix and quarantines the damaged
+region to ``wal.quarantine/`` with offset/CRC forensics; a bad snapshot
+falls back to the previous retained one (rotation keeps N=2 snapshots
+plus the WAL segment between them, instead of truncating) at the cost of
+a longer WAL replay.  The verdict is surfaced as
+``RecoveredState.integrity`` — a corrupted store is never served
+silently.
+
+Disk-error semantics are pinned: ``EIO``/``ENOSPC`` on an append fails
+the write *before* the in-memory commit (the same fail-closed ordering
+the fence uses) and trips the layer into a metrics-visible read-only
+**degraded mode** (``storage_degraded`` gauge, ``degraded_mode_entered``
+cluster event); a probe append re-opens it automatically once the device
+recovers.  :class:`Scrubber` re-verifies cold segments and snapshot
+digests in the background and re-checks follower/leader rv+digest
+agreement.
 
 The write hook sits *before* the in-memory commit (see
 ``APIServer._persist_put``), so a simulated crash at a kill-point leaves
@@ -59,6 +82,7 @@ them before committing), so a steady-state reconcile sweep appends
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import logging
 import os
@@ -74,8 +98,77 @@ logger = logging.getLogger("runtime.persistence")
 
 SNAPSHOT_NAME = "snapshot.json"
 SNAPSHOT_TMP_NAME = "snapshot.json.tmp"
+#: Previous retained snapshot + the WAL segment between it and the
+#: current snapshot: the fallback pair a corrupt ``snapshot.json``
+#: recovers from (rotation demotes instead of deleting).
+SNAPSHOT_PREV_NAME = "snapshot.json.1"
 WAL_NAME = "wal.jsonl"
+WAL_PREV_NAME = "wal.jsonl.1"
+#: Damaged WAL regions are moved here (with offset/CRC forensics
+#: sidecars) instead of being silently discarded.
+QUARANTINE_DIR = "wal.quarantine"
 SCHEMA_VERSION = 1
+
+# CRC implementation: CRC32C (Castagnoli) via the native google_crc32c
+# wheel when the image carries it, zlib's CRC-32 otherwise — both are
+# C-speed (the append-path overhead is gated at 2µs/record in
+# hack/controlplane_bench.py). Writer and verifier share wal_crc(), so
+# the "c" field is consistent within a deployment either way.
+try:
+    from google_crc32c import value as _crc32c_value
+
+    CRC_IMPL = "crc32c"
+
+    def wal_crc(payload: bytes) -> int:
+        """CRC32C of a serialized WAL record (the bytes before the
+        ``"c"`` field is spliced in)."""
+        return _crc32c_value(payload)
+except ImportError:  # pragma: no cover - image always carries the wheel
+    import zlib
+
+    CRC_IMPL = "crc32-zlib"
+
+    def wal_crc(payload: bytes) -> int:
+        return zlib.crc32(payload) & 0xFFFFFFFF
+
+#: The stamped CRC always rides as the LAST key of the record line:
+#: ``...,"c":3735928559}``. Verification reconstructs the pre-stamp
+#: bytes by splitting at the final occurrence.
+_CRC_KEY = b',"c":'
+
+
+def split_crc(line: bytes) -> Tuple[bytes, Optional[int]]:
+    """Split a WAL record line (no trailing newline) into the CRC-covered
+    body and the stamped CRC. Returns ``(line, None)`` for legacy
+    un-checksummed records — the stamp is strictly ``,"c":<digits>}`` at
+    the very end of the line, so an embedded ``"c"`` key inside a
+    persisted object can never alias it (the reconstruction would not be
+    all-digits and the line degrades to legacy handling)."""
+    if not line.endswith(b"}"):
+        return line, None
+    idx = line.rfind(_CRC_KEY)
+    if idx < 0:
+        return line, None
+    digits = line[idx + len(_CRC_KEY):-1]
+    if not digits.isdigit():
+        return line, None
+    return line[:idx] + b"}", int(digits)
+
+
+def stamp_crc(body: bytes) -> bytes:
+    """Splice the CRC field into a serialized record: one checksum plus
+    two byte-slices, no second ``json.dumps`` on the hot append path."""
+    return b'%s,"c":%d}' % (body[:-1], wal_crc(body))
+
+
+def verify_line(line: bytes) -> Tuple[bool, Optional[int], Optional[int]]:
+    """Verify one record line. Returns ``(ok, expected, actual)`` —
+    ``(True, None, None)`` for a legacy line without a CRC."""
+    body, expected = split_crc(line)
+    if expected is None:
+        return True, None, None
+    actual = wal_crc(body)
+    return actual == expected, expected, actual
 
 #: Records buffered before a flush+fsync (group commit). 1 = fsync per
 #: commit (maximum durability, maximum latency); the default trades a
@@ -139,6 +232,16 @@ class WrongShardError(FencedError):
         self.map_epoch = map_epoch
 
 
+class StorageDegradedError(ApiError):
+    """Raised by a persistence layer in read-only degraded mode: a disk
+    error (``EIO``/``ENOSPC`` from append/fsync/rename) was observed, so
+    durable writes are refused *before* the in-memory commit — the same
+    fail-closed ordering the fence uses, but recoverable: a probe append
+    that succeeds re-opens the layer automatically. Reads keep serving
+    from memory throughout (HTTP 507 on the wire; the router's circuit
+    breakers observe the failing writes and shed load)."""
+
+
 @dataclass
 class RecoveredState:
     """Result of replaying a data dir: the objects and counters a fresh
@@ -161,6 +264,24 @@ class RecoveredState:
     #: (snapshot header or WAL record). 0 on dirs written before fencing
     #: existed, or by an unsharded single-process deployment.
     generation: int = 0
+    #: Integrity forensics of this replay: records verified against
+    #: their CRC, legacy records accepted without one, CRC failures,
+    #: quarantined region size, and which snapshot the base state came
+    #: from ("primary" / "previous" / "none"). ``verdict`` summarizes:
+    #: "verified" (every byte checked out), "clean" (no damage, but some
+    #: legacy bytes were taken on trust), "torn_tail", "snapshot_fallback"
+    #: or "quarantined" — anything past "clean" means the on-disk history
+    #: was damaged and replay stopped at the last verifiable prefix.
+    crc_records_verified: int = 0
+    crc_records_unverified: int = 0
+    crc_failures: int = 0
+    quarantined_records: int = 0
+    quarantined_bytes: int = 0
+    snapshot_fallback: bool = False
+    #: True when the base snapshot carried a digest trailer that checked
+    #: out; False for a legacy trailer-less snapshot (or no snapshot).
+    snapshot_digest_verified: bool = False
+    integrity: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def empty(self) -> bool:
@@ -347,6 +468,9 @@ class Persistence:
         snapshot_every: int = DEFAULT_SNAPSHOT_EVERY,
         kill_switch: Optional[Any] = None,
         flush_interval_s: float = DEFAULT_FLUSH_INTERVAL_S,
+        checksums: bool = True,
+        disk_faults: Optional[Any] = None,
+        degraded_probe_interval_s: float = 0.05,
     ):
         self.data_dir = data_dir
         self.fsync_every = max(1, int(fsync_every))
@@ -355,10 +479,22 @@ class Persistence:
         #: Chaos seam (:class:`runtime.faults.KillSwitch`): consulted on
         #: every append; when it fires, this layer dies mid-operation.
         self.kill_switch = kill_switch
+        #: Chaos seam (:class:`runtime.faults.DiskFaultInjector`):
+        #: consulted before append/fsync/rename syscalls; an injected
+        #: OSError trips degraded mode exactly like a real one.
+        self.disk_faults = disk_faults
+        #: False = legacy format (no record CRCs, no snapshot trailer,
+        #: no verification on replay) — the ``--no-checksums``
+        #: counter-proof mode of hack/chaos_soak.py --disk.
+        self.checksums = bool(checksums)
+        self.degraded_probe_interval_s = float(degraded_probe_interval_s)
         self._lock = threading.RLock()
         self._wal_path = os.path.join(data_dir, WAL_NAME)
+        self._wal_prev_path = os.path.join(data_dir, WAL_PREV_NAME)
         self._snap_path = os.path.join(data_dir, SNAPSHOT_NAME)
+        self._snap_prev_path = os.path.join(data_dir, SNAPSHOT_PREV_NAME)
         self._snap_tmp_path = os.path.join(data_dir, SNAPSHOT_TMP_NAME)
+        self._quarantine_dir = os.path.join(data_dir, QUARANTINE_DIR)
         self._f: Optional[Any] = None  # binary append handle, open()ed
         self._buf: List[bytes] = []    # serialized records awaiting flush
         # WAL shipping sinks (hot-standby replicas in runtime/shard.py,
@@ -372,8 +508,30 @@ class Persistence:
         self._stop_flusher = threading.Event()
         self._since_snapshot = 0
         self._dead = False
-        self._die_mid_snapshot = False
+        #: Armed by a rotate-phase kill point ("mid_snapshot",
+        #: "mid_rotate_demote", "mid_rotate_wal"): write_snapshot dies at
+        #: the corresponding interleaving instead of completing.
+        self._die_at_rotate: Optional[str] = None
         self._metrics = None
+        # Read-only degraded mode (disk-error semantics): entered on
+        # EIO/ENOSPC from append/fsync/rename, exited when a probe
+        # append succeeds. While degraded every durable write is refused
+        # BEFORE the in-memory commit (StorageDegradedError).
+        self._degraded = False
+        self.degraded_reason: Optional[str] = None
+        self.degraded_entries = 0
+        self.degraded_exits = 0
+        self.degraded_refused = 0
+        self.probe_failures = 0
+        self._next_probe_monotonic = 0.0
+        #: Called as ``on_degraded(entered: bool, reason: str)`` on every
+        #: mode transition (ShardServing hooks cluster events / debug
+        #: surfaces here). Invoked with the WAL lock held — keep it light
+        #: and never re-enter this layer from it.
+        self.on_degraded: Optional[Callable[[bool, str], None]] = None
+        # Integrity forensics counters (lifetime of this layer object).
+        self.crc_failures = 0
+        self.records_quarantined = 0
         # Fencing token (lease generation epoch): when > 0, every WAL
         # record and snapshot carries it, so a replay can prove no
         # stale-generation write ever landed. fence() flips _fenced and
@@ -508,6 +666,112 @@ class Persistence:
     def range_fenced(self) -> bool:
         return self._range_fence is not None
 
+    # ---- disk-error semantics (degraded mode) -----------------------------
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def _disk_check(self, op: str) -> None:
+        """Consult the disk-fault seam before a syscall of kind ``op``
+        ("append" | "fsync" | "rename"). An armed injector raises the
+        planned OSError here, indistinguishable from the device doing
+        it."""
+        df = self.disk_faults
+        if df is not None:
+            err = df.check(op)
+            if err is not None:
+                raise err
+
+    def _enter_degraded(self, reason: str) -> None:
+        """Trip read-only degraded mode (lock held). The store keeps
+        serving reads from memory; every durable write is refused
+        fail-closed until a probe append succeeds."""
+        if self._degraded:
+            return
+        self._degraded = True
+        self.degraded_reason = reason
+        self.degraded_entries += 1
+        self._next_probe_monotonic = (
+            time.monotonic() + self.degraded_probe_interval_s
+        )
+        if self._metrics is not None:
+            self._metrics.set("storage_degraded", 1.0)
+        if self.audit is not None:
+            self.audit.record(
+                "cluster", "degraded_mode_entered", reason=reason,
+            )
+        logger.error("persistence degraded (read-only): %s", reason)
+        if self.on_degraded is not None:
+            try:
+                self.on_degraded(True, reason)
+            except Exception:  # pragma: no cover - observers stay soft
+                logger.exception("on_degraded observer failed")
+
+    def _exit_degraded(self) -> None:
+        reason = self.degraded_reason or ""
+        self._degraded = False
+        self.degraded_reason = None
+        self.degraded_exits += 1
+        if self._metrics is not None:
+            self._metrics.set("storage_degraded", 0.0)
+        if self.audit is not None:
+            self.audit.record(
+                "cluster", "degraded_mode_exited", reason=reason,
+            )
+        logger.warning("persistence degraded mode exited (probe append "
+                       "succeeded; was: %s)", reason)
+        if self.on_degraded is not None:
+            try:
+                self.on_degraded(False, reason)
+            except Exception:  # pragma: no cover - observers stay soft
+                logger.exception("on_degraded observer failed")
+
+    def probe(self) -> bool:
+        """Probe append: one sidecar write+fsync through the same fault
+        seam the WAL uses. Success exits degraded mode — the automatic
+        recovery path (the flusher probes on its interval; a refused
+        append probes at most every ``degraded_probe_interval_s``).
+        Returns True when the layer is healthy after the call."""
+        with self._lock:
+            if not self._degraded:
+                return True
+            if self._dead or self._fenced:
+                return False
+            probe_path = os.path.join(self.data_dir, "probe.tmp")
+            try:
+                self._disk_check("append")
+                with open(probe_path, "wb") as f:
+                    f.write(b"probe\n")
+                    f.flush()
+                    self._disk_check("fsync")
+                    os.fsync(f.fileno())
+                os.unlink(probe_path)
+            except OSError as err:
+                self.probe_failures += 1
+                logger.debug("degraded probe append failed: %s", err)
+                try:
+                    os.unlink(probe_path)
+                except OSError:
+                    pass
+                return False
+            # The WAL handle itself may be poisoned (ENOSPC mid-write);
+            # reopen it fresh now that the device answers again.
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+            try:
+                self._f = open(self._wal_path, "ab")
+            except OSError as err:
+                self.probe_failures += 1
+                logger.debug("degraded probe reopen failed: %s", err)
+                return False
+            self._exit_degraded()
+            return True
+
     @staticmethod
     def _rec_ns_name(rec: Dict[str, Any]) -> Optional[Tuple[str, str]]:
         """(namespace, name) of a put/del record, for the range fence."""
@@ -544,10 +808,15 @@ class Persistence:
         # after an fsync batch is durable within flush_interval_s even if
         # the batch never fills.
         while not self._stop_flusher.wait(self.flush_interval_s):
+            if self._degraded:
+                # The flusher doubles as the degraded-mode health probe:
+                # the layer re-opens automatically when the device
+                # answers again, no operator action required.
+                self.probe()
             with self._lock:
                 if self._dead:
                     return
-                if self._buf:
+                if self._buf and not self._degraded:
                     self._flush_locked(fsync=True)
 
     def close(self) -> None:
@@ -559,7 +828,10 @@ class Persistence:
             self._flusher = None
             if not self._dead and self._f is not None:
                 self._flush_locked(fsync=True)
-                self._f.close()
+                try:
+                    self._f.close()
+                except OSError:  # degraded device: nothing left to save
+                    pass
                 self._f = None
         # Join OUTSIDE the lock: the flusher may be blocked acquiring it.
         if flusher is not None and flusher is not threading.current_thread():
@@ -617,9 +889,14 @@ class Persistence:
             # untraced writes — the steady state — which never pay this
             # key) stay byte-compatible both directions.
             rec["tc"] = tc
-        line = (
-            json.dumps(rec, separators=(",", ":"), default=str) + "\n"
-        ).encode("utf-8")
+        body = json.dumps(rec, separators=(",", ":"), default=str).encode("utf-8")
+        if self.checksums:
+            # Stamp the CRC as the LAST key, next to "gen"/"tc": replay
+            # and followers that predate it ignore unknown keys, so the
+            # upgrade is byte-compatible both directions.
+            line = stamp_crc(body) + b"\n"
+        else:
+            line = body + b"\n"
         with self._lock:
             if self._fenced:
                 self.fenced_appends += 1
@@ -646,6 +923,25 @@ class Persistence:
                     )
             if self._dead:
                 raise SimulatedCrash("persistence layer is dead (kill-point fired)")
+            if self._degraded:
+                # Throttled inline probe: even a flusher-less deployment
+                # (the chaos soak) heals automatically once the device
+                # answers again. The RLock makes the re-entrant probe()
+                # call safe under the store lock.
+                now = time.monotonic()
+                if now >= self._next_probe_monotonic:
+                    self._next_probe_monotonic = (
+                        now + self.degraded_probe_interval_s
+                    )
+                    self.probe()
+                if self._degraded:
+                    self.degraded_refused += 1
+                    self._count("wal_degraded_refused_total")
+                    raise StorageDegradedError(
+                        "persistence layer is in read-only degraded mode "
+                        f"({self.degraded_reason}); durable writes are "
+                        "refused fail-closed until a probe append succeeds"
+                    )
             if self._f is None:
                 self.open()
             ks = self.kill_switch
@@ -670,6 +966,21 @@ class Persistence:
                 self._ship(torn)
                 self._die(action)
                 raise SimulatedCrash("kill-point: torn final WAL record")
+            try:
+                self._disk_check("append")
+            except OSError as err:
+                # EIO/ENOSPC fails the write BEFORE the in-memory commit
+                # (the fence pattern: _persist_put runs ahead of the
+                # store mutation), so the store never holds a record the
+                # disk refused — and the shard trips into metrics-visible
+                # read-only degraded mode.
+                self._enter_degraded(
+                    f"append {err.__class__.__name__}: {err}"
+                )
+                raise StorageDegradedError(
+                    f"WAL append failed ({err}); shard is read-only "
+                    "degraded until a probe append succeeds"
+                ) from err
             self._buf.append(line)
             self.records_appended += 1
             self.bytes_appended += len(line)
@@ -690,11 +1001,13 @@ class Persistence:
                 self._flush_locked(fsync=True)
                 self._die(action)
                 raise SimulatedCrash("kill-point: crash after WAL append")
-            if action == "mid_snapshot":
+            if action in ("mid_snapshot", "mid_rotate_demote",
+                          "mid_rotate_wal"):
                 # Force rotation NOW; write_snapshot (called by the store
-                # right after this append) will die before the rename.
+                # right after this append) dies at the named rotate
+                # phase — see the phase table in its docstring.
                 self._since_snapshot = self.snapshot_every
-                self._die_mid_snapshot = True
+                self._die_at_rotate = action
             if len(self._buf) >= self.fsync_every:
                 # While a group-commit leader's fsync is in flight, the
                 # size trigger only writes (the leader's next fsync — or
@@ -723,20 +1036,37 @@ class Persistence:
         assert self._f is not None
         data = b"".join(self._buf)
         if data:
-            self._f.write(data)
+            try:
+                self._disk_check("append")
+                self._f.write(data)
+                self._f.flush()
+            except OSError as err:
+                # Records stay buffered (they are already committed in
+                # memory and possibly acked non-durable); degraded mode
+                # refuses NEW writes, and the probe-heal path reopens
+                # the handle, after which the next flush delivers them.
+                self._enter_degraded(f"wal write failed: {err}")
+                return
             self._buf.clear()
-            self._f.flush()
             # Appends happen under this lock, so once the buffer drains
             # every appended record has reached the OS file.
             self._written_seq = self.records_appended
         if fsync:
             t0 = time.monotonic()
-            os.fsync(self._f.fileno())
-            self._observe("wal_fsync_seconds", time.monotonic() - t0,
-                          WAL_LATENCY_BUCKETS)
-            self.fsyncs += 1
-            self.durable_seq = self._written_seq
-            self._count("wal_fsync_total")
+            try:
+                self._disk_check("fsync")
+                os.fsync(self._f.fileno())
+            except OSError as err:
+                self._enter_degraded(f"wal fsync failed: {err}")
+            else:
+                self._observe("wal_fsync_seconds", time.monotonic() - t0,
+                              WAL_LATENCY_BUCKETS)
+                self.fsyncs += 1
+                self.durable_seq = self._written_seq
+                self._count("wal_fsync_total")
+        # Ship even after a failed fsync: the bytes reached the OS file,
+        # which is the existing ship contract (group commit ships before
+        # its leader fsync too).
         self._ship(data)
 
     # ---- group commit (HTTP write fan-in) ---------------------------------
@@ -760,6 +1090,12 @@ class Persistence:
             if self.durable_seq >= seq:
                 return True
             if self._dead:
+                return False
+            if self._degraded:
+                # Nothing becomes durable until a probe heals the
+                # device; fail the waiter now instead of spinning out
+                # the deadline. The caller surfaces the non-durable
+                # write as an error, fail-closed.
                 return False
             with self._gc_cond:
                 if self._gc_flushing:
@@ -794,9 +1130,13 @@ class Persistence:
             fileno = self._f.fileno()
         t0 = time.monotonic()
         try:
+            self._disk_check("fsync")
             os.fsync(fileno)
-        except OSError:
+        except OSError as err:
             logger.exception("group-commit fsync failed")
+            with self._lock:
+                if not self._dead:
+                    self._enter_degraded(f"group-commit fsync failed: {err}")
             return
         with self._lock:
             if self._dead:
@@ -917,26 +1257,58 @@ class Persistence:
         return not self._dead and self._since_snapshot >= self.snapshot_every
 
     def write_snapshot(self, objects: List[Dict[str, Any]], rv: int) -> None:
-        """Write a compacted snapshot and truncate the WAL.
+        """Write a compacted snapshot and rotate (never truncate) the WAL.
 
-        Crash-safe at every step: the snapshot lands under a tmp name and
-        is atomically renamed over the old one; until the rename the old
-        snapshot + full WAL are authoritative, and after it the stale WAL
-        records (rv <= snapshot rv) are skipped on replay, so dying
-        between rename and truncate also recovers cleanly."""
+        Retention is N=2: the previous snapshot is demoted to
+        ``snapshot.json.1`` and the WAL segment it compacted is demoted
+        to ``wal.jsonl.1``, so when the NEW snapshot later fails its
+        digest check, recovery falls back to the previous snapshot and
+        the retained segment still reconstructs the exact same state
+        (corruption-aware fallback, invariant I12). The snapshot file is
+        one payload line plus a digest-trailer line (sha256 over the
+        payload bytes); a legacy trailer-less snapshot still loads.
+
+        Crash-safe at EVERY interleaving. Phases, with the rotate-phase
+        kill points (PR 5 table, extended) between them::
+
+            flush WAL  ->  write tmp + fsync     [mid_snapshot]
+            demote snapshot -> snapshot.json.1   [mid_rotate_demote]
+            install tmp -> snapshot.json         [mid_rotate_wal]
+            demote wal -> wal.jsonl.1, open fresh wal, fsync dir
+
+        Recovery always replays ``wal.jsonl.1`` then ``wal.jsonl`` on
+        top of whichever snapshot verifies (rv-skip makes the overlap
+        idempotent), so dying between any two phases converges to the
+        same state:
+
+        * after ``mid_snapshot``: tmp is orphaned dead bytes; old
+          snapshot + both segments are authoritative.
+        * after ``mid_rotate_demote``: no primary snapshot on disk —
+          recovery uses the just-demoted ``snapshot.json.1`` plus both
+          segments (the live WAL still holds everything the orphaned
+          tmp would have compacted).
+        * after ``mid_rotate_wal``: new snapshot installed, WAL not yet
+          rotated — its records are all ``rv <=`` snapshot rv and are
+          skipped on replay.
+
+        An ``EIO``/``ENOSPC`` during any phase aborts the rotation and
+        trips degraded mode; the pre-rotation chain stays authoritative.
+        """
         with self._lock:
             if self._fenced:
                 self.fenced_appends += 1
                 self._count("wal_fenced_appends_total")
                 raise FencedError(
                     "persistence layer is fenced: refusing snapshot "
-                    "rotation (it would truncate the new leader's WAL)"
+                    "rotation (it would rotate the new leader's WAL)"
                 )
             if self._dead:
                 return  # a dead process compacts nothing
             t0 = time.monotonic()
             # WAL first: the snapshot claims to cover everything <= rv.
             self._flush_locked(fsync=True)
+            if self._degraded:
+                return  # no rotation on a refusing device
             payload = {
                 "schema": SCHEMA_VERSION,
                 "rv": int(rv),
@@ -944,25 +1316,68 @@ class Persistence:
             }
             if self.generation:
                 payload["generation"] = self.generation
-            with open(self._snap_tmp_path, "w") as f:
-                json.dump(payload, f, separators=(",", ":"), default=str)
-                f.flush()
-                os.fsync(f.fileno())
-            if self._die_mid_snapshot:
-                # Kill-point: tmp written, rename never happens — recovery
-                # must ignore the orphaned tmp file. No raise: the commit
-                # that triggered this rotation already succeeded (record
-                # durable, memory committed, watch notified) — process
-                # death during background compaction cannot unwind it.
-                # The NEXT write observes the dead layer and crashes.
-                self._die("mid_snapshot")
+            body = json.dumps(payload, separators=(",", ":"), default=str)
+            # json escapes newlines inside strings, so the payload is one
+            # line by construction and the loader splits at the first \n.
+            trailer = json.dumps(
+                {
+                    "digest": "sha256:"
+                    + hashlib.sha256(body.encode("utf-8")).hexdigest(),
+                    "len": len(body),
+                },
+                separators=(",", ":"),
+            )
+            try:
+                with open(self._snap_tmp_path, "w") as f:
+                    f.write(body + "\n" + trailer + "\n")
+                    f.flush()
+                    self._disk_check("fsync")
+                    os.fsync(f.fileno())
+                if self._die_at_rotate == "mid_snapshot":
+                    # Kill-point: tmp written, nothing renamed — recovery
+                    # must ignore the orphaned tmp file. No raise: the
+                    # commit that triggered this rotation already
+                    # succeeded (record durable, memory committed, watch
+                    # notified) — process death during background
+                    # compaction cannot unwind it. The NEXT write
+                    # observes the dead layer and crashes.
+                    self._die("mid_snapshot")
+                    return
+                self._disk_check("rename")
+                if os.path.exists(self._snap_path):
+                    # Demote the previous snapshot BEFORE installing the
+                    # new one: from here until the install the chain
+                    # snapshot.json.1 + wal.jsonl.1 + wal.jsonl is
+                    # authoritative (and complete: the live WAL still
+                    # holds everything since that snapshot).
+                    os.replace(self._snap_path, self._snap_prev_path)
+                if self._die_at_rotate == "mid_rotate_demote":
+                    self._die("mid_rotate_demote")
+                    return
+                os.replace(self._snap_tmp_path, self._snap_path)
+                if self._die_at_rotate == "mid_rotate_wal":
+                    self._die("mid_rotate_wal")
+                    return
+                # Rotate — never truncate — the WAL: the just-demoted
+                # snapshot may be the one recovery falls back to, and it
+                # needs this segment to reach the present.
+                if self._f is not None:
+                    self._f.close()
+                    self._f = None
+                if os.path.exists(self._wal_path):
+                    os.replace(self._wal_path, self._wal_prev_path)
+                self._f = open(self._wal_path, "wb")
+                self._fsync_dir()
+            except OSError as err:
+                self._enter_degraded(f"snapshot rotation failed: {err}")
+                if self._f is None:
+                    # Keep a usable (if refusing) handle so the heal
+                    # path has something to reopen against.
+                    try:
+                        self._f = open(self._wal_path, "ab")
+                    except OSError:
+                        pass
                 return
-            os.replace(self._snap_tmp_path, self._snap_path)
-            # Start a fresh WAL segment for the new snapshot generation.
-            if self._f is not None:
-                self._f.close()
-            self._f = open(self._wal_path, "wb")
-            self._fsync_dir()
             self._since_snapshot = 0
             self.snapshots_written += 1
             self._count("wal_snapshots_total")
@@ -981,59 +1396,216 @@ class Persistence:
 
     # ---- recovery ---------------------------------------------------------
 
-    def recover(self) -> RecoveredState:
-        """Replay snapshot + WAL into a :class:`RecoveredState`.
+    def _read_snapshot(
+        self, path: str
+    ) -> Tuple[Optional[Dict[str, Any]], bool]:
+        """Load one snapshot file, verifying its digest trailer.
 
-        Pure function of the on-disk bytes (modulo the one repair it
-        performs: truncating a torn tail) — recovering the same dir twice
-        yields identical state, which is invariant I6 of the chaos soak.
-        """
+        Returns ``(payload, verified)``; ``(None, False)`` when the file
+        is unreadable, fails JSON parse, or fails its digest. A legacy
+        trailer-less snapshot parses as ``(payload, False)`` — accepted
+        (upgrade path) but not digest-verifiable."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return None, False
+        nl = raw.find(b"\n")
+        body = raw if nl < 0 else raw[:nl]
+        trailer = b"" if nl < 0 else raw[nl + 1:]
+        verified = False
+        if trailer.strip():
+            try:
+                t = json.loads(trailer)
+                digest = t["digest"]
+            except (ValueError, KeyError, TypeError):
+                return None, False
+            actual = "sha256:" + hashlib.sha256(body).hexdigest()
+            if actual != digest:
+                return None, False
+            verified = True
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return None, False
+        if not isinstance(payload, dict):
+            return None, False
+        return payload, verified
+
+    def recover(self) -> RecoveredState:
+        """Replay snapshot + WAL segments into a :class:`RecoveredState`,
+        verifying every byte as it goes.
+
+        Pure function of the on-disk bytes (modulo the repairs it
+        performs: truncating a torn tail, quarantining a corrupt region)
+        — recovering the same dir twice yields identical state, which is
+        invariant I6 of the chaos soak.
+
+        Integrity semantics (invariant I12): the primary snapshot must
+        pass its digest trailer or recovery falls back to the retained
+        previous snapshot (``snapshot.json.1``) plus a longer WAL
+        replay; a record that fails its CRC or its parse mid-segment
+        stops replay at the last verifiable prefix and quarantines the
+        untrustworthy suffix to ``wal.quarantine/`` — no corrupted
+        record is ever applied. The verdict lands in
+        ``RecoveredState.integrity``."""
         state = RecoveredState()
         objects: Dict[Tuple[str, str, str, str], Dict[str, Any]] = {}
-        # Orphaned tmp from a crash mid-snapshot: the rename never
-        # happened, so it is dead bytes.
+        # Orphaned tmp from a crash mid-rotation: no install rename
+        # happened (or the chain past it is already complete), so it is
+        # dead bytes either way.
         if os.path.exists(self._snap_tmp_path):
-            logger.warning("removing orphaned %s (crash mid-snapshot)",
+            logger.warning("removing orphaned %s (crash mid-rotation)",
                            SNAPSHOT_TMP_NAME)
             os.unlink(self._snap_tmp_path)
+        chosen: Optional[Dict[str, Any]] = None
+        primary_bad = False
         if os.path.exists(self._snap_path):
-            with open(self._snap_path) as f:
-                payload = json.load(f)
+            payload, verified = self._read_snapshot(self._snap_path)
+            if payload is None:
+                primary_bad = True
+                logger.error(
+                    "%s failed its digest/parse check; falling back to "
+                    "the previous retained snapshot", SNAPSHOT_NAME,
+                )
+                if self.audit is not None:
+                    self.audit.record(
+                        "cluster", "corruption_detected",
+                        reason="snapshot_digest_mismatch",
+                        segment=SNAPSHOT_NAME,
+                    )
+            else:
+                chosen = payload
+                state.snapshot_digest_verified = verified
+        if chosen is None and os.path.exists(self._snap_prev_path):
+            # Either the primary failed verification (corruption
+            # fallback) or a crash between the demote and install
+            # renames left no primary at all — the retained previous
+            # snapshot plus BOTH WAL segments reconstructs the state.
+            payload, verified = self._read_snapshot(self._snap_prev_path)
+            if payload is not None:
+                chosen = payload
+                state.snapshot_digest_verified = verified
+                state.snapshot_fallback = primary_bad
+                if primary_bad:
+                    logger.warning(
+                        "recovered from %s + longer WAL replay",
+                        SNAPSHOT_PREV_NAME,
+                    )
+            elif primary_bad:
+                state.snapshot_fallback = True  # last resort: WAL-only
+        elif primary_bad:
+            state.snapshot_fallback = True
+        if chosen is not None:
             state.had_snapshot = True
-            state.snapshot_rv = int(payload.get("rv") or 0)
+            state.snapshot_rv = int(chosen.get("rv") or 0)
             state.rv = state.snapshot_rv
-            state.generation = int(payload.get("generation") or 0)
-            for obj in payload.get("objects") or []:
+            state.generation = int(chosen.get("generation") or 0)
+            for obj in chosen.get("objects") or []:
                 objects[object_key(obj)] = obj
-        self._replay_wal(state, objects)
+        # Always replay the retained previous segment FIRST, then the
+        # live one: rv-skip makes the overlap idempotent, and when the
+        # PREVIOUS snapshot is the one that verified it needs
+        # wal.jsonl.1 for the records its successor had compacted.
+        deleted: set = set()
+        self._replay_segment(self._wal_prev_path, state, objects, deleted,
+                             live=False)
+        self._replay_segment(self._wal_path, state, objects, deleted,
+                             live=True)
+        state.wal_deleted_keys = sorted(deleted)
         state.objects = list(objects.values())
+        if state.quarantined_records:
+            verdict = "quarantined"
+        elif state.snapshot_fallback:
+            verdict = "snapshot_fallback"
+        elif state.torn_records_dropped:
+            verdict = "torn_tail"
+        elif state.crc_records_verified and not state.crc_records_unverified:
+            verdict = "verified"
+        else:
+            verdict = "clean"
+        state.integrity = {
+            "verdict": verdict,
+            "crc_impl": CRC_IMPL,
+            "records_verified": state.crc_records_verified,
+            "records_unverified": state.crc_records_unverified,
+            "crc_failures": state.crc_failures,
+            "quarantined_records": state.quarantined_records,
+            "quarantined_bytes": state.quarantined_bytes,
+            "snapshot_fallback": state.snapshot_fallback,
+            "snapshot_digest_verified": state.snapshot_digest_verified,
+            "torn_records_dropped": state.torn_records_dropped,
+        }
         return state
 
-    def _replay_wal(self, state: RecoveredState, objects: Dict) -> None:
-        if not os.path.exists(self._wal_path):
+    def _replay_segment(self, path: str, state: RecoveredState,
+                        objects: Dict, deleted: set, live: bool) -> None:
+        """Replay one WAL segment, verifying each record's CRC.
+
+        ``live=True`` is the open segment (``wal.jsonl``): damage on its
+        FINAL record is the classic torn-append and keeps the PR 5
+        torn-tail semantics. Damage anywhere else — a CRC mismatch, or a
+        parse failure mid-file — is corruption: replay stops at the last
+        verifiable prefix and the untrustworthy suffix is quarantined
+        (appends are strictly ordered, so nothing after a bad record can
+        be trusted to be an append of THIS history)."""
+        if not os.path.exists(path):
             return
         good_end = 0
-        with open(self._wal_path, "rb") as f:
+        with open(path, "rb") as f:
             data = f.read()
         pos = 0
-        deleted: set = set()
         while pos < len(data):
             nl = data.find(b"\n", pos)
             if nl < 0:
-                # Final record has no newline — torn mid-append.
-                state.torn_records_dropped += 1
+                if live:
+                    # Final record has no newline — torn mid-append.
+                    state.torn_records_dropped += 1
+                else:
+                    # A sealed segment was flushed whole before its
+                    # rotation; a missing newline here is damage, not a
+                    # torn append.
+                    self._quarantine_region(
+                        path, data, pos, len(data), state,
+                        reason="torn_sealed_segment",
+                    )
                 break
             line = data[pos:nl]
-            try:
-                rec = json.loads(line)
-                op = rec["op"]
-                rv = int(rec["rv"])
-            except (ValueError, KeyError, TypeError):
-                # Corrupt record: everything from here on is untrustworthy
-                # (appends are strictly ordered, so a bad record means the
-                # tail was torn, not that a later record is fine).
-                state.torn_records_dropped += 1
+            bad_reason = None
+            if self.checksums:
+                ok, expected, actual = verify_line(line)
+                if not ok:
+                    bad_reason = (f"crc_mismatch expected={expected} "
+                                  f"actual={actual}")
+                    state.crc_failures += 1
+                    self.crc_failures += 1
+                    self._count('wal_crc_failures_total{site="recovery"}')
+                elif expected is None:
+                    state.crc_records_unverified += 1
+                else:
+                    state.crc_records_verified += 1
+            else:
+                state.crc_records_unverified += 1
+            rec: Optional[Dict[str, Any]] = None
+            if bad_reason is None:
+                try:
+                    rec = json.loads(line)
+                    op = rec["op"]
+                    rv = int(rec["rv"])
+                except (ValueError, KeyError, TypeError):
+                    bad_reason = "json_parse_failure"
+            if bad_reason is not None:
+                if (live and bad_reason == "json_parse_failure"
+                        and nl + 1 >= len(data)):
+                    # Damaged FINAL record of the live segment: the
+                    # classic torn append (possibly torn exactly at a
+                    # newline boundary), not mid-file corruption.
+                    state.torn_records_dropped += 1
+                    break
+                self._quarantine_region(path, data, pos, len(data), state,
+                                        reason=bad_reason)
                 break
+            assert rec is not None
             state.generation = max(
                 state.generation, int(rec.get("gen") or 0)
             )
@@ -1052,14 +1624,62 @@ class Persistence:
                 state.wal_records_replayed += 1
                 state.rv = max(state.rv, rv)
             pos = good_end = nl + 1
-        state.wal_deleted_keys = sorted(deleted)
         if good_end < len(data):
             logger.warning(
-                "truncating torn WAL tail: %d byte(s) after the last "
-                "intact record", len(data) - good_end,
+                "truncating damaged WAL suffix of %s: %d byte(s) after "
+                "the last intact record",
+                os.path.basename(path), len(data) - good_end,
             )
-            with open(self._wal_path, "r+b") as f:
+            with open(path, "r+b") as f:
                 f.truncate(good_end)
+
+    def _quarantine_region(self, path: str, data: bytes, start: int,
+                           end: int, state: RecoveredState,
+                           reason: str) -> None:
+        """Preserve an untrustworthy byte region in ``wal.quarantine/``
+        with offset/CRC forensics before it is truncated out of the
+        segment. Nothing from the region is ever applied (invariant
+        I12); the bytes are kept for post-mortem instead of destroyed."""
+        region = data[start:end]
+        nrecords = region.count(b"\n")
+        if not region.endswith(b"\n"):
+            nrecords += 1
+        nrecords = max(1, nrecords)
+        state.quarantined_records += nrecords
+        state.quarantined_bytes += len(region)
+        self.records_quarantined += nrecords
+        self._count("wal_records_quarantined_total", float(nrecords))
+        segment = os.path.basename(path)
+        try:
+            os.makedirs(self._quarantine_dir, exist_ok=True)
+            base = "%s.%d-%d" % (segment, start, end)
+            with open(os.path.join(self._quarantine_dir, base + ".bin"),
+                      "wb") as f:
+                f.write(region)
+            forensics = {
+                "segment": segment,
+                "offset": start,
+                "length": len(region),
+                "records": nrecords,
+                "reason": reason,
+                "crc_impl": CRC_IMPL,
+                "region_crc": wal_crc(region),
+            }
+            with open(os.path.join(self._quarantine_dir, base + ".json"),
+                      "w") as f:
+                json.dump(forensics, f, indent=2, sort_keys=True)
+        except OSError:
+            logger.exception("failed to write quarantine forensics")
+        if self.audit is not None:
+            self.audit.record(
+                "cluster", "corruption_detected",
+                reason=reason, segment=segment,
+                offset=start, bytes=len(region),
+            )
+        logger.error(
+            "WAL corruption: quarantined %d byte(s) at offset %d of %s "
+            "(%s)", len(region), start, segment, reason,
+        )
 
     def start(self, api, keep=None) -> RecoveredState:
         """Recover this data dir into ``api``, compact, and attach.
@@ -1100,6 +1720,9 @@ class Persistence:
                 had_snapshot=state.had_snapshot,
                 wal_records_replayed=state.wal_records_replayed,
                 torn_records_dropped=state.torn_records_dropped,
+                integrity=state.integrity.get("verdict", "clean"),
+                quarantined_records=state.quarantined_records,
+                snapshot_fallback=state.snapshot_fallback,
             )
         return state
 
@@ -1117,6 +1740,14 @@ class Persistence:
                 "fenced_appends": self.fenced_appends,
                 "range_fenced": int(self._range_fence is not None),
                 "range_fenced_appends": self.range_fenced_appends,
+                "checksums": int(self.checksums),
+                "degraded": int(self._degraded),
+                "degraded_entries": self.degraded_entries,
+                "degraded_exits": self.degraded_exits,
+                "degraded_refused": self.degraded_refused,
+                "probe_failures": self.probe_failures,
+                "crc_failures": self.crc_failures,
+                "records_quarantined": self.records_quarantined,
             }
 
     def buffered_bytes(self) -> int:
@@ -1126,15 +1757,199 @@ class Persistence:
             return sum(len(line) for line in self._buf)
 
 
+class Scrubber:
+    """Background integrity scrubber: re-verifies cold bytes on a low
+    duty cycle so corruption is found while the redundancy to recover
+    from it (the retained snapshot + segment pair) still exists.
+
+    Each pass re-checks, in order:
+
+    * the CRC of every record in the SEALED WAL segment
+      (``wal.jsonl.1``) — cold bytes nothing else ever re-reads;
+    * the digest trailers of both retained snapshots;
+    * leader/follower agreement: each registered follower probe's
+      ``(rv, digest)`` pair against the leader probe's, compared only
+      when the rvs match (a lagging follower is lag, not corruption).
+
+    Findings become counters (``scrub_corruptions_found_total``,
+    ``wal_crc_failures_total{site="scrub"}``), a typed
+    ``corruption_detected`` cluster event, and a bounded ``findings``
+    list surfaced on ``/debug/shards``. The scrubber never repairs —
+    recovery owns repair — it only reports while there is still time
+    to act."""
+
+    MAX_FINDINGS = 20
+
+    def __init__(
+        self,
+        wal: Persistence,
+        interval_s: float = 30.0,
+        name: str = "scrubber",
+    ) -> None:
+        self.wal = wal
+        self.interval_s = float(interval_s)
+        self.name = name
+        #: Leader-side state probe: ``() -> (rv, digest)``.
+        self.leader_probe: Optional[Callable[[], Tuple[int, str]]] = None
+        #: Follower probes: ``label -> (() -> (rv, digest))``.
+        self.follower_probes: Dict[str, Callable[[], Tuple[int, str]]] = {}
+        self.passes = 0
+        self.records_verified = 0
+        self.corruptions_found = 0
+        self.findings: List[Dict[str, Any]] = []
+        self.last_pass_monotonic = 0.0
+        self._metrics = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def instrument(self, metrics) -> None:
+        self._metrics = metrics
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name, value)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"wal-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        t = self._thread
+        self._thread = None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrub_once()
+            except Exception:  # pragma: no cover - scrubbing stays soft
+                logger.exception("scrub pass failed")
+
+    def _finding(self, kind: str, **details: Any) -> None:
+        entry = dict(kind=kind, **details)
+        with self._lock:
+            self.findings.append(entry)
+            del self.findings[:-self.MAX_FINDINGS]
+        self.corruptions_found += 1
+        self._count("scrub_corruptions_found_total")
+        wal = self.wal
+        if wal.audit is not None:
+            wal.audit.record("cluster", "corruption_detected",
+                             reason=f"scrub_{kind}", **details)
+        logger.error("scrubber finding: %s %s", kind, details)
+
+    def scrub_once(self) -> Dict[str, Any]:
+        """One full verification pass. Returns a summary dict (also the
+        shape surfaced on /debug/shards)."""
+        wal = self.wal
+        self.passes += 1
+        self._count("scrub_passes_total")
+        verified = 0
+        # Sealed segment: cold bytes. The live segment is skipped — its
+        # tail is in flight under the WAL lock, and recovery verifies it
+        # on every boot anyway.
+        prev = wal._wal_prev_path
+        if wal.checksums and os.path.exists(prev):
+            try:
+                with open(prev, "rb") as f:
+                    data = f.read()
+            except OSError as err:
+                self._finding("segment_unreadable",
+                              segment=os.path.basename(prev),
+                              error=str(err))
+                data = b""
+            pos = 0
+            while pos < len(data):
+                nl = data.find(b"\n", pos)
+                if nl < 0:
+                    break
+                ok, expected, actual = verify_line(data[pos:nl])
+                if not ok:
+                    wal.crc_failures += 1
+                    self._count('wal_crc_failures_total{site="scrub"}')
+                    self._finding(
+                        "wal_crc_mismatch",
+                        segment=os.path.basename(prev), offset=pos,
+                        expected=expected, actual=actual,
+                    )
+                    break  # prefix rule: nothing past this is trusted
+                verified += 1
+                pos = nl + 1
+        # Snapshot digests: a snapshot that exists but no longer loads
+        # is corruption found EARLY, while the sibling still has the
+        # redundancy to recover from it.
+        for path in (wal._snap_path, wal._snap_prev_path):
+            if not os.path.exists(path):
+                continue
+            payload, _digest_ok = wal._read_snapshot(path)
+            if payload is None:
+                self._finding("snapshot_digest_mismatch",
+                              segment=os.path.basename(path))
+            else:
+                verified += 1
+        # rv+digest agreement: only when caught up — lag is not damage.
+        if self.leader_probe is not None and self.follower_probes:
+            try:
+                leader_rv, leader_digest = self.leader_probe()
+            except Exception:  # pragma: no cover
+                leader_rv, leader_digest = -1, ""
+            for label, probe in list(self.follower_probes.items()):
+                try:
+                    f_rv, f_digest = probe()
+                except Exception:  # pragma: no cover
+                    continue
+                if f_rv == leader_rv and f_digest != leader_digest:
+                    self._finding(
+                        "replica_divergence", follower=label,
+                        rv=int(f_rv), leader_digest=leader_digest,
+                        follower_digest=f_digest,
+                    )
+                elif f_rv == leader_rv:
+                    verified += 1
+        self.records_verified += verified
+        if verified:
+            self._count("scrub_records_verified_total", float(verified))
+        self.last_pass_monotonic = time.monotonic()
+        return self.summary()
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            findings = list(self.findings)
+        return {
+            "passes": self.passes,
+            "records_verified": self.records_verified,
+            "corruptions_found": self.corruptions_found,
+            "findings": findings,
+        }
+
+
 __all__ = [
     "Persistence",
     "RecoveredState",
+    "Scrubber",
     "SimulatedCrash",
     "FencedError",
     "WrongShardError",
+    "StorageDegradedError",
     "DEFAULT_FSYNC_EVERY",
     "DEFAULT_SNAPSHOT_EVERY",
     "DEFAULT_SHIP_QUEUE_BYTES",
     "SNAPSHOT_NAME",
+    "SNAPSHOT_PREV_NAME",
     "WAL_NAME",
+    "WAL_PREV_NAME",
+    "QUARANTINE_DIR",
+    "CRC_IMPL",
+    "wal_crc",
+    "stamp_crc",
+    "split_crc",
+    "verify_line",
 ]

@@ -58,7 +58,9 @@ from cron_operator_tpu.runtime.kube import (
 from cron_operator_tpu.runtime.persistence import (
     Persistence,
     RecoveredState,
+    Scrubber,
     WrongShardError,
+    verify_line,
 )
 from cron_operator_tpu.telemetry.trace import new_trace_id
 from cron_operator_tpu.utils.clock import Clock, RealClock
@@ -495,6 +497,15 @@ class FollowerReplica:
         #: highest this replica has seen came from a demoted zombie
         #: leader and must never reach the store.
         self.records_rejected = 0
+        #: Records refused because their stamped CRC failed verification
+        #: (integrity, chaos invariant I12): a corrupt record must never
+        #: reach the store — not via replay, not via the ship stream.
+        self.records_rejected_crc = 0
+        #: Verify each shipped record's CRC stamp before applying it.
+        #: Mirrors ``Persistence.checksums`` (the --no-checksums
+        #: counter-proof disables both ends together).
+        self.verify_checksums = True
+        self._metrics = None
         self.resyncs = 0
         self.bootstrap_rv = 0
         #: Highest lease generation observed (bootstrap state or any
@@ -518,6 +529,13 @@ class FollowerReplica:
 
     def add_resync_listener(self, fn: Callable[[], None]) -> None:
         self._resync_listeners.append(fn)
+
+    def instrument(self, metrics) -> None:
+        self._metrics = metrics
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name, value)
 
     def bootstrap(self, state: RecoveredState) -> None:
         if not state.empty:
@@ -581,6 +599,24 @@ class FollowerReplica:
             self.last_apply_monotonic = time.monotonic()
 
     def _apply_line(self, line: bytes) -> None:
+        if self.verify_checksums:
+            ok, expected, actual = verify_line(line)
+            if not ok:
+                # Integrity (I12): the leader stamped a CRC over this
+                # record and the bytes that arrived do not match it —
+                # damage on the wire or on the leader's disk. Refuse it;
+                # a corrupt record must never reach the store.
+                self.records_rejected += 1
+                self.records_rejected_crc += 1
+                self._count(
+                    'shard_follower_records_rejected_total{reason="crc"}'
+                )
+                self._count('wal_crc_failures_total{site="follower"}')
+                logger.warning(
+                    "follower %s rejected corrupt record (crc expected "
+                    "%s, actual %s)", self.name, expected, actual,
+                )
+                return
         try:
             rec = json.loads(line)
             op = rec["op"]
@@ -595,6 +631,10 @@ class FollowerReplica:
                 # record arrived over a still-open ship socket. Refuse
                 # it — the new leader's stream is authoritative.
                 self.records_rejected += 1
+                self._count(
+                    'shard_follower_records_rejected_total'
+                    '{reason="stale_generation"}'
+                )
                 logger.warning(
                     "follower %s rejected stale-generation record "
                     "(gen %d < %d)", self.name, gen, self.generation,
@@ -692,6 +732,12 @@ class RangeFilteredFollower(FollowerReplica):
         super().resync(self._filter_state(state))
 
     def _apply_line(self, line: bytes) -> None:
+        if self.verify_checksums and not verify_line(line)[0]:
+            # Route a CRC-corrupt record straight to the parent's
+            # rejection path: filtering judges CONTENT, and corrupt
+            # content must not even advance the generation watermark.
+            super()._apply_line(line)
+            return
         try:
             rec = json.loads(line)
             op = rec.get("op")
@@ -753,6 +799,10 @@ class Shard:
         #: whoever owns the managers (the CLI, the chaos soak). Purely
         #: informational — surfaced in ``/debug/shards``.
         self.leader: Optional[str] = None
+        #: Background integrity scrubber over this shard's persistence
+        #: (``Scrubber``), when the plane enables one. Surfaced on
+        #: ``/debug/shards``.
+        self.scrubber: Optional[Any] = None
 
     def lag(self) -> Dict[str, Any]:
         """Follower replication lag: records / bytes / seconds behind
@@ -1165,6 +1215,9 @@ class ShardedControlPlane:
         flush_interval_s: Optional[float] = None,
         audit: Optional[Any] = None,
         tracer: Optional[Any] = None,
+        checksums: bool = True,
+        scrub_interval_s: float = 0.0,
+        disk_faults: Optional[Any] = None,
     ):
         if n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, got {n_shards}")
@@ -1183,13 +1236,17 @@ class ShardedControlPlane:
         self.metrics = metrics
         self.audit = audit
         self.tracer = tracer
-        self._pers_kwargs: Dict[str, Any] = {}
+        self.checksums = checksums
+        self.scrub_interval_s = float(scrub_interval_s)
+        self._pers_kwargs: Dict[str, Any] = {"checksums": checksums}
         if fsync_every is not None:
             self._pers_kwargs["fsync_every"] = fsync_every
         if snapshot_every is not None:
             self._pers_kwargs["snapshot_every"] = snapshot_every
         if flush_interval_s is not None:
             self._pers_kwargs["flush_interval_s"] = flush_interval_s
+        if disk_faults is not None:
+            self._pers_kwargs["disk_faults"] = disk_faults
 
         # Keyspace ownership: the on-disk map outranks the boot count —
         # a restart after live splits must serve every shard the map
@@ -1236,19 +1293,51 @@ class ShardedControlPlane:
                 recovered = pers.start(store, keep=self._keep_fn(i))
                 if replicas:
                     follower = FollowerReplica(self.clock)
+                    follower.verify_checksums = checksums
+                    if metrics is not None:
+                        follower.instrument(ShardMetrics(metrics, i))
                     pers.attach_follower(follower)
             if metrics is not None:
                 store.instrument(ShardMetrics(metrics, i))
             if shard_audit is not None:
                 store.attach_audit(shard_audit)
-            self.shards.append(
-                Shard(i, store, pers, follower, sdir, recovered)
-            )
+            shard = Shard(i, store, pers, follower, sdir, recovered)
+            self._attach_scrubber(shard)
+            self.shards.append(shard)
         self.router = ShardRouter(
             [s.store for s in self.shards],
             ownership=self.ownership,
             metrics=metrics,
         )
+
+    def _attach_scrubber(self, shard: Shard) -> None:
+        """Start a background integrity scrubber over ``shard``'s
+        persistence (when the plane enables scrubbing): sealed-segment
+        CRCs, snapshot digests, and leader/follower rv+digest agreement
+        re-verified on a low duty cycle, findings on /debug/shards."""
+        if self.scrub_interval_s <= 0 or shard.persistence is None:
+            return
+
+        def _state_digest(store) -> Tuple[int, str]:
+            rv = int(getattr(store, "_rv", 0))
+            state = canonical_state(store.all_objects(), rv)
+            return rv, hashlib.blake2b(
+                state.encode("utf-8"), digest_size=16
+            ).hexdigest()
+
+        scrub = Scrubber(
+            shard.persistence, interval_s=self.scrub_interval_s,
+            name=f"shard-{shard.index}",
+        )
+        if self.metrics is not None:
+            scrub.instrument(ShardMetrics(self.metrics, shard.index))
+        scrub.leader_probe = lambda s=shard: _state_digest(s.store)
+        if shard.follower is not None:
+            scrub.follower_probes["follower"] = (
+                lambda s=shard: _state_digest(s.follower.store)
+            )
+        scrub.start()
+        shard.scrubber = scrub
 
     @property
     def recovered_any(self) -> bool:
@@ -1740,13 +1829,20 @@ class ShardedControlPlane:
         new_follower: Optional[FollowerReplica] = None
         if self.replicas:
             new_follower = FollowerReplica(self.clock)
+            new_follower.verify_checksums = self.checksums
+            if self.metrics is not None:
+                new_follower.instrument(ShardMetrics(self.metrics, index))
             new_pers.attach_follower(new_follower)
 
+        if shard.scrubber is not None:
+            shard.scrubber.stop()
+            shard.scrubber = None
         shard.store = store
         shard.persistence = new_pers
         shard.follower = new_follower
         shard.failovers += 1
         shard.leader = None  # the caller starts (and registers) a manager
+        self._attach_scrubber(shard)
         self.router.replace(index, store)
         t_serving = time.time()
         duration = time.monotonic() - t0_mono
@@ -1832,11 +1928,23 @@ class ShardedControlPlane:
             if s.persistence is not None:
                 entry["wal"] = s.persistence.stats()
                 entry["wal_buffered_bytes"] = s.persistence.buffered_bytes()
+                entry["degraded"] = {
+                    "active": s.persistence.degraded,
+                    "reason": s.persistence.degraded_reason,
+                    "entries": s.persistence.degraded_entries,
+                    "exits": s.persistence.degraded_exits,
+                    "refused_writes": s.persistence.degraded_refused,
+                }
+            if s.recovered is not None and s.recovered.integrity:
+                entry["integrity"] = s.recovered.integrity
+            if s.scrubber is not None:
+                entry["scrub"] = s.scrubber.summary()
             if s.follower is not None:
                 lag = s.lag()
                 entry["follower"] = {
                     "records_applied": s.follower.records_applied,
                     "records_dropped": s.follower.records_dropped,
+                    "records_rejected_crc": s.follower.records_rejected_crc,
                     "resyncs": s.follower.resyncs,
                     "bytes_applied": s.follower.bytes_applied,
                     "torn_tail_bytes": s.follower.lag_bytes,
@@ -1876,6 +1984,12 @@ class ShardedControlPlane:
 
     def close(self) -> None:
         for shard in self.shards:
+            if shard.scrubber is not None:
+                try:
+                    shard.scrubber.stop()
+                except Exception:  # pragma: no cover - teardown best-effort
+                    logger.exception("shard %d scrubber stop failed", shard.index)
+                shard.scrubber = None
             try:
                 shard.store.close()
             except Exception:  # pragma: no cover - teardown best-effort

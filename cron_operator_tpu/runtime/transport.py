@@ -82,6 +82,7 @@ from cron_operator_tpu.runtime.persistence import (
     Persistence,
     RecoveredState,
     WrongShardError,
+    wal_crc,
 )
 from cron_operator_tpu.runtime.readroute import (
     DEFAULT_BARRIER_TIMEOUT_S,
@@ -111,10 +112,21 @@ logger = logging.getLogger("runtime.transport")
 FRAME_WAL = b"W"
 FRAME_BOOT = b"B"
 
-_HEADER = struct.Struct("!cI")  # type byte + big-endian payload length
+#: type byte + big-endian payload length + CRC32C of the payload. The
+#: CRC travels in the frame header, so a follower rejects a frame whose
+#: bytes were damaged in flight (or on the leader's disk between flush
+#: and send) BEFORE any line of it reaches the replica's store — the
+#: wire leg of invariant I12.
+_HEADER = struct.Struct("!cII")
 
 #: Refuse absurd frames (a desynced peer, not a real payload).
 MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+class FrameCorruptError(ValueError):
+    """A fully-received frame failed its header CRC: the length framing
+    held (this is not a torn frame) but the payload bytes are not the
+    bytes the peer checksummed."""
 
 #: Reconnect backoff (the runtime/retry.py policy shape:
 #: ``min(base * 2**attempt, cap)``).
@@ -123,7 +135,9 @@ RECONNECT_CAP_S = 2.0
 
 
 def write_frame(sock: socket.socket, ftype: bytes, payload: bytes) -> None:
-    sock.sendall(_HEADER.pack(ftype, len(payload)) + payload)
+    sock.sendall(
+        _HEADER.pack(ftype, len(payload), wal_crc(payload)) + payload
+    )
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -143,16 +157,23 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 def read_frame(sock: socket.socket) -> Optional[Tuple[bytes, bytes]]:
     """→ (type, payload), or None on EOF / torn frame. A record split
     across TCP segments is reassembled here; a frame cut short by the
-    peer's death never yields a partial payload."""
+    peer's death never yields a partial payload; a complete frame whose
+    payload fails the header CRC raises :class:`FrameCorruptError`."""
     header = _recv_exact(sock, _HEADER.size)
     if header is None:
         return None
-    ftype, length = _HEADER.unpack(header)
+    ftype, length, crc = _HEADER.unpack(header)
     if length > MAX_FRAME_BYTES:
         raise ValueError(f"frame length {length} exceeds cap")
     payload = _recv_exact(sock, length)
     if payload is None:
         return None  # torn mid-frame: discard whole
+    actual = wal_crc(payload)
+    if actual != crc:
+        raise FrameCorruptError(
+            f"frame crc mismatch: header {crc}, payload {actual} "
+            f"({length} byte(s), type {ftype!r})"
+        )
     return ftype, payload
 
 
@@ -369,6 +390,7 @@ class ShipFollower:
         self.connects = 0
         self.reconnects = 0
         self.frames_applied = 0
+        self.frames_rejected = 0
         self.bootstraps = 0
         self.last_error: Optional[str] = None
         self._stop = threading.Event()
@@ -443,7 +465,22 @@ class ShipFollower:
 
     def _consume(self, sock: socket.socket) -> None:
         while not self._stop.is_set():
-            frame = read_frame(sock)
+            try:
+                frame = read_frame(sock)
+            except FrameCorruptError as err:
+                # Damaged in flight (or on the wire-side buffers): no
+                # line of the frame reaches the replica. Drop the
+                # connection — the reconnect's fresh BOOTSTRAP frame
+                # resyncs from the leader's durable (and CRC-verified)
+                # state, so the stream cannot silently diverge.
+                self.frames_rejected += 1
+                self._count(
+                    'shard_follower_records_rejected_total{reason="crc"}'
+                )
+                self._count('wal_crc_failures_total{site="frame"}')
+                self.last_error = str(err)
+                logger.warning("rejected corrupt ship frame: %s", err)
+                return
             if frame is None:
                 # EOF (or torn mid-frame): every byte the kernel accepted
                 # before the leader died has been consumed; a partial
@@ -481,6 +518,7 @@ class ShipFollower:
             "reconnects": self.reconnects,
             "bootstraps": self.bootstraps,
             "frames_applied": self.frames_applied,
+            "frames_rejected": self.frames_rejected,
             "connected": self._connected.is_set(),
             "last_error": self.last_error,
         }

@@ -97,11 +97,30 @@ class CheckpointStore:
         )
         self.namespace = namespace
         self.job_name = job_name
+        #: Restores served from an older retained step after the newest
+        #: one failed verification (truncated/corrupt on disk).
+        self.fallbacks = 0
+        self._metrics: Optional[Any] = None
         with _OPEN_LOCK:
             _OPEN_STORES.add(self)
 
+    def instrument(self, metrics: Any) -> None:
+        """Attach a metrics sink (``.inc(series)``) for fallback counts."""
+        self._metrics = metrics
+
+    def _count(self, series: str, value: int = 1) -> None:
+        if self._metrics is not None:
+            try:
+                self._metrics.inc(series, value)
+            except Exception:  # pragma: no cover - sink must never break IO
+                logger.debug("metrics sink failed for %s", series)
+
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list:
+        """Retained steps, oldest first."""
+        return sorted(self._mgr.all_steps())
 
     def save(self, step: int, state: Any) -> None:
         import orbax.checkpoint as ocp
@@ -130,6 +149,42 @@ class CheckpointStore:
                 "host-side", step, exc_info=True,
             )
             return self.restore_resharded(step, like)
+
+    def restore_latest(self, like: Any) -> Any:
+        """Restore the newest step that actually restores — the integrity
+        fallback chain for the resume path.
+
+        An async save torn by a preemption (or a disk fault under the
+        checkpoint root) can leave the NEWEST retained step unreadable
+        while older steps are intact; ``max_to_keep`` retains several
+        precisely so resume never depends on a single on-disk artifact.
+        Walk ``all_steps()`` newest→oldest: each candidate goes through
+        :meth:`restore` (direct sharded read, then the host-side reshard
+        fallback); the first success wins. Every skipped step counts a
+        ``workload_checkpoint_fallbacks_total`` so a job that silently
+        resumed N intervals back is visible on /metrics.
+
+        Returns ``(step, state)``; raises ``FileNotFoundError`` when no
+        steps exist and the last restore error when every step fails.
+        """
+        steps = self.all_steps()
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}"
+            )
+        last_err: Optional[BaseException] = None
+        for step in reversed(steps):
+            try:
+                return step, self.restore(step, like)
+            except Exception as err:
+                last_err = err
+                self.fallbacks += 1
+                self._count("workload_checkpoint_fallbacks_total")
+                logger.warning(
+                    "checkpoint step %s unreadable (%s); falling back to "
+                    "an older retained step", step, err,
+                )
+        raise last_err  # type: ignore[misc]  # loop ran at least once
 
     def _restore_raw(self, step: int) -> Any:
         """Template-free restore: the checkpoint as saved (nested dicts of

@@ -280,8 +280,10 @@ class Trainer:
             latest = self.checkpoint.latest_step()
             if latest is not None:
                 # Resume: restore directly into the mesh layout (no host
-                # gather) and continue from the recorded step.
-                self.state = self.checkpoint.restore(latest, self.state)
+                # gather) and continue from the recorded step. The chain
+                # walks back to an older retained step if the newest save
+                # is truncated (torn async save at preemption time).
+                _, self.state = self.checkpoint.restore_latest(self.state)
                 self.steps_done = int(self.state.step)
 
         x_spec = batch_pspec(mesh, seq_dim=self.config.seq_dim_in_batch)

@@ -54,6 +54,19 @@ end-state invariants:
   after each — and ``--preempt-storm`` adds the goodput leg: productive
   steps over total steps trained across every attempt chain must clear
   ``GOODPUT_FLOOR``.
+- **I12 storage_integrity** (``--disk``) — a dedicated disk-fault leg
+  cycles every :data:`runtime.faults.DISK_FAULT_KINDS` kind against the
+  checksummed store: no corrupted (or never-acknowledged) record is
+  ever applied — recovery always lands on a verifiable prefix of the
+  acknowledged history (I12a); every damage round is *detected* — a
+  non-clean integrity verdict, quarantine forensics under
+  ``wal.quarantine/``, and the background scrubber finding a latent
+  bit-flip in cold sealed-segment bytes (I12b); and injected
+  EIO/ENOSPC fail closed — the refused write exists NOWHERE, the shard
+  degrades read-only with a metrics-visible gauge, and a probe append
+  heals it (I12c).  ``--disk --no-checksums`` is the counter-proof: the
+  same seeded bit-flip is applied SILENTLY to the legacy format,
+  violating I12a (use with ``--expect-violation``).
 
 Determinism model: every fault decision, kill-point, and simulated
 workload outcome is a pure function of ``(seed, injection point)`` (see
@@ -4570,6 +4583,435 @@ def check_gray_invariants(ev: dict) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# Disk-fault leg (--disk): end-to-end storage integrity, invariant I12
+# ---------------------------------------------------------------------------
+
+#: Acked creates per soak round — enough that offline damage always has a
+#: verifiable prefix before it and acked records after it.
+DISK_BATCH = 12
+
+
+def _disk_obj(r: int, i: int) -> dict:
+    # Digit-dense payload on purpose: the bit-flip fault targets value
+    # digits, and a flipped payload digit is exactly the silent-corruption
+    # case only a checksum catches.
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": f"disk-{r}-{i}", "namespace": NAMESPACE},
+        "data": {"round": r, "seq": i, "payload": 1000000 + r * 1000 + i},
+    }
+
+
+def _canon(obj) -> dict:
+    """JSON-roundtrip an object (frozen or thawed) into plain comparable
+    containers — the same normalization a WAL record goes through."""
+    return json.loads(json.dumps(obj, sort_keys=True, default=str))
+
+
+def _disk_book_check(store, acked: dict) -> dict:
+    """I12a: the recovered store must be exactly a replay of an ACKED
+    prefix of history.
+
+    ``acked`` is the client-side ledger: name -> the canonicalized object
+    the store RETURNED from a successful create. Two checks:
+
+    * membership — every live object must be byte-equal to the acked
+      commit of the same name (a silently applied bit-flip, or a record
+      that was never acknowledged, fails here);
+    * prefix completeness — every acked write at or below the surviving
+      rv high-water mark must still be present (recovery may drop an
+      acked SUFFIX to quarantine, never punch holes).
+    """
+    live = {}
+    for obj in store.all_objects():
+        live[(obj.get("metadata") or {}).get("name")] = _canon(obj)
+    # resourceVersion is stringly-typed on the wire — compare numerically.
+    cut = max(
+        (int(o["metadata"]["resourceVersion"]) for o in live.values()),
+        default=0,
+    )
+    mismatched = []
+    for name, obj in sorted(live.items()):
+        entry = acked.get(name)
+        if entry is None:
+            mismatched.append(
+                {"name": name, "why": "applied but never acknowledged"}
+            )
+        elif entry != obj:
+            mismatched.append(
+                {"name": name,
+                 "why": "applied bytes differ from the acked commit"}
+            )
+    missing = sorted(
+        name for name, entry in acked.items()
+        if int(entry["metadata"]["resourceVersion"]) <= cut
+        and name not in live
+    )
+    return {
+        "cut_rv": cut,
+        "live_objects": len(live),
+        "mismatched": mismatched,
+        "missing": missing,
+        "ok": not mismatched and not missing,
+    }
+
+
+def run_disk_soak(seed: int, rounds: int, checksums: bool = True) -> dict:
+    """Cycle every disk-fault kind against ONE store + persistence dir.
+
+    Offline kinds (bit_flip, torn_midfile) damage the closed WAL between
+    generations and reboot through recovery; online kinds (eio/enospc on
+    append, eio on fsync/rename) are injected mid-storm through the
+    syscall seam and must trip read-only degraded mode fail-closed, then
+    heal on a probe append. The acked ledger is carried across every
+    generation for the I12a prefix check."""
+    import errno
+
+    from cron_operator_tpu.runtime.faults import (
+        DISK_FAULT_KINDS,
+        DiskFaultInjector,
+    )
+    from cron_operator_tpu.runtime.kube import APIServer
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.runtime.persistence import (
+        QUARANTINE_DIR,
+        WAL_NAME,
+        WAL_PREV_NAME,
+        Persistence,
+        Scrubber,
+        StorageDegradedError,
+    )
+    from cron_operator_tpu.telemetry.audit import AuditJournal
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    data_dir = tempfile.mkdtemp(prefix="chaos-disk-")
+    wal_path = os.path.join(data_dir, WAL_NAME)
+    wal_prev_path = os.path.join(data_dir, WAL_PREV_NAME)
+    qdir = os.path.join(data_dir, QUARANTINE_DIR)
+    metrics = Metrics()
+    journal = AuditJournal()
+    acked: dict = {}  # name -> canonical acked object (the ledger)
+    ev: dict = {
+        "checksums": checksums,
+        "rounds": [],
+        "acked_total": 0,
+        "refused_verified_absent": 0,
+        "lost_to_quarantine": 0,
+        "book_violation_rounds": [],
+    }
+
+    def _boot(round_idx: int):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(
+            data_dir,
+            fsync_every=1,
+            snapshot_every=10_000,  # rotations are explicit in this soak
+            flush_interval_s=0,
+            checksums=checksums,
+            disk_faults=DiskFaultInjector(seed, round_idx=round_idx),
+            # Heals are explicit probe() calls — the throttled inline
+            # probe must not race the refused-write assertions.
+            degraded_probe_interval_s=3600.0,
+        )
+        pers.instrument(metrics)
+        pers.attach_audit(journal)
+        rec = pers.start(store)
+        return store, pers, rec
+
+    def _ack(obj) -> None:
+        acked[obj["metadata"]["name"]] = _canon(obj)
+        ev["acked_total"] += 1
+
+    def _qfiles():
+        try:
+            return sorted(os.listdir(qdir))
+        except OSError:
+            return []
+
+    try:
+        store, pers, _rec = _boot(0)
+        for r in range(rounds):
+            # Deterministic coverage: the kind cycles (all six within one
+            # default soak); the PRF offsets inside flip/tear stay a pure
+            # function of (seed, round).
+            kind = DISK_FAULT_KINDS[r % len(DISK_FAULT_KINDS)]
+            inj = DiskFaultInjector(seed, round_idx=r)
+            pers.disk_faults = inj
+            round_ev: dict = {"round": r, "kind": kind}
+
+            if kind in ("bit_flip", "torn_midfile"):
+                # ---- offline damage: write, close, damage, recover ----
+                round_ev["mode"] = "offline"
+                for i in range(DISK_BATCH):
+                    _ack(store.create(_disk_obj(r, i)))
+                pers.close()
+                q_before = _qfiles()
+                if kind == "bit_flip":
+                    dmg_off = inj.flip_value_digit(wal_path)
+                else:
+                    dmg_off = inj.tear_midfile(wal_path)
+                store, pers, rec = _boot(r + 1000)
+                check = _disk_book_check(store, acked)
+                new_q = [f for f in _qfiles() if f not in q_before]
+                forensics = None
+                for f in new_q:
+                    if f.endswith(".json"):
+                        try:
+                            with open(os.path.join(qdir, f)) as fh:
+                                forensics = json.load(fh)
+                        except (OSError, ValueError):
+                            pass
+                # Acked records past the surviving rv were legitimately
+                # lost to the quarantined suffix (prefix semantics) —
+                # retire them from the ledger.
+                lost = [
+                    n for n, e in acked.items()
+                    if int(e["metadata"]["resourceVersion"])
+                    > check["cut_rv"]
+                ]
+                for n in lost:
+                    del acked[n]
+                ev["lost_to_quarantine"] += len(lost)
+                round_ev.update({
+                    "damage_offset": dmg_off,
+                    "verdict": rec.integrity.get("verdict"),
+                    "integrity": rec.integrity,
+                    "book_check": check,
+                    "quarantine_files_added": new_q,
+                    "forensics": forensics,
+                    "acked_lost_past_cut": len(lost),
+                })
+                if not check["ok"]:
+                    ev["book_violation_rounds"].append(r)
+            else:
+                # ---- online errno fault: trip, refuse, probe, heal ----
+                round_ev["mode"] = "online"
+                for i in range(DISK_BATCH // 2):
+                    _ack(store.create(_disk_obj(r, i)))
+                victim = _disk_obj(r, 900)
+                tripped_by_refusal = False
+                if kind in ("eio_append", "enospc_append"):
+                    err_no = (errno.EIO if kind == "eio_append"
+                              else errno.ENOSPC)
+                    inj.arm_errno("append", err_no)
+                    # The armed errno fires inside _append BEFORE the
+                    # in-memory commit: the very first write is refused.
+                    tripped_by_refusal = True
+                elif kind == "eio_fsync":
+                    inj.arm_errno("fsync", errno.EIO)
+                    # The append reaches the OS file before the group
+                    # fsync dies: THIS write is acked and durable, the
+                    # layer degrades for everyone after it.
+                    _ack(store.create(_disk_obj(r, 900)))
+                    victim = _disk_obj(r, 901)
+                else:  # eio_rename — dies inside snapshot rotation
+                    inj.arm_errno("rename", errno.EIO)
+                    # Rotation aborts, pre-rotation chain stays
+                    # authoritative, no acked write fails.
+                    pers.write_snapshot(
+                        store.all_objects(), int(getattr(store, "_rv", 0))
+                    )
+                refused = None
+                try:
+                    store.create(dict(victim))
+                except StorageDegradedError as e:
+                    refused = str(e)
+                round_ev["tripped_degraded"] = pers.degraded
+                round_ev["degraded_reason"] = pers.degraded_reason
+                round_ev["gauge_during"] = metrics.gauge("storage_degraded")
+                name = victim["metadata"]["name"]
+                absent = (
+                    store.try_get("v1", "ConfigMap", NAMESPACE, name) is None
+                )
+                if absent:
+                    ev["refused_verified_absent"] += 1
+                healed = pers.probe()
+                # The refused write existed NOWHERE, so the same name
+                # creates cleanly once the device answers again.
+                _ack(store.create(dict(victim)))
+                for i in range(1000, 1000 + DISK_BATCH // 2):
+                    _ack(store.create(_disk_obj(r, i)))
+                round_ev.update({
+                    "tripped_by_refusal": tripped_by_refusal,
+                    "refused": refused,
+                    "refused_absent": absent,
+                    "healed": healed,
+                    "gauge_after_heal": metrics.gauge("storage_degraded"),
+                    "degraded_entries": pers.degraded_entries,
+                    "degraded_exits": pers.degraded_exits,
+                    "degraded_refused": pers.degraded_refused,
+                })
+            ev["rounds"].append(round_ev)
+
+        # ---- scrubber leg: latent corruption in COLD sealed bytes ----
+        scrub = None
+        if checksums:
+            for i in range(4):
+                _ack(store.create(_disk_obj(rounds, i)))
+            pers.disk_faults = None
+            pers.write_snapshot(
+                store.all_objects(), int(getattr(store, "_rv", 0))
+            )
+            inj = DiskFaultInjector(seed, round_idx=rounds + 7)
+            flip_off = inj.flip_value_digit(wal_prev_path)
+            scrubber = Scrubber(pers, interval_s=3600.0)
+            scrubber.instrument(metrics)
+            summary = scrubber.scrub_once()
+            scrub = {
+                "flip_offset": flip_off,
+                "summary": summary,
+                "found_kinds": sorted(
+                    {f["kind"] for f in summary["findings"]}
+                ),
+            }
+        ev["scrub"] = scrub
+
+        # ---- final generation: a clean close must lose nothing ----
+        pers.close()
+        store, pers, rec = _boot(rounds + 2000)
+        final_check = _disk_book_check(store, acked)
+        ev["final"] = {
+            "verdict": rec.integrity.get("verdict"),
+            "integrity": rec.integrity,
+            "book_check": final_check,
+            "acked_past_cut": sum(
+                1 for e in acked.values()
+                if int(e["metadata"]["resourceVersion"])
+                > final_check["cut_rv"]
+            ),
+            "objects": len(store.all_objects()),
+        }
+        if not final_check["ok"]:
+            ev["book_violation_rounds"].append("final")
+        pers.close()
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+    ev["metrics"] = {
+        "storage_degraded": metrics.gauge("storage_degraded"),
+        "wal_degraded_refused_total": metrics.get(
+            "wal_degraded_refused_total"
+        ),
+        "wal_records_quarantined_total": metrics.get(
+            "wal_records_quarantined_total"
+        ),
+        "wal_crc_failures_recovery": metrics.get(
+            'wal_crc_failures_total{site="recovery"}'
+        ),
+        "wal_crc_failures_scrub": metrics.get(
+            'wal_crc_failures_total{site="scrub"}'
+        ),
+        "scrub_corruptions_found_total": metrics.get(
+            "scrub_corruptions_found_total"
+        ),
+    }
+    ev["audit"] = {
+        "corruption_detected": len(
+            journal.records(event="corruption_detected")
+        ),
+        "degraded_mode_entered": len(
+            journal.records(event="degraded_mode_entered")
+        ),
+        "degraded_mode_exited": len(
+            journal.records(event="degraded_mode_exited")
+        ),
+    }
+    return ev
+
+
+def check_disk_invariants(ev: dict) -> dict:
+    """I12 verdicts over one ``run_disk_soak`` evidence dict."""
+    rounds = ev["rounds"]
+    offline = [r for r in rounds if r["mode"] == "offline"]
+    online = [r for r in rounds if r["mode"] == "online"]
+    final = ev.get("final") or {}
+
+    book_ok = (
+        not ev["book_violation_rounds"]
+        and bool((final.get("book_check") or {}).get("ok"))
+        and final.get("acked_past_cut") == 0
+    )
+    i12a = {
+        "ok": book_ok,
+        "detail": (
+            f"every recovery (after {len(offline)} damage round(s) and a "
+            f"clean final close) applied only acknowledged bytes and "
+            f"landed on an acked prefix; {ev['acked_total']} acked "
+            f"writes, {ev['lost_to_quarantine']} retired to quarantined "
+            f"suffixes" if book_ok
+            else {"violation_rounds": ev["book_violation_rounds"],
+                  "final": final.get("book_check")}
+        ),
+    }
+
+    # Detection: every offline damage round must end in a non-clean
+    # verdict; a quarantined verdict must come with on-disk forensics;
+    # the scrubber must find the latent sealed-segment flip.
+    detected = bool(offline) and all(
+        r.get("verdict") in ("quarantined", "torn_tail", "snapshot_fallback")
+        for r in offline
+    )
+    forensics_ok = all(
+        r.get("forensics") is not None
+        for r in offline if r.get("verdict") == "quarantined"
+    )
+    quarantined_rounds = [
+        r["round"] for r in offline if r.get("verdict") == "quarantined"
+    ]
+    scrub = ev.get("scrub") or {}
+    scrub_ok = "wal_crc_mismatch" in (scrub.get("found_kinds") or [])
+    audit_ok = (ev.get("audit") or {}).get("corruption_detected", 0) > 0
+    i12b_ok = detected and forensics_ok and scrub_ok and audit_ok
+    i12b = {
+        "ok": i12b_ok,
+        "detail": (
+            f"all {len(offline)} damage rounds detected "
+            f"(verdicts: {[r.get('verdict') for r in offline]}), "
+            f"quarantine forensics written in rounds "
+            f"{quarantined_rounds}, scrubber found the latent "
+            f"sealed-segment flip, "
+            f"{ev['audit']['corruption_detected']} corruption_detected "
+            f"audit event(s)" if i12b_ok
+            else {"detected": detected, "forensics_ok": forensics_ok,
+                  "scrub": scrub, "audit": ev.get("audit")}
+        ),
+    }
+
+    closed = bool(online) and all(
+        r.get("tripped_degraded")
+        and r.get("refused")
+        and r.get("refused_absent")
+        and r.get("healed")
+        and r.get("gauge_during") == 1.0
+        and r.get("gauge_after_heal") == 0.0
+        for r in online
+    )
+    i12c = {
+        "ok": closed,
+        "detail": (
+            f"all {len(online)} injected errno round(s) "
+            f"({[r['kind'] for r in online]}) refused the write BEFORE "
+            f"any commit (refused object verified absent "
+            f"{ev['refused_verified_absent']} time(s)), degraded gauge "
+            f"visible during and clear after the probe heal" if closed
+            else [
+                {k: r.get(k) for k in
+                 ("round", "kind", "tripped_degraded", "refused",
+                  "refused_absent", "healed", "gauge_during",
+                  "gauge_after_heal")}
+                for r in online
+            ]
+        ),
+    }
+    return {
+        "I12a_no_corrupt_record_applied": i12a,
+        "I12b_damage_detected_and_quarantined": i12b,
+        "I12c_disk_errors_fail_closed": i12c,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -4672,6 +5114,21 @@ def main(argv=None) -> int:
                          "--no-fencing the dark-window poison write is "
                          "ACKED then erased — the counter-proof (use "
                          "with --expect-violation)")
+    ap.add_argument("--disk", action="store_true", default=False,
+                    help="run ONLY the disk-fault leg: cycle every "
+                         "DISK_FAULT_KINDS kind (seeded bit-flips, "
+                         "mid-file torn writes, EIO/ENOSPC from "
+                         "append/fsync/rename) against the checksummed "
+                         "store — no corrupted record is ever applied, "
+                         "damage is detected and quarantined with "
+                         "forensics, injected errors fail closed into "
+                         "probe-healed degraded mode (invariant I12)")
+    ap.add_argument("--no-checksums", action="store_true", default=False,
+                    help="run the disk leg against the LEGACY format "
+                         "(record CRCs and snapshot digests disabled) — "
+                         "the I12 counter-proof: the same seeded "
+                         "bit-flip applies silently (use with "
+                         "--expect-violation)")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
 
@@ -4699,6 +5156,81 @@ def main(argv=None) -> int:
         plan_a.schedule(args.rounds) == plan_b.schedule(args.rounds)
         and plan_a.trace_hash(args.rounds) == plan_b.trace_hash(args.rounds)
     )
+
+    if args.disk:
+        checksums = not args.no_checksums
+        # At least one full cycle through the six fault kinds.
+        rounds = max(6, args.rounds)
+        mode = ("disk" if checksums
+                else "disk counter-proof (checksums OFF)")
+        print(
+            f"chaos soak ({mode}): seed={args.seed} rounds={rounds} — "
+            "bit-flips, torn writes, EIO/ENOSPC through the syscall seam",
+            flush=True,
+        )
+        ev = run_disk_soak(args.seed, rounds, checksums=checksums)
+        if not checksums:
+            violated = bool(ev["book_violation_rounds"])
+            report = {
+                "seed": args.seed,
+                "mode": "disk-no-checksums",
+                "rounds": rounds,
+                "disk_leg": ev,
+                "violation_rounds": ev["book_violation_rounds"],
+                "violation_observed": violated,
+                "ok": not violated,
+            }
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+                f.write("\n")
+            print(
+                f"  I12a book check violated in round(s) "
+                f"{ev['book_violation_rounds']} of {rounds}"
+            )
+            print(f"wrote {args.out}")
+            if args.expect_violation:
+                if violated:
+                    print("expected violation observed (I12a) — without "
+                          "record CRCs the seeded bit-flip was applied "
+                          "SILENTLY: the recovered store no longer "
+                          "matches the acknowledged history")
+                    return 0
+                print("ERROR: expected an I12a violation but every "
+                      "recovery matched the acked ledger")
+                return 1
+            return 0 if not violated else 1
+        invariants = check_disk_invariants(ev)
+        ok = all(v["ok"] for v in invariants.values())
+        report = {
+            "seed": args.seed,
+            "mode": "disk",
+            "rounds": rounds,
+            "disk_leg": ev,
+            "invariants": invariants,
+            "ok": ok,
+        }
+        # Fold into an existing CHAOS.json from another leg (the
+        # processes/gray-leg idiom) so the report carries every proof.
+        out_doc = report
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+            if (isinstance(existing, dict)
+                    and existing.get("mode") != "disk"
+                    and "invariants" in existing):
+                existing["disk"] = report
+                existing["ok"] = bool(existing.get("ok")) and ok
+                out_doc = existing
+        except (OSError, ValueError):
+            pass
+        with open(args.out, "w") as f:
+            json.dump(out_doc, f, indent=2, default=str)
+            f.write("\n")
+        for name, v in invariants.items():
+            mark = "PASS" if v["ok"] else "FAIL"
+            print(f"  [{mark}] {name}: {v['detail']}")
+        print(f"wrote {args.out} (ok={ok})")
+        return 0 if ok else 1
 
     if args.processes:
         shards = args.shards if args.shards > 0 else 2

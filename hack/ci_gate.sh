@@ -264,6 +264,27 @@ echo "==> split counter-proof (same storm, fencing off -> acked write must vanis
 python hack/chaos_soak.py --split --no-fencing --seed 3 --crons 40 \
     --rounds 2 --expect-violation --out /dev/null
 
+echo "==> disk-fault smoke (checksummed WAL, quarantine, degraded mode, scrubber)"
+# Fixed-seed disk-fault soak: cycles every DiskFaultInjector kind —
+# seeded bit-flips and mid-file torn writes against the closed WAL,
+# EIO/ENOSPC injected into append/fsync/rename through the syscall seam.
+# I12a: no corrupted (or never-acked) record is ever applied — recovery
+# always lands on a verifiable prefix of the acked history. I12b: every
+# damage round is detected (non-clean verdict, wal.quarantine/ forensics,
+# scrubber finding the latent sealed-segment flip). I12c: injected
+# errors fail closed (refused write exists NOWHERE, degraded gauge
+# visible, probe append heals). Full run: make chaos-soak-disk (folds
+# into CHAOS.json).
+python hack/chaos_soak.py --disk --seed 42 --rounds 6 --out /dev/null
+
+echo "==> checksum counter-proof (same bit-flip, CRCs off -> I12a must break)"
+# The same seeded bit-flip against the LEGACY trailer-less format: the
+# flipped record must be applied SILENTLY (verdict "clean", store no
+# longer matches the acked ledger) — proves the I12a PASS above detects
+# the silent corruption the checksums exist to catch, i.e. not vacuous.
+python hack/chaos_soak.py --disk --no-checksums --seed 42 --rounds 6 \
+    --expect-violation --out /dev/null
+
 echo "==> metric registry drift (every emitted family declared + typed)"
 # Explicit run of the registry drift guard: scans every metrics.inc/
 # observe/set call site AND interned-series assignment in the package,

@@ -331,6 +331,88 @@ def _wal_microbench(repeat: int = 200) -> dict:
         shutil.rmtree(data_dir, ignore_errors=True)
 
 
+#: Absolute ceiling on the self-verifying format's per-record cost:
+#: ``stamp_crc`` rides inside the WAL lock on EVERY append and
+#: ``verify_line`` on every replayed/scrubbed record, so each is gated
+#: here (not merely reported) — the durability-integrity upgrade must
+#: stay invisible next to the fsync it protects.
+CRC_APPEND_GATE_US = 2.0
+
+
+def _crc_microbench(repeat: int = 2000) -> dict:
+    """The checksummed-WAL overhead, three ways: (a) one bare
+    ``stamp_crc`` over a representative serialized record — the exact
+    cost added to every append — gated at ``CRC_APPEND_GATE_US``; (b)
+    one bare ``verify_line`` over the stamped line — the replay/scrub
+    cost per record — gated the same; and (c) the write microbench
+    re-run against a ``checksums=False`` legacy-format store
+    (``wal_nocrc_*`` keys), so the committed artifact carries the
+    end-to-end A/B next to the default checksummed ``wal_*`` numbers."""
+    try:
+        from cron_operator_tpu.runtime.persistence import (
+            CRC_IMPL,
+            Persistence,
+            stamp_crc,
+            verify_line,
+        )
+    except ImportError:  # baseline trees predate the integrity format
+        return {}
+    import shutil
+
+    from cron_operator_tpu.runtime import APIServer
+    from cron_operator_tpu.utils.clock import FakeClock
+
+    # A representative committed record: the exact shape _append
+    # serializes for a populated-store Cron update.
+    body = json.dumps(
+        {"op": "put", "verb": "update", "rv": 123456, "obj": _cron(7)},
+        separators=(",", ":"),
+        default=str,
+    ).encode("utf-8")
+    stamp_us = min(
+        _time_calls(lambda: stamp_crc(body), repeat) for _ in range(3)
+    )
+    assert stamp_us <= CRC_APPEND_GATE_US, (
+        f"CRC stamping costs {stamp_us:.2f}µs/record "
+        f"(gate: {CRC_APPEND_GATE_US}µs, impl: {CRC_IMPL})"
+    )
+
+    line = stamp_crc(body)
+    verify_us = min(
+        _time_calls(lambda: verify_line(line), repeat) for _ in range(3)
+    )
+    assert verify_us <= CRC_APPEND_GATE_US, (
+        f"CRC verification costs {verify_us:.2f}µs/record "
+        f"(gate: {CRC_APPEND_GATE_US}µs, impl: {CRC_IMPL})"
+    )
+
+    # (c) the same write microbench against the LEGACY format — the
+    # delta against the default checksummed wal_* keys is the whole
+    # end-to-end price of the self-verifying format.
+    data_dir = tempfile.mkdtemp(prefix="cpbench-nocrc-")
+    try:
+        api = APIServer(clock=FakeClock())
+        pers = Persistence(data_dir, checksums=False)
+        pers.start(api)
+        for i in range(3):
+            api.create(_cron(i))
+        out = {
+            f"wal_nocrc_{k}": v
+            for k, v in _write_microbench(api, 200).items()
+        }
+        pers.close()
+        api.close()
+    finally:
+        shutil.rmtree(data_dir, ignore_errors=True)
+    out.update({
+        "crc_impl": CRC_IMPL,
+        "crc_stamp_us": round(stamp_us, 3),
+        "crc_verify_us": round(verify_us, 3),
+        "crc_append_gate_us": CRC_APPEND_GATE_US,
+    })
+    return out
+
+
 #: Absolute ceiling on the flight recorder's hot-path cost: one
 #: ``AuditJournal.record`` call rides inside the store lock on EVERY
 #: committed verb, so its mean cost is pure commit-path overhead and is
@@ -598,6 +680,7 @@ def run_one(n_crons: int, sweep_timeout_s: float) -> dict:
     mgr.stop()
     write_us = _write_microbench(api)
     write_us.update(_wal_microbench())
+    write_us.update(_crc_microbench())
     write_us.update(_audit_microbench())
     write_us.update(_timeseries_microbench())
     write_us.update(_trace_ctx_microbench())

@@ -421,3 +421,154 @@ class TestSubmitRetries:
         assert len(events) == 1
         assert events[0].type == "Warning"
         assert "demo-" in events[0].message
+
+
+class TestDiskFaultInjector:
+    """Seeded disk-fault source (I12 harness): deterministic kind
+    choice, pop-once errno arming, and JSON-preserving offline
+    corruption."""
+
+    def test_choose_kind_is_deterministic_and_covers_all_kinds(self):
+        from cron_operator_tpu.runtime.faults import (
+            DISK_FAULT_KINDS,
+            DiskFaultInjector,
+        )
+
+        seen = set()
+        for r in range(64):
+            a = DiskFaultInjector.choose_kind(42, r)
+            b = DiskFaultInjector.choose_kind(42, r)
+            assert a == b and a in DISK_FAULT_KINDS
+            seen.add(a)
+        assert seen == set(DISK_FAULT_KINDS)
+        # a different seed produces a different schedule
+        sched_a = [DiskFaultInjector.choose_kind(1, r) for r in range(16)]
+        sched_b = [DiskFaultInjector.choose_kind(2, r) for r in range(16)]
+        assert sched_a != sched_b
+
+    def test_arm_errno_pops_exactly_count_times(self):
+        import errno
+
+        from cron_operator_tpu.runtime.faults import DiskFaultInjector
+
+        inj = DiskFaultInjector(seed=7)
+        inj.arm_errno("append", errno.EIO, count=2)
+        e1 = inj.check("append")
+        e2 = inj.check("append")
+        assert e1 is not None and e1.errno == errno.EIO
+        assert e2 is not None and e2.errno == errno.EIO
+        assert inj.check("append") is None
+        assert inj.check("fsync") is None  # other ops unaffected
+        assert len(inj.injected) == 2
+
+    def test_arm_planned_maps_kinds_to_ops(self):
+        import errno
+
+        from cron_operator_tpu.runtime.faults import (
+            DISK_FAULT_KINDS,
+            DiskFaultInjector,
+        )
+
+        ops = {}
+        for r in range(64):
+            inj = DiskFaultInjector(seed=42, round_idx=r)
+            ops[inj.kind] = inj.arm_planned()
+        assert ops["eio_append"] == "append"
+        assert ops["enospc_append"] == "append"
+        assert ops["eio_fsync"] == "fsync"
+        assert ops["eio_rename"] == "rename"
+        # offline kinds arm nothing — the harness applies them between
+        # rounds by mutating the closed segment
+        assert ops["bit_flip"] is None
+        assert ops["torn_midfile"] is None
+        assert set(ops) == set(DISK_FAULT_KINDS)
+
+    def test_flip_value_digit_is_silent_json_loud_crc(self, tmp_path):
+        import json
+
+        from cron_operator_tpu.runtime.faults import DiskFaultInjector
+        from cron_operator_tpu.runtime.persistence import (
+            stamp_crc,
+            verify_line,
+        )
+
+        path = str(tmp_path / "seg.jsonl")
+        lines = [
+            stamp_crc(json.dumps(
+                {"op": "put", "rv": 100 + i,
+                 "obj": {"value": 123456 + i}}).encode())
+            for i in range(5)
+        ]
+        with open(path, "wb") as f:
+            f.write(b"\n".join(lines) + b"\n")
+        inj = DiskFaultInjector(seed=3)
+        offset = inj.flip_value_digit(path)
+        assert offset is not None
+        with open(path, "rb") as f:
+            damaged = f.read().splitlines()
+        flipped = [
+            (i, line) for i, line in enumerate(damaged)
+            if line != lines[i]
+        ]
+        assert len(flipped) == 1
+        _, bad = flipped[0]
+        json.loads(bad)  # still VALID JSON — silent without a checksum
+        ok, expected, actual = verify_line(bad)
+        assert not ok and expected != actual  # ...but the CRC catches it
+
+    def test_flip_never_lands_in_the_crc_stamp(self, tmp_path):
+        import json
+
+        from cron_operator_tpu.runtime.faults import DiskFaultInjector
+        from cron_operator_tpu.runtime.persistence import (
+            split_crc,
+            stamp_crc,
+        )
+
+        path = str(tmp_path / "seg.jsonl")
+        body = json.dumps({"op": "put", "rv": 7, "obj": {"n": 9}}).encode()
+        line = stamp_crc(body)
+        with open(path, "wb") as f:
+            f.write(line + b"\n")
+        # every seed must flip inside the VALUE region, never the stamp
+        for seed in range(20):
+            with open(path, "wb") as f:
+                f.write(line + b"\n")
+            offset = DiskFaultInjector(seed=seed).flip_value_digit(path)
+            assert offset is not None
+            assert offset < len(body) - 1  # strictly before the splice
+            with open(path, "rb") as f:
+                _, crc = split_crc(f.read().splitlines()[0])
+            assert crc is not None  # the stamp itself survived intact
+
+    def test_tear_midfile_merges_a_record_into_its_successor(self, tmp_path):
+        import json
+
+        from cron_operator_tpu.runtime.faults import DiskFaultInjector
+
+        path = str(tmp_path / "seg.jsonl")
+        lines = [
+            json.dumps({"op": "put", "rv": i, "obj": {"i": i}}).encode()
+            for i in range(6)
+        ]
+        with open(path, "wb") as f:
+            f.write(b"\n".join(lines) + b"\n")
+        inj = DiskFaultInjector(seed=5)
+        cut = inj.tear_midfile(path)
+        assert cut is not None
+        with open(path, "rb") as f:
+            damaged = f.read().splitlines()
+        # one record lost its tail and merged into its successor
+        assert len(damaged) == len(lines) - 1
+        bad = [l for l in damaged if l not in lines]
+        assert len(bad) == 1
+        with pytest.raises(ValueError):
+            json.loads(bad[0])
+
+    def test_tear_requires_two_records(self, tmp_path):
+        from cron_operator_tpu.runtime.faults import DiskFaultInjector
+
+        path = str(tmp_path / "seg.jsonl")
+        with open(path, "wb") as f:
+            f.write(b'{"op":"put","rv":1}\n')
+        assert DiskFaultInjector(seed=5).tear_midfile(path) is None

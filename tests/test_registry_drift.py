@@ -94,6 +94,31 @@ class TestRegistryDrift:
             assert mtype == want_type, family
             assert mhelp
 
+    def test_integrity_families_declared_with_types(self):
+        """The storage-integrity families (per-record CRC, quarantine,
+        degraded mode, scrubber, checkpoint fallback chain) must be
+        scanned AND declared: the I12 soak reads these series to prove
+        no corrupted record was applied and degraded shards failed
+        closed."""
+        found = _emitted_families()
+        expected = {
+            "wal_crc_failures_total": "counter",
+            "wal_records_quarantined_total": "counter",
+            "storage_degraded": "gauge",
+            "wal_degraded_refused_total": "counter",
+            "scrub_passes_total": "counter",
+            "scrub_records_verified_total": "counter",
+            "scrub_corruptions_found_total": "counter",
+            "shard_follower_records_rejected_total": "counter",
+            "workload_checkpoint_fallbacks_total": "counter",
+        }
+        for family, want_type in expected.items():
+            assert family in found, family
+            assert family in _FAMILY_META, family
+            mtype, mhelp = _FAMILY_META[family]
+            assert mtype == want_type, family
+            assert mhelp
+
     def test_every_emitted_family_is_declared(self):
         undeclared = {
             family: sites

@@ -159,6 +159,102 @@ class TestLiveSplit:
         finally:
             plane.close()
 
+    def test_router_wrong_shard_retry_exhaustion_reraises(self, tmp_path):
+        """The 421-chase is BOUNDED: when every shard keeps answering
+        WrongShardError past the deadline (a cutover that never lands,
+        or hints that ping-pong), the router re-raises instead of
+        spinning forever — the caller sees the 421, not a hang."""
+        m = Metrics()
+
+        class _AlwaysWrong:
+            """A shard that refuses every write with a hint at the
+            OTHER stub — the worst case: hints that chase each other."""
+
+            def __init__(self, owner_hint):
+                self.owner_hint = owner_hint
+                self.calls = 0
+
+            def create(self, obj):
+                self.calls += 1
+                raise WrongShardError(
+                    "range moved", owner=self.owner_hint, map_epoch=9
+                )
+
+        stubs = [_AlwaysWrong(1), _AlwaysWrong(0)]
+        router = ShardRouter(stubs, ownership=OwnershipMap.boot(2),
+                             metrics=m)
+        router.WRONG_SHARD_RETRY_DEADLINE_S = 0.2
+        router.WRONG_SHARD_RETRY_SLEEP_S = 0.005
+        with pytest.raises(WrongShardError) as exc:
+            router.create(_cron("doomed"))
+        # the hint survives exhaustion so the client can re-resolve
+        assert exc.value.owner in (0, 1) and exc.value.map_epoch == 9
+        assert router.wrong_shard_retries >= 2
+        assert m.get("router_wrong_shard_retries_total") >= 2.0
+        # both stubs were actually tried (the hint chase worked until
+        # the deadline cut it off)
+        assert stubs[0].calls >= 1 and stubs[1].calls >= 1
+
+    def test_router_exhaustion_with_unaddressable_owner_hint(self):
+        """Owner hint names a shard this router cannot address (child
+        exists server-side, the new map not yet published here): the
+        bounded retry re-resolves, sleeps, and still exhausts."""
+
+        class _Fenced:
+            def create(self, obj):
+                raise WrongShardError("moved", owner=7, map_epoch=3)
+
+        router = ShardRouter([_Fenced()], ownership=OwnershipMap.boot(1))
+        router.WRONG_SHARD_RETRY_DEADLINE_S = 0.1
+        router.WRONG_SHARD_RETRY_SLEEP_S = 0.005
+        with pytest.raises(WrongShardError):
+            router.create(_cron("doomed"))
+        assert router.wrong_shard_retries >= 2
+
+    def test_wrong_shard_exhaustion_surfaces_421_over_http(self):
+        """Full wire path: shard door answers 421 → RouterServer's
+        ShardClient re-raises WrongShardError → the router exhausts its
+        chase → the router's OWN front door answers 421 → the outer
+        client sees WrongShardError with the hints intact. No hang, no
+        5xx, no breaker trip (a 421 is the shard fencing correctly)."""
+        from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
+        from cron_operator_tpu.runtime.transport import (
+            RouterServer,
+            ShardClient,
+        )
+
+        class _FencedStore(APIServer):
+            def create(self, obj):
+                raise WrongShardError("range moved", owner=7, map_epoch=4)
+
+        m = Metrics()
+        store = _FencedStore(clock=FakeClock())
+        door = HTTPAPIServer(api=store)
+        door.start()
+        try:
+            rs = RouterServer(
+                peers=[f"127.0.0.1:{door.port}"], metrics=m,
+                start_watches=False,
+            )
+            rs.router.WRONG_SHARD_RETRY_DEADLINE_S = 0.2
+            rs.router.WRONG_SHARD_RETRY_SLEEP_S = 0.01
+            client = ShardClient(f"http://127.0.0.1:{rs.port}")
+            try:
+                with pytest.raises(WrongShardError) as exc:
+                    client.create(_cron("doomed"))
+                assert exc.value.owner == 7
+                assert exc.value.map_epoch == 4
+                assert m.get("router_wrong_shard_retries_total") >= 2.0
+                # the shard answered promptly and correctly — its
+                # breaker must still be closed
+                assert rs.clients[0].breaker.state == 0
+            finally:
+                client.close()
+                rs.close()
+        finally:
+            door.stop()
+            store.close()
+
     def test_split_under_concurrent_writes_loses_nothing(self, tmp_path):
         plane = _plane(tmp_path)
         stop = threading.Event()

@@ -97,7 +97,7 @@ class TestFraming(unittest.TestCase):
 
     def test_torn_header_discarded_whole(self):
         a, b = self._pair()
-        a.sendall(b"W\x00\x00")  # 3 of 5 header bytes, then death
+        a.sendall(b"W\x00\x00")  # 3 of 9 header bytes, then death
         a.close()
         self.assertIsNone(read_frame(b))
 
@@ -115,7 +115,11 @@ class TestFraming(unittest.TestCase):
         a, b = self._pair()
         payload = b'{"op":"put","rv":7}\n'
         import struct
-        wire = struct.pack("!cI", FRAME_WAL, len(payload)) + payload
+        from cron_operator_tpu.runtime.persistence import wal_crc
+        wire = (
+            struct.pack("!cII", FRAME_WAL, len(payload), wal_crc(payload))
+            + payload
+        )
         got = {}
 
         def reader():
