@@ -1,7 +1,7 @@
 # Development entry points — reference Makefile analog (its test/build
 # targets, minus the Go toolchain).
 
-.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray chaos-soak-split chaos-soak-disk obs-report obs-report-dist
+.PHONY: all test gate manifests chart docker-build docker-build-workloads dryrun bench bench-controlplane bench-shards bench-http bench-fleet bench-step chaos-soak chaos-soak-preempt chaos-soak-grow chaos-soak-gray chaos-soak-split chaos-soak-disk chaos-soak-partition obs-report obs-report-dist
 
 all: gate
 
@@ -211,6 +211,26 @@ chaos-soak-disk:
 	python hack/chaos_soak.py --disk --seed $(or $(SEED),42) \
 	    --rounds $(or $(ROUNDS),6) --out CHAOS.json
 	python hack/chaos_soak.py --disk --no-checksums \
+	    --seed $(or $(SEED),42) --rounds $(or $(ROUNDS),6) \
+	    --expect-violation --out /dev/null
+
+# Partition soak (hack/chaos_soak.py --partition, invariant I13): seeded
+# in-process socket proxies turn every transport seam into a lying
+# network — one-way blackholes, delay/jitter, reordering, duplicated
+# frames, slow-drip partial frames, mid-stream RSTs. Proves no acked
+# write is lost or doubled across dark windows (the ship-stream book
+# check), a leader partitioned from the ROUTER but still heartbeating
+# its local lease never false-fails-over (generation pinned, breaker
+# fails fast, zero stale-generation bytes), every scheduled partition is
+# detected by the ping/pong heartbeat stack and heals within a measured
+# bound, and a retry storm at a dark shard leaves the healthy shard's
+# write p99 within 1.2x baseline. Folds into CHAOS.json; then the
+# counter-proof re-runs the ship leg with heartbeats/read deadlines OFF
+# and requires the half-open wedge — proof the detection is not vacuous.
+chaos-soak-partition:
+	python hack/chaos_soak.py --partition --seed $(or $(SEED),42) \
+	    --rounds $(or $(ROUNDS),6) --out CHAOS.json
+	python hack/chaos_soak.py --partition --no-net-heartbeats \
 	    --seed $(or $(SEED),42) --rounds $(or $(ROUNDS),6) \
 	    --expect-violation --out /dev/null
 

@@ -452,6 +452,16 @@ def build_parser() -> argparse.ArgumentParser:
                        help="router role: disable the per-shard circuit "
                             "breakers (every request goes to the wire "
                             "even when the shard is known-wedged)")
+    start.add_argument("--no-net-heartbeats", action="store_true",
+                       default=False,
+                       help="shard/standby/follower roles: disable the "
+                            "WAL-ship link heartbeats (ping/pong + read/"
+                            "write deadlines). Without them a half-open "
+                            "connection — asymmetric partition, dropped "
+                            "FIN — wedges shipping silently while "
+                            "follower lag grows. For the chaos "
+                            "counter-proof only; never disable in a "
+                            "real deployment")
 
     # kubectl-style inspection for standalone mode: the reference relies
     # on kubectl + CRD printcolumns (cron_types.go:33-36); with no
@@ -630,6 +640,7 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             ship_port=args.ship_port, lease_ttl_s=args.lease_ttl,
             token=args.serve_api_token, scheme=scheme, metrics=metrics,
             fencing=not args.no_fencing, tracer=tracer,
+            net_heartbeats=not args.no_net_heartbeats,
         )
         serving.audit.instrument(metrics)
         recovering = (serving.recovered is not None
@@ -675,6 +686,7 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             fencing=not args.no_fencing, tracer=tracer,
             serve_reads=args.serve_reads is not None,
             read_port=args.serve_reads or 0,
+            net_heartbeats=not args.no_net_heartbeats,
         )
         log.info(
             "shard %d standby: following :%d, watching lease %s%s (pid %d)",
@@ -717,6 +729,7 @@ def cmd_start_process(args: argparse.Namespace) -> int:
             args.shard_index, leader_host=host, ship_port=args.ship_port,
             host=host, port=port, token=args.serve_api_token,
             scheme=scheme, metrics=metrics, tracer=tracer,
+            net_heartbeats=not args.no_net_heartbeats,
         )
         door.audit.instrument(metrics)
         log.info(
@@ -780,6 +793,8 @@ def _run_supervisor(args: argparse.Namespace, stop: threading.Event,
               "--lease-ttl", str(args.lease_ttl)]
     if args.serve_api_token:
         common += ["--serve-api-token", args.serve_api_token]
+    if args.no_net_heartbeats:
+        common += ["--no-net-heartbeats"]
 
     def spawn(extra):
         cmd = [sys.executable, "-m", "cron_operator_tpu.cli.main",

@@ -102,6 +102,10 @@ CATCHUP_ITERATION_CAP = 100_000
 # live Forbid Cron costs at most one re-counted skip tick, never
 # correctness.
 SKIP_DEDUP_CAP = 4096
+# Wall-vs-monotonic disagreement (seconds) before the reconciler calls
+# it a clock jump. Generous: NTP slewing stays far below it; only a
+# genuine step (admin set-clock, VM migration, leap mishap) crosses it.
+CLOCK_JUMP_TOLERANCE_S = 5.0
 # Bounded submit retry budget for transient API failures (injected by the
 # chaos layer or surfaced by a real apiserver as 429/503). Exhaustion
 # raises after a terminal Warning event; the reconcile error then takes
@@ -165,6 +169,17 @@ class CronReconciler:
         # same missed tick is re-observed by every reconcile until it fires
         # or is superseded.
         self._last_skipped_tick: Dict[Tuple[str, str], datetime] = {}
+        # Clock-jump guard: per cron, the last fired tick anchored to
+        # BOTH clocks — [last_tick, wall_at_fire, mono_at_fire,
+        # jump_counted]. Wall time can step backwards under the
+        # scheduler's feet (NTP step, VM migration); lastScheduleTime
+        # math alone would then re-miss an already-fired tick and
+        # double-fire it if the status write was also lost. Monotonic
+        # time cannot step, so wall-vs-monotonic disagreement since the
+        # last fire detects the jump, and the last-fire comparison
+        # suppresses the re-fire. Injectable for jump-injected tests.
+        self._monotonic = time.monotonic
+        self._fire_guard: Dict[Tuple[str, str], List[Any]] = {}
         # Per-cron: workload UIDs whose tick→first-step latency has been
         # observed (each workload contributes exactly one observation).
         # Keyed by cron so pruning can use that cron's live workload list:
@@ -208,6 +223,52 @@ class CronReconciler:
                 if key != (ns, name):
                     del self._last_skipped_tick[key]
         return True
+
+    def _record_fire(self, ns: str, name: str, tick: datetime,
+                     now: datetime) -> None:
+        """Anchor this fire to both clocks (see ``_fire_guard``). Capped
+        like the skip-dedup map; evicting a live entry costs at most the
+        guard for one cron, never correctness (the AlreadyExists name
+        collision and the lastScheduleTime check remain underneath)."""
+        self._fire_guard[(ns, name)] = [tick, now, self._monotonic(), False]
+        if len(self._fire_guard) > SKIP_DEDUP_CAP:
+            excess = len(self._fire_guard) - SKIP_DEDUP_CAP
+            for key in list(self._fire_guard)[:excess]:
+                if key != (ns, name):
+                    del self._fire_guard[key]
+
+    def _clock_jumped_back(self, cron: Cron, ns: str, name: str,
+                           now: datetime, missed_run: datetime,
+                           log: Any) -> bool:
+        """True iff wall clock stepped backwards since this cron's last
+        fire AND the tick about to fire is not newer than that fire —
+        i.e. the ONLY reason it looks missed is the jump. Counting is
+        once per jump (per guard entry), not per reconcile."""
+        entry = self._fire_guard.get((ns, name))
+        if entry is None:
+            return False
+        last_tick, wall0, mono0, counted = entry
+        drift = ((now - wall0).total_seconds()
+                 - (self._monotonic() - mono0))
+        if drift >= -CLOCK_JUMP_TOLERANCE_S:
+            return False
+        if not counted:
+            entry[3] = True
+            self._count("cron_clock_jumps_total")
+            self._audit(
+                "clock_jump", cron=f"{ns}/{name}",
+                drift_s=round(drift, 3), last_fired_tick=str(last_tick),
+            )
+            self.api.record_event(
+                cron.to_dict(), "Warning", "ClockJump",
+                f"wall clock stepped backwards ~{-drift:.0f}s since the "
+                f"last fired tick; holding already-fired ticks",
+            )
+            log.warning(
+                "wall clock stepped backwards %.1fs since last fire "
+                "(tick %s)", -drift, last_tick,
+            )
+        return missed_run <= last_tick
 
     # -- entry point --------------------------------------------------------
 
@@ -380,6 +441,14 @@ class CronReconciler:
         scheduled = ReconcileResult(requeue_after=next_run - now)
 
         if missed_run is None:
+            return scheduled
+
+        if self._clock_jumped_back(cron, ns, name, now, missed_run, log):
+            # The tick only looks missed because wall time stepped
+            # backwards past a fire this process already performed (and
+            # the lastScheduleTime that would prove it may have been
+            # lost with a failed status write). Monotonic time says it
+            # fired — don't fire it twice.
             return scheduled
 
         if (
@@ -598,6 +667,7 @@ class CronReconciler:
         )
 
         cron.status.last_schedule_time = now
+        self._record_fire(ns, name, missed_run, now)
         return scheduled
 
     # -- helpers ------------------------------------------------------------

@@ -490,10 +490,25 @@ class ClusterAPIServer:
     def stop(self) -> None:
         self._stop.set()
 
+    def _watch_redial_delay(self, attempt: int) -> float:
+        """Redial pacing for a broken watch stream: full-jitter
+        exponential backoff (0.2s base, 5s cap) so N watch loops that
+        lost the same peer at the same instant spread their redials
+        instead of arriving in lockstep — and when the process's shared
+        retry budget (installed by RouterServer) is dry, wait at the
+        cap: a partition-era storm of redials IS retry traffic."""
+        import random
+
+        budget = getattr(self, "retry_budget", None)
+        if budget is not None and not budget.try_retry():
+            return 5.0
+        return random.uniform(0.0, min(5.0, 0.2 * (2 ** min(attempt, 6))))
+
     def _watch_loop(self, gvk: GVK, namespace: Optional[str]) -> None:
         import socket
 
         rv: Optional[str] = None
+        attempt = 0
         while not self._stop.is_set():
             try:
                 if rv is None:
@@ -512,6 +527,7 @@ class ClusterAPIServer:
                 # routine stream closes (apiserver drops watches every few
                 # minutes by design) don't trigger a full re-list.
                 rv = self._stream_watch(gvk, namespace, rv) or rv
+                attempt = 0  # the stream worked: next failure starts fresh
             except socket.timeout:
                 logger.debug("watch %s idle timeout; resuming", gvk)
             except ExpiredWatchError:
@@ -521,7 +537,8 @@ class ClusterAPIServer:
                 logger.warning("watch %s failed; re-listing", gvk,
                                exc_info=True)
                 rv = None
-                self._stop.wait(1.0)
+                self._stop.wait(self._watch_redial_delay(attempt))
+                attempt += 1
             except (OSError, urllib.error.URLError) as err:
                 if self._stop.is_set():
                     # Teardown races the stream: the peer (or this
@@ -534,13 +551,15 @@ class ClusterAPIServer:
                 logger.warning("watch %s connection lost (%s); retrying",
                                gvk, err)
                 rv = None
-                self._stop.wait(1.0)
+                self._stop.wait(self._watch_redial_delay(attempt))
+                attempt += 1
             except Exception:
                 if self._stop.is_set():
                     break
                 logger.error("watch %s crashed; retrying", gvk, exc_info=True)
                 rv = None
-                self._stop.wait(1.0)
+                self._stop.wait(self._watch_redial_delay(attempt))
+                attempt += 1
 
     def _stream_watch(
         self, gvk: GVK, namespace: Optional[str], rv: Optional[str]

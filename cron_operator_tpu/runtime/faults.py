@@ -752,3 +752,30 @@ class FaultInjector:
 
     def __bool__(self) -> bool:
         return True
+
+
+# ---------------------------------------------------------------------------
+# network-fault wiring
+# ---------------------------------------------------------------------------
+
+#: The network leg of the fault ladder lives in ``runtime/netfaults.py``
+#: (it builds on :func:`seeded_fraction`, so a top-level import here
+#: would be circular). Re-exported lazily: ``faults`` stays the single
+#: import surface the chaos harness uses for every injector family.
+_NETFAULT_EXPORTS = (
+    "NET_FAULT_KINDS",
+    "DIRECTIONS",
+    "LinkPlan",
+    "FaultProxy",
+    "NetworkFaultInjector",
+)
+
+
+def __getattr__(name: str):
+    if name in _NETFAULT_EXPORTS:
+        from cron_operator_tpu.runtime import netfaults
+
+        return getattr(netfaults, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -417,9 +417,45 @@ _FAMILY_META: Dict[str, tuple] = {
                    "cluster event"),
     "shard_follower_records_rejected_total": (
         "counter", "Shipped WAL records the follower refused to apply "
-                   "(label reason=crc|stale_generation): crc = the "
-                   "record failed checksum verification at apply time, "
-                   "stale_generation = it carried a fenced leader epoch"),
+                   "(label reason=crc|stale_generation|seq_gap): crc = "
+                   "the record failed checksum verification at apply "
+                   "time, stale_generation = it carried a fenced leader "
+                   "epoch, seq_gap = the frame sequence skipped (frames "
+                   "lost or reordered in flight; the connection drops "
+                   "and re-bootstraps rather than apply across a hole)"),
+    "net_faults_injected_total": (
+        "counter", "Faults the seeded network-fault injector delivered "
+                   "through its link proxies (label kind=blackhole|"
+                   "delay|reorder|duplicate|slowdrip|rst) — chaos "
+                   "harness only, zero in production topologies"),
+    "transport_heartbeat_timeouts_total": (
+        "counter", "Transport links declared half-open and torn down "
+                   "after the ping/pong heartbeat went silent past the "
+                   "timeout (label side=leader|follower): bounded-time "
+                   "detection of asymmetric partitions and dropped "
+                   "FINs on the WAL ship path"),
+    "transport_duplicate_frames_total": (
+        "counter", "Shipped WAL frames discarded as duplicates by the "
+                   "follower's per-connection sequence ledger (a lying "
+                   "network replayed bytes that still CRC'd clean) — "
+                   "each one is a counted no-op, never a double-apply"),
+    "router_retry_budget_exhausted_total": (
+        "counter", "Retries denied by the router's shared retry budget "
+                   "(token bucket across dispatch chases, watch "
+                   "redials and follower-read fallbacks): the error "
+                   "surfaced instead of amplifying into a retry storm "
+                   "against surviving shards"),
+    "shard_follower_reconnect_backoff_seconds": (
+        "gauge", "The delay the ship follower's NEXT reconnect will "
+                 "wait (label port): stuck at the cap = flapping or "
+                 "partitioned link, back at base = the last stream "
+                 "bootstrapped successfully (backoff resets only on a "
+                 "proven-good bootstrap, not on bare TCP accept)"),
+    "cron_clock_jumps_total": (
+        "counter", "Backwards wall-clock steps the reconciler detected "
+                   "via its monotonic fire anchors (NTP step, VM "
+                   "migration): already-fired ticks are held instead "
+                   "of double-fired while wall time replays them"),
     "workload_checkpoint_fallbacks_total": (
         "counter", "Checkpoint restores served from an older retained "
                    "step because the newest one was unreadable "

@@ -1,0 +1,509 @@
+"""Deterministic network-fault injection: the lying-network seam.
+
+PR 13 killed processes, PR 15 froze them, PR 19 corrupted their disks —
+this module attacks the one layer still assumed honest: the sockets.
+A :class:`NetworkFaultInjector` owns a set of in-process TCP proxies
+(:class:`FaultProxy`), one per transport link (WAL ship server ↔
+``ShipFollower``, ``RouterServer`` ↔ ``ShardClient``, follower read
+doors). Every byte of a proxied link flows through a per-direction pump
+that consults a keyed PRF (``seeded_fraction``, the same primitive as
+``FaultPlan`` and ``DiskFaultInjector``) over ``(seed, link, direction,
+unit-index, kind)`` — so a given seed produces the *same* partition
+schedule, the same duplicated frame, the same mid-stream RST in every
+run, independent of thread interleaving.
+
+Fault kinds (:data:`NET_FAULT_KINDS`):
+
+- ``blackhole`` — one-way partition: the pump keeps *reading* (no
+  backpressure, no EOF) but forwards nothing. The receiving peer sees a
+  half-open connection: alive by every kernel signal, silent forever.
+  Sticky per connection — healing admits new connections but never
+  revives a blackholed one, exactly like a real asymmetric partition
+  with a dropped FIN.
+- ``delay`` — hold a unit for ``delay_s`` before forwarding (jitter).
+- ``reorder`` — hold one frame and forward its successor first
+  (framed links only; TCP never reorders within a stream, a lying
+  middlebox or a reconnect race does).
+- ``duplicate`` — forward the same frame twice (framed links only).
+- ``slowdrip`` — trickle a unit a few bytes at a time with pauses, so
+  the peer sits mid-frame below the framing boundary.
+- ``rst`` — abort the connection with ``SO_LINGER(0)``: the peer gets
+  ECONNRESET mid-stream instead of a clean FIN.
+
+Framed links (``framed=True``) parse the WAL-ship header so faults act
+on whole frames — the unit the transport's seq/CRC hardening must
+survive. Chunk links treat each ``recv`` as the unit (HTTP seams).
+
+Alongside the PRF per-unit faults, :meth:`NetworkFaultInjector.partition`
+/ :meth:`heal` flip whole links (optionally one direction — the
+asymmetric case) for schedule-driven soaks;
+:meth:`NetworkFaultInjector.schedule` expands a pure-PRF partition
+schedule from the seed, the ``FaultPlan.schedule`` idiom.
+
+Everything injected counts into ``net_faults_injected_total{kind=...}``
+so a soak can assert the schedule actually bit.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Tuple
+
+from cron_operator_tpu.runtime.faults import seeded_fraction
+
+logger = logging.getLogger("runtime.netfaults")
+
+#: Every fault kind a proxy can inject (the ``kind`` label values).
+NET_FAULT_KINDS = (
+    "blackhole",
+    "delay",
+    "reorder",
+    "duplicate",
+    "slowdrip",
+    "rst",
+)
+
+#: Directions, named from the dialer's point of view: ``c2s`` carries
+#: the client's bytes toward the server, ``s2c`` the replies back.
+DIRECTIONS = ("c2s", "s2c")
+
+
+@dataclass(frozen=True)
+class LinkPlan:
+    """Per-unit fault probabilities for one link (both directions).
+
+    All default to 0 — a plan-less proxy is a transparent TCP relay —
+    and each is consulted through the PRF, so two runs with one seed
+    inject at identical unit indices."""
+
+    p_blackhole: float = 0.0
+    p_delay: float = 0.0
+    p_reorder: float = 0.0
+    p_duplicate: float = 0.0
+    p_slowdrip: float = 0.0
+    p_rst: float = 0.0
+    #: Injected delay per delayed unit (jittered by the PRF up to 2x).
+    delay_s: float = 0.02
+    #: Slow-drip granularity: bytes per trickle write, pause between.
+    drip_bytes: int = 3
+    drip_pause_s: float = 0.002
+
+    def any_faults(self) -> bool:
+        return any(
+            getattr(self, f.name) > 0.0
+            for f in fields(self) if f.name.startswith("p_")
+        )
+
+
+class _ConnPumps:
+    """One accepted client connection: upstream dial + two pump threads.
+
+    Each direction keeps its own unit counter and its own *sticky*
+    blackhole flag — once a direction goes dark the pump drains the
+    source forever without forwarding OR closing, which is what makes
+    the peer's view genuinely half-open (no EOF, no RST, no bytes)."""
+
+    def __init__(self, proxy: "FaultProxy", client: socket.socket,
+                 conn_index: int):
+        self.proxy = proxy
+        self.client = client
+        self.conn_index = conn_index
+        self.upstream = socket.create_connection(
+            proxy.target, timeout=proxy.connect_timeout_s
+        )
+        self.upstream.settimeout(None)
+        self.client.settimeout(None)
+        for s in (self.client, self.upstream):
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        self._closed = False
+        self._lock = threading.Lock()
+        self._threads = [
+            threading.Thread(
+                target=self._pump, name=f"netfault-{proxy.name}-{d}",
+                args=(d,), daemon=True,
+            )
+            for d in DIRECTIONS
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- plumbing -------------------------------------------------------
+
+    def _ends(self, direction: str) -> Tuple[socket.socket, socket.socket]:
+        if direction == "c2s":
+            return self.client, self.upstream
+        return self.upstream, self.client
+
+    def close(self, rst: bool = False) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for s in (self.client, self.upstream):
+            try:
+                if rst:
+                    # Abort, don't close: linger(0) turns the teardown
+                    # into an RST so the peer sees a mid-stream reset.
+                    s.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        b"\x01\x00\x00\x00\x00\x00\x00\x00",
+                    )
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.proxy._forget(self)
+
+    # -- unit readers ---------------------------------------------------
+
+    def _read_exact(self, src: socket.socket, n: int) -> Optional[bytes]:
+        chunks: List[bytes] = []
+        got = 0
+        while got < n:
+            data = src.recv(min(65536, n - got))
+            if not data:
+                return None
+            chunks.append(data)
+            got += len(data)
+        return b"".join(chunks)
+
+    def _read_unit(self, src: socket.socket) -> Optional[bytes]:
+        """One fault unit: a whole ship frame (framed links) or one
+        recv chunk. Returns None on EOF."""
+        if not self.proxy.framed:
+            data = src.recv(65536)
+            return data or None
+        # Parse the ship framing so faults hit whole frames. Imported
+        # lazily: transport imports nothing from here, so the one-way
+        # dependency stays acyclic.
+        from cron_operator_tpu.runtime.transport import _HEADER
+        header = self._read_exact(src, _HEADER.size)
+        if header is None:
+            return None
+        _, length, _, _ = _HEADER.unpack(header)
+        payload = self._read_exact(src, length)
+        if payload is None:
+            return None
+        return header + payload
+
+    # -- the pump -------------------------------------------------------
+
+    def _pump(self, direction: str) -> None:
+        src, dst = self._ends(direction)
+        inj = self.proxy.injector
+        plan = self.proxy.plan
+        link = self.proxy.name
+        idx = 0
+        blackholed = False
+        held: Optional[bytes] = None  # reorder buffer (framed links)
+        try:
+            while True:
+                unit = self._read_unit(src)
+                if unit is None:
+                    break
+                idx += 1
+
+                def frac(kind: str) -> float:
+                    return inj.fraction(link, direction,
+                                        self.conn_index, idx, kind)
+
+                if not blackholed and (
+                    inj.partitioned(link, direction)
+                    or (plan.p_blackhole > 0.0
+                        and frac("blackhole") < plan.p_blackhole)
+                ):
+                    # Partition onset: this connection-direction goes
+                    # dark for good. Keep draining so the sender never
+                    # feels backpressure — silence, not failure.
+                    blackholed = True
+                    inj._count("blackhole")
+                    logger.debug("link %s/%s conn %d blackholed at unit %d",
+                                 link, direction, self.conn_index, idx)
+                if blackholed:
+                    if held is not None:
+                        held = None
+                    continue
+
+                if plan.p_rst > 0.0 and frac("rst") < plan.p_rst:
+                    inj._count("rst")
+                    self.close(rst=True)
+                    return
+
+                if plan.p_delay > 0.0 and frac("delay") < plan.p_delay:
+                    inj._count("delay")
+                    time.sleep(plan.delay_s * (1.0 + frac("delay_jitter")))
+
+                if (self.proxy.framed and plan.p_reorder > 0.0
+                        and held is None
+                        and frac("reorder") < plan.p_reorder):
+                    # Hold this frame; its successor jumps the queue.
+                    inj._count("reorder")
+                    held = unit
+                    continue
+
+                self._forward(dst, unit, plan, frac)
+                if (self.proxy.framed and plan.p_duplicate > 0.0
+                        and frac("duplicate") < plan.p_duplicate):
+                    inj._count("duplicate")
+                    self._forward(dst, unit, plan, frac)
+                if held is not None:
+                    out, held = held, None
+                    self._forward(dst, out, plan, frac)
+        except OSError:
+            pass
+        finally:
+            # EOF/error: propagate the close — unless this direction is
+            # blackholed, where the whole point is that the peer never
+            # learns (the half-open connection outlives its sender).
+            if not blackholed:
+                self.close()
+
+    def _forward(self, dst: socket.socket, unit: bytes, plan: LinkPlan,
+                 frac: Any) -> None:
+        if plan.p_slowdrip > 0.0 and frac("slowdrip") < plan.p_slowdrip:
+            self.proxy.injector._count("slowdrip")
+            step = max(1, int(plan.drip_bytes))
+            for i in range(0, len(unit), step):
+                dst.sendall(unit[i:i + step])
+                time.sleep(plan.drip_pause_s)
+            return
+        dst.sendall(unit)
+
+
+class FaultProxy:
+    """One proxied link: listens on an ephemeral local port and relays
+    every accepted connection to ``target`` through the fault pumps.
+    Point the dialer at :attr:`port` instead of the real endpoint."""
+
+    def __init__(
+        self,
+        injector: "NetworkFaultInjector",
+        name: str,
+        target: Tuple[str, int],
+        framed: bool = False,
+        plan: Optional[LinkPlan] = None,
+        host: str = "127.0.0.1",
+        connect_timeout_s: float = 2.0,
+    ):
+        self.injector = injector
+        self.name = name
+        self.target = target
+        self.framed = framed
+        self.plan = plan or LinkPlan()
+        self.connect_timeout_s = connect_timeout_s
+        self._listener = socket.create_server((host, 0))
+        self._listener.settimeout(0.2)
+        self._conns: List[_ConnPumps] = []
+        self._accepted = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"netfault-proxy-{name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                self._accepted += 1
+                conn_index = self._accepted
+            try:
+                conn = _ConnPumps(self, sock, conn_index)
+            except OSError:
+                # Upstream refused (peer between death and promotion):
+                # refuse the dialer too, the honest TCP outcome.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
+            with self._lock:
+                self._conns.append(conn)
+
+    def _forget(self, conn: _ConnPumps) -> None:
+        with self._lock:
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+
+    def connections(self) -> int:
+        with self._lock:
+            return len(self._conns)
+
+    def accepted(self) -> int:
+        with self._lock:
+            return self._accepted
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            conn.close()
+        self._thread.join(timeout=2.0)
+
+
+class NetworkFaultInjector:
+    """The seeded owner of every :class:`FaultProxy` in a topology.
+
+    One injector per soak/test: proxies register under link names, PRF
+    decisions key on ``(seed, link, direction, conn, unit, kind)``, and
+    dynamic partitions (:meth:`partition` / :meth:`heal`) overlay the
+    per-unit plan — a partitioned link blackholes the *current*
+    connections (sticky) and every new one until healed."""
+
+    def __init__(self, seed: int, metrics: Optional[Any] = None):
+        self.seed = int(seed)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._proxies: Dict[str, FaultProxy] = {}
+        #: (link, direction) pairs currently partitioned.
+        self._partitions: set = set()
+        self.injected: Dict[str, int] = {k: 0 for k in NET_FAULT_KINDS}
+
+    # -- PRF ------------------------------------------------------------
+
+    def fraction(self, *parts: object) -> float:
+        return seeded_fraction(self.seed, "net", *parts)
+
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+        if self._metrics is not None:
+            self._metrics.inc(f'net_faults_injected_total{{kind="{kind}"}}')
+
+    # -- topology -------------------------------------------------------
+
+    def proxy(
+        self,
+        name: str,
+        target_host: str,
+        target_port: int,
+        framed: bool = False,
+        plan: Optional[LinkPlan] = None,
+    ) -> FaultProxy:
+        """Interpose a proxy on one link; dialers use ``.port``."""
+        p = FaultProxy(self, name, (target_host, target_port),
+                       framed=framed, plan=plan)
+        with self._lock:
+            if name in self._proxies:
+                raise ValueError(f"link {name!r} already proxied")
+            self._proxies[name] = p
+        return p
+
+    def __getitem__(self, name: str) -> FaultProxy:
+        with self._lock:
+            return self._proxies[name]
+
+    # -- dynamic partitions ---------------------------------------------
+
+    def partition(self, link: str, direction: str = "both") -> None:
+        """Blackhole ``link`` (both directions, or one — the asymmetric
+        partition where A→B flows but B→A doesn't). Existing
+        connections go dark at their next unit; new connections accept
+        and then stay silent (half-open from birth)."""
+        dirs = DIRECTIONS if direction == "both" else (direction,)
+        with self._lock:
+            for d in dirs:
+                if d not in DIRECTIONS:
+                    raise ValueError(f"unknown direction {d!r}")
+                self._partitions.add((link, d))
+
+    def heal(self, link: Optional[str] = None) -> None:
+        """Lift partitions (one link, or all). Already-blackholed
+        connections stay dark — a half-open socket does not heal, its
+        replacement does — so recovery must come from the transport's
+        own detection + reconnect, which is exactly what I13c measures.
+        """
+        with self._lock:
+            if link is None:
+                self._partitions.clear()
+            else:
+                self._partitions = {
+                    (ln, d) for (ln, d) in self._partitions if ln != link
+                }
+
+    def partitioned(self, link: str, direction: str) -> bool:
+        with self._lock:
+            return (link, direction) in self._partitions
+
+    def schedule(self, rounds: int, links: List[str]) -> List[Dict[str, Any]]:
+        """Expand the seeded partition schedule: per round, which link
+        partitions, in which direction(s), for how long. A pure function
+        of ``(seed, rounds, links)`` — the soak and its counter-proof
+        replay byte-identical schedules."""
+        out: List[Dict[str, Any]] = []
+        for r in range(int(rounds)):
+            link = links[int(self.fraction("sched", r, "link")
+                             * len(links)) % len(links)]
+            d = self.fraction("sched", r, "direction")
+            direction = ("c2s" if d < 0.25 else
+                         "s2c" if d < 0.5 else "both")
+            hold_s = 0.3 + self.fraction("sched", r, "hold") * 0.7
+            out.append({
+                "round": r,
+                "link": link,
+                "direction": direction,
+                "hold_s": round(hold_s, 3),
+            })
+        return out
+
+    # -- lifecycle ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "injected": dict(self.injected),
+                "partitions": sorted(self._partitions),
+                "links": {
+                    name: {
+                        "port": p.port,
+                        "accepted": p.accepted(),
+                        "connections": p.connections(),
+                    }
+                    for name, p in self._proxies.items()
+                },
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            proxies = list(self._proxies.values())
+            self._proxies.clear()
+            self._partitions.clear()
+        for p in proxies:
+            p.close()
+
+
+__all__ = [
+    "NET_FAULT_KINDS",
+    "DIRECTIONS",
+    "LinkPlan",
+    "FaultProxy",
+    "NetworkFaultInjector",
+]

@@ -383,11 +383,18 @@ class FollowerReadClient:
         shard: int = 0,
         metrics: Optional[Any] = None,
         on_fallback: Optional[Callable[[str, str], None]] = None,
+        retry_budget: Optional[Any] = None,
     ):
         self.leader = leader
         self.followers = list(followers)
         self.shard = int(shard)
         self.metrics = metrics
+        #: Shared :class:`~runtime.transport.RetryBudget`. A follower
+        #: read that fails over to the leader is a retry (two requests
+        #: for one read): when the budget is dry, skip the follower leg
+        #: entirely and go leader-direct — one request, no amplification
+        #: — instead of hammering a partitioned door first every time.
+        self.retry_budget = retry_budget
         #: Called as ``fn(reason, detail)`` on every leader fallback
         #: (the router records a cluster event through this).
         self.on_fallback = on_fallback
@@ -487,6 +494,14 @@ class FollowerReadClient:
             return None
         if READ_CONSISTENCY.get() == "strong":
             return None
+        if (self.retry_budget is not None
+                and getattr(self.retry_budget, "depleted", False)):
+            # Storm mode: every follower miss would cost a second
+            # (leader) request. Serve leader-direct until successes
+            # refill the budget.
+            self._count_fallback("budget",
+                                 RuntimeError("retry budget depleted"))
+            return None
         with self._lock:
             idx = self._rr
             self._rr = (self._rr + 1) % len(self.followers)
@@ -518,7 +533,12 @@ class FollowerReadClient:
             self._count_fallback("unhealthy", err)
         else:
             self._count_read("follower")
+            if self.retry_budget is not None:
+                self.retry_budget.on_success()
             return out
+        # The leader request below is the retry leg of this read.
+        if self.retry_budget is not None:
+            self.retry_budget.try_retry()
         self._count_read("leader")
         return self.leader.list_with_rv(
             api_version, kind, namespace=namespace,

@@ -42,6 +42,7 @@ import hashlib
 import json
 import logging
 import os
+import random
 import shutil
 import threading
 import time
@@ -874,6 +875,7 @@ class ShardRouter:
         stores: Sequence[Any],
         ownership: Optional[OwnershipMap] = None,
         metrics: Optional[Any] = None,
+        retry_budget: Optional[Any] = None,
     ):
         if not stores:
             raise ValueError("ShardRouter needs at least one shard store")
@@ -884,6 +886,10 @@ class ShardRouter:
             else OwnershipMap.boot(self.n_shards)
         )
         self._metrics = metrics
+        #: Shared :class:`~runtime.transport.RetryBudget` (the router
+        #: process passes its own): WrongShard chases draw on it, so a
+        #: partition-era storm of re-routes cannot amplify unboundedly.
+        self.retry_budget = retry_budget
         self._watchers: List[Tuple[Callable[[WatchEvent], None], bool]] = []
         #: Writes re-routed after a WrongShardError (split cutover race).
         self.wrong_shard_retries = 0
@@ -975,13 +981,25 @@ class ShardRouter:
         published or the deadline passes."""
         target = relocate()
         deadline = time.monotonic() + self.WRONG_SHARD_RETRY_DEADLINE_S
+        attempt = 0
         while True:
             try:
-                return call(target)
+                result = call(target)
+                if self.retry_budget is not None:
+                    # Every success refunds: retry capacity stays
+                    # proportional to how much traffic is succeeding.
+                    self.retry_budget.on_success()
+                return result
             except WrongShardError as err:
                 self.wrong_shard_retries += 1
                 self._count("router_wrong_shard_retries_total")
                 if time.monotonic() >= deadline:
+                    raise
+                if (self.retry_budget is not None
+                        and not self.retry_budget.try_retry()):
+                    # Budget dry: the process is already drowning in
+                    # retries (a partition somewhere). Surfacing the
+                    # error beats joining the storm.
                     raise
                 owner = getattr(err, "owner", None)
                 nxt = None
@@ -990,7 +1008,14 @@ class ShardRouter:
                 if nxt is None or nxt is target:
                     nxt = relocate()
                 if nxt is target:
-                    time.sleep(self.WRONG_SHARD_RETRY_SLEEP_S)
+                    # Full jitter (AWS backoff shape): retries that all
+                    # raced one cutover MUST NOT re-arrive in lockstep.
+                    time.sleep(random.uniform(
+                        0.0,
+                        self.WRONG_SHARD_RETRY_SLEEP_S
+                        * (2 ** min(attempt, 5)),
+                    ))
+                attempt += 1
                 target = nxt
 
     # -- single-object verbs -------------------------------------------------

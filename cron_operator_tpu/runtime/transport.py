@@ -111,13 +111,26 @@ logger = logging.getLogger("runtime.transport")
 #: follower before any WAL bytes of the new subscription arrive.
 FRAME_WAL = b"W"
 FRAME_BOOT = b"B"
+#: Link-liveness probes (empty payload, seq 0). The leader PINGs on an
+#: interval; a follower that answers PONG proves the *return* path —
+#: which is exactly what a one-way blackhole severs. Either side timing
+#: out tears the connection down in bounded time instead of trusting a
+#: half-open socket forever (the SIGSTOP watchdog idea, applied to
+#: links).
+FRAME_PING = b"P"
+FRAME_PONG = b"O"
 
-#: type byte + big-endian payload length + CRC32C of the payload. The
-#: CRC travels in the frame header, so a follower rejects a frame whose
-#: bytes were damaged in flight (or on the leader's disk between flush
-#: and send) BEFORE any line of it reaches the replica's store — the
-#: wire leg of invariant I12.
-_HEADER = struct.Struct("!cII")
+#: type byte + big-endian payload length + CRC32C of the payload +
+#: per-connection sequence number. The CRC travels in the frame header,
+#: so a follower rejects a frame whose bytes were damaged in flight (or
+#: on the leader's disk between flush and send) BEFORE any line of it
+#: reaches the replica's store — the wire leg of invariant I12. The seq
+#: starts at 1 with each connection's BOOT frame and increments per
+#: WAL frame, so a follower can tell a duplicated frame (seq <= last:
+#: counted no-op) from a gap (seq skipped: drop the connection and
+#: re-bootstrap) — a lying middlebox can repeat or reorder bytes that
+#: still CRC clean, and the CRC alone cannot see that.
+_HEADER = struct.Struct("!cIII")
 
 #: Refuse absurd frames (a desynced peer, not a real payload).
 MAX_FRAME_BYTES = 256 * 1024 * 1024
@@ -134,9 +147,18 @@ RECONNECT_BASE_S = 0.05
 RECONNECT_CAP_S = 2.0
 
 
-def write_frame(sock: socket.socket, ftype: bytes, payload: bytes) -> None:
+#: Default link-heartbeat cadence: PING every interval; a side that
+#: sees no traffic for the timeout declares the link half-open and
+#: tears it down. timeout >> interval so jitter/slow-drip alone never
+#: kills a healthy link.
+HEARTBEAT_INTERVAL_S = 1.0
+HEARTBEAT_TIMEOUT_S = 5.0
+
+
+def write_frame(sock: socket.socket, ftype: bytes, payload: bytes,
+                seq: int = 0) -> None:
     sock.sendall(
-        _HEADER.pack(ftype, len(payload), wal_crc(payload)) + payload
+        _HEADER.pack(ftype, len(payload), wal_crc(payload), seq) + payload
     )
 
 
@@ -154,15 +176,16 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
-def read_frame(sock: socket.socket) -> Optional[Tuple[bytes, bytes]]:
-    """→ (type, payload), or None on EOF / torn frame. A record split
-    across TCP segments is reassembled here; a frame cut short by the
-    peer's death never yields a partial payload; a complete frame whose
-    payload fails the header CRC raises :class:`FrameCorruptError`."""
+def read_frame(sock: socket.socket) -> Optional[Tuple[bytes, bytes, int]]:
+    """→ (type, payload, seq), or None on EOF / torn frame. A record
+    split across TCP segments is reassembled here; a frame cut short by
+    the peer's death never yields a partial payload; a complete frame
+    whose payload fails the header CRC raises
+    :class:`FrameCorruptError`."""
     header = _recv_exact(sock, _HEADER.size)
     if header is None:
         return None
-    ftype, length, crc = _HEADER.unpack(header)
+    ftype, length, crc, seq = _HEADER.unpack(header)
     if length > MAX_FRAME_BYTES:
         raise ValueError(f"frame length {length} exceeds cap")
     payload = _recv_exact(sock, length)
@@ -174,7 +197,7 @@ def read_frame(sock: socket.socket) -> Optional[Tuple[bytes, bytes]]:
             f"frame crc mismatch: header {crc}, payload {actual} "
             f"({length} byte(s), type {ftype!r})"
         )
-    return ftype, payload
+    return ftype, payload, seq
 
 
 def encode_bootstrap(state: RecoveredState) -> bytes:
@@ -210,9 +233,13 @@ def decode_bootstrap(payload: bytes) -> RecoveredState:
 
 class _ShipConn:
     """One accepted follower connection: a socket wrapped as a
-    Persistence ship sink. The sink's sender thread is the only writer,
-    so frames never interleave. Any socket error detaches the sink —
-    the follower reconnects and re-bootstraps on a fresh connection."""
+    Persistence ship sink. Writes go through ``_send_lock`` (the sink's
+    sender thread and the heartbeat thread share the socket) with a
+    socket write deadline, so a peer whose receive window went dark
+    cannot park ``sendall`` forever. Any socket error — including a
+    heartbeat timeout, the half-open case where the kernel still calls
+    the connection healthy — detaches the sink; the follower reconnects
+    and re-bootstraps on a fresh connection."""
 
     def __init__(self, server: "WALShipServer", sock: socket.socket,
                  addr: Any):
@@ -221,33 +248,134 @@ class _ShipConn:
         self.addr = addr
         self._closed = False
         self._lock = threading.Lock()
-        self.sink = None  # set right after; guard close() on early failure
+        self._send_lock = threading.Lock()
+        #: Per-connection frame sequence: BOOT=1, each WAL +1. Written
+        #: only under _send_lock, so seq order ≡ wire order.
+        self._seq = 0
+        self._last_pong = time.monotonic()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._pong_thread: Optional[threading.Thread] = None
+        if server.heartbeats:
+            # Bound every sendall: a blackholed peer stops ACKing, the
+            # send buffer fills, and the deadline turns an eternal park
+            # into a socket.timeout (an OSError → the close path).
+            sock.settimeout(server.heartbeat_timeout_s)
+        self.sink = None  # set in start(); guard close() on early failure
+
+    def start(self) -> None:
+        """Attach the sink and start the heartbeat/pong threads.
+
+        Split from ``__init__`` so the accept loop can register the
+        connection in ``_conns`` FIRST: the sink's sender thread ships
+        the bootstrap asynchronously, so a follower can be fully live
+        before this method even returns — and a live connection that
+        ``connections()`` can't see (or ``close()`` can't reach) is a
+        leak."""
+        server, addr = self.server, self.addr
         self.sink = server.persistence.attach_sink(
             self._send_wal,
             resync=self._send_bootstrap,
             name=f"ship-{addr[0]}:{addr[1]}",
             max_buffered_bytes=server.max_buffered_bytes,
         )
+        if server.heartbeats:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop,
+                name=f"ship-heartbeat-{addr[1]}", daemon=True,
+            )
+            self._pong_thread = threading.Thread(
+                target=self._pong_loop,
+                name=f"ship-pong-{addr[1]}", daemon=True,
+            )
+            self._hb_thread.start()
+            self._pong_thread.start()
 
     def _send_wal(self, data: bytes) -> None:
         try:
-            write_frame(self.sock, FRAME_WAL, data)
+            with self._send_lock:
+                self._seq += 1
+                write_frame(self.sock, FRAME_WAL, data, seq=self._seq)
         except OSError:
             self.close()
             raise
 
     def _send_bootstrap(self, state: RecoveredState) -> None:
         try:
-            write_frame(self.sock, FRAME_BOOT, encode_bootstrap(state))
+            with self._send_lock:
+                self._seq += 1
+                write_frame(self.sock, FRAME_BOOT, encode_bootstrap(state),
+                            seq=self._seq)
         except OSError:
             self.close()
             raise
+
+    def _heartbeat_loop(self) -> None:
+        """PING on an interval; declare the link half-open when no PONG
+        arrived for the timeout. Detection is bounded by construction:
+        a silent peer costs at most ``heartbeat_timeout_s`` before the
+        sink detaches and the leader's queue stops growing toward the
+        overflow kick."""
+        stop = self.server._stop
+        while not stop.wait(self.server.heartbeat_interval_s):
+            with self._lock:
+                if self._closed:
+                    return
+            if (time.monotonic() - self._last_pong
+                    > self.server.heartbeat_timeout_s):
+                self.server._count(
+                    'transport_heartbeat_timeouts_total{side="leader"}'
+                )
+                logger.warning(
+                    "ship subscriber %s:%s half-open: no PONG in %.1fs — "
+                    "dropping connection", self.addr[0], self.addr[1],
+                    self.server.heartbeat_timeout_s,
+                )
+                self.close()
+                return
+            try:
+                with self._send_lock:
+                    write_frame(self.sock, FRAME_PING, b"")
+            except OSError:
+                self.close()
+                return
+
+    def _pong_loop(self) -> None:
+        """Sole reader of the subscriber socket: consumes PONGs (and
+        tolerates anything else a confused peer sends back). EOF here is
+        the follower hanging up — close the sink promptly instead of
+        waiting for the next WAL send to fail."""
+        try:
+            while True:
+                with self._lock:
+                    if self._closed:
+                        return
+                try:
+                    frame = read_frame(self.sock)
+                except socket.timeout:
+                    continue  # liveness is the heartbeat thread's call
+                except (FrameCorruptError, ValueError):
+                    break
+                if frame is None:
+                    break
+                if frame[0] == FRAME_PONG:
+                    self._last_pong = time.monotonic()
+        except OSError:
+            pass
+        self.close()
 
     def close(self) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
+        # shutdown() before close(): with the pong reader blocked in
+        # recv on this fd, a bare close() defers the FIN until that
+        # syscall returns (up to the read deadline) — shutdown sends it
+        # now and wakes the reader with EOF.
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -271,6 +399,10 @@ class WALShipServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_buffered_bytes: Optional[int] = None,
+        heartbeats: bool = True,
+        heartbeat_interval_s: float = HEARTBEAT_INTERVAL_S,
+        heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
+        metrics: Optional[Any] = None,
     ):
         from cron_operator_tpu.runtime.persistence import (
             DEFAULT_SHIP_QUEUE_BYTES,
@@ -280,6 +412,10 @@ class WALShipServer:
             DEFAULT_SHIP_QUEUE_BYTES if max_buffered_bytes is None
             else max_buffered_bytes
         )
+        self.heartbeats = bool(heartbeats)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self._metrics = metrics
         self._listener = socket.create_server((host, port))
         # accept() won't reliably wake when another thread closes the
         # listener; poll so close() joins promptly.
@@ -318,18 +454,20 @@ class WALShipServer:
                 continue
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            try:
-                conn = _ShipConn(self, sock, addr)
-            except Exception:
-                logger.exception("ship connection setup failed")
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-                continue
+            conn = _ShipConn(self, sock, addr)
             with self._lock:
                 self._conns.append(conn)
+            try:
+                conn.start()
+            except Exception:
+                logger.exception("ship connection setup failed")
+                conn.close()
+                continue
             logger.info("WAL ship subscriber connected from %s:%s", *addr[:2])
+
+    def _count(self, name: str, value: float = 1.0) -> None:
+        if self._metrics is not None:
+            self._metrics.inc(name, value)
 
     def _forget(self, conn: _ShipConn) -> None:
         with self._lock:
@@ -381,17 +519,27 @@ class ShipFollower:
         replica: FollowerReplica,
         metrics: Optional[Any] = None,
         connect_timeout_s: float = 2.0,
+        heartbeats: bool = True,
+        heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
     ):
         self.host = host
         self.port = port
         self.replica = replica
         self._metrics = metrics
         self.connect_timeout_s = connect_timeout_s
+        self.heartbeats = bool(heartbeats)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.connects = 0
         self.reconnects = 0
         self.frames_applied = 0
         self.frames_rejected = 0
+        self.duplicate_frames = 0
+        self.heartbeat_timeouts = 0
         self.bootstraps = 0
+        #: The delay the NEXT reconnect will wait (gauge-visible: a
+        #: follower stuck at the cap is a flapping link, a follower back
+        #: at base just proved a bootstrap).
+        self.current_backoff_s = 0.0
         self.last_error: Optional[str] = None
         self._stop = threading.Event()
         self._connected = threading.Event()
@@ -405,13 +553,27 @@ class ShipFollower:
         if self._metrics is not None:
             self._metrics.inc(name, value)
 
+    def _set_backoff(self, delay: float) -> None:
+        self.current_backoff_s = delay
+        if self._metrics is not None:
+            self._metrics.set(
+                f'shard_follower_reconnect_backoff_seconds'
+                f'{{port="{self.port}"}}', delay,
+            )
+
     def wait_connected(self, timeout: float = 5.0) -> bool:
         """Block until a connection has delivered its bootstrap."""
         return self._connected.wait(timeout)
 
     def _run(self) -> None:
-        attempt = 0
-        consume_failures = 0
+        # ONE failure ladder for both connect refusals and streams that
+        # die before bootstrapping. It resets only on a *successful*
+        # bootstrap — a TCP accept proves nothing (a gray leader accepts
+        # and serves silence) — so the reset is the first moment the
+        # link demonstrably worked, and the very next flap after a long
+        # outage retries at base instead of dragging the old history's
+        # cap behind it.
+        failures = 0
         while not self._stop.is_set():
             try:
                 sock = socket.create_connection(
@@ -420,16 +582,23 @@ class ShipFollower:
             except OSError as err:
                 self.last_error = str(err)
                 # Bounded exponential backoff, the retry.py policy shape.
-                delay = min(RECONNECT_BASE_S * (2 ** attempt),
+                delay = min(RECONNECT_BASE_S * (2 ** failures),
                             RECONNECT_CAP_S)
-                attempt += 1
+                failures += 1
+                self._set_backoff(delay)
                 if self._stop.wait(delay):
                     return
                 continue
-            sock.settimeout(None)
+            # With heartbeats the leader PINGs every interval, so a
+            # healthy link never goes quiet for the timeout: a read
+            # deadline turns a half-open socket (asymmetric partition,
+            # dropped FIN) into a bounded-time reconnect instead of a
+            # forever-blocked recv with follower lag growing silently.
+            sock.settimeout(
+                self.heartbeat_timeout_s if self.heartbeats else None
+            )
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = sock
-            attempt = 0
             self.connects += 1
             if self.connects > 1:
                 self.reconnects += 1
@@ -449,24 +618,43 @@ class ShipFollower:
                     pass
             if self._stop.is_set():
                 return
-            # A connection that never delivered its bootstrap is a GRAY
-            # leader — it accepts connects but serves nothing. A flat
-            # base wait here redials it in a tight spin; escalate with
-            # the same bounded exponential backoff as connect failures,
-            # reset the moment a stream bootstraps again.
             if self.bootstraps > boots_before:
-                consume_failures = 0
+                failures = 0
             else:
-                consume_failures += 1
-            delay = min(RECONNECT_BASE_S * (2 ** consume_failures),
+                failures += 1
+            delay = min(RECONNECT_BASE_S * (2 ** failures),
                         RECONNECT_CAP_S)
+            self._set_backoff(delay)
             if self._stop.wait(delay):
                 return
 
     def _consume(self, sock: socket.socket) -> None:
+        # Per-connection seq ledger. The leader stamps BOOT=1 and
+        # increments per frame under its send lock; anything else on the
+        # wire is the network lying.
+        last_seq: Optional[int] = None
         while not self._stop.is_set():
             try:
                 frame = read_frame(sock)
+            except socket.timeout:
+                # Read deadline fired with heartbeats on: no frame AND
+                # no PING for the full timeout. The connection is
+                # half-open (the leader's side died, or a one-way
+                # partition ate the s2c direction) — tear it down and
+                # reconnect; the fresh bootstrap makes the drop safe.
+                self.heartbeat_timeouts += 1
+                self._count(
+                    'transport_heartbeat_timeouts_total{side="follower"}'
+                )
+                self.last_error = (
+                    f"no traffic in {self.heartbeat_timeout_s}s "
+                    "(half-open link?)"
+                )
+                logger.warning(
+                    "ship link to %s:%s half-open: %s — reconnecting",
+                    self.host, self.port, self.last_error,
+                )
+                return
             except FrameCorruptError as err:
                 # Damaged in flight (or on the wire-side buffers): no
                 # line of the frame reaches the replica. Drop the
@@ -487,13 +675,66 @@ class ShipFollower:
                 # frame is discarded whole and the next connection
                 # re-bootstraps, so nothing is ever applied partially.
                 return
-            ftype, payload = frame
+            ftype, payload, seq = frame
+            if ftype == FRAME_PING:
+                # Prove the return path: the PONG is the only thing a
+                # one-way (c2s-dead) blackhole cannot fake, so the
+                # leader's timeout fires and both sides converge on a
+                # fresh connection. Replied regardless of our own
+                # heartbeats flag — the leader's policy decides.
+                try:
+                    write_frame(sock, FRAME_PONG, b"")
+                except OSError as err:
+                    self.last_error = str(err)
+                    return
+                continue
             if ftype == FRAME_BOOT:
                 self.replica.resync(decode_bootstrap(payload))
+                last_seq = seq
                 self.bootstraps += 1
                 self._connected.set()
             elif ftype == FRAME_WAL:
+                if last_seq is None:
+                    # WAL before BOOT: the stream start itself was
+                    # reordered. There is no state to apply onto —
+                    # reconnect for a clean bootstrap.
+                    self.frames_rejected += 1
+                    self._count(
+                        'shard_follower_records_rejected_total'
+                        '{reason="seq_gap"}'
+                    )
+                    self.last_error = "WAL frame before bootstrap"
+                    return
+                if seq <= last_seq:
+                    # A lying network replayed a frame that still CRCs
+                    # clean. The seq ledger makes it a counted no-op —
+                    # never a double-apply (I13a's "no write doubled").
+                    self.duplicate_frames += 1
+                    self._count("transport_duplicate_frames_total")
+                    logger.warning(
+                        "duplicate ship frame seq=%d (last=%d): dropped",
+                        seq, last_seq,
+                    )
+                    continue
+                if seq != last_seq + 1:
+                    # A gap means frames were lost or reordered past the
+                    # hold window. Applying across it could skip records
+                    # silently — drop the connection instead; the
+                    # reconnect's bootstrap restores the full prefix, so
+                    # nothing is lost (I13a's "no write lost").
+                    self.frames_rejected += 1
+                    self._count(
+                        'shard_follower_records_rejected_total'
+                        '{reason="seq_gap"}'
+                    )
+                    self.last_error = (
+                        f"ship frame seq gap: got {seq}, "
+                        f"expected {last_seq + 1}"
+                    )
+                    logger.warning("%s — resyncing", self.last_error)
+                    return
                 self.replica.apply_bytes(payload)
+                last_seq = seq
                 self.frames_applied += 1
             else:
                 raise ValueError(f"unknown frame type {ftype!r}")
@@ -519,6 +760,9 @@ class ShipFollower:
             "bootstraps": self.bootstraps,
             "frames_applied": self.frames_applied,
             "frames_rejected": self.frames_rejected,
+            "duplicate_frames": self.duplicate_frames,
+            "heartbeat_timeouts": self.heartbeat_timeouts,
+            "current_backoff_s": self.current_backoff_s,
             "connected": self._connected.is_set(),
             "last_error": self.last_error,
         }
@@ -530,14 +774,25 @@ class ShipFollower:
 
 
 class LeaseFile:
-    """A leader lease as a file: atomic renewal, expiry by wall clock.
+    """A leader lease as a file: atomic renewal, expiry by *observed
+    change* on a monotonic clock.
 
     The process analog of the in-process ``LeaderLease``: the leader
-    renews by rewriting the file (tmp + rename, so a reader never sees a
-    torn lease), a standby polls and treats ``renewed_at + ttl < now``
-    (or a missing file) as leader death. ``generation`` increments on
-    every takeover, so a stale leader that wakes up can detect it lost
-    the lease (it reads a generation it never wrote).
+    renews by rewriting the file (tmp + rename, so a reader never sees
+    a torn lease); a standby polls and declares death when the file's
+    content stops *changing* for a TTL of **monotonic** time. The doc
+    carries an always-incrementing ``beat`` counter, so every renewal
+    changes the bytes even under a frozen wall clock — and the observer
+    anchors each change to ``time.monotonic()``, so an NTP step on
+    either side can neither fake freshness (backwards jump stretching
+    ``now - renewed_at``) nor trigger a spurious failover (forward jump
+    aging a live lease past its TTL). Wall-clock ``renewed_at`` still
+    travels in the doc: it seeds the very first observation (a lease
+    already TTLs-stale on cold boot must read expired immediately) and
+    stays human-readable. The heartbeat cadence itself rides
+    ``Event.wait``, which is monotonic by construction. ``generation``
+    increments on every takeover, so a stale leader that wakes up can
+    detect it lost the lease (it reads a generation it never wrote).
 
     Renewal is read-before-write: a holder that observes a higher
     generation — or a foreign holder at its own generation — has been
@@ -555,6 +810,16 @@ class LeaseFile:
         self.ttl_s = float(ttl_s)
         self.generation = 0
         self._metrics = metrics
+        # Injectable clocks (tests stub these to simulate NTP steps
+        # without sleeping). All TTL math rides _mono; _time only
+        # stamps the doc and seeds the first observation.
+        self._time: Callable[[], float] = time.time
+        self._mono: Callable[[], float] = time.monotonic
+        self._beat = 0
+        #: Observer state: fingerprint of the last lease doc seen and
+        #: the monotonic instant it was first seen.
+        self._obs_fp: Optional[Tuple[Any, ...]] = None
+        self._obs_anchor = 0.0
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
         self._lost_lock = threading.Lock()
@@ -617,12 +882,17 @@ class LeaseFile:
                 return False
             # cur_gen < self.generation: our own acquire() bumped past a
             # stale doc — the write below installs the new epoch.
+        self._beat += 1
         self._write({
             "holder": self.holder,
             "pid": os.getpid(),
-            "renewed_at": time.time(),
+            "renewed_at": self._time(),
             "ttl_s": self.ttl_s,
             "generation": self.generation,
+            # Always-changing: a frozen wall clock must not make two
+            # renewals byte-identical, or the observer would read a
+            # live leader as silent.
+            "beat": self._beat,
         })
         return True
 
@@ -682,13 +952,33 @@ class LeaseFile:
 
     # -- standby side ---------------------------------------------------
 
-    def expired(self, now: Optional[float] = None) -> bool:
+    def expired(self) -> bool:
+        """True when the lease doc stopped changing for a TTL of
+        monotonic time (or the file is missing). The first observation
+        of a given doc seeds its age from wall-clock ``renewed_at`` —
+        so a cold-booting standby reads an hours-dead lease as expired
+        at once — and every observation after that is pure monotonic
+        elapsed-time, immune to NTP steps on the observing host."""
         doc = self.read()
         if doc is None:
             return True
-        now = time.time() if now is None else now
         ttl = float(doc.get("ttl_s") or self.ttl_s)
-        return (now - float(doc.get("renewed_at") or 0.0)) > ttl
+        fp = (
+            doc.get("holder"),
+            doc.get("generation"),
+            doc.get("renewed_at"),
+            doc.get("beat"),
+        )
+        mono_now = self._mono()
+        if fp != self._obs_fp:
+            # The doc changed since we last looked: the holder is
+            # renewing. Anchor this observation; until the next change
+            # the lease ages at one monotonic second per second.
+            self._obs_fp = fp
+            age = max(0.0, self._time() - float(doc.get("renewed_at")
+                                                or 0.0))
+            self._obs_anchor = mono_now - min(age, ttl + 1.0)
+        return (mono_now - self._obs_anchor) > ttl
 
     def _poll_until(self, predicate: Callable[[], bool], poll_s: float,
                     stop: Optional[threading.Event],
@@ -870,6 +1160,71 @@ class CircuitBreaker:
                 "p50_latency_s": lats[len(lats) // 2] if lats else 0.0,
                 "trips": self.trips,
                 "fast_failures": self.fast_failures,
+            }
+
+
+class RetryBudget:
+    """A shared token bucket that caps the *fraction* of traffic that
+    may be retries — the gRPC retry-throttling shape.
+
+    The breaker protects one shard from its own wedge; the budget
+    protects the *survivors* from everyone else's retries. During a
+    partition every request at the dead shard fails and wants a retry;
+    unbounded, those retries (plus WrongShard chases and watch redials)
+    stack into a storm that drags the healthy shards' p99 down with the
+    sick one. The budget makes retry capacity proportional to success:
+    each success refunds ``token_ratio`` tokens (so steady state
+    tolerates ~``token_ratio`` retries per success), each retry spends
+    one, and retries are denied below the half-full line — first-try
+    traffic is never gated, so a healthy shard behind the same router
+    keeps its latency while the partitioned one fails fast.
+
+    One instance is shared across ALL of a router's shards and retry
+    sites (dispatch chases, watch redials, follower-read fallbacks):
+    a storm is a process-wide phenomenon, so the throttle is too."""
+
+    def __init__(self, max_tokens: float = 100.0,
+                 token_ratio: float = 0.1,
+                 metrics: Optional[Any] = None):
+        self.max_tokens = float(max_tokens)
+        self.token_ratio = float(token_ratio)
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._tokens = self.max_tokens
+        self.denied = 0
+        self.granted = 0
+
+    def on_success(self) -> None:
+        with self._lock:
+            self._tokens = min(self.max_tokens,
+                               self._tokens + self.token_ratio)
+
+    def try_retry(self) -> bool:
+        """Spend one token iff the bucket is above half — False means
+        the caller should surface its error instead of retrying."""
+        with self._lock:
+            if self._tokens > self.max_tokens / 2.0:
+                self._tokens -= 1.0
+                self.granted += 1
+                return True
+            self.denied += 1
+        if self._metrics is not None:
+            self._metrics.inc("router_retry_budget_exhausted_total")
+        return False
+
+    @property
+    def depleted(self) -> bool:
+        with self._lock:
+            return self._tokens <= self.max_tokens / 2.0
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "tokens": round(self._tokens, 3),
+                "max_tokens": self.max_tokens,
+                "token_ratio": self.token_ratio,
+                "granted": self.granted,
+                "denied": self.denied,
             }
 
 
@@ -1218,11 +1573,13 @@ class ShardServing:
         lease: Optional[LeaseFile] = None,
         fencing: bool = True,
         tracer: Optional[Any] = None,
+        net_heartbeats: bool = True,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.telemetry import AuditJournal
 
         self.shard_index = int(shard_index)
+        self.net_heartbeats = bool(net_heartbeats)
         self.data_dir = data_dir
         self.sdir = shard_dir(data_dir, self.shard_index)
         os.makedirs(self.sdir, exist_ok=True)
@@ -1285,7 +1642,10 @@ class ShardServing:
             self.store.attach_persistence(self.pers)
             self.recovered = None
 
-        self.ship = WALShipServer(self.pers, host=api_host, port=ship_port)
+        self.ship = WALShipServer(
+            self.pers, host=api_host, port=ship_port,
+            heartbeats=self.net_heartbeats, metrics=metrics,
+        )
         self.lease.start_heartbeat()
         self.audit.record(
             "cluster", "lease_acquired", shard=self.shard_index,
@@ -1445,6 +1805,7 @@ class FollowerReadServer:
         replica: Optional[FollowerReplica] = None,
         follower: Optional[ShipFollower] = None,
         barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
+        net_heartbeats: bool = True,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.telemetry import AuditJournal
@@ -1461,7 +1822,8 @@ class FollowerReadServer:
                 clock, name=f"follower-{self.shard_index}", tracer=tracer
             )
             follower = ShipFollower(
-                leader_host, ship_port, replica, metrics=metrics
+                leader_host, ship_port, replica, metrics=metrics,
+                heartbeats=net_heartbeats,
             )
         self.replica = replica
         self.follower = follower
@@ -1584,8 +1946,10 @@ class StandbyServer:
         serve_reads: bool = False,
         read_port: int = 0,
         barrier_timeout_s: float = DEFAULT_BARRIER_TIMEOUT_S,
+        net_heartbeats: bool = True,
     ):
         self.shard_index = int(shard_index)
+        self.net_heartbeats = bool(net_heartbeats)
         self.data_dir = data_dir
         self.sdir = shard_dir(data_dir, self.shard_index)
         self.leader_host = leader_host
@@ -1615,7 +1979,8 @@ class StandbyServer:
             self.clock, name=f"standby-{self.shard_index}", tracer=tracer
         )
         self.follower = ShipFollower(
-            leader_host, ship_port, self.replica, metrics=metrics
+            leader_host, ship_port, self.replica, metrics=metrics,
+            heartbeats=self.net_heartbeats,
         )
         self.lease = LeaseFile(
             os.path.join(self.sdir, "lease.json"),
@@ -1725,6 +2090,7 @@ class StandbyServer:
             lease=self.lease,
             fencing=self.fencing,
             tracer=self.tracer,
+            net_heartbeats=self.net_heartbeats,
         )
         duration = time.monotonic() - t0
         # The failover as a typed timeline: one cluster event per phase
@@ -1832,6 +2198,8 @@ class RouterServer:
         tracer: Optional[Any] = None,
         read_peers: Optional[List[List[str]]] = None,
         ownership: Optional[Any] = None,
+        retry_budgets: bool = True,
+        retry_budget_kwargs: Optional[Dict[str, Any]] = None,
     ):
         from cron_operator_tpu.runtime.apiserver_http import HTTPAPIServer
         from cron_operator_tpu.runtime.shard import ShardRouter
@@ -1845,6 +2213,14 @@ class RouterServer:
         # The router's own journal holds cluster events it witnesses
         # (breaker flips); /debug/events merges it with every shard's.
         self.audit = AuditJournal(metrics=metrics)
+        # ONE retry budget for the whole front door: dispatch chases,
+        # watch redials and follower-read fallbacks all draw on it, so
+        # a partitioned shard's failures throttle RETRIES process-wide
+        # while first-try traffic to healthy shards flows untouched.
+        self.retry_budget: Optional[RetryBudget] = (
+            RetryBudget(metrics=metrics, **(retry_budget_kwargs or {}))
+            if retry_budgets else None
+        )
         # Per shard: a ShardClient, or its FollowerReadClient wrapper
         # when the shard has read peers (same surface either way).
         self.clients: List[Any] = []
@@ -1858,6 +2234,8 @@ class RouterServer:
                 request_timeout_s=request_timeout_s,
                 metrics=metrics,
             )
+            # Consulted by the cluster watch loop's redial backoff.
+            client.retry_budget = self.retry_budget
             if client.breaker is not None:
                 client.breaker.on_transition = (
                     lambda old, new, s=i: self.audit.record(
@@ -1884,6 +2262,7 @@ class RouterServer:
                     ))
                 client = FollowerReadClient(
                     client, fclients, shard=i, metrics=metrics,
+                    retry_budget=self.retry_budget,
                 )
             self.clients.append(client)
         # ownership: a keyspace OwnershipMap loaded from the data dir's
@@ -1891,7 +2270,8 @@ class RouterServer:
         # through splits (the boot map only routes the boot-time
         # modulo layout). Default: epoch-0 boot map over the peers.
         self.router = ShardRouter(
-            self.clients, ownership=ownership, metrics=metrics
+            self.clients, ownership=ownership, metrics=metrics,
+            retry_budget=self.retry_budget,
         )
         routes: Dict[str, Any] = {
             "/debug/shards": self.debug_shards,
@@ -1984,6 +2364,8 @@ class RouterServer:
             "router": {
                 "wrong_shard_retries": self.router.wrong_shard_retries,
                 "probe_fallbacks": self.router.probe_fallbacks,
+                "retry_budget": (self.retry_budget.stats()
+                                 if self.retry_budget is not None else None),
             },
             "shards": shards,
         }
@@ -2068,9 +2450,13 @@ class RouterServer:
 __all__ = [
     "FRAME_WAL",
     "FRAME_BOOT",
+    "FRAME_PING",
+    "FRAME_PONG",
     "MAX_FRAME_BYTES",
     "RECONNECT_BASE_S",
     "RECONNECT_CAP_S",
+    "HEARTBEAT_INTERVAL_S",
+    "HEARTBEAT_TIMEOUT_S",
     "write_frame",
     "read_frame",
     "encode_bootstrap",
@@ -2079,6 +2465,7 @@ __all__ = [
     "ShipFollower",
     "LeaseFile",
     "CircuitBreaker",
+    "RetryBudget",
     "BREAKER_CLOSED",
     "BREAKER_OPEN",
     "BREAKER_HALF_OPEN",

@@ -5012,6 +5012,595 @@ def check_disk_invariants(ev: dict) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# partition leg (--partition): lying networks, invariant I13
+# ---------------------------------------------------------------------------
+
+#: One scheduled partition must detect + heal within this wall bound —
+#: generous against CI jitter; the measured numbers land in CHAOS.json.
+PARTITION_HEAL_BOUND_S = 20.0
+#: Ship-link heartbeat cadence for the soak (tight so half-open windows
+#: are detected in hundreds of ms, not the production 5 s).
+NET_HB_INTERVAL_S = 0.1
+NET_HB_TIMEOUT_S = 0.6
+
+
+def _net_obj(tag: str, i: int) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": f"net-{tag}-{i}", "namespace": NAMESPACE},
+        "data": {"tag": tag, "seq": i, "payload": 2000000 + i},
+    }
+
+
+def _run_ship_leg(inj, metrics, rounds: int, net_heartbeats: bool) -> dict:
+    """Scenario A: one leader's WAL ship stream through a lying link.
+
+    A seeded :class:`LinkPlan` keeps delay/duplicate/reorder/slow-drip/
+    RST faults flowing on EVERY frame window, while ``inj.schedule``
+    expands the deterministic partition storm: each round writes acked
+    objects, goes dark in a PRF-chosen direction, keeps writing into
+    the darkness, heals, and measures time-to-reconverge.  The acked
+    ledger is carried to the end for the I13a book check (the exact
+    I12a check, aimed at the replica instead of a recovered store).
+
+    With ``net_heartbeats=False`` this is the counter-proof: the first
+    s2c/both window wedges the follower's blocking recv forever — no
+    deadline, no PING to miss — and the evidence records the silently
+    growing lag instead of a heal time."""
+    from cron_operator_tpu.runtime.kube import APIServer
+    from cron_operator_tpu.runtime.netfaults import LinkPlan
+    from cron_operator_tpu.runtime.persistence import Persistence
+    from cron_operator_tpu.runtime.shard import (
+        FollowerReplica,
+        canonical_state,
+    )
+    from cron_operator_tpu.runtime.transport import (
+        ShipFollower,
+        WALShipServer,
+    )
+    from cron_operator_tpu.utils.clock import FakeClock, RealClock
+
+    data_dir = tempfile.mkdtemp(prefix="chaos-net-ship-")
+    store = APIServer(clock=FakeClock())
+    pers = Persistence(data_dir, fsync_every=1)
+    pers.start(store)
+    server = WALShipServer(
+        pers,
+        heartbeats=net_heartbeats,
+        heartbeat_interval_s=NET_HB_INTERVAL_S,
+        heartbeat_timeout_s=NET_HB_TIMEOUT_S,
+        metrics=metrics,
+    )
+    plan = LinkPlan(
+        p_delay=0.05, p_duplicate=0.08, p_reorder=0.04, p_slowdrip=0.04,
+        p_rst=0.02, delay_s=0.01, drip_bytes=16, drip_pause_s=0.0005,
+    )
+    proxy = inj.proxy("ship", "127.0.0.1", server.port, framed=True,
+                      plan=plan)
+    replica = FollowerReplica(RealClock(), name="partition-soak")
+    follower = ShipFollower(
+        "127.0.0.1", proxy.port, replica, metrics=metrics,
+        heartbeats=net_heartbeats, heartbeat_timeout_s=NET_HB_TIMEOUT_S,
+    )
+
+    acked: dict = {}
+    seq = [0]
+
+    def _write(n: int) -> None:
+        for _ in range(n):
+            obj = store.create(_net_obj("ship", seq[0]))
+            acked[obj["metadata"]["name"]] = _canon(obj)
+            seq[0] += 1
+        pers.flush()
+
+    def _leader_state() -> str:
+        return canonical_state(store.all_objects(), store._rv)
+
+    def _converged() -> bool:
+        return replica.state() == _leader_state()
+
+    def _wait_converged(timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if _converged():
+                return True
+            time.sleep(0.02)
+        return _converged()
+
+    ev: dict = {
+        "rounds": [],
+        "acked_total": 0,
+        "plan": asdict(plan),
+        "connected": False,
+    }
+    try:
+        ev["connected"] = follower.wait_connected(10.0)
+        sched = inj.schedule(rounds, ["ship"])
+        for entry in sched:
+            reconnects0 = follower.reconnects
+            hb0 = follower.heartbeat_timeouts + int(
+                metrics.counters.get(
+                    'transport_heartbeat_timeouts_total{side="leader"}', 0
+                )
+            )
+            _write(15)
+            inj.partition("ship", entry["direction"])
+            _write(10)  # acked into the darkness — must survive the heal
+            time.sleep(entry["hold_s"])
+            inj.heal("ship")
+            t0 = time.monotonic()
+            if not net_heartbeats and entry["direction"] in ("s2c", "both"):
+                # Counter-proof: the darkened conn is sticky and nothing
+                # wakes the blocking recv — give the system a window a
+                # heartbeat stack would have healed in, then record the
+                # wedge instead of a heal.
+                time.sleep(NET_HB_TIMEOUT_S * 3 + 2.0)
+                _write(5)
+                time.sleep(0.5)
+                ev["wedge"] = {
+                    "round": entry["round"],
+                    "direction": entry["direction"],
+                    "converged": _converged(),
+                    "reconnects_after_heal":
+                        follower.reconnects - reconnects0,
+                    "heartbeat_timeouts": follower.heartbeat_timeouts,
+                    "replica_lag": len(acked) - len(replica.store),
+                    # Wedged = still diverged after a window a heartbeat
+                    # stack heals in <1 s.  (Reconnect COUNT is evidence,
+                    # not the gate: a plan-injected reorder/RST can
+                    # legally resync once, but the conn that then went
+                    # dark stays half-open forever.)
+                    "wedged": not _converged(),
+                }
+                break
+            healed = _wait_converged(PARTITION_HEAL_BOUND_S)
+            ev["rounds"].append({
+                "round": entry["round"],
+                "direction": entry["direction"],
+                "hold_s": round(entry["hold_s"], 3),
+                "healed": healed,
+                "heal_s": round(time.monotonic() - t0, 3),
+                "reconnects_delta": follower.reconnects - reconnects0,
+                "heartbeat_timeouts_delta":
+                    follower.heartbeat_timeouts + int(
+                        metrics.counters.get(
+                            'transport_heartbeat_timeouts_total'
+                            '{side="leader"}', 0
+                        )
+                    ) - hb0,
+            })
+        if not net_heartbeats and "wedge" not in ev:
+            # The seeded schedule drew only c2s windows — force the one
+            # direction the counter-proof is about (a half-open conn the
+            # follower is blocked reading) so the violation is
+            # deterministic for any seed.
+            reconnects0 = follower.reconnects
+            inj.partition("ship", "s2c")
+            _write(10)
+            time.sleep(0.5)
+            inj.heal("ship")
+            time.sleep(NET_HB_TIMEOUT_S * 3 + 2.0)
+            _write(5)
+            time.sleep(0.5)
+            ev["wedge"] = {
+                "round": "forced-s2c",
+                "direction": "s2c",
+                "converged": _converged(),
+                "reconnects_after_heal": follower.reconnects - reconnects0,
+                "heartbeat_timeouts": follower.heartbeat_timeouts,
+                "replica_lag": len(acked) - len(replica.store),
+                "wedged": not _converged(),
+            }
+        ev["acked_total"] = len(acked)
+        if net_heartbeats:
+            ev["final_converged"] = _wait_converged(PARTITION_HEAL_BOUND_S)
+            ev["book_check"] = _disk_book_check(replica.store, acked)
+        ev["follower"] = {
+            "reconnects": follower.reconnects,
+            "bootstraps": follower.bootstraps,
+            "heartbeat_timeouts": follower.heartbeat_timeouts,
+            "duplicate_frames": follower.duplicate_frames,
+            "frames_rejected": follower.frames_rejected,
+        }
+    finally:
+        follower.stop()
+        server.close()
+        pers.close()
+        shutil.rmtree(data_dir, ignore_errors=True)
+    return ev
+
+
+def _run_lease_leg(inj, metrics) -> dict:
+    """Scenario B — the nastiest interaction: the leader is socket-
+    partitioned from the ROUTER, but its lease heartbeat rides the
+    local shard dir, which the partition cannot touch.  The standby
+    (whose ship stream is also untouched) must NOT promote — the
+    generation stays put — while the router's breaker converts the
+    partition into fast failures instead of a timeout storm.  Healing
+    the link restores writes with no operator action, and the WAL scan
+    proves I10 (zero stale-generation bytes) held throughout."""
+    from cron_operator_tpu.runtime.transport import (
+        BREAKER_OPEN,
+        RouterServer,
+        ShardClient,
+        ShardServing,
+        StandbyServer,
+    )
+
+    data_dir = tempfile.mkdtemp(prefix="chaos-net-lease-")
+    request_timeout_s = 1.0
+    serving = ShardServing(0, data_dir=data_dir, lease_ttl_s=1.0,
+                           metrics=metrics)
+    standby = StandbyServer(
+        0, data_dir=data_dir, ship_port=serving.ship_port,
+        api_port=serving.api_port, lease_ttl_s=1.0,
+        promote_api_port=0, promote_ship_port=0, metrics=metrics,
+    )
+    stop = threading.Event()
+    standby_thread = threading.Thread(
+        target=standby.run, args=(stop,), daemon=True
+    )
+    standby_thread.start()
+    proxy = inj.proxy("api", "127.0.0.1", serving.api_port)
+    router = RouterServer(
+        peers=[f"127.0.0.1:{proxy.port}"], metrics=metrics,
+        request_timeout_s=request_timeout_s,
+        breaker_kwargs={"window": 8, "min_samples": 2,
+                        "error_threshold": 0.5, "cooldown_s": 0.5},
+    )
+    front = ShardClient(f"http://127.0.0.1:{router.port}")
+
+    ev: dict = {}
+    try:
+        for i in range(5):
+            front.create(_net_obj("lease-base", i))
+        gen_before = serving.lease.generation
+        inj.partition("api", "both")
+        t_dark = time.monotonic()
+        attempts = []
+        for i in range(8):
+            t0 = time.monotonic()
+            try:
+                front.create(_net_obj("lease-dark", i))
+                ok = True
+            except Exception:  # noqa: BLE001 — the partition IS the test
+                ok = False
+            attempts.append({"ok": ok,
+                             "latency_s": round(time.monotonic() - t0, 3)})
+        breaker = router.clients[0].breaker
+        ev["breaker_open_during"] = breaker.state == BREAKER_OPEN
+        ev["breaker_fast_failures"] = breaker.fast_failures
+        # Fast-fail once tripped: the rolling window starts with the
+        # baseline successes, so the trip lands a few timeouts in — but
+        # the LAST attempts must all refuse without paying the timeout.
+        tail = attempts[-3:]
+        ev["fast_fail_ok"] = (
+            breaker.fast_failures > 0
+            and all(a["latency_s"] < request_timeout_s / 2 for a in tail)
+        )
+        # Hold the partition past three full lease TTLs measured from
+        # darkness onset — the false-failover window.
+        time.sleep(max(0.0, 3.5 - (time.monotonic() - t_dark)))
+        ev["dark_attempts"] = attempts
+        ev["promoted_during_partition"] = standby.serving is not None
+        ev["generation_before"] = gen_before
+        ev["generation_during"] = serving.lease.generation
+        inj.heal("api")
+        t0 = time.monotonic()
+        healed = False
+        while time.monotonic() - t0 < PARTITION_HEAL_BOUND_S:
+            try:
+                front.create(_net_obj("lease-heal", int(t0)))
+                healed = True
+                break
+            except Exception:  # noqa: BLE001 — breaker still cooling
+                time.sleep(0.1)
+        ev["healed_without_operator"] = healed
+        ev["heal_s"] = round(time.monotonic() - t0, 3)
+        ev["promoted_after_heal"] = standby.serving is not None
+        ev["generation_after"] = serving.lease.generation
+        ev["audit_check"] = serving.audit_check()
+        ev["wal_scan"] = _scan_stale_generations(serving.sdir)
+        ev["retry_budget_denials"] = int(
+            metrics.counters.get("router_retry_budget_exhausted_total", 0)
+        )
+    finally:
+        stop.set()
+        router.close()
+        standby.follower.stop()
+        standby_thread.join(timeout=5.0)
+        if standby.serving is not None:
+            standby.serving.close(write_report=False)
+        serving.close(write_report=False)
+        shutil.rmtree(data_dir, ignore_errors=True)
+    return ev
+
+
+def _run_budget_leg(inj, metrics) -> dict:
+    """Scenario C — retry-storm containment: two shards behind the
+    router, one partitioned, a storm of writes aimed at the dark shard.
+    The breaker + shared retry budget must keep the HEALTHY shard's
+    write p99 within 1.2x its pre-partition baseline (absolute floor
+    50 ms so an in-process microbenchmark blip can't flake the gate)."""
+    from cron_operator_tpu.runtime.transport import (
+        RouterServer,
+        ShardClient,
+        ShardServing,
+    )
+
+    dirs = [tempfile.mkdtemp(prefix=f"chaos-net-budget{i}-")
+            for i in range(2)]
+    servings = [ShardServing(i, data_dir=dirs[i], metrics=metrics)
+                for i in range(2)]
+    proxy = inj.proxy("shard0", "127.0.0.1", servings[0].api_port)
+    router = RouterServer(
+        peers=[f"127.0.0.1:{proxy.port}",
+               f"127.0.0.1:{servings[1].api_port}"],
+        metrics=metrics,
+        request_timeout_s=0.5,
+        breaker_kwargs={"window": 8, "min_samples": 2,
+                        "error_threshold": 0.5, "cooldown_s": 0.5},
+        retry_budget_kwargs={"max_tokens": 4.0, "token_ratio": 0.1},
+    )
+    front = ShardClient(f"http://127.0.0.1:{router.port}")
+    shard_of = router.router.shard_for
+
+    healthy, victim = [], []
+    i = 0
+    while len(healthy) < 160 or len(victim) < 60:
+        name = f"net-budget-{i}"
+        (healthy if shard_of(NAMESPACE, name) == 1 else victim).append(name)
+        i += 1
+
+    def _create(name: str) -> float:
+        t0 = time.monotonic()
+        front.create({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": NAMESPACE},
+            "data": {"leg": "budget"},
+        })
+        return time.monotonic() - t0
+
+    def _p99(samples) -> float:
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(round(0.99 * (len(s) - 1))))]
+
+    ev: dict = {}
+    storm_stop = threading.Event()
+
+    def _storm() -> None:
+        # Wraps around the victim pool: once the breaker trips the
+        # refusals are ~free, so the storm keeps hammering the dark
+        # shard for the WHOLE measurement window (duplicate names after
+        # the wrap still exercise allow()).
+        j = 0
+        while not storm_stop.is_set():
+            try:
+                _create(victim[j % len(victim)])
+            except Exception:  # noqa: BLE001 — the dark shard IS dark
+                pass
+            j += 1
+
+    try:
+        base = [_create(n) for n in healthy[:70]]
+        denials0 = int(
+            metrics.counters.get("router_retry_budget_exhausted_total", 0)
+        )
+        breaker = router.clients[0].breaker
+        inj.partition("shard0", "both")
+        storm = threading.Thread(target=_storm, daemon=True)
+        storm.start()
+        # The rolling window opens with baseline-era successes, so the
+        # trip costs a handful of request timeouts — wait for it, THEN
+        # measure the healthy shard under a tripped-breaker storm.
+        deadline = time.monotonic() + 15.0
+        while breaker.trips == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        during = [_create(n) for n in healthy[70:140]]
+        # The storm's cooldown probes legally flip OPEN -> HALF_OPEN and
+        # back, so gate on the trip having happened, not a state
+        # snapshot.
+        ev["victim_breaker_open"] = breaker.trips >= 1
+        ev["victim_breaker_trips"] = breaker.trips
+        storm_stop.set()
+        storm.join(timeout=10.0)
+        inj.heal("shard0")
+        k = 0
+        while shard_of(NAMESPACE, f"net-budget-heal-{k}") != 0:
+            k += 1
+        t0 = time.monotonic()
+        healed = False
+        while time.monotonic() - t0 < PARTITION_HEAL_BOUND_S:
+            try:
+                _create(f"net-budget-heal-{k}")
+                healed = True
+                break
+            except Exception:  # noqa: BLE001 — breaker still cooling
+                time.sleep(0.1)
+        ev["victim_healed"] = healed
+        ev["victim_heal_s"] = round(time.monotonic() - t0, 3)
+        p99_base, p99_during = _p99(base), _p99(during)
+        ev["p99_baseline_s"] = round(p99_base, 4)
+        ev["p99_during_partition_s"] = round(p99_during, 4)
+        ev["p99_bound_s"] = round(max(1.2 * p99_base, 0.05), 4)
+        ev["p99_contained"] = p99_during <= max(1.2 * p99_base, 0.05)
+        ev["retry_budget_denials_delta"] = int(
+            metrics.counters.get("router_retry_budget_exhausted_total", 0)
+        ) - denials0
+        ev["retry_budget_depleted"] = bool(
+            router.retry_budget is not None and router.retry_budget.depleted
+        )
+    finally:
+        storm_stop.set()
+        router.close()
+        for s in servings:
+            s.close(write_report=False)
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    return ev
+
+
+def run_partition_soak(seed: int, rounds: int,
+                       net_heartbeats: bool = True) -> dict:
+    """The I13 partition soak: three in-process legs against one seeded
+    :class:`NetworkFaultInjector` (ship stream under a lying link; the
+    router-partitioned-but-lease-fresh leader; the retry-storm p99
+    gate).  ``net_heartbeats=False`` runs only the ship leg and records
+    the half-open wedge the heartbeat stack exists to prevent."""
+    from cron_operator_tpu.runtime.manager import Metrics
+    from cron_operator_tpu.runtime.netfaults import NetworkFaultInjector
+
+    metrics = Metrics()
+    inj = NetworkFaultInjector(seed, metrics=metrics)
+    ev: dict = {"net_heartbeats": net_heartbeats, "seed": seed}
+    try:
+        ev["ship_leg"] = _run_ship_leg(inj, metrics, rounds, net_heartbeats)
+        if "wedge" in ev["ship_leg"]:
+            ev["wedge"] = ev["ship_leg"]["wedge"]
+        if net_heartbeats:
+            ev["lease_leg"] = _run_lease_leg(inj, metrics)
+            ev["budget_leg"] = _run_budget_leg(inj, metrics)
+        ev["injector"] = inj.stats()
+        ev["metrics"] = {
+            k: v for k, v in sorted(metrics.counters.items())
+            if k.startswith(("net_faults_injected_total",
+                             "transport_heartbeat_timeouts_total",
+                             "transport_duplicate_frames_total",
+                             "router_retry_budget_exhausted_total",
+                             "shard_follower_reconnects_total"))
+        }
+    finally:
+        inj.close()
+    return ev
+
+
+def check_partition_invariants(ev: dict) -> dict:
+    """I13 verdicts over one ``run_partition_soak`` evidence dict."""
+    ship = ev.get("ship_leg") or {}
+    lease = ev.get("lease_leg") or {}
+    budget = ev.get("budget_leg") or {}
+
+    book = ship.get("book_check") or {}
+    fol = ship.get("follower") or {}
+    inj = ev.get("injector") or {}
+    injected = inj.get("injected") or {}
+    a_ok = (
+        bool(ship.get("connected"))
+        and bool(ship.get("final_converged"))
+        and bool(book.get("ok"))
+        and fol.get("duplicate_frames", 0) > 0
+        and injected.get("duplicate", 0) > 0
+    )
+    i13a = {
+        "ok": a_ok,
+        "detail": (
+            f"{ship.get('acked_total')} acked writes (many into dark "
+            f"windows) all present exactly once on the replica; "
+            f"{fol.get('duplicate_frames')} duplicated frames absorbed "
+            f"as counted no-ops, {fol.get('frames_rejected')} frames "
+            f"rejected, injector landed {dict(injected)}" if a_ok
+            else {"connected": ship.get("connected"),
+                  "final_converged": ship.get("final_converged"),
+                  "book_check": book, "follower": fol,
+                  "injected": dict(injected)}
+        ),
+    }
+
+    gen_stable = (
+        lease.get("generation_before") is not None
+        and lease.get("generation_before")
+        == lease.get("generation_during")
+        == lease.get("generation_after")
+    )
+    wal_scan = lease.get("wal_scan") or {}
+    audit = lease.get("audit_check") or {}
+    b_ok = (
+        not lease.get("promoted_during_partition", True)
+        and not lease.get("promoted_after_heal", True)
+        and gen_stable
+        and bool(lease.get("breaker_open_during"))
+        and bool(lease.get("fast_fail_ok"))
+        and bool(lease.get("healed_without_operator"))
+        and wal_scan.get("stale_records", 1) == 0
+        and bool(audit.get("ok"))
+    )
+    i13b = {
+        "ok": b_ok,
+        "detail": (
+            f"leader partitioned from the router for >3 lease TTLs: "
+            f"standby never promoted, generation pinned at "
+            f"{lease.get('generation_after')}, breaker failed fast "
+            f"({lease.get('breaker_fast_failures')} refusals), link "
+            f"healed in {lease.get('heal_s')}s with no operator action; "
+            f"audit≡WAL ok, {wal_scan.get('stale_records')} "
+            f"stale-generation records" if b_ok
+            else {k: lease.get(k) for k in
+                  ("promoted_during_partition", "promoted_after_heal",
+                   "generation_before", "generation_during",
+                   "generation_after", "breaker_open_during",
+                   "fast_fail_ok", "healed_without_operator",
+                   "heal_s", "wal_scan", "audit_check")}
+        ),
+    }
+
+    rounds = ship.get("rounds") or []
+    heal_times = [r["heal_s"] for r in rounds]
+    detected = sum(
+        r["reconnects_delta"] + r["heartbeat_timeouts_delta"]
+        for r in rounds
+    )
+    c_ok = (
+        bool(rounds)
+        and all(r["healed"] for r in rounds)
+        and max(heal_times, default=PARTITION_HEAL_BOUND_S)
+        <= PARTITION_HEAL_BOUND_S
+        and detected > 0
+        and bool(lease.get("healed_without_operator"))
+        and lease.get("heal_s", PARTITION_HEAL_BOUND_S + 1)
+        <= PARTITION_HEAL_BOUND_S
+    )
+    i13c = {
+        "ok": c_ok,
+        "detail": (
+            f"all {len(rounds)} scheduled partitions "
+            f"({[r['direction'] for r in rounds]}) detected "
+            f"({detected} reconnects/heartbeat-timeouts) and healed; "
+            f"heal times {heal_times}s, max "
+            f"{max(heal_times, default=0)}s <= "
+            f"{PARTITION_HEAL_BOUND_S}s bound" if c_ok
+            else {"rounds": rounds, "detected": detected,
+                  "lease_heal_s": lease.get("heal_s")}
+        ),
+    }
+
+    d_ok = (
+        bool(budget.get("p99_contained"))
+        and bool(budget.get("victim_breaker_open"))
+        and bool(budget.get("victim_healed"))
+    )
+    i13d = {
+        "ok": d_ok,
+        "detail": (
+            f"healthy-shard write p99 {budget.get('p99_during_partition_s')}s "
+            f"during the storm vs {budget.get('p99_baseline_s')}s baseline "
+            f"(bound {budget.get('p99_bound_s')}s); victim breaker open "
+            f"({budget.get('victim_breaker_trips')} trip(s)), "
+            f"{budget.get('retry_budget_denials_delta')} retry-budget "
+            f"denial(s), victim healed in {budget.get('victim_heal_s')}s"
+            if d_ok
+            else dict(budget)
+        ),
+    }
+    return {
+        "I13a_no_acked_write_lost_or_doubled": i13a,
+        "I13b_partition_cannot_cause_false_failover": i13b,
+        "I13c_detection_and_heal_bounded": i13c,
+        "I13d_retry_storm_contained": i13d,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=0)
@@ -5129,6 +5718,25 @@ def main(argv=None) -> int:
                          "the I12 counter-proof: the same seeded "
                          "bit-flip applies silently (use with "
                          "--expect-violation)")
+    ap.add_argument("--partition", action="store_true", default=False,
+                    help="run ONLY the lying-network leg: seeded "
+                         "in-process socket proxies inject one-way "
+                         "blackholes, delay, reordering, duplicates, "
+                         "slow-drip partial frames and mid-stream RSTs "
+                         "on every transport seam — no acked write lost "
+                         "or doubled, a router-partitioned leader with "
+                         "a fresh lease never false-fails-over, every "
+                         "partition detects and heals within a bound, "
+                         "and a retry storm at a dark shard leaves the "
+                         "healthy shard's p99 intact (invariant I13)")
+    ap.add_argument("--no-net-heartbeats", action="store_true",
+                    default=False,
+                    help="run the partition leg WITHOUT app-level "
+                         "ping/pong heartbeats or read deadlines — the "
+                         "I13 counter-proof: a one-way s2c blackhole "
+                         "wedges the ship connection half-open and the "
+                         "follower's lag grows silently (use with "
+                         "--expect-violation)")
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "CHAOS.json"))
     args = ap.parse_args(argv)
 
@@ -5219,6 +5827,87 @@ def main(argv=None) -> int:
                     and existing.get("mode") != "disk"
                     and "invariants" in existing):
                 existing["disk"] = report
+                existing["ok"] = bool(existing.get("ok")) and ok
+                out_doc = existing
+        except (OSError, ValueError):
+            pass
+        with open(args.out, "w") as f:
+            json.dump(out_doc, f, indent=2, default=str)
+            f.write("\n")
+        for name, v in invariants.items():
+            mark = "PASS" if v["ok"] else "FAIL"
+            print(f"  [{mark}] {name}: {v['detail']}")
+        print(f"wrote {args.out} (ok={ok})")
+        return 0 if ok else 1
+
+    if args.partition:
+        hb = not args.no_net_heartbeats
+        rounds = max(4, min(args.rounds, 8))  # bounded wall time per run
+        mode = ("partition" if hb
+                else "partition counter-proof (net heartbeats OFF)")
+        print(
+            f"chaos soak ({mode}): seed={args.seed} rounds={rounds} — "
+            "one-way blackholes, delay, reorder, duplicates, slow-drip, "
+            "RSTs through in-process socket proxies",
+            flush=True,
+        )
+        ev = run_partition_soak(args.seed, rounds, net_heartbeats=hb)
+        if not hb:
+            wedge = ev.get("wedge") or {}
+            violated = bool(wedge.get("wedged"))
+            report = {
+                "seed": args.seed,
+                "mode": "partition-no-heartbeats",
+                "rounds": rounds,
+                "partition_leg": ev,
+                "wedge": wedge,
+                "violation_observed": violated,
+                "ok": not violated,
+            }
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+                f.write("\n")
+            print(
+                f"  half-open wedge: round={wedge.get('round')} "
+                f"direction={wedge.get('direction')} "
+                f"reconnects={wedge.get('reconnects_after_heal')} "
+                f"replica_lag={wedge.get('replica_lag')} "
+                f"converged={wedge.get('converged')}"
+            )
+            print(f"wrote {args.out}")
+            if args.expect_violation:
+                if violated:
+                    print("expected violation observed (I13c) — without "
+                          "heartbeats/read deadlines the one-way "
+                          "blackhole left the ship connection half-open "
+                          "FOREVER: the follower never re-dialed after "
+                          "the heal and its lag grew silently")
+                    return 0
+                print("ERROR: expected a half-open wedge but the "
+                      "follower detected the partition anyway")
+                return 1
+            return 0 if not violated else 1
+        invariants = check_partition_invariants(ev)
+        ok = all(v["ok"] for v in invariants.values())
+        report = {
+            "seed": args.seed,
+            "mode": "partition",
+            "rounds": rounds,
+            "partition_leg": ev,
+            "invariants": invariants,
+            "ok": ok,
+        }
+        # Fold into an existing CHAOS.json from another leg (the
+        # disk/processes/gray-leg idiom) so one report carries every
+        # proof.
+        out_doc = report
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+            if (isinstance(existing, dict)
+                    and existing.get("mode") != "partition"
+                    and "invariants" in existing):
+                existing["partition"] = report
                 existing["ok"] = bool(existing.get("ok")) and ok
                 out_doc = existing
         except (OSError, ValueError):

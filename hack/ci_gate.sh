@@ -285,6 +285,28 @@ echo "==> checksum counter-proof (same bit-flip, CRCs off -> I12a must break)"
 python hack/chaos_soak.py --disk --no-checksums --seed 42 --rounds 6 \
     --expect-violation --out /dev/null
 
+echo "==> partition smoke (lying network: blackholes, dup/reorder, half-open)"
+# Fixed-seed partition soak: seeded socket proxies on every transport
+# seam inject one-way blackholes, delay, reordering, duplicated frames,
+# slow-drip partial frames and mid-stream RSTs. I13a: no acked write
+# lost or doubled across dark windows (ship-stream book check). I13b: a
+# leader partitioned from the router but lease-fresh never
+# false-fails-over (generation pinned, breaker fails fast, zero
+# stale-generation bytes). I13c: every partition detected by the
+# ping/pong heartbeats and healed within the bound. I13d: a retry storm
+# at a dark shard leaves the healthy shard's write p99 within 1.2x
+# baseline. Full run: make chaos-soak-partition (folds into CHAOS.json).
+python hack/chaos_soak.py --partition --seed 42 --rounds 4 --out /dev/null
+
+echo "==> heartbeat counter-proof (same blackhole, heartbeats off -> wedge)"
+# The same seeded one-way blackhole with app-level heartbeats and read
+# deadlines OFF: the ship connection must wedge half-open FOREVER (the
+# follower never re-dials, its lag grows silently) — proves the I13c
+# PASS above detects the gray failure the heartbeat stack exists to
+# catch, i.e. not vacuous.
+python hack/chaos_soak.py --partition --no-net-heartbeats --seed 42 \
+    --rounds 4 --expect-violation --out /dev/null
+
 echo "==> metric registry drift (every emitted family declared + typed)"
 # Explicit run of the registry drift guard: scans every metrics.inc/
 # observe/set call site AND interned-series assignment in the package,

@@ -466,6 +466,65 @@ class TestMissedRunCatchup:
         assert len(list_jobs(api)) == 2
 
 
+class TestClockJumpSafety:
+    """Satellite (PR 20): a backwards wall-clock step (NTP step, VM
+    migration) must not double-fire a tick this process already fired —
+    even when the status write that would prove the fire was also lost.
+    The monotonic-anchored last-fire guard detects the jump, suppresses
+    the re-fire, and counts it exactly once per jump."""
+
+    def test_backward_jump_suppresses_refire(self, api, fake_clock):
+        from cron_operator_tpu.runtime.manager import Metrics
+        metrics = Metrics()
+        r = CronReconciler(api, metrics=metrics)
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        r.reconcile("default", "demo")
+        jobs = list_jobs(api)
+        assert len(jobs) == 1
+
+        # Kill both wall-clock breadcrumbs that normally prevent the
+        # double fire: the created workload (AlreadyExists collision)
+        # and lastScheduleTime (regressed, as if the status write was
+        # lost in a fail-over).
+        api.delete(JAX_AV, JAX_KIND, "default",
+                   jobs[0]["metadata"]["name"])
+        api.patch_status(API_VERSION, KIND_CRON, "default", "demo",
+                         {"lastScheduleTime": "2026-01-01T00:00:00Z"})
+        # The wall clock steps 30s backwards; monotonic time (real, in
+        # this process) keeps running. The tick at T0+1min now looks
+        # missed again.
+        fake_clock.advance(-timedelta(seconds=30))
+        r.reconcile("default", "demo")
+        assert list_jobs(api) == []  # no second workload
+        assert metrics.counters.get("cron_clock_jumps_total") == 1
+        assert len(api.events(reason="ClockJump")) == 1
+
+        # Counted once per jump, not once per reconcile.
+        r.reconcile("default", "demo")
+        assert metrics.counters.get("cron_clock_jumps_total") == 1
+
+        # Once wall time catches back up past the fire, fresh ticks
+        # fire normally — the guard never wedges the schedule.
+        fake_clock.advance(timedelta(minutes=3))
+        r.reconcile("default", "demo")
+        assert len(list_jobs(api)) == 1
+
+    def test_forward_catchup_is_not_a_jump(self, api, fake_clock):
+        from cron_operator_tpu.runtime.manager import Metrics
+        metrics = Metrics()
+        r = CronReconciler(api, metrics=metrics)
+        make_cron(api)
+        fake_clock.advance(timedelta(minutes=2))
+        r.reconcile("default", "demo")
+        # Plain forward progress (even a big leap: the TooManyMissed
+        # path) must not count as a clock jump.
+        fake_clock.advance(timedelta(hours=3))
+        r.reconcile("default", "demo")
+        assert metrics.counters.get("cron_clock_jumps_total") is None
+        assert len(list_jobs(api)) == 2
+
+
 class TestMalformedStatus:
     def test_malformed_status_workload_skipped(self, api, fake_clock, reconciler):
         """A workload whose status fails conversion is skipped entirely —

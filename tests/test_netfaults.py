@@ -1,0 +1,416 @@
+"""Lying-network injection (runtime/netfaults.py) and the transport
+hardening that survives it (PR 20's tentpole).
+
+Two halves:
+
+- the injector itself: PRF determinism (one seed → one schedule),
+  transparent relay when no faults are planned, and each fault kind
+  doing what the label says (duplicate, slow-drip, RST, partition);
+- the transport under the injector: duplicated frames become counted
+  no-ops (the seq ledger), slow-dripped frames reassemble whole, a
+  one-way blackhole is detected within the heartbeat timeout on
+  whichever side went deaf — and WITHOUT heartbeats the same blackhole
+  wedges the link silently, which is the counter-proof the chaos soak
+  automates.
+"""
+
+import shutil
+import socket
+import tempfile
+import threading
+import time
+import unittest
+
+from cron_operator_tpu.runtime.faults import (
+    NET_FAULT_KINDS,
+    LinkPlan,
+    NetworkFaultInjector,
+)
+from cron_operator_tpu.runtime.kube import APIServer
+from cron_operator_tpu.runtime.persistence import Persistence
+from cron_operator_tpu.runtime.shard import FollowerReplica, canonical_state
+from cron_operator_tpu.runtime.transport import (
+    RetryBudget,
+    ShipFollower,
+    WALShipServer,
+)
+from cron_operator_tpu.utils.clock import FakeClock, RealClock
+
+
+def _obj(name: str, ns: str = "default") -> dict:
+    return {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "JAXJob",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"replicaSpecs": {"Worker": {"replicas": 1}}},
+    }
+
+
+def _wait(predicate, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class _TmpDirTest(unittest.TestCase):
+    def setUp(self):
+        self.dir = tempfile.mkdtemp(prefix="netfaults-test-")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+
+
+class _Echo:
+    """Minimal TCP echo server (one connection at a time is plenty)."""
+
+    def __init__(self):
+        self.listener = socket.create_server(("127.0.0.1", 0))
+        self.listener.settimeout(0.2)
+        self.port = self.listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self.listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._echo, args=(sock,), daemon=True
+            ).start()
+
+    def _echo(self, sock):
+        try:
+            while True:
+                data = sock.recv(65536)
+                if not data:
+                    return
+                sock.sendall(data)
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._stop.set()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+class TestInjectorPRF(unittest.TestCase):
+    def test_same_seed_same_decisions(self):
+        a = NetworkFaultInjector(seed=42)
+        b = NetworkFaultInjector(seed=42)
+        for kind in NET_FAULT_KINDS:
+            for idx in range(50):
+                self.assertEqual(
+                    a.fraction("ship", "c2s", 1, idx, kind),
+                    b.fraction("ship", "c2s", 1, idx, kind),
+                )
+
+    def test_different_seed_different_schedule(self):
+        a = NetworkFaultInjector(seed=42)
+        b = NetworkFaultInjector(seed=43)
+        sched_a = a.schedule(rounds=12, links=["ship", "api"])
+        sched_b = b.schedule(rounds=12, links=["ship", "api"])
+        self.assertNotEqual(sched_a, sched_b)
+        # And re-expanding from the same injector is stable.
+        self.assertEqual(sched_a, a.schedule(rounds=12, links=["ship", "api"]))
+
+    def test_schedule_shape(self):
+        inj = NetworkFaultInjector(seed=7)
+        sched = inj.schedule(rounds=20, links=["ship"])
+        self.assertEqual(len(sched), 20)
+        for entry in sched:
+            self.assertEqual(entry["link"], "ship")
+            self.assertIn(entry["direction"], ("c2s", "s2c", "both"))
+            self.assertGreaterEqual(entry["hold_s"], 0.3)
+            self.assertLessEqual(entry["hold_s"], 1.0)
+
+
+class TestFaultProxy(unittest.TestCase):
+    def setUp(self):
+        self.echo = _Echo()
+        self.addCleanup(self.echo.close)
+        self.inj = NetworkFaultInjector(seed=1)
+        self.addCleanup(self.inj.close)
+
+    def _dial(self, port):
+        sock = socket.create_connection(("127.0.0.1", port), timeout=2.0)
+        sock.settimeout(2.0)
+        self.addCleanup(sock.close)
+        return sock
+
+    def test_planless_proxy_is_transparent(self):
+        proxy = self.inj.proxy("echo", "127.0.0.1", self.echo.port)
+        sock = self._dial(proxy.port)
+        for payload in (b"hello", b"x" * 10000):
+            sock.sendall(payload)
+            got = b""
+            while len(got) < len(payload):
+                got += sock.recv(65536)
+            self.assertEqual(got, payload)
+        self.assertEqual(self.inj.stats()["injected"]["blackhole"], 0)
+
+    def test_upstream_refused_refuses_dialer(self):
+        dead = socket.create_server(("127.0.0.1", 0))
+        port = dead.getsockname()[1]
+        dead.close()
+        proxy = self.inj.proxy("dead", "127.0.0.1", port)
+        sock = self._dial(proxy.port)  # accept() succeeds...
+        # ...but the connection is torn down once the upstream refuses.
+        sock.settimeout(2.0)
+        self.assertEqual(sock.recv(1), b"")
+
+    def test_partition_goes_dark_heal_admits_new_connections(self):
+        proxy = self.inj.proxy("echo", "127.0.0.1", self.echo.port)
+        sock = self._dial(proxy.port)
+        sock.sendall(b"ping")
+        self.assertEqual(sock.recv(65536), b"ping")
+
+        self.inj.partition("echo")  # both directions
+        sock.sendall(b"lost")
+        with self.assertRaises(socket.timeout):
+            sock.recv(65536)  # silence, not EOF: half-open by design
+
+        self.inj.heal("echo")
+        # The old connection is sticky-dark; a NEW one works.
+        sock2 = self._dial(proxy.port)
+        sock2.sendall(b"back")
+        self.assertEqual(sock2.recv(65536), b"back")
+        self.assertGreaterEqual(self.inj.stats()["injected"]["blackhole"], 1)
+
+    def test_one_way_partition_other_direction_flows(self):
+        proxy = self.inj.proxy("echo", "127.0.0.1", self.echo.port)
+        self.inj.partition("echo", direction="s2c")
+        sock = self._dial(proxy.port)
+        sock.sendall(b"there")  # c2s still flows (echo server gets it)
+        with self.assertRaises(socket.timeout):
+            sock.recv(65536)  # the reply is eaten
+
+    def test_rst_surfaces_as_connection_reset(self):
+        plan = LinkPlan(p_rst=1.0)
+        proxy = self.inj.proxy("echo", "127.0.0.1", self.echo.port,
+                               plan=plan)
+        sock = self._dial(proxy.port)
+        try:
+            sock.sendall(b"doomed")
+            # First unit through the pump RSTs both ends.
+            with self.assertRaises((ConnectionResetError, BrokenPipeError,
+                                    ConnectionAbortedError)):
+                for _ in range(20):
+                    if sock.recv(65536) == b"":
+                        raise ConnectionResetError  # EOF also acceptable
+                    time.sleep(0.05)
+        except socket.timeout:
+            self.fail("RST never arrived")
+        self.assertGreaterEqual(self.inj.stats()["injected"]["rst"], 1)
+
+
+class TestTransportUnderFaults(_TmpDirTest):
+    """WALShipServer ↔ ShipFollower through a framed FaultProxy."""
+
+    # Tight heartbeat so detection tests run in ~1s, with timeout still
+    # >> interval so a healthy-but-slow link never trips it.
+    HB_INTERVAL = 0.1
+    HB_TIMEOUT = 1.0
+
+    def _leader(self, heartbeats=True):
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=1)
+        pers.start(store)
+        server = WALShipServer(
+            pers, heartbeats=heartbeats,
+            heartbeat_interval_s=self.HB_INTERVAL,
+            heartbeat_timeout_s=self.HB_TIMEOUT,
+        )
+        self.addCleanup(server.close)
+        return store, pers, server
+
+    def _follower_via(self, proxy, heartbeats=True):
+        replica = FollowerReplica(RealClock(), name="nf-test")
+        follower = ShipFollower(
+            "127.0.0.1", proxy.port, replica,
+            heartbeats=heartbeats, heartbeat_timeout_s=self.HB_TIMEOUT,
+        )
+        self.addCleanup(follower.stop)
+        return replica, follower
+
+    def _injector(self, seed=11):
+        inj = NetworkFaultInjector(seed=seed)
+        self.addCleanup(inj.close)
+        return inj
+
+    def test_duplicated_frames_are_counted_noops(self):
+        """Every WAL frame duplicated on the wire: the seq ledger drops
+        each copy, the replica converges to the exact leader state —
+        I13a's "no write doubled" under a frame-repeating middlebox."""
+        store, pers, server = self._leader()
+        inj = self._injector()
+        proxy = inj.proxy("ship", "127.0.0.1", server.port, framed=True,
+                          plan=LinkPlan(p_duplicate=1.0))
+        replica, follower = self._follower_via(proxy)
+        self.assertTrue(follower.wait_connected(5.0))
+        for i in range(10):
+            store.create(_obj(f"dup-{i}"))
+        pers.flush()
+        self.assertTrue(_wait(lambda: len(replica.store) == 10))
+        self.assertTrue(_wait(lambda: follower.duplicate_frames >= 10))
+        self.assertEqual(
+            replica.state(),
+            canonical_state(store.all_objects(), store._rv),
+        )
+        self.assertGreaterEqual(inj.stats()["injected"]["duplicate"], 10)
+
+    def test_slowdripped_frames_reassemble_whole(self):
+        """Every frame trickled 3 bytes at a time: framing reassembles,
+        heartbeats don't fire (traffic IS flowing), state converges."""
+        store, pers, server = self._leader()
+        inj = self._injector()
+        proxy = inj.proxy("ship", "127.0.0.1", server.port, framed=True,
+                          plan=LinkPlan(p_slowdrip=1.0, drip_bytes=7,
+                                        drip_pause_s=0.0005))
+        replica, follower = self._follower_via(proxy)
+        self.assertTrue(follower.wait_connected(10.0))
+        for i in range(5):
+            store.create(_obj(f"drip-{i}"))
+        pers.flush()
+        self.assertTrue(_wait(lambda: len(replica.store) == 5, timeout=10))
+        self.assertEqual(follower.frames_rejected, 0)
+        self.assertEqual(
+            replica.state(),
+            canonical_state(store.all_objects(), store._rv),
+        )
+
+    def test_s2c_blackhole_detected_by_follower_heartbeat(self):
+        """Leader→follower direction goes dark mid-stream. The follower
+        hears silence for the timeout, declares the link half-open,
+        reconnects — and once healed, the re-bootstrap converges."""
+        store, pers, server = self._leader()
+        inj = self._injector()
+        proxy = inj.proxy("ship", "127.0.0.1", server.port, framed=True)
+        replica, follower = self._follower_via(proxy)
+        self.assertTrue(follower.wait_connected(5.0))
+        store.create(_obj("before"))
+        pers.flush()
+        self.assertTrue(_wait(lambda: len(replica.store) == 1))
+
+        inj.partition("ship", direction="s2c")
+        store.create(_obj("dark-window"))
+        pers.flush()
+        t0 = time.monotonic()
+        self.assertTrue(_wait(
+            lambda: follower.heartbeat_timeouts >= 1, timeout=10))
+        detect_s = time.monotonic() - t0
+        # Bounded detection: timeout + one poll of slack, not "minutes".
+        self.assertLess(detect_s, self.HB_TIMEOUT * 3 + 1.0)
+
+        inj.heal("ship")
+        self.assertTrue(_wait(lambda: len(replica.store) == 2, timeout=15))
+        self.assertEqual(
+            replica.state(),
+            canonical_state(store.all_objects(), store._rv),
+        )
+
+    def test_c2s_blackhole_detected_by_leader_heartbeat(self):
+        """Follower→leader direction dark: PONGs are eaten, so the
+        LEADER's timeout fires and drops the conn; the follower sees the
+        EOF, redials, and heals."""
+        from cron_operator_tpu.runtime.manager import Metrics
+        metrics = Metrics()
+        store, pers, server = self._leader()
+        server._metrics = metrics
+        inj = self._injector()
+        proxy = inj.proxy("ship", "127.0.0.1", server.port, framed=True)
+        replica, follower = self._follower_via(proxy)
+        self.assertTrue(follower.wait_connected(5.0))
+
+        inj.partition("ship", direction="c2s")
+        self.assertTrue(_wait(
+            lambda: metrics.counters.get(
+                'transport_heartbeat_timeouts_total{side="leader"}', 0) >= 1,
+            timeout=10,
+        ))
+        inj.heal("ship")
+        store.create(_obj("after-heal"))
+        pers.flush()
+        self.assertTrue(_wait(lambda: len(replica.store) == 1, timeout=15))
+
+    def test_counterproof_no_heartbeats_wedges_silently(self):
+        """The same s2c blackhole with heartbeats OFF: the follower
+        blocks in recv forever — no timeout, no reconnect, follower lag
+        growing silently. This is the failure mode the tentpole exists
+        to close; the chaos soak's --expect-violation leg automates it."""
+        store, pers, server = self._leader(heartbeats=False)
+        inj = self._injector()
+        proxy = inj.proxy("ship", "127.0.0.1", server.port, framed=True)
+        replica, follower = self._follower_via(proxy, heartbeats=False)
+        self.assertTrue(follower.wait_connected(5.0))
+        store.create(_obj("seen"))
+        pers.flush()
+        self.assertTrue(_wait(lambda: len(replica.store) == 1))
+
+        inj.partition("ship", direction="s2c")
+        for i in range(3):
+            store.create(_obj(f"unseen-{i}"))
+        pers.flush()
+        # Give it several heartbeat-timeouts' worth of wall time: with
+        # detection disabled, NOTHING happens.
+        time.sleep(self.HB_TIMEOUT * 2.5)
+        self.assertEqual(follower.reconnects, 0)
+        self.assertEqual(follower.heartbeat_timeouts, 0)
+        self.assertEqual(len(replica.store), 1)  # lag, growing silently
+
+
+class TestRetryBudget(unittest.TestCase):
+    def test_first_tries_never_gated_retries_spend(self):
+        b = RetryBudget(max_tokens=10.0, token_ratio=0.1)
+        self.assertFalse(b.depleted)
+        # 5 retries take the bucket from 10 to 5 == half: grants stop.
+        for _ in range(5):
+            self.assertTrue(b.try_retry())
+        self.assertTrue(b.depleted)
+        self.assertFalse(b.try_retry())
+        self.assertEqual(b.stats()["granted"], 5)
+        self.assertEqual(b.stats()["denied"], 1)
+
+    def test_successes_refund_toward_cap(self):
+        b = RetryBudget(max_tokens=10.0, token_ratio=0.5)
+        for _ in range(5):
+            b.try_retry()
+        self.assertTrue(b.depleted)
+        # Each success refunds token_ratio; 2 successes puts the bucket
+        # above half again.
+        b.on_success()
+        b.on_success()
+        self.assertFalse(b.depleted)
+        self.assertTrue(b.try_retry())
+        # Refunds never overflow the cap.
+        for _ in range(1000):
+            b.on_success()
+        self.assertEqual(b.stats()["tokens"], 10.0)
+
+    def test_exhaustion_counts_into_metrics(self):
+        from cron_operator_tpu.runtime.manager import Metrics
+        metrics = Metrics()
+        b = RetryBudget(max_tokens=2.0, token_ratio=0.1, metrics=metrics)
+        # First spend: 2.0 > 1.0 → granted (tokens now 1.0). Second:
+        # 1.0 > 1.0 is false → denied and counted.
+        self.assertTrue(b.try_retry())
+        self.assertFalse(b.try_retry())
+        self.assertGreaterEqual(
+            metrics.counters.get("router_retry_budget_exhausted_total", 0), 1)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -119,6 +119,29 @@ class TestRegistryDrift:
             assert mtype == want_type, family
             assert mhelp
 
+    def test_partition_families_declared_with_types(self):
+        """The lying-network families (PR 20: injected net faults,
+        half-open heartbeat timeouts, duplicate-frame no-ops, retry
+        budget denials, follower backoff gauge, clock jumps) must be
+        scanned AND declared: the I13 partition soak reads these series
+        to prove the schedule bit, detection stayed bounded, and no
+        retry storm reached the healthy shards."""
+        found = _emitted_families()
+        expected = {
+            "net_faults_injected_total": "counter",
+            "transport_heartbeat_timeouts_total": "counter",
+            "transport_duplicate_frames_total": "counter",
+            "router_retry_budget_exhausted_total": "counter",
+            "shard_follower_reconnect_backoff_seconds": "gauge",
+            "cron_clock_jumps_total": "counter",
+        }
+        for family, want_type in expected.items():
+            assert family in found, family
+            assert family in _FAMILY_META, family
+            mtype, mhelp = _FAMILY_META[family]
+            assert mtype == want_type, family
+            assert mhelp
+
     def test_every_emitted_family_is_declared(self):
         undeclared = {
             family: sites

@@ -30,6 +30,7 @@ from cron_operator_tpu.runtime.persistence import FencedError, Persistence
 from cron_operator_tpu.runtime.shard import FollowerReplica, canonical_state
 from cron_operator_tpu.runtime.transport import (
     BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
     BREAKER_OPEN,
     FRAME_BOOT,
     FRAME_WAL,
@@ -87,8 +88,13 @@ class TestFraming(unittest.TestCase):
             write_frame(a, FRAME_WAL, p)
         write_frame(a, FRAME_BOOT, b"boot")
         for p in payloads:
-            self.assertEqual(read_frame(b), (FRAME_WAL, p))
-        self.assertEqual(read_frame(b), (FRAME_BOOT, b"boot"))
+            self.assertEqual(read_frame(b), (FRAME_WAL, p, 0))
+        self.assertEqual(read_frame(b), (FRAME_BOOT, b"boot", 0))
+
+    def test_seq_travels_with_frame(self):
+        a, b = self._pair()
+        write_frame(a, FRAME_WAL, b"rec", seq=7)
+        self.assertEqual(read_frame(b), (FRAME_WAL, b"rec", 7))
 
     def test_eof_returns_none(self):
         a, b = self._pair()
@@ -117,7 +123,8 @@ class TestFraming(unittest.TestCase):
         import struct
         from cron_operator_tpu.runtime.persistence import wal_crc
         wire = (
-            struct.pack("!cII", FRAME_WAL, len(payload), wal_crc(payload))
+            struct.pack(
+                "!cIII", FRAME_WAL, len(payload), wal_crc(payload), 3)
             + payload
         )
         got = {}
@@ -131,7 +138,7 @@ class TestFraming(unittest.TestCase):
             a.sendall(wire[i:i + 1])
             time.sleep(0.0005)
         t.join(timeout=5)
-        self.assertEqual(got["frame"], (FRAME_WAL, payload))
+        self.assertEqual(got["frame"], (FRAME_WAL, payload, 3))
 
     def test_bootstrap_codec_round_trip(self):
         store = APIServer(clock=FakeClock())
@@ -268,6 +275,85 @@ class TestShipSocket(_TmpDirTest):
         ))
         self.assertEqual(int(replica.store._rv), 8)
 
+    def test_backoff_resets_only_after_successful_bootstrap(self):
+        """Satellite: the reconnect ladder resets at the first PROVEN
+        link (a delivered bootstrap), and only there — so a follower
+        coming back from a long outage retries its healthy leader at
+        base delay instead of dragging the outage's cap behind it."""
+        from cron_operator_tpu.runtime.manager import Metrics
+        from cron_operator_tpu.runtime.transport import RECONNECT_BASE_S
+        metrics = Metrics()
+        # Reserve a port, then leave it dead: every dial is refused.
+        probe = socket.create_server(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        replica = FollowerReplica(RealClock(), name="backoff-test")
+        follower = ShipFollower("127.0.0.1", port, replica, metrics=metrics)
+        self.addCleanup(follower.stop)
+        # Refusals climb the ladder well past base.
+        self.assertTrue(_wait(
+            lambda: follower.current_backoff_s >= RECONNECT_BASE_S * 8,
+            timeout=10,
+        ))
+        gauge = f'shard_follower_reconnect_backoff_seconds{{port="{port}"}}'
+        self.assertEqual(metrics.gauges.get(gauge),
+                         follower.current_backoff_s)
+
+        # The leader comes up on that port; the next dial bootstraps.
+        store = APIServer(clock=FakeClock())
+        pers = Persistence(self.dir, fsync_every=1)
+        pers.start(store)
+        server = WALShipServer(pers, port=port)
+        self.addCleanup(server.close)
+        self.assertTrue(follower.wait_connected(10.0))
+        boots = follower.bootstraps
+
+        # Drop the stream: because a bootstrap was delivered, the very
+        # next delay is BASE again — not the refused-era ladder value.
+        for conn in list(server._conns):
+            conn.close()
+        self.assertTrue(_wait(
+            lambda: follower.bootstraps > boots, timeout=10))
+        self.assertTrue(_wait(
+            lambda: follower.current_backoff_s == RECONNECT_BASE_S,
+            timeout=10,
+        ))
+        self.assertEqual(metrics.gauges.get(gauge), RECONNECT_BASE_S)
+
+    def test_tcp_accept_alone_does_not_reset_backoff(self):
+        """The gray case the reset rule exists for: a listener that
+        accepts and hangs up before any bootstrap proves nothing, so
+        the ladder keeps climbing."""
+        listener = socket.create_server(("127.0.0.1", 0))
+        listener.settimeout(0.2)
+        port = listener.getsockname()[1]
+        stop = threading.Event()
+
+        def accept_and_slam():
+            while not stop.is_set():
+                try:
+                    sock, _ = listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                sock.close()
+
+        t = threading.Thread(target=accept_and_slam, daemon=True)
+        t.start()
+        self.addCleanup(listener.close)
+        self.addCleanup(stop.set)
+
+        from cron_operator_tpu.runtime.transport import RECONNECT_BASE_S
+        replica = FollowerReplica(RealClock(), name="slam-test")
+        follower = ShipFollower("127.0.0.1", port, replica)
+        self.addCleanup(follower.stop)
+        self.assertTrue(_wait(
+            lambda: follower.current_backoff_s >= RECONNECT_BASE_S * 8,
+            timeout=10,
+        ))
+        self.assertEqual(follower.bootstraps, 0)
+
     def test_wedged_socket_stalls_leader_side_not_writers(self):
         """Satellite: a follower that stops reading must not block the
         leader's write path — the bounded ship queue drops whole and
@@ -360,6 +446,81 @@ class TestLeaseFile(_TmpDirTest):
         self.assertEqual(torn, [])
 
 
+class TestLeaseFileClockJumps(_TmpDirTest):
+    """Satellite: lease heartbeat/TTL math rides MONOTONIC time. An NTP
+    step on the observing host can neither fake freshness (backwards
+    jump) nor evict a live leader (forward jump). Tests stub the
+    injectable clocks — no sleeping, no real NTP."""
+
+    def _pair(self, ttl=10.0):
+        path = os.path.join(self.dir, "lease.json")
+        leader = LeaseFile(path, holder="leader", ttl_s=ttl)
+        standby = LeaseFile(path, holder="standby", ttl_s=ttl)
+        return leader, standby
+
+    def test_forward_wall_jump_does_not_evict_live_leader(self):
+        leader, standby = self._pair(ttl=10.0)
+        wall, mono = [1000.0], [500.0]
+        leader._time = standby._time = lambda: wall[0]
+        standby._mono = lambda: mono[0]
+        leader.acquire()
+        self.assertFalse(standby.expired())
+        # NTP slams the wall clock an hour forward; one real second
+        # passes. Naive "now - renewed_at" math would read the live
+        # lease as 3600s stale and promote a second leader.
+        wall[0] += 3600.0
+        mono[0] += 1.0
+        self.assertFalse(standby.expired())
+        # And it stays live across the leader's next renewal too.
+        leader.renew()
+        mono[0] += 1.0
+        self.assertFalse(standby.expired())
+
+    def test_backward_wall_jump_cannot_fake_freshness(self):
+        leader, standby = self._pair(ttl=10.0)
+        wall, mono = [1000.0], [500.0]
+        leader._time = standby._time = lambda: wall[0]
+        standby._mono = lambda: mono[0]
+        leader.acquire()
+        self.assertFalse(standby.expired())
+        # The leader dies; the observer's wall clock then steps BACK,
+        # putting renewed_at in the future. Wall math would keep the
+        # corpse "fresh" forever (negative age); monotonic elapsed time
+        # still runs and must expire it.
+        wall[0] -= 3600.0
+        mono[0] += 11.0  # one TTL + 1s of real time, doc unchanged
+        self.assertTrue(standby.expired())
+
+    def test_cold_boot_on_stale_lease_expires_immediately(self):
+        leader, standby = self._pair(ttl=10.0)
+        wall, mono = [1000.0], [500.0]
+        leader._time = standby._time = lambda: wall[0]
+        standby._mono = lambda: mono[0]
+        leader.acquire()
+        # Hours pass before the standby's FIRST look: the seed-from-
+        # renewed_at rule must read it expired at once, not wait a
+        # fresh TTL of monotonic time.
+        wall[0] += 3600.0
+        self.assertTrue(standby.expired())
+
+    def test_frozen_wall_clock_renewals_still_observed(self):
+        # The beat counter: with the leader's wall clock frozen, every
+        # renewal still changes the doc bytes, so the observer keeps
+        # re-anchoring and the lease never falsely expires.
+        leader, standby = self._pair(ttl=10.0)
+        wall, mono = [1000.0], [500.0]
+        leader._time = standby._time = lambda: wall[0]
+        standby._mono = lambda: mono[0]
+        leader.acquire()
+        for _ in range(5):
+            mono[0] += 8.0  # under a TTL since the last observed change
+            self.assertTrue(leader.renew())
+            self.assertFalse(standby.expired())
+        # Renewals stop: expiry now arrives in monotonic time.
+        mono[0] += 11.0
+        self.assertTrue(standby.expired())
+
+
 class TestCircuitBreaker(unittest.TestCase):
     """Per-shard breaker state machine (gray failures: wedged-but-alive
     shards answer slowly or never — fail fast, probe, recover)."""
@@ -401,6 +562,33 @@ class TestCircuitBreaker(unittest.TestCase):
         # immediately re-trip.
         br.record(False, 0.5)
         self.assertEqual(br.state, BREAKER_CLOSED)
+
+    def test_half_open_admits_exactly_one_probe_under_race(self):
+        """Satellite: N threads hit allow() the instant the cooldown
+        lapses — exactly ONE wins the probe slot. Two probes against a
+        still-wedged shard is two timeouts' worth of user latency; zero
+        probes means the breaker never recovers."""
+        br = self._tripped(cooldown_s=0.05)
+        for _ in range(3):  # several open → half-open cycles
+            time.sleep(0.06)
+            n = 16
+            admitted = []
+            barrier = threading.Barrier(n)
+
+            def racer():
+                barrier.wait()
+                if br.allow():
+                    admitted.append(1)
+
+            threads = [threading.Thread(target=racer) for _ in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=5)
+            self.assertEqual(len(admitted), 1)
+            self.assertEqual(br.state, BREAKER_HALF_OPEN)
+            br.record(False, 0.5)  # probe fails: re-open, race again
+            self.assertEqual(br.state, BREAKER_OPEN)
 
     def test_half_open_probe_failure_reopens(self):
         br = self._tripped(cooldown_s=0.05)
